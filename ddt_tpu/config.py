@@ -1,0 +1,74 @@
+"""Training configuration for the TPU-native distributed decision-tree trainer.
+
+Capability contract: SURVEY.md §5 ("Config/flag system") — a single TrainConfig
+dataclass mirrored by CLI flags, including the [BASELINE]-required backend flag
+(FPGA vs TPU selectable by flag in the reference; here cpu/tpu, with fpga
+present-but-stubbed so the flag surface matches).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+LOSSES = ("logloss", "mse", "softmax")
+BACKENDS = ("cpu", "tpu", "fpga")  # fpga is a stub: flag parity with reference
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Hyper-parameters and system knobs for GBDT training.
+
+    Mirrors the reference's flag set (depth, trees, bins, backend, partitions)
+    as recovered in SURVEY.md §2 "CLI / config".
+    """
+
+    # --- model ---
+    n_trees: int = 100          # boosting rounds (x n_classes trees for softmax)
+    max_depth: int = 6          # levels of splits; complete heap tree layout
+    n_bins: int = 255           # [BASELINE] "255 bins named explicitly"
+    learning_rate: float = 0.1
+    loss: str = "logloss"       # logloss | mse | softmax
+    n_classes: int = 2          # used when loss == "softmax"
+
+    # --- regularisation (XGBoost-style gain formula) ---
+    reg_lambda: float = 1.0     # L2 on leaf weights
+    min_child_weight: float = 1e-3   # min hessian sum per child
+    min_split_gain: float = 0.0      # split only if gain > this
+
+    # --- system ---
+    backend: str = "tpu"        # cpu | tpu | fpga(stub)
+    n_partitions: int = 1       # row partitions (data parallel over mesh axis)
+    hist_impl: str = "auto"     # auto | matmul | segment | pallas
+    seed: int = 0
+
+    # --- numerics ---
+    hist_dtype: str = "float32"     # accumulator dtype for histograms
+    matmul_input_dtype: str = "bfloat16"  # one-hot matmul input dtype on TPU
+
+    def __post_init__(self) -> None:
+        if self.loss not in LOSSES:
+            raise ValueError(f"loss must be one of {LOSSES}, got {self.loss!r}")
+        if self.backend not in BACKENDS:
+            raise ValueError(
+                f"backend must be one of {BACKENDS}, got {self.backend!r}"
+            )
+        if not (1 <= self.n_bins <= 256):
+            raise ValueError("n_bins must be in [1, 256] (uint8 binned data)")
+        if self.max_depth < 1:
+            raise ValueError("max_depth must be >= 1")
+        if self.loss == "softmax" and self.n_classes < 2:
+            raise ValueError("softmax needs n_classes >= 2")
+
+    @property
+    def n_nodes_total(self) -> int:
+        """Heap-layout node count for a complete tree of `max_depth` levels."""
+        return 2 ** (self.max_depth + 1) - 1
+
+    @property
+    def n_leaves_max(self) -> int:
+        return 2 ** self.max_depth
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)

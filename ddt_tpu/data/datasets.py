@@ -1,0 +1,120 @@
+"""Deterministic synthetic dataset generators for the BASELINE.json configs.
+
+SURVEY.md §2 "Datasets": the reference's eval configs are Higgs-1M (binary),
+Covertype (7-class), Criteo (sparse categorical CTR) and a synthetic 10B-row
+stress config. This environment has no network, so each config gets a seeded
+synthetic generator with the same schema/statistics shape; real-data loaders
+can be dropped in later behind the same functions.
+
+All generators return float32 features + integer labels and are chunk-streamable
+for the 10B-row config (generate(chunk_start, chunk_rows) is pure in the seed).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _rng(seed: int, *stream: int) -> np.random.Generator:
+    return np.random.default_rng(np.random.SeedSequence([seed, *stream]))
+
+
+def synthetic_binary(
+    n_rows: int, n_features: int = 28, seed: int = 0, noise: float = 1.0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Higgs-like binary task: 28 continuous features, nonlinear signal.
+
+    Label depends on a few nonlinear feature interactions so trees of depth>=3
+    have real signal to find; AUC of a good GBDT lands ~0.8-0.9 (sanity band
+    used by tests, not a physics claim).
+    """
+    rng = _rng(seed, 1)
+    X = rng.standard_normal((n_rows, n_features), dtype=np.float32)
+    score = (
+        np.sin(X[:, 0] * 2.0)
+        + X[:, 1] * X[:, 2]
+        + 0.5 * np.square(X[:, 3])
+        - 1.0 * (X[:, 4] > 0.5)
+        + noise * rng.standard_normal(n_rows, dtype=np.float32) * 0.5
+    )
+    y = (score > np.median(score)).astype(np.int32)
+    return X, y
+
+
+def synthetic_multiclass(
+    n_rows: int,
+    n_features: int = 54,
+    n_classes: int = 7,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Covertype-like 7-class task: class-dependent cluster centers + noise."""
+    rng = _rng(seed, 2)
+    centers = rng.standard_normal((n_classes, n_features), dtype=np.float32) * 2.0
+    y = rng.integers(0, n_classes, size=n_rows).astype(np.int32)
+    X = centers[y] + rng.standard_normal((n_rows, n_features), dtype=np.float32)
+    return X, y
+
+
+def synthetic_ctr(
+    n_rows: int,
+    n_numeric: int = 13,
+    n_categorical: int = 26,
+    cardinality: int = 100_000,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Criteo-like CTR task: 13 numeric + 26 high-cardinality categorical cols.
+
+    Returns (X_num float32 [R, 13], X_cat int64 [R, 26], y int32 [R]).
+    Categorical ids are Zipf-distributed (few heavy hitters), like real CTR
+    logs; a subset of categories carries label signal.
+    """
+    rng = _rng(seed, 3)
+    X_num = rng.standard_normal((n_rows, n_numeric), dtype=np.float32)
+    # Zipf-ish: sample from a power-law over [0, cardinality)
+    u = rng.random((n_rows, n_categorical))
+    X_cat = np.floor(cardinality * np.power(u, 3.0)).astype(np.int64)
+    signal = (
+        0.8 * np.sin((X_cat[:, 0] % 17).astype(np.float32))
+        + 0.6 * ((X_cat[:, 1] % 5) == 0)
+        + 0.5 * X_num[:, 0]
+        + rng.standard_normal(n_rows, dtype=np.float32) * 0.7
+    )
+    y = (signal > np.quantile(signal, 0.75)).astype(np.int32)  # ~25% CTR
+    return X_num, X_cat, y
+
+
+def synthetic_regression(
+    n_rows: int, n_features: int = 16, seed: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    rng = _rng(seed, 4)
+    X = rng.standard_normal((n_rows, n_features), dtype=np.float32)
+    y = (
+        2.0 * X[:, 0]
+        + np.square(X[:, 1])
+        + X[:, 2] * (X[:, 3] > 0)
+        + 0.1 * rng.standard_normal(n_rows, dtype=np.float32)
+    ).astype(np.float32)
+    return X, y
+
+
+def stress_binned_chunk(
+    chunk_start: int,
+    chunk_rows: int,
+    n_features: int = 1024,
+    n_bins: int = 255,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Streaming generator for the 10B-row/1024-feature stress config.
+
+    Emits already-binned uint8 chunks (no quantizer pass needed at this scale)
+    plus binary labels; pure function of (seed, chunk_start), so any chunk can
+    be regenerated independently on any host — this is how the pod-scale config
+    streams without a shared filesystem.
+    """
+    rng = _rng(seed, 5, chunk_start)
+    Xb = rng.integers(0, n_bins, size=(chunk_rows, n_features), dtype=np.uint8)
+    y = (
+        (Xb[:, 0].astype(np.int32) + Xb[:, 1].astype(np.int32))
+        > n_bins
+    ).astype(np.int32)
+    return Xb, y

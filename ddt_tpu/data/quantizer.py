@@ -1,0 +1,115 @@
+"""Quantile binning: float features -> uint8 bin indices (<=255 bins).
+
+Layer L7 of SURVEY.md §1: the reference runs an offline quantizer producing
+<=255-bin binned matrices before training ([BASELINE] "features are quantized
+into bins (255 bins named explicitly)"). TPU realisation: a NumPy/JAX quantile
+sketch on a row sample, then `searchsorted` to produce a uint8 matrix that is
+the only large tensor ever shipped to the device.
+
+Bin semantics (shared by every kernel in this repo — oracle, XLA, Pallas, C++):
+  bin b covers values v with  edges[b-1] < v <= edges[b]   (edges ascending)
+  i.e. bin = searchsorted(edges, v, side='left') clipped to [0, n_bins-1].
+A split "(feature f, threshold bin t)" routes rows with bin <= t LEFT.
+The raw-value threshold equivalent is edges[t] (go left iff v <= edges[t]).
+NaNs are mapped to bin 0 (documented v1 policy; dedicated missing-bin is a
+later extension).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class BinMapper:
+    """Per-feature bin edges + the binned-matrix transform."""
+
+    edges: np.ndarray       # [n_features, n_bins-1] float32, ascending per row
+    n_bins: int
+
+    @property
+    def n_features(self) -> int:
+        return self.edges.shape[0]
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        """Bin a float matrix [rows, n_features] -> uint8 [rows, n_features]."""
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim != 2 or X.shape[1] != self.n_features:
+            raise ValueError(
+                f"X must be [rows, {self.n_features}], got {X.shape}"
+            )
+        out = np.empty(X.shape, dtype=np.uint8)
+        for f in range(self.n_features):
+            col = X[:, f]
+            binned = np.searchsorted(self.edges[f], col, side="left")
+            np.clip(binned, 0, self.n_bins - 1, out=binned)
+            binned[np.isnan(col)] = 0  # v1 NaN policy (see module doc);
+            # +/-inf fall naturally into the top/bottom bin via searchsorted.
+            out[:, f] = binned.astype(np.uint8)
+        return out
+
+    def threshold_value(self, feature: int, threshold_bin: int) -> float:
+        """Raw-value threshold for a (feature, bin) split: go left iff v <= it."""
+        t = int(threshold_bin)
+        if t >= self.edges.shape[1]:
+            return float("inf")  # rightmost bin: everything goes left
+        return float(self.edges[feature, t])
+
+    def save(self) -> dict:
+        return {"edges": self.edges, "n_bins": np.int64(self.n_bins)}
+
+    @staticmethod
+    def load(d: dict) -> "BinMapper":
+        return BinMapper(edges=np.asarray(d["edges"], np.float32),
+                         n_bins=int(d["n_bins"]))
+
+
+def fit_bin_mapper(
+    X: np.ndarray,
+    n_bins: int = 255,
+    max_sample: int = 200_000,
+    seed: int = 0,
+) -> BinMapper:
+    """Fit per-feature quantile bin edges on (a sample of) X.
+
+    Edges are non-decreasing per feature (np.maximum.accumulate). Duplicate
+    edge values form runs that searchsorted(side='left') always resolves to
+    the first edge of the run, so the corresponding higher bins are simply
+    never assigned — constant / low-cardinality features occupy few distinct
+    bins, matching histogram-GBDT convention. Backends must not assume
+    strictly increasing edges.
+    """
+    X = np.asarray(X, dtype=np.float32)
+    rows, n_features = X.shape
+    if rows > max_sample:
+        rng = np.random.default_rng(seed)
+        idx = rng.choice(rows, size=max_sample, replace=False)
+        Xs = X[idx]
+    else:
+        Xs = X
+
+    qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]  # n_bins-1 interior quantiles
+    edges = np.empty((n_features, n_bins - 1), dtype=np.float32)
+    for f in range(n_features):
+        col = Xs[:, f]
+        col = col[np.isfinite(col)]
+        if col.size == 0:
+            edges[f] = np.arange(n_bins - 1, dtype=np.float32)
+            continue
+        e = np.quantile(col, qs).astype(np.float32)
+        # Force strict monotonicity: collapse duplicates upward by epsilon-free
+        # padding — duplicates become a run that searchsorted('left') resolves
+        # to the first edge, so dup bins are simply never assigned.
+        e = np.maximum.accumulate(e)
+        edges[f] = e
+    return BinMapper(edges=edges, n_bins=n_bins)
+
+
+def quantize(
+    X: np.ndarray, n_bins: int = 255, max_sample: int = 200_000, seed: int = 0
+) -> tuple[np.ndarray, BinMapper]:
+    """fit + transform convenience: returns (binned uint8 matrix, mapper)."""
+    mapper = fit_bin_mapper(X, n_bins=n_bins, max_sample=max_sample, seed=seed)
+    return mapper.transform(X), mapper

@@ -1,0 +1,190 @@
+"""TreeEnsemble: SoA tensor representation of a boosted-tree ensemble.
+
+Layer L6 of SURVEY.md §1. The reference stores trees as arrays-of-nodes and
+exposes `TreeEnsemble.predict` for batch scoring [BASELINE]. TPU realisation:
+structure-of-arrays tensors in complete-heap layout so prediction lowers to
+depth-unrolled gather+compare with fully static shapes (no pointers, no
+recursion — XLA-friendly by construction).
+
+Heap layout: a tree of `max_depth` split levels occupies 2^(max_depth+1)-1 node
+slots; node i's children are 2i+1 (left) and 2i+2 (right). Early-stopped nodes
+are marked `is_leaf` and traversal freezes there. Every node slot stores a
+`leaf_value` (its value as-if-leaf), so traversal needs no special casing.
+
+Split semantics (shared repo-wide, see data/quantizer.py): binned row goes LEFT
+iff bin[feature] <= threshold_bin; raw row goes LEFT iff value <= threshold_raw.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class TreeEnsemble:
+    """Boosted ensemble as stacked per-tree SoA arrays.
+
+    Shapes: [n_trees, n_nodes_total] for all node arrays. For multiclass
+    (softmax), trees are interleaved round-major: tree t scores class
+    `t % n_classes` (n_trees = rounds * n_classes).
+    """
+
+    feature: np.ndarray        # int32  [T, N] split feature (-1 on leaves)
+    threshold_bin: np.ndarray  # int32  [T, N] split bin (go left if <=)
+    threshold_raw: np.ndarray  # float32 [T, N] raw-value threshold (same rule)
+    is_leaf: np.ndarray        # bool   [T, N]
+    leaf_value: np.ndarray     # float32 [T, N]
+    max_depth: int
+    n_features: int
+    learning_rate: float
+    base_score: float          # raw-score offset (per class for softmax)
+    loss: str                  # logloss | mse | softmax
+    n_classes: int = 2
+    has_raw_thresholds: bool = False  # True once a BinMapper filled threshold_raw
+
+    @property
+    def n_trees(self) -> int:
+        return int(self.feature.shape[0])
+
+    @property
+    def n_nodes_total(self) -> int:
+        return int(self.feature.shape[1])
+
+    # ------------------------------------------------------------------ #
+    # NumPy prediction (oracle-grade; the fast path is ops/predict.py)
+    # ------------------------------------------------------------------ #
+
+    def _traverse_np(self, X: np.ndarray, binned: bool) -> np.ndarray:
+        """Leaf index per (tree, row): int32 [T, R]."""
+        if not binned and not self.has_raw_thresholds:
+            raise ValueError(
+                "Ensemble has no raw-value thresholds (trained without a "
+                "BinMapper); predict on binned data with binned=True, or "
+                "train/fill with a mapper first."
+            )
+        T = self.n_trees
+        R = X.shape[0]
+        node = np.zeros((T, R), dtype=np.int64)
+        thr = self.threshold_bin if binned else self.threshold_raw
+        Xc = X.astype(np.int32) if binned else X.astype(np.float32)
+        for _ in range(self.max_depth):
+            feat = np.take_along_axis(self.feature, node, axis=1)
+            t = np.take_along_axis(thr, node, axis=1)
+            leaf = np.take_along_axis(self.is_leaf, node, axis=1)
+            fv = np.stack([Xc[np.arange(R), np.maximum(feat[k], 0)]
+                           for k in range(T)])
+            go_right = fv > t
+            nxt = 2 * node + 1 + go_right
+            node = np.where(leaf, node, nxt)
+        return node.astype(np.int32)
+
+    def predict_raw(self, X: np.ndarray, binned: bool = False) -> np.ndarray:
+        """Raw (margin) scores. Binary/regression: [R]; softmax: [R, C]."""
+        leaf_idx = self._traverse_np(X, binned=binned)  # [T, R]
+        vals = np.take_along_axis(self.leaf_value, leaf_idx.astype(np.int64),
+                                  axis=1)               # [T, R]
+        vals = vals * self.learning_rate
+        if self.loss == "softmax":
+            C = self.n_classes
+            R = X.shape[0]
+            out = np.full((R, C), self.base_score, dtype=np.float32)
+            for t in range(self.n_trees):
+                out[:, t % C] += vals[t]
+            return out
+        return (self.base_score + vals.sum(axis=0)).astype(np.float32)
+
+    def predict(self, X: np.ndarray, binned: bool = False) -> np.ndarray:
+        """Probability predictions (or raw values for mse)."""
+        raw = self.predict_raw(X, binned=binned)
+        if self.loss == "logloss":
+            return 1.0 / (1.0 + np.exp(-raw))
+        if self.loss == "softmax":
+            z = raw - raw.max(axis=1, keepdims=True)
+            e = np.exp(z)
+            return e / e.sum(axis=1, keepdims=True)
+        return raw
+
+    # ------------------------------------------------------------------ #
+    # Serialization (SURVEY.md §5 checkpoint/resume: ensembles are tiny)
+    # ------------------------------------------------------------------ #
+
+    def to_dict(self) -> dict:
+        return {
+            "feature": self.feature,
+            "threshold_bin": self.threshold_bin,
+            "threshold_raw": self.threshold_raw,
+            "is_leaf": self.is_leaf,
+            "leaf_value": self.leaf_value,
+            "max_depth": np.int64(self.max_depth),
+            "n_features": np.int64(self.n_features),
+            "learning_rate": np.float64(self.learning_rate),
+            "base_score": np.float64(self.base_score),
+            "loss": np.bytes_(self.loss.encode()),
+            "n_classes": np.int64(self.n_classes),
+            "has_raw_thresholds": np.bool_(self.has_raw_thresholds),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "TreeEnsemble":
+        return TreeEnsemble(
+            feature=np.asarray(d["feature"], np.int32),
+            threshold_bin=np.asarray(d["threshold_bin"], np.int32),
+            threshold_raw=np.asarray(d["threshold_raw"], np.float32),
+            is_leaf=np.asarray(d["is_leaf"], bool),
+            leaf_value=np.asarray(d["leaf_value"], np.float32),
+            max_depth=int(d["max_depth"]),
+            n_features=int(d["n_features"]),
+            learning_rate=float(d["learning_rate"]),
+            base_score=float(d["base_score"]),
+            loss=bytes(d["loss"]).decode(),
+            n_classes=int(d["n_classes"]),
+            has_raw_thresholds=bool(d.get("has_raw_thresholds", False)),
+        )
+
+    def save(self, path: str) -> None:
+        np.savez_compressed(path, **self.to_dict())
+
+    @staticmethod
+    def load(path: str) -> "TreeEnsemble":
+        with np.load(path) as d:
+            return TreeEnsemble.from_dict(dict(d))
+
+    @staticmethod
+    def concat(ensembles: list["TreeEnsemble"]) -> "TreeEnsemble":
+        """Stack ensembles trained sequentially (used by checkpoint resume)."""
+        head = ensembles[0]
+        return dataclasses.replace(
+            head,
+            feature=np.concatenate([e.feature for e in ensembles]),
+            threshold_bin=np.concatenate([e.threshold_bin for e in ensembles]),
+            threshold_raw=np.concatenate([e.threshold_raw for e in ensembles]),
+            is_leaf=np.concatenate([e.is_leaf for e in ensembles]),
+            leaf_value=np.concatenate([e.leaf_value for e in ensembles]),
+        )
+
+
+def empty_ensemble(
+    n_trees: int,
+    max_depth: int,
+    n_features: int,
+    learning_rate: float,
+    base_score: float,
+    loss: str,
+    n_classes: int = 2,
+) -> TreeEnsemble:
+    n_nodes = 2 ** (max_depth + 1) - 1
+    return TreeEnsemble(
+        feature=np.full((n_trees, n_nodes), -1, np.int32),
+        threshold_bin=np.zeros((n_trees, n_nodes), np.int32),
+        threshold_raw=np.zeros((n_trees, n_nodes), np.float32),
+        is_leaf=np.zeros((n_trees, n_nodes), bool),
+        leaf_value=np.zeros((n_trees, n_nodes), np.float32),
+        max_depth=max_depth,
+        n_features=n_features,
+        learning_rate=learning_rate,
+        base_score=base_score,
+        loss=loss,
+        n_classes=n_classes,
+    )

@@ -1,0 +1,284 @@
+"""M0: pure-NumPy reference GBDT trainer — the correctness oracle.
+
+SURVEY.md §7 step 1: an exact histogram-algorithm GBDT on one host. Every other
+backend (TPU XLA, Pallas, C++ CPU kernels) must reproduce this trainer's split
+decisions on small data; SURVEY.md §4 names this "the real correctness anchor".
+It doubles as the CPU-reference implementation whose histogram throughput
+instantiates the >=5x BASELINE target (BASELINE.md).
+
+Algorithm (classic histogram GBDT, level-wise, complete heap trees):
+  for each boosting round:
+    g, h = loss.grad_hess(pred, y)
+    for depth d in 0..max_depth-1:
+      hist[node, feature, bin] = sum of (g, h) via np.add.at   <- HOT LOOP
+      cumsum over bins -> left/right aggregates -> gain; argmax (feature, bin)
+      split or freeze each level node; reroute rows (node-id vector update)
+    leaf values = -G/(H+lambda); pred += lr * leaf_value[leaf of row]
+
+All accumulations are float32 to match device numerics (accumulation order may
+still differ; tests use small data where argmax ties are improbable).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.data.quantizer import BinMapper
+from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
+
+
+# --------------------------------------------------------------------------- #
+# Losses (NumPy twins of ops/grad.py — keep formulas in sync)
+# --------------------------------------------------------------------------- #
+
+def base_score(y: np.ndarray, loss: str, n_classes: int = 2) -> float:
+    if loss == "logloss":
+        p = float(np.clip(np.mean(y), 1e-6, 1 - 1e-6))
+        return float(np.log(p / (1 - p)))
+    if loss == "mse":
+        return float(np.mean(y))
+    return 0.0  # softmax: symmetric zero init per class
+
+
+def grad_hess(
+    pred_raw: np.ndarray, y: np.ndarray, loss: str
+) -> tuple[np.ndarray, np.ndarray]:
+    """Gradient/hessian of the loss wrt raw scores. float32 [R] or [R, C]."""
+    if loss == "logloss":
+        p = 1.0 / (1.0 + np.exp(-pred_raw.astype(np.float64)))
+        g = (p - y).astype(np.float32)
+        h = (p * (1.0 - p)).astype(np.float32)
+        return g, h
+    if loss == "mse":
+        return (pred_raw - y).astype(np.float32), np.ones_like(y, np.float32)
+    if loss == "softmax":
+        z = pred_raw - pred_raw.max(axis=1, keepdims=True)
+        e = np.exp(z.astype(np.float64))
+        p = e / e.sum(axis=1, keepdims=True)
+        onehot = np.zeros_like(p)
+        onehot[np.arange(y.shape[0]), y.astype(np.int64)] = 1.0
+        g = (p - onehot).astype(np.float32)
+        h = (p * (1.0 - p)).astype(np.float32)
+        return g, h
+    raise ValueError(loss)
+
+
+# --------------------------------------------------------------------------- #
+# Kernels (NumPy reference of L3 in SURVEY.md §1)
+# --------------------------------------------------------------------------- #
+
+def build_histograms(
+    Xb: np.ndarray,        # uint8 [R, F]
+    g: np.ndarray,         # float32 [R]
+    h: np.ndarray,         # float32 [R]
+    node_index: np.ndarray,  # int32 [R]; level-local node in [0, n_nodes) or -1
+    n_nodes: int,
+    n_bins: int,
+) -> np.ndarray:
+    """Reference HistogramBuilder: float32 [n_nodes, F, n_bins, 2] (g, h sums).
+
+    Rows with node_index < 0 (frozen at an earlier-level leaf) contribute
+    nothing. This signature is the L4 kernel contract every backend implements.
+    """
+    R, F = Xb.shape
+    hist = np.zeros((n_nodes, F, n_bins, 2), dtype=np.float32)
+    active = node_index >= 0
+    idx_n = node_index[active]
+    ga = g[active]
+    ha = h[active]
+    Xa = Xb[active]
+    for f in range(F):
+        bins_f = Xa[:, f].astype(np.int64)
+        np.add.at(hist, (idx_n, f, bins_f, 0), ga)
+        np.add.at(hist, (idx_n, f, bins_f, 1), ha)
+    return hist
+
+
+def best_splits(
+    hist: np.ndarray,          # [n_nodes, F, B, 2]
+    reg_lambda: float,
+    min_child_weight: float,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Reference SplitGain: per-node best (gain, feature, threshold_bin).
+
+    gain = 0.5*(GL^2/(HL+l) + GR^2/(HR+l) - G^2/(H+l)), maximised over the
+    flattened (feature, bin) axis; first-occurrence argmax (matches jnp.argmax)
+    so all backends agree on tie-breaks. Splitting at bin b sends bins <= b
+    left; the last bin is excluded (empty right child).
+    """
+    n_nodes, F, B, _ = hist.shape
+    GL = np.cumsum(hist[..., 0], axis=2)       # [n, F, B]
+    HL = np.cumsum(hist[..., 1], axis=2)
+    G = GL[:, 0, -1][:, None, None]            # totals (feature 0 = any)
+    H = HL[:, 0, -1][:, None, None]
+    GR = G - GL
+    HR = H - HL
+    with np.errstate(divide="ignore", invalid="ignore"):
+        parent = np.square(G) / (H + reg_lambda)
+        gain = 0.5 * (
+            np.square(GL) / (HL + reg_lambda)
+            + np.square(GR) / (HR + reg_lambda)
+            - parent
+        )
+    valid = (HL >= min_child_weight) & (HR >= min_child_weight)
+    valid[:, :, B - 1] = False                 # cannot split on last bin
+    # 0/0 with reg_lambda=0 yields NaN; NaN would win np.argmax — mask it.
+    valid &= ~np.isnan(gain)
+    gain = np.where(valid, gain, -np.inf).astype(np.float32)
+    flat = gain.reshape(n_nodes, F * B)
+    best = np.argmax(flat, axis=1)
+    best_gain = flat[np.arange(n_nodes), best]
+    return best_gain, (best // B).astype(np.int32), (best % B).astype(np.int32)
+
+
+def node_totals(hist: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(G, H) per node from a histogram (sums over bins of feature 0)."""
+    return hist[:, 0, :, 0].sum(axis=1), hist[:, 0, :, 1].sum(axis=1)
+
+
+# --------------------------------------------------------------------------- #
+# Tree growth + boosting (L5 Driver loop, reference edition)
+# --------------------------------------------------------------------------- #
+
+def grow_tree(
+    Xb: np.ndarray, g: np.ndarray, h: np.ndarray, cfg: TrainConfig
+) -> dict:
+    """Grow one complete-heap tree. Returns dict of node arrays [n_nodes_total].
+    """
+    R, F = Xb.shape
+    N = cfg.n_nodes_total
+    feature = np.full(N, -1, np.int32)
+    threshold_bin = np.zeros(N, np.int32)
+    is_leaf = np.zeros(N, bool)
+    leaf_value = np.zeros(N, np.float32)
+
+    node_id = np.zeros(R, np.int64)    # heap index per row
+    frozen = np.zeros(R, bool)         # row reached an early leaf
+
+    for depth in range(cfg.max_depth):
+        offset = (1 << depth) - 1
+        n_level = 1 << depth
+        node_index = np.where(frozen, -1, node_id - offset).astype(np.int32)
+        hist = build_histograms(Xb, g, h, node_index, n_level, cfg.n_bins)
+        G, H = node_totals(hist)
+        gains, feats, bins = best_splits(
+            hist, cfg.reg_lambda, cfg.min_child_weight
+        )
+        value = -G / (H + cfg.reg_lambda)
+
+        do_split = (gains > cfg.min_split_gain) & np.isfinite(gains) & (H > 0)
+        for i in range(n_level):
+            node = offset + i
+            if do_split[i]:
+                feature[node] = feats[i]
+                threshold_bin[node] = bins[i]
+            else:
+                is_leaf[node] = True
+                leaf_value[node] = value[i]
+
+        # Reroute active rows through new splits; freeze rows at new leaves.
+        active = ~frozen
+        idx = (node_id - offset)[active]
+        split_here = do_split[idx]
+        feat_r = feats[idx]
+        bin_r = bins[idx]
+        go_right = (
+            Xb[active, feat_r].astype(np.int32) > bin_r
+        )
+        new_ids = np.where(
+            split_here,
+            2 * node_id[active] + 1 + go_right,
+            node_id[active],
+        )
+        node_id[active] = new_ids
+        newly_frozen = np.zeros(R, bool)
+        newly_frozen[active] = ~split_here
+        frozen |= newly_frozen
+
+    # Final-level leaves: value from G/H aggregated per terminal node.
+    active = ~frozen
+    if active.any():
+        offset = (1 << cfg.max_depth) - 1
+        idx = node_id[active] - offset
+        n_last = 1 << cfg.max_depth
+        Gl = np.zeros(n_last, np.float32)
+        Hl = np.zeros(n_last, np.float32)
+        np.add.at(Gl, idx, g[active])
+        np.add.at(Hl, idx, h[active])
+        vals = -Gl / (Hl + cfg.reg_lambda)
+        leaf_ids = offset + np.arange(n_last)
+        is_leaf[leaf_ids] = True
+        leaf_value[leaf_ids] = np.where(Hl > 0, vals, 0.0)
+
+    return {
+        "feature": feature,
+        "threshold_bin": threshold_bin,
+        "is_leaf": is_leaf,
+        "leaf_value": leaf_value,
+        "leaf_of_row": node_id.astype(np.int64),
+    }
+
+
+def fit(
+    Xb: np.ndarray,
+    y: np.ndarray,
+    cfg: TrainConfig,
+    mapper: BinMapper | None = None,
+) -> TreeEnsemble:
+    """Train a GBDT on binned data. The oracle for all backends."""
+    R, F = Xb.shape
+    if Xb.dtype != np.uint8:
+        raise TypeError(f"Xb must be uint8 binned data, got {Xb.dtype}")
+    if R and int(Xb.max()) >= cfg.n_bins:
+        raise ValueError(
+            f"Xb contains bin {int(Xb.max())} but cfg.n_bins={cfg.n_bins}; "
+            "quantize with the same n_bins as the TrainConfig."
+        )
+    y = np.asarray(y)
+    C = cfg.n_classes if cfg.loss == "softmax" else 1
+    bs = base_score(y, cfg.loss, cfg.n_classes)
+    n_trees_total = cfg.n_trees * C
+    ens = empty_ensemble(
+        n_trees_total, cfg.max_depth, F, cfg.learning_rate, bs,
+        cfg.loss, cfg.n_classes,
+    )
+
+    if cfg.loss == "softmax":
+        pred = np.zeros((R, C), np.float32)
+    else:
+        pred = np.full(R, bs, np.float32)
+
+    t_out = 0
+    for _round in range(cfg.n_trees):
+        g, h = grad_hess(pred, y, cfg.loss)
+        for c in range(C):
+            gc = g[:, c] if C > 1 else g
+            hc = h[:, c] if C > 1 else h
+            tree = grow_tree(Xb, gc, hc, cfg)
+            ens.feature[t_out] = tree["feature"]
+            ens.threshold_bin[t_out] = tree["threshold_bin"]
+            ens.is_leaf[t_out] = tree["is_leaf"]
+            ens.leaf_value[t_out] = tree["leaf_value"]
+            delta = cfg.learning_rate * tree["leaf_value"][tree["leaf_of_row"]]
+            if C > 1:
+                pred[:, c] += delta
+            else:
+                pred += delta
+            t_out += 1
+
+    if mapper is not None:
+        _fill_raw_thresholds(ens, mapper)
+    return ens
+
+
+def _fill_raw_thresholds(ens: TreeEnsemble, mapper: BinMapper) -> None:
+    T, N = ens.feature.shape
+    for t in range(T):
+        for n in range(N):
+            f = ens.feature[t, n]
+            if f >= 0:
+                ens.threshold_raw[t, n] = mapper.threshold_value(
+                    int(f), int(ens.threshold_bin[t, n])
+                )
+    ens.has_raw_thresholds = True

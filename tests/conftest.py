@@ -1,0 +1,18 @@
+"""Test harness config.
+
+Distributed tests run single-process multi-device on CPU (SURVEY.md §4
+"Distributed without a cluster"): 8 virtual XLA CPU devices via
+--xla_force_host_platform_device_count. Must be set before jax imports.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,67 @@
+import numpy as np
+
+from ddt_tpu.data.quantizer import BinMapper, fit_bin_mapper, quantize
+
+
+def test_bins_in_range_and_dtype():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((1000, 5)).astype(np.float32)
+    Xb, mapper = quantize(X, n_bins=255)
+    assert Xb.dtype == np.uint8
+    assert Xb.min() >= 0 and Xb.max() <= 254
+    assert mapper.edges.shape == (5, 254)
+
+
+def test_bins_monotone_in_value():
+    # Larger raw value never gets a smaller bin.
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((5000, 1)).astype(np.float32)
+    Xb, _ = quantize(X, n_bins=64)
+    order = np.argsort(X[:, 0])
+    bins_sorted = Xb[order, 0].astype(int)
+    assert (np.diff(bins_sorted) >= 0).all()
+
+
+def test_quantile_balance():
+    # Roughly equal mass per bin for continuous data.
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((100_000, 1)).astype(np.float32)
+    Xb, _ = quantize(X, n_bins=16)
+    counts = np.bincount(Xb[:, 0], minlength=16)
+    assert counts.min() > 100_000 / 16 * 0.8
+    assert counts.max() < 100_000 / 16 * 1.2
+
+
+def test_threshold_value_consistency():
+    # Split semantics: bin <= t  <=>  value <= threshold_value(f, t).
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((20_000, 3)).astype(np.float32)
+    Xb, mapper = quantize(X, n_bins=32)
+    for f in range(3):
+        for t in (0, 7, 15, 30):
+            left_by_bin = Xb[:, f] <= t
+            left_by_val = X[:, f] <= mapper.threshold_value(f, t)
+            assert (left_by_bin == left_by_val).all(), (f, t)
+
+
+def test_constant_feature():
+    X = np.ones((100, 2), dtype=np.float32)
+    Xb, _ = quantize(X, n_bins=255)
+    assert (Xb == Xb[0, 0]).all()  # single bin used
+
+
+def test_nan_policy():
+    X = np.array([[np.nan], [0.0], [1.0]], dtype=np.float32)
+    mapper = fit_bin_mapper(np.array([[0.0], [0.5], [1.0]], np.float32), 8)
+    Xb = mapper.transform(X)
+    assert Xb[0, 0] == 0  # NaN -> bin 0 (documented v1 policy)
+
+
+def test_save_load_roundtrip():
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((500, 4)).astype(np.float32)
+    _, mapper = quantize(X, n_bins=100)
+    m2 = BinMapper.load(mapper.save())
+    assert np.array_equal(m2.edges, mapper.edges)
+    assert m2.n_bins == mapper.n_bins
+    assert np.array_equal(m2.transform(X), mapper.transform(X))
