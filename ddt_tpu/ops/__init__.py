@@ -1,0 +1,32 @@
+"""L3 operator/kernel layer (SURVEY.md §1): HistogramBuilder, SplitGain,
+Predict, gradients, and the fused whole-tree growth step. Pure JAX/XLA (+
+Pallas for the histogram hot loop); every op has a NumPy twin in
+ddt_tpu/reference/numpy_trainer.py that serves as its correctness oracle."""
+
+from ddt_tpu.ops.grad import base_score, grad_hess
+from ddt_tpu.ops.grow import TreeArrays, grow_tree, tree_predict_delta
+from ddt_tpu.ops.histogram import (
+    build_histograms,
+    build_histograms_matmul,
+    build_histograms_segment,
+    resolve_hist_impl,
+)
+from ddt_tpu.ops.predict import predict_proba, predict_raw, traverse
+from ddt_tpu.ops.split import best_splits, node_totals
+
+__all__ = [
+    "TreeArrays",
+    "base_score",
+    "best_splits",
+    "build_histograms",
+    "build_histograms_matmul",
+    "build_histograms_segment",
+    "grad_hess",
+    "grow_tree",
+    "node_totals",
+    "predict_proba",
+    "predict_raw",
+    "resolve_hist_impl",
+    "traverse",
+    "tree_predict_delta",
+]

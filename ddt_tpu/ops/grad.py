@@ -1,0 +1,46 @@
+"""Loss gradients/hessians as jitted elementwise XLA ops.
+
+Layer L3 of SURVEY.md §1 ("Gradient computer"): per-boosting-round grad/hess
+from the loss — logloss (binary), mse (regression), softmax (one-vs-all
+multiclass histograms, the Covertype config [BASELINE]). NumPy twin:
+ddt_tpu/reference/numpy_trainer.grad_hess — keep formulas in sync; the parity
+test is tests/test_ops.py::test_grad_hess_matches_oracle.
+
+Elementwise, so XLA fuses these into whatever consumes them; no Pallas needed.
+Internally computed in float32 (matching the NumPy oracle's effective
+precision for these formula shapes) and returned as float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def base_score(y: jax.Array, loss: str) -> jax.Array:
+    """Raw-score init: log-odds for logloss, mean for mse, 0 for softmax."""
+    if loss == "logloss":
+        p = jnp.clip(jnp.mean(y.astype(jnp.float32)), 1e-6, 1 - 1e-6)
+        return jnp.log(p / (1 - p))
+    if loss == "mse":
+        return jnp.mean(y.astype(jnp.float32))
+    return jnp.float32(0.0)
+
+
+def grad_hess(
+    pred_raw: jax.Array, y: jax.Array, loss: str
+) -> tuple[jax.Array, jax.Array]:
+    """(g, h) of the loss wrt raw scores. float32, [R] or [R, C] for softmax."""
+    if loss == "logloss":
+        p = jax.nn.sigmoid(pred_raw.astype(jnp.float32))
+        return p - y.astype(jnp.float32), p * (1.0 - p)
+    if loss == "mse":
+        return (
+            pred_raw.astype(jnp.float32) - y.astype(jnp.float32),
+            jnp.ones_like(pred_raw, jnp.float32),
+        )
+    if loss == "softmax":
+        p = jax.nn.softmax(pred_raw.astype(jnp.float32), axis=1)
+        onehot = jax.nn.one_hot(y, pred_raw.shape[1], dtype=jnp.float32)
+        return p - onehot, p * (1.0 - p)
+    raise ValueError(loss)

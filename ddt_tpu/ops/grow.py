@@ -1,0 +1,129 @@
+"""Whole-tree growth as one traced XLA program (the L5 level loop, on device).
+
+SURVEY.md §3's per-level stack — build_histograms -> [psum] -> best_splits ->
+apply splits -> partition_rows — realised TPU-first: the depth loop is
+UNROLLED inside one jitted function (static shapes per level: level d has
+2^d nodes), so growing a tree is a single device dispatch with zero host
+round-trips. The reference crosses the host<->device boundary per kernel call;
+on TPU that would serialise ~6 dispatches x 100 trees of latency, so we fuse.
+
+Distribution (SURVEY.md §1 L2): pass `axis_name` when tracing under
+jax.shard_map over a row-sharded mesh — the histogram (and final-leaf
+aggregate) get a `jax.lax.psum` over ICI, which is the TPU-native realisation
+of the reference's "cross-partition histogram allreduce over the FPGA network
+fabric" [BASELINE]. Everything else is replicated math on tiny arrays, so all
+shards deterministically grow identical trees.
+
+Row routing keeps a dense per-row heap node-id vector ("partition_rows" as a
+jnp.where update — SURVEY.md §2 "Node partitioner": no data movement, static
+shapes; rows frozen at early leaves are masked out of histograms by the
+node_index = -1 sentinel).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ddt_tpu.ops import histogram as H
+from ddt_tpu.ops import split as S
+
+
+class TreeArrays(NamedTuple):
+    """One grown tree in SoA heap layout + per-row leaf assignment."""
+
+    feature: jax.Array        # int32 [n_nodes_total], -1 on leaves
+    threshold_bin: jax.Array  # int32 [n_nodes_total]
+    is_leaf: jax.Array        # bool  [n_nodes_total]
+    leaf_value: jax.Array     # float32 [n_nodes_total]
+    leaf_of_row: jax.Array    # int32 [R] heap slot where each row landed
+
+
+def grow_tree(
+    Xb: jax.Array,            # uint8 [R, F] (the local shard when distributed)
+    g: jax.Array,             # float32 [R]
+    h: jax.Array,             # float32 [R]
+    *,
+    max_depth: int,
+    n_bins: int,
+    reg_lambda: float,
+    min_child_weight: float,
+    min_split_gain: float,
+    hist_impl: str = "auto",
+    row_chunk: int = 32_768,
+    input_dtype=jnp.bfloat16,
+    axis_name: str | None = None,
+) -> TreeArrays:
+    """Grow one complete-heap tree. Trace under jit (and shard_map if
+    axis_name is set). Matches reference/numpy_trainer.grow_tree decisions."""
+    R, F = Xb.shape
+    N = 2 ** (max_depth + 1) - 1
+
+    feature = jnp.full((N,), -1, jnp.int32)
+    threshold_bin = jnp.zeros((N,), jnp.int32)
+    is_leaf = jnp.zeros((N,), bool)
+    leaf_value = jnp.zeros((N,), jnp.float32)
+
+    node_id = jnp.zeros((R,), jnp.int32)   # heap slot per row
+    frozen = jnp.zeros((R,), bool)
+
+    def allreduce(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+    for depth in range(max_depth):         # unrolled: static 2^d nodes/level
+        offset = (1 << depth) - 1
+        n_level = 1 << depth
+        node_index = jnp.where(frozen, -1, node_id - offset).astype(jnp.int32)
+        hist = H.build_histograms(
+            Xb, g, h, node_index, n_level, n_bins,
+            impl=hist_impl, row_chunk=row_chunk, input_dtype=input_dtype,
+        )
+        hist = allreduce(hist)             # the cross-partition allreduce
+        G, Hh = S.node_totals(hist)
+        gains, feats, bins = S.best_splits(hist, reg_lambda, min_child_weight)
+        value = -G / (Hh + reg_lambda)
+
+        do_split = (
+            (gains > min_split_gain) & jnp.isfinite(gains) & (Hh > 0)
+        )
+        sl = slice(offset, offset + n_level)
+        feature = feature.at[sl].set(jnp.where(do_split, feats, -1))
+        threshold_bin = threshold_bin.at[sl].set(jnp.where(do_split, bins, 0))
+        is_leaf = is_leaf.at[sl].set(~do_split)
+        leaf_value = leaf_value.at[sl].set(jnp.where(do_split, 0.0, value))
+
+        # Route rows through the new splits (dense node-id update).
+        idx_c = jnp.clip(node_id - offset, 0, n_level - 1)
+        split_here = do_split[idx_c] & ~frozen
+        feat_r = feats[idx_c]
+        bin_r = bins[idx_c]
+        fv = jnp.take_along_axis(Xb, feat_r[:, None].clip(0), axis=1)[:, 0]
+        go_right = (fv.astype(jnp.int32) > bin_r).astype(jnp.int32)
+        node_id = jnp.where(split_here, 2 * node_id + 1 + go_right, node_id)
+        frozen = frozen | ~split_here
+
+    # Final level: leaf values from per-terminal-node (G, H) aggregates.
+    offset = (1 << max_depth) - 1
+    n_last = 1 << max_depth
+    active = ~frozen
+    idx = jnp.clip(node_id - offset, 0, n_last - 1)
+    ga = jnp.where(active, g, 0.0)
+    ha = jnp.where(active, h, 0.0)
+    Gl = allreduce(jax.ops.segment_sum(ga, idx, num_segments=n_last))
+    Hl = allreduce(jax.ops.segment_sum(ha, idx, num_segments=n_last))
+    vals = jnp.where(Hl > 0, -Gl / (Hl + reg_lambda), 0.0)
+    sl = slice(offset, offset + n_last)
+    is_leaf = is_leaf.at[sl].set(True)
+    leaf_value = leaf_value.at[sl].set(vals.astype(jnp.float32))
+
+    return TreeArrays(feature, threshold_bin, is_leaf, leaf_value, node_id)
+
+
+def tree_predict_delta(tree: TreeArrays, learning_rate: float) -> jax.Array:
+    """Per-row raw-score increment from a freshly grown tree: lr * leaf value
+    at the slot each row landed in (leaf_of_row). Keeps residuals fresh
+    without re-traversing (SURVEY.md §3 hot loop #2 avoided during training).
+    """
+    return learning_rate * tree.leaf_value[tree.leaf_of_row]

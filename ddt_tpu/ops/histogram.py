@@ -1,0 +1,207 @@
+"""HistogramBuilder: per-(node, feature, bin) gradient/hessian histograms.
+
+THE hot kernel (SURVEY.md §2/§3 "HOT LOOP #1", the benchmark metric:
+M-rows/sec/chip). Contract (identical to the NumPy oracle
+reference/numpy_trainer.build_histograms): given binned uint8 features
+Xb [R, F], gradients g/h [R] float32 and a per-row level-local node index
+(int32, -1 for rows frozen at an earlier leaf), return float32
+[n_nodes, F, n_bins, 2] with (g, h) sums per (node, feature, bin).
+
+TPU realisation — XLA hates random-access scatter, so three interchangeable
+implementations (SURVEY.md §7 "hard parts (a)"):
+
+- "matmul": one-hot outer-product accumulation on the MXU. Per feature f the
+  histogram is A^T @ Bf where A [R, 2N] stacks node-one-hot weighted by g and
+  by h, and Bf [R, B] is the bin one-hot. Chunked over rows with lax.scan so
+  the one-hot never materialises more than `row_chunk` rows in HBM. This is
+  the TPU default: the FLOPs land on the systolic array, bf16 inputs with
+  float32 accumulation (`preferred_element_type`).
+- "segment": `jax.ops.segment_sum` over combined (node*B + bin) keys, vmapped
+  over features. Lowers to scatter-add; the fast path on CPU, the fallback on
+  TPU.
+- "pallas": tiled VMEM kernel (ops/hist_pallas.py) that fuses one-hot
+  construction into the matmul so nothing but Xb and the output ever touches
+  HBM. Opt-in via hist_impl="pallas"; "auto" picks matmul on TPU until the
+  bench shows pallas winning across shapes.
+
+All return bit-identical shapes and (up to float addition order) the same
+values; parity vs the NumPy oracle is tests/test_ops.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _mask_inactive(
+    g: jax.Array, h: jax.Array, node_index: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Zero out frozen rows (node_index < 0) and clamp their index to 0."""
+    active = node_index >= 0
+    idx = jnp.where(active, node_index, 0).astype(jnp.int32)
+    gz = jnp.where(active, g, 0.0).astype(jnp.float32)
+    hz = jnp.where(active, h, 0.0).astype(jnp.float32)
+    return gz, hz, idx
+
+
+# --------------------------------------------------------------------------- #
+# segment_sum implementation (scatter path; CPU fast path / TPU fallback)
+# --------------------------------------------------------------------------- #
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
+def build_histograms_segment(
+    Xb: jax.Array,          # uint8 [R, F]
+    g: jax.Array,           # float32 [R]
+    h: jax.Array,           # float32 [R]
+    node_index: jax.Array,  # int32 [R], -1 = frozen
+    n_nodes: int,
+    n_bins: int,
+) -> jax.Array:
+    gz, hz, idx = _mask_inactive(g, h, node_index)
+    keys = idx[:, None] * n_bins + Xb.astype(jnp.int32)       # [R, F]
+    num = n_nodes * n_bins
+
+    def per_feature(k):
+        gs = jax.ops.segment_sum(gz, k, num_segments=num)
+        hs = jax.ops.segment_sum(hz, k, num_segments=num)
+        return jnp.stack([gs, hs], axis=-1)                   # [N*B, 2]
+
+    out = jax.vmap(per_feature, in_axes=1)(keys)              # [F, N*B, 2]
+    F = Xb.shape[1]
+    return out.reshape(F, n_nodes, n_bins, 2).transpose(1, 0, 2, 3)
+
+
+# --------------------------------------------------------------------------- #
+# one-hot matmul implementation (MXU path; TPU default)
+# --------------------------------------------------------------------------- #
+
+def _hist_chunk_matmul(
+    Xb_c: jax.Array,    # [r, F] uint8
+    gz: jax.Array,      # [r] float32 (already masked)
+    hz: jax.Array,
+    idx: jax.Array,     # [r] int32 in [0, n_nodes)
+    n_nodes: int,
+    n_bins: int,
+    input_dtype: jnp.dtype,
+) -> jax.Array:
+    """One row-chunk's histogram via outer-product matmuls: [F, 2N, B] f32."""
+    node_oh = jax.nn.one_hot(idx, n_nodes, dtype=jnp.float32)     # [r, N]
+    # A stacks g-weighted and h-weighted node one-hots: [r, 2N].
+    A = jnp.concatenate(
+        [node_oh * gz[:, None], node_oh * hz[:, None]], axis=1
+    ).astype(input_dtype)
+    # TPU default matmul precision is bf16 passes even for f32 operands;
+    # when the caller asked for f32 inputs they want exact accumulation.
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if input_dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+
+    def per_feature(xcol):                                        # [r] uint8
+        bins_oh = (
+            xcol[:, None] == jnp.arange(n_bins, dtype=jnp.uint8)[None, :]
+        ).astype(input_dtype)                                     # [r, B]
+        return jax.lax.dot_general(
+            A, bins_oh,
+            (((0,), (0,)), ((), ())),                             # contract rows
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )                                                         # [2N, B]
+
+    return jax.vmap(per_feature, in_axes=1)(Xb_c)                 # [F, 2N, B]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_nodes", "n_bins", "row_chunk", "input_dtype"),
+)
+def build_histograms_matmul(
+    Xb: jax.Array,          # uint8 [R, F]
+    g: jax.Array,
+    h: jax.Array,
+    node_index: jax.Array,
+    n_nodes: int,
+    n_bins: int,
+    row_chunk: int = 32_768,
+    input_dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    R, F = Xb.shape
+    gz, hz, idx = _mask_inactive(g, h, node_index)
+
+    if R <= row_chunk:
+        out = _hist_chunk_matmul(Xb, gz, hz, idx, n_nodes, n_bins, input_dtype)
+    else:
+        # Pad R to a chunk multiple; padded rows carry g=h=0 so they add 0.
+        n_chunks = -(-R // row_chunk)
+        pad = n_chunks * row_chunk - R
+        Xb_p = jnp.pad(Xb, ((0, pad), (0, 0)))
+        gz_p = jnp.pad(gz, (0, pad))
+        hz_p = jnp.pad(hz, (0, pad))
+        idx_p = jnp.pad(idx, (0, pad))
+
+        def body(acc, args):
+            xc, gc, hc, ic = args
+            return acc + _hist_chunk_matmul(
+                xc, gc, hc, ic, n_nodes, n_bins, input_dtype
+            ), None
+
+        acc0 = jnp.zeros((F, 2 * n_nodes, n_bins), jnp.float32)
+        out, _ = jax.lax.scan(
+            body,
+            acc0,
+            (
+                Xb_p.reshape(n_chunks, row_chunk, F),
+                gz_p.reshape(n_chunks, row_chunk),
+                hz_p.reshape(n_chunks, row_chunk),
+                idx_p.reshape(n_chunks, row_chunk),
+            ),
+        )
+
+    # [F, 2N, B] -> [N, F, B, 2]
+    out = out.reshape(F, 2, n_nodes, n_bins)
+    return out.transpose(2, 0, 3, 1)
+
+
+# --------------------------------------------------------------------------- #
+# dispatch
+# --------------------------------------------------------------------------- #
+
+def resolve_hist_impl(hist_impl: str, platform: str | None = None) -> str:
+    """'auto' -> the right implementation for the platform."""
+    if hist_impl != "auto":
+        return hist_impl
+    if platform is None:
+        platform = jax.default_backend()
+    # Scatter is fine on CPU; MXU matmul wins on TPU. Pallas opted into
+    # explicitly until it beats matmul across shapes (bench decides).
+    return "segment" if platform == "cpu" else "matmul"
+
+
+def build_histograms(
+    Xb: jax.Array,
+    g: jax.Array,
+    h: jax.Array,
+    node_index: jax.Array,
+    n_nodes: int,
+    n_bins: int,
+    impl: str = "auto",
+    row_chunk: int = 32_768,
+    input_dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    """Dispatching HistogramBuilder; see module docstring for impls."""
+    impl = resolve_hist_impl(impl)
+    if impl == "segment":
+        return build_histograms_segment(Xb, g, h, node_index, n_nodes, n_bins)
+    if impl == "matmul":
+        return build_histograms_matmul(
+            Xb, g, h, node_index, n_nodes, n_bins,
+            row_chunk=row_chunk, input_dtype=input_dtype,
+        )
+    if impl == "pallas":
+        from ddt_tpu.ops.hist_pallas import build_histograms_pallas
+        return build_histograms_pallas(Xb, g, h, node_index, n_nodes, n_bins)
+    raise ValueError(f"unknown hist impl {impl!r}")

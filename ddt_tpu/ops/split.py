@@ -1,0 +1,67 @@
+"""SplitGain: scan histogram bins, score splits, argmax per node.
+
+Layer L3 kernel #2 (SURVEY.md §2 "SplitGain"): cumulative-sum scan over the
+bin axis, XGBoost-style gain formula, argmax over the flattened (feature, bin)
+axis. NumPy twin: reference/numpy_trainer.best_splits — tie-break semantics
+(first occurrence in flattened order) deliberately match jnp.argmax so every
+backend picks identical splits.
+
+This is tiny (histograms are [N, F, B, 2] ~ KBs-MBs) — pure XLA vector code,
+fused by the compiler; never a bottleneck next to the histogram build.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=())
+def node_totals(hist: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(G, H) per node: sums over bins of feature 0 (any feature sums the
+    same rows). float32 [n_nodes] each."""
+    return hist[:, 0, :, 0].sum(axis=1), hist[:, 0, :, 1].sum(axis=1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("reg_lambda", "min_child_weight")
+)
+def best_splits(
+    hist: jax.Array,            # float32 [n_nodes, F, B, 2]
+    reg_lambda: float,
+    min_child_weight: float,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-node best split: (gain [n], feature [n] int32, bin [n] int32).
+
+    gain = 0.5 * (GL^2/(HL+l) + GR^2/(HR+l) - G^2/(H+l)); split at bin b
+    sends bins <= b left; last bin invalid (empty right child); children must
+    carry >= min_child_weight hessian mass. Invalid positions score -inf.
+    """
+    n_nodes, F, B, _ = hist.shape
+    GL = jnp.cumsum(hist[..., 0], axis=2)           # [n, F, B]
+    HL = jnp.cumsum(hist[..., 1], axis=2)
+    G = GL[:, 0:1, B - 1:B]                         # [n, 1, 1] totals
+    H = HL[:, 0:1, B - 1:B]
+    GR = G - GL
+    HR = H - HL
+    parent = jnp.square(G) / (H + reg_lambda)
+    gain = 0.5 * (
+        jnp.square(GL) / (HL + reg_lambda)
+        + jnp.square(GR) / (HR + reg_lambda)
+        - parent
+    )
+    valid = (HL >= min_child_weight) & (HR >= min_child_weight)
+    valid = valid & (jnp.arange(B) < B - 1)[None, None, :]
+    valid = valid & ~jnp.isnan(gain)                # 0/0 when reg_lambda == 0
+    gain = jnp.where(valid, gain, -jnp.inf).astype(jnp.float32)
+
+    flat = gain.reshape(n_nodes, F * B)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    return (
+        best_gain,
+        (best // B).astype(jnp.int32),
+        (best % B).astype(jnp.int32),
+    )

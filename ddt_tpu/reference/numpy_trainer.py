@@ -196,20 +196,23 @@ def grow_tree(
         newly_frozen[active] = ~split_here
         frozen |= newly_frozen
 
-    # Final-level leaves: value from G/H aggregated per terminal node.
+    # Final-level leaves: value from G/H aggregated per terminal node. All
+    # last-level slots are marked leaves even when unreachable (no active
+    # rows) — unreachable slots become inert zero-value leaves, identical to
+    # ops/grow.py's device semantics (backend-parity contract).
     active = ~frozen
-    if active.any():
-        offset = (1 << cfg.max_depth) - 1
-        idx = node_id[active] - offset
-        n_last = 1 << cfg.max_depth
-        Gl = np.zeros(n_last, np.float32)
-        Hl = np.zeros(n_last, np.float32)
-        np.add.at(Gl, idx, g[active])
-        np.add.at(Hl, idx, h[active])
+    offset = (1 << cfg.max_depth) - 1
+    idx = node_id[active] - offset
+    n_last = 1 << cfg.max_depth
+    Gl = np.zeros(n_last, np.float32)
+    Hl = np.zeros(n_last, np.float32)
+    np.add.at(Gl, idx, g[active])
+    np.add.at(Hl, idx, h[active])
+    with np.errstate(divide="ignore", invalid="ignore"):
         vals = -Gl / (Hl + cfg.reg_lambda)
-        leaf_ids = offset + np.arange(n_last)
-        is_leaf[leaf_ids] = True
-        leaf_value[leaf_ids] = np.where(Hl > 0, vals, 0.0)
+    leaf_ids = offset + np.arange(n_last)
+    is_leaf[leaf_ids] = True
+    leaf_value[leaf_ids] = np.where(Hl > 0, vals, 0.0)
 
     return {
         "feature": feature,

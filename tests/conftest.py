@@ -8,7 +8,9 @@ Distributed tests run single-process multi-device on CPU (SURVEY.md §4
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment pins JAX_PLATFORMS=axon (the real TPU
+# tunnel); the test suite needs the 8-virtual-device CPU mesh instead.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

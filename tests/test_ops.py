@@ -1,0 +1,214 @@
+"""Kernel-level parity tests: JAX ops vs the NumPy oracle (SURVEY.md §4
+"Unit (kernel-level)"). Runs on 8 virtual CPU devices (conftest.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.ops import grad as jgrad
+from ddt_tpu.ops import grow as jgrow
+from ddt_tpu.ops import histogram as jhist
+from ddt_tpu.ops import predict as jpred
+from ddt_tpu.ops import split as jsplit
+from ddt_tpu.reference import numpy_trainer as oracle
+from ddt_tpu.data.datasets import synthetic_binary
+from ddt_tpu.data.quantizer import quantize
+
+
+def _rand_case(R=500, F=7, B=32, n_nodes=4, seed=0, frozen_frac=0.2):
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, B, size=(R, F), dtype=np.uint8)
+    g = rng.standard_normal(R).astype(np.float32)
+    h = rng.random(R).astype(np.float32) + 0.1
+    node_index = rng.integers(0, n_nodes, size=R).astype(np.int32)
+    node_index[rng.random(R) < frozen_frac] = -1
+    return Xb, g, h, node_index
+
+
+# --------------------------------------------------------------------------- #
+# histogram
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("impl", ["segment", "matmul"])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_histogram_matches_oracle(impl, seed):
+    Xb, g, h, node_index = _rand_case(seed=seed)
+    want = oracle.build_histograms(Xb, g, h, node_index, 4, 32)
+    got = np.asarray(
+        jhist.build_histograms(
+            jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h),
+            jnp.asarray(node_index), 4, 32,
+            impl=impl, input_dtype=jnp.float32,
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+def test_histogram_matmul_chunked_equals_unchunked():
+    Xb, g, h, node_index = _rand_case(R=1000)
+    a = jhist.build_histograms_matmul(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(node_index), 4, 32,
+        row_chunk=128, input_dtype=jnp.float32,
+    )
+    b = jhist.build_histograms_matmul(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(node_index), 4, 32,
+        row_chunk=4096, input_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                               atol=1e-4)
+
+
+def test_histogram_mass_conservation():
+    """Property: per-node sums over (bin) equal per-node sums of g/h, for
+    every feature (each feature's histogram redistributes the same rows)."""
+    Xb, g, h, node_index = _rand_case(R=300, F=3, B=16, n_nodes=3)
+    hist = np.asarray(
+        jhist.build_histograms(
+            jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h),
+            jnp.asarray(node_index), 3, 16, impl="segment",
+        )
+    )
+    for n in range(3):
+        m = node_index == n
+        for f in range(3):
+            np.testing.assert_allclose(
+                hist[n, f, :, 0].sum(), g[m].sum(), rtol=1e-4, atol=1e-4
+            )
+            np.testing.assert_allclose(
+                hist[n, f, :, 1].sum(), h[m].sum(), rtol=1e-4, atol=1e-4
+            )
+
+
+# --------------------------------------------------------------------------- #
+# split gain
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("reg_lambda,mcw", [(1.0, 1e-3), (0.0, 0.5)])
+def test_best_splits_matches_oracle(reg_lambda, mcw):
+    Xb, g, h, node_index = _rand_case(B=16, n_nodes=4)
+    hist = oracle.build_histograms(Xb, g, h, node_index, 4, 16)
+    want_gain, want_f, want_b = oracle.best_splits(hist, reg_lambda, mcw)
+    got_gain, got_f, got_b = jsplit.best_splits(
+        jnp.asarray(hist), reg_lambda, mcw
+    )
+    np.testing.assert_allclose(np.asarray(got_gain), want_gain, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got_f), want_f)
+    np.testing.assert_array_equal(np.asarray(got_b), want_b)
+
+
+# --------------------------------------------------------------------------- #
+# gradients
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("loss", ["logloss", "mse", "softmax"])
+def test_grad_hess_matches_oracle(loss):
+    rng = np.random.default_rng(0)
+    R, C = 200, 4
+    if loss == "softmax":
+        pred = rng.standard_normal((R, C)).astype(np.float32)
+        y = rng.integers(0, C, R).astype(np.int32)
+    else:
+        pred = rng.standard_normal(R).astype(np.float32)
+        y = (rng.random(R) > 0.5).astype(np.float32)
+    wg, wh = oracle.grad_hess(pred, y, loss)
+    gg, gh = jgrad.grad_hess(jnp.asarray(pred), jnp.asarray(y), loss)
+    np.testing.assert_allclose(np.asarray(gg), wg, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gh), wh, rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------------------------------- #
+# whole-tree growth
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("hist_impl", ["segment", "matmul"])
+def test_grow_tree_matches_oracle(hist_impl):
+    X, y = synthetic_binary(800, n_features=6, seed=3)
+    Xb, _ = quantize(X, n_bins=32)
+    cfg = TrainConfig(n_trees=1, max_depth=4, n_bins=32, backend="cpu")
+    pred = np.full(800, 0.1, np.float32)
+    g, h = oracle.grad_hess(pred, y.astype(np.float32), "logloss")
+    want = oracle.grow_tree(Xb, g, h, cfg)
+
+    got = jgrow.grow_tree(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h),
+        max_depth=4, n_bins=32, reg_lambda=cfg.reg_lambda,
+        min_child_weight=cfg.min_child_weight,
+        min_split_gain=cfg.min_split_gain,
+        hist_impl=hist_impl, input_dtype=jnp.float32,
+    )
+    np.testing.assert_array_equal(np.asarray(got.feature), want["feature"])
+    np.testing.assert_array_equal(
+        np.asarray(got.threshold_bin), want["threshold_bin"]
+    )
+    np.testing.assert_array_equal(np.asarray(got.is_leaf), want["is_leaf"])
+    np.testing.assert_allclose(
+        np.asarray(got.leaf_value), want["leaf_value"], rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.leaf_of_row), want["leaf_of_row"]
+    )
+
+
+# --------------------------------------------------------------------------- #
+# predict
+# --------------------------------------------------------------------------- #
+
+def _train_tiny_ensemble():
+    X, y = synthetic_binary(600, n_features=5, seed=7)
+    Xb, mapper = quantize(X, n_bins=32)
+    cfg = TrainConfig(n_trees=5, max_depth=3, n_bins=32, backend="cpu")
+    ens = oracle.fit(Xb, y, cfg, mapper=mapper)
+    return ens, Xb, X
+
+
+@pytest.mark.parametrize("tree_chunk", [2, 64])
+def test_predict_matches_oracle(tree_chunk):
+    ens, Xb, X = _train_tiny_ensemble()
+    want = ens.predict_raw(Xb, binned=True)
+    got = jpred.predict_raw(
+        jnp.asarray(ens.feature), jnp.asarray(ens.threshold_bin),
+        jnp.asarray(ens.is_leaf), jnp.asarray(ens.leaf_value),
+        jnp.asarray(Xb.astype(np.int32)),
+        max_depth=ens.max_depth, learning_rate=ens.learning_rate,
+        base=ens.base_score, n_classes=1, tree_chunk=tree_chunk,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_predict_raw_thresholds_match_binned():
+    """Raw-value traversal (threshold_raw) agrees with binned traversal."""
+    ens, Xb, X = _train_tiny_ensemble()
+    want = ens.predict_raw(Xb, binned=True)
+    got = jpred.predict_raw(
+        jnp.asarray(ens.feature), jnp.asarray(ens.threshold_raw),
+        jnp.asarray(ens.is_leaf), jnp.asarray(ens.leaf_value),
+        jnp.asarray(X.astype(np.float32)),
+        max_depth=ens.max_depth, learning_rate=ens.learning_rate,
+        base=ens.base_score, n_classes=1,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_predict_softmax_interleave():
+    X, y = synthetic_binary(400, n_features=5, seed=11)
+    y = (y + (X[:, 0] > 0)).astype(np.int32)  # 3-ish classes
+    Xb, _ = quantize(X, n_bins=32)
+    cfg = TrainConfig(
+        n_trees=3, max_depth=3, n_bins=32, loss="softmax", n_classes=3,
+        backend="cpu",
+    )
+    ens = oracle.fit(Xb, y, cfg)
+    want = ens.predict_raw(Xb, binned=True)          # [R, 3]
+    got = jpred.predict_raw(
+        jnp.asarray(ens.feature), jnp.asarray(ens.threshold_bin),
+        jnp.asarray(ens.is_leaf), jnp.asarray(ens.leaf_value),
+        jnp.asarray(Xb.astype(np.int32)),
+        max_depth=ens.max_depth, learning_rate=ens.learning_rate,
+        base=ens.base_score, n_classes=3, tree_chunk=4,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
