@@ -1,0 +1,26 @@
+"""ddt_tpu: a TPU-native distributed decision-tree (GBDT) framework.
+
+Brand-new JAX/XLA/Pallas realisation of the capabilities of
+fpgasystems/Distributed-DecisionTrees (see SURVEY.md for the capability
+contract; the reference source was unavailable — everything here is built to
+BASELINE.json's north star, not translated).
+
+Public surface (layer L8):
+    from ddt_tpu import train, predict, TrainConfig, TreeEnsemble
+    python -m ddt_tpu.cli train --backend=tpu
+"""
+
+from ddt_tpu.api import TrainResult, predict, train
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.models.tree import TreeEnsemble
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "train",
+    "predict",
+    "TrainResult",
+    "TrainConfig",
+    "TreeEnsemble",
+    "__version__",
+]

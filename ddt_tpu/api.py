@@ -1,0 +1,122 @@
+"""Public API (layer L8): ddt.train / ddt.predict.
+
+SURVEY.md §1 L8: "`ddt.train()`, `ddt.predict()`, `python -m ddt_tpu.cli
+train --backend=tpu`". Thin orchestration over the layers below: quantize
+(L7) → Driver.fit against the flag-selected backend (L5/L4) → TreeEnsemble
+(L6); predict routes through the backend's gather+compare scorer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+
+from ddt_tpu.backends import get_backend
+from ddt_tpu.backends.base import DeviceBackend
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.data.quantizer import BinMapper, fit_bin_mapper
+from ddt_tpu.driver import Driver
+from ddt_tpu.models.tree import TreeEnsemble
+
+log = logging.getLogger("ddt_tpu.api")
+
+
+@dataclasses.dataclass
+class TrainResult:
+    ensemble: TreeEnsemble
+    mapper: BinMapper | None      # None when the caller passed binned data
+    history: list[dict]           # per-round {round, train_loss, ms_per_round}
+
+
+def train(
+    X: np.ndarray,
+    y: np.ndarray,
+    cfg: TrainConfig | None = None,
+    *,
+    binned: bool = False,
+    mapper: BinMapper | None = None,
+    backend: DeviceBackend | None = None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 25,
+    log_every: int = 10,
+    **cfg_overrides,
+) -> TrainResult:
+    """Train a GBDT. `X` is float features (quantized here) unless
+    `binned=True` (uint8 bin indices). `cfg_overrides` are TrainConfig fields
+    (e.g. train(X, y, n_trees=50, backend="cpu")). `backend` accepts either
+    the flag string (a TrainConfig field) or a pre-built DeviceBackend
+    instance (e.g. one holding a specific mesh)."""
+    if isinstance(backend, str):
+        cfg_overrides["backend"] = backend
+        backend = None
+    if cfg is None:
+        cfg = TrainConfig(**cfg_overrides)
+    elif cfg_overrides:
+        cfg = cfg.replace(**cfg_overrides)
+
+    if binned:
+        Xb = np.asarray(X)
+        if Xb.dtype != np.uint8:
+            raise TypeError("binned=True requires uint8 bin indices")
+    else:
+        if mapper is None:
+            mapper = fit_bin_mapper(np.asarray(X), n_bins=cfg.n_bins,
+                                    seed=cfg.seed)
+        Xb = mapper.transform(np.asarray(X))
+
+    be = backend if backend is not None else get_backend(cfg)
+    driver = Driver(
+        be, cfg,
+        log_every=log_every,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+    )
+    ens = driver.fit(Xb, np.asarray(y))
+    if mapper is not None:
+        from ddt_tpu.reference.numpy_trainer import _fill_raw_thresholds
+
+        _fill_raw_thresholds(ens, mapper)
+    return TrainResult(ensemble=ens, mapper=mapper, history=driver.history)
+
+
+def predict(
+    ens: TreeEnsemble,
+    X: np.ndarray,
+    *,
+    binned: bool = False,
+    mapper: BinMapper | None = None,
+    raw: bool = False,
+    backend: DeviceBackend | None = None,
+    cfg: TrainConfig | None = None,
+) -> np.ndarray:
+    """Score a batch. Routes through the device gather+compare path when a
+    backend is given (or cfg selects one); NumPy otherwise."""
+    X = np.asarray(X)
+    if not binned:
+        if mapper is not None:
+            X = mapper.transform(X)
+            binned = True
+        elif not ens.has_raw_thresholds:
+            raise ValueError(
+                "predict on raw features needs a mapper or an ensemble with "
+                "raw thresholds; or pass binned=True with uint8 bins"
+            )
+    if backend is None and cfg is not None:
+        backend = get_backend(cfg)
+    if binned and X.dtype != np.uint8:
+        raise TypeError(
+            f"binned=True requires uint8 bin indices, got {X.dtype}"
+        )
+    if backend is not None and binned:
+        out = backend.predict_raw(ens, X)
+        if raw:
+            return out
+        from ddt_tpu.ops.predict import predict_proba
+        import jax.numpy as jnp
+
+        return np.asarray(predict_proba(jnp.asarray(out), ens.loss))
+    return ens.predict_raw(X, binned=binned) if raw else ens.predict(
+        X, binned=binned
+    )

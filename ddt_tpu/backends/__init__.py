@@ -1,0 +1,51 @@
+"""Backend registry + flag selection.
+
+[BASELINE]: "FPGA vs TPU backend selectable by flag" — the reference picks its
+DeviceBackend by a runtime flag. Here the registry maps flag values to
+implementations: "cpu" (NumPy/native reference), "tpu" (JAX/XLA — the north
+star), and "fpga" (present for flag-surface parity, unavailable in this
+build: we have no FPGA shell to drive, and stubbing silently would be lying
+about capability).
+"""
+
+from __future__ import annotations
+
+from ddt_tpu.backends.base import DeviceBackend, HostTree
+from ddt_tpu.config import TrainConfig
+
+
+class FPGADevice(DeviceBackend):
+    """Flag-parity stub for the reference's FPGA backend (not in this build)."""
+
+    name = "fpga"
+
+    def __init__(self, cfg: TrainConfig):
+        raise NotImplementedError(
+            "The FPGA backend exists in this framework's flag surface for "
+            "parity with the reference, but this build targets TPU: no FPGA "
+            "shell/runtime is present. Use --backend=tpu or --backend=cpu."
+        )
+
+    # Abstract methods are never reachable (init always raises); satisfy the
+    # ABC so the class itself is constructible up to the NotImplementedError.
+    upload = upload_labels = build_histograms = best_splits = None  # type: ignore
+    init_pred = load_pred = grad_hess = grow_tree = apply_delta = None  # type: ignore
+    loss_value = predict_raw = None  # type: ignore
+
+
+def get_backend(cfg: TrainConfig, **kwargs) -> DeviceBackend:
+    """Instantiate the backend named by cfg.backend (the flag)."""
+    if cfg.backend == "cpu":
+        from ddt_tpu.backends.cpu import CPUDevice
+
+        return CPUDevice(cfg, **kwargs)
+    if cfg.backend == "tpu":
+        from ddt_tpu.backends.tpu import TPUDevice
+
+        return TPUDevice(cfg, **kwargs)
+    if cfg.backend == "fpga":
+        return FPGADevice(cfg)
+    raise ValueError(f"unknown backend {cfg.backend!r}")
+
+
+__all__ = ["DeviceBackend", "HostTree", "FPGADevice", "get_backend"]

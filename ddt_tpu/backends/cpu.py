@@ -1,0 +1,124 @@
+"""CPUDevice: the NumPy reference backend behind the DeviceBackend boundary.
+
+The reference ships a CPU reference implementation of (at least) the histogram
+kernel and compares device throughput against it [BASELINE: "≥5× the repo's
+CPU-reference histogram throughput"]. This backend wraps the M0 oracle trainer
+(reference/numpy_trainer.py) behind the L4 interface so:
+
+- backend-parity tests can drive CPU vs TPU through the identical call
+  surface (SURVEY.md §4 "Backend parity"), and
+- the bench harness measures the baseline M-rows/sec on the same contract it
+  measures the TPU path.
+
+When the native C++ kernel (ddt_tpu/native) is built, `build_histograms` uses
+it (that's the honest CPU baseline — a compiled kernel, like the reference's);
+otherwise the NumPy np.add.at path runs. Both match the oracle bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ddt_tpu.backends.base import DeviceBackend, HostTree
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.models.tree import TreeEnsemble
+from ddt_tpu.reference import numpy_trainer as ref
+
+
+class CPUDevice(DeviceBackend):
+    """NumPy (optionally native-C++-accelerated) reference backend."""
+
+    name = "cpu"
+
+    def __init__(self, cfg: TrainConfig, use_native: bool | None = None):
+        super().__init__(cfg)
+        self._native = None
+        if use_native is not False:
+            try:
+                from ddt_tpu.native import histogram_native
+
+                self._native = histogram_native
+            except Exception:
+                if use_native:  # explicitly requested → surface the failure
+                    raise
+                self._native = None
+
+    # ------------------------------------------------------------------ #
+
+    def upload(self, Xb: np.ndarray) -> np.ndarray:
+        Xb = np.ascontiguousarray(Xb)
+        if Xb.dtype != np.uint8:
+            raise TypeError(f"binned data must be uint8, got {Xb.dtype}")
+        return Xb
+
+    def upload_labels(self, y: np.ndarray) -> np.ndarray:
+        return np.asarray(y)
+
+    # ------------------------------------------------------------------ #
+
+    def build_histograms(self, data, g, h, node_index, n_nodes) -> np.ndarray:
+        if self._native is not None:
+            return self._native(
+                data, g, h, node_index, n_nodes, self.cfg.n_bins
+            )
+        return ref.build_histograms(
+            data, g, h, node_index, n_nodes, self.cfg.n_bins
+        )
+
+    def best_splits(self, hist):
+        return ref.best_splits(
+            hist, self.cfg.reg_lambda, self.cfg.min_child_weight
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def init_pred(self, y, base: float):
+        R = y.shape[0]
+        if self.cfg.loss == "softmax":
+            return np.zeros((R, self.cfg.n_classes), np.float32)
+        return np.full(R, base, np.float32)
+
+    def load_pred(self, raw: np.ndarray):
+        return np.array(raw, np.float32)
+
+    def grad_hess(self, pred, y):
+        return ref.grad_hess(pred, y, self.cfg.loss)
+
+    def grow_tree(self, data, g, h) -> tuple[HostTree, Any]:
+        tree = ref.grow_tree(data, g, h, self.cfg)
+        delta = (
+            self.cfg.learning_rate * tree["leaf_value"][tree["leaf_of_row"]]
+        ).astype(np.float32)
+        host = HostTree(
+            feature=tree["feature"],
+            threshold_bin=tree["threshold_bin"],
+            is_leaf=tree["is_leaf"],
+            leaf_value=tree["leaf_value"],
+        )
+        return host, delta
+
+    def apply_delta(self, pred, delta, class_idx: int):
+        if pred.ndim == 2:
+            pred[:, class_idx] += delta
+        else:
+            pred += delta
+        return pred
+
+    def loss_value(self, pred, y) -> float:
+        loss = self.cfg.loss
+        if loss == "logloss":
+            p = 1.0 / (1.0 + np.exp(-pred.astype(np.float64)))
+            p = np.clip(p, 1e-12, 1 - 1e-12)
+            return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+        if loss == "mse":
+            return float(np.mean((pred - y) ** 2))
+        z = pred - pred.max(axis=1, keepdims=True)
+        logp = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
+        return float(-np.mean(logp[np.arange(y.shape[0]), y.astype(np.int64)]))
+
+    # ------------------------------------------------------------------ #
+
+    def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
+        return ens.predict_raw(Xb, binned=True)

@@ -1,0 +1,322 @@
+"""TPUDevice: the JAX/XLA execution backend (the north-star deliverable).
+
+Realises [BASELINE]: "the per-feature HistogramBuilder and SplitGain kernels
+are re-expressed as jax.vmap'd XLA ops, and the cross-partition histogram
+allreduce that today runs over the FPGA network fabric becomes jax.lax.psum
+over TPU ICI. The host-side Driver/DeviceBackend abstraction gains a TPUDevice
+implementation alongside FPGADevice."
+
+Design, TPU-first (SURVEY.md §1 L2–L4):
+
+- **One dispatch per tree.** `grow_tree` jit-compiles the whole level-unrolled
+  growth program (ops/grow.py) once per (shape, config) and reuses it for all
+  trees; only ~KBs of node arrays cross the host boundary per tree. The
+  reference's per-kernel host↔device calling convention would serialise
+  6 × depth × trees dispatch latencies — fused instead.
+- **Distribution = mesh axis, not message passing.** With n_partitions > 1 the
+  backend builds a 1-D `jax.sharding.Mesh` over axis "rows", row-shards the
+  binned matrix/labels/boosting state with NamedSharding, and traces the same
+  growth program under `jax.shard_map` with axis_name="rows" — the histogram
+  allreduce appears as `jax.lax.psum` riding ICI. Tree arrays come out
+  replicated (every shard deterministically grows the identical tree); the
+  per-row state stays sharded and never moves.
+- **Static shapes.** Rows are padded to a multiple of the partition count;
+  padded rows are masked out of gradients (g = h = 0) so they contribute to
+  no histogram, no leaf sum, and no loss.
+
+This class runs unmodified on CPU XLA (tests use an 8-virtual-device CPU
+mesh — SURVEY.md §4 "Distributed without a cluster") and on real TPU; "tpu"
+names the design target, and the flag surface matches the reference's
+fpga/tpu selection [BASELINE].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddt_tpu.backends.base import DeviceBackend, HostTree
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.models.tree import TreeEnsemble
+from ddt_tpu.ops import grad as grad_ops
+from ddt_tpu.ops import grow as grow_ops
+from ddt_tpu.ops import histogram as hist_ops
+from ddt_tpu.ops import predict as predict_ops
+from ddt_tpu.ops import split as split_ops
+
+P = jax.sharding.PartitionSpec
+
+AXIS = "rows"  # the data-parallel mesh axis (SURVEY.md §2 "Mesh axes")
+
+
+class TPUDevice(DeviceBackend):
+    """XLA backend; single-chip or row-sharded over a device mesh."""
+
+    name = "tpu"
+
+    def __init__(
+        self,
+        cfg: TrainConfig,
+        devices: list | None = None,
+        mesh: jax.sharding.Mesh | None = None,
+    ):
+        super().__init__(cfg)
+        self.n_partitions = max(1, cfg.n_partitions)
+        if mesh is not None:
+            self.mesh = mesh
+            self.n_partitions = mesh.devices.size
+        elif self.n_partitions > 1:
+            devs = devices if devices is not None else jax.devices()
+            if len(devs) < self.n_partitions:
+                raise ValueError(
+                    f"n_partitions={self.n_partitions} but only "
+                    f"{len(devs)} devices visible"
+                )
+            self.mesh = jax.make_mesh(
+                (self.n_partitions,), (AXIS,),
+                devices=devs[: self.n_partitions],
+            )
+        else:
+            self.mesh = None
+        self.distributed = self.mesh is not None
+        self._valid = None       # [Rp] bool row-validity mask (pad exclusion)
+        self._n_rows = None      # real (unpadded) training row count
+        self._input_dtype = jnp.dtype(cfg.matmul_input_dtype)
+
+    # ------------------------------------------------------------------ #
+    # sharding helpers
+    # ------------------------------------------------------------------ #
+
+    def _sharding(self, *spec):
+        if not self.distributed:
+            return None
+        return jax.sharding.NamedSharding(self.mesh, P(*spec))
+
+    def _pad_rows(self, a: np.ndarray) -> np.ndarray:
+        """Pad axis 0 to a multiple of n_partitions (zeros)."""
+        R = a.shape[0]
+        Rp = -(-R // self.n_partitions) * self.n_partitions
+        if Rp == R:
+            return a
+        pad = [(0, Rp - R)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, pad)
+
+    def _put_rows(self, a: np.ndarray, extra_dims: int = 0) -> jax.Array:
+        a = self._pad_rows(np.ascontiguousarray(a))
+        sh = self._sharding(AXIS, *([None] * extra_dims))
+        return jax.device_put(a, sh) if sh is not None else jax.device_put(a)
+
+    # ------------------------------------------------------------------ #
+    # data plane
+    # ------------------------------------------------------------------ #
+
+    def upload(self, Xb: np.ndarray) -> jax.Array:
+        if Xb.dtype != np.uint8:
+            raise TypeError(f"binned data must be uint8, got {Xb.dtype}")
+        R = Xb.shape[0]
+        data = self._put_rows(Xb, extra_dims=1)
+        # Validity mask for the training rows this upload defines.
+        valid = np.zeros(data.shape[0], bool)
+        valid[:R] = True
+        self._valid = self._put_rows(valid)
+        self._n_rows = R
+        return data
+
+    def upload_labels(self, y: np.ndarray) -> jax.Array:
+        return self._put_rows(np.asarray(y))
+
+    # ------------------------------------------------------------------ #
+    # granular L3 kernels (parity/bench surface)
+    # ------------------------------------------------------------------ #
+
+    @functools.cached_property
+    def _hist_fn(self):
+        cfg = self.cfg
+        impl = hist_ops.resolve_hist_impl(cfg.hist_impl)
+
+        def hist(Xb, g, h, node_index, *, n_nodes):
+            out = hist_ops.build_histograms(
+                Xb, g, h, node_index, n_nodes, cfg.n_bins,
+                impl=impl, input_dtype=self._input_dtype,
+            )
+            if self.distributed:
+                out = jax.lax.psum(out, AXIS)  # the fabric-allreduce analog
+            return out
+
+        if self.distributed:
+            def sharded(Xb, g, h, node_index, *, n_nodes):
+                f = jax.shard_map(
+                    functools.partial(hist, n_nodes=n_nodes),
+                    mesh=self.mesh,
+                    in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS)),
+                    out_specs=P(),
+                )
+                return f(Xb, g, h, node_index)
+            return sharded
+        return hist
+
+    def build_histograms(self, data, g, h, node_index, n_nodes):
+        g = g if isinstance(g, jax.Array) else self._put_rows(np.asarray(g))
+        h = h if isinstance(h, jax.Array) else self._put_rows(np.asarray(h))
+        if not isinstance(node_index, jax.Array):
+            node_index = self._put_rows(
+                self._pad_rows_index(np.asarray(node_index))
+            )
+        return self._hist_fn(data, g, h, node_index, n_nodes=n_nodes)
+
+    def _pad_rows_index(self, idx: np.ndarray) -> np.ndarray:
+        """Pad a node-index vector with -1 (frozen) so pad rows are inert."""
+        R = idx.shape[0]
+        Rp = -(-R // self.n_partitions) * self.n_partitions
+        if Rp == R:
+            return idx
+        return np.concatenate(
+            [idx, np.full(Rp - R, -1, idx.dtype)]
+        )
+
+    def best_splits(self, hist):
+        return split_ops.best_splits(
+            jnp.asarray(hist), self.cfg.reg_lambda, self.cfg.min_child_weight
+        )
+
+    # ------------------------------------------------------------------ #
+    # fused training ops
+    # ------------------------------------------------------------------ #
+
+    def init_pred(self, y, base: float):
+        Rp = y.shape[0]
+        if self.cfg.loss == "softmax":
+            z = np.zeros((Rp, self.cfg.n_classes), np.float32)
+            sh = self._sharding(AXIS, None)
+        else:
+            z = np.full(Rp, base, np.float32)
+            sh = self._sharding(AXIS)
+        return jax.device_put(z, sh) if sh is not None else jax.device_put(z)
+
+    def load_pred(self, raw: np.ndarray):
+        extra = 1 if raw.ndim == 2 else 0
+        return self._put_rows(raw.astype(np.float32), extra_dims=extra)
+
+    @functools.cached_property
+    def _grad_fn(self):
+        loss = self.cfg.loss
+
+        @jax.jit
+        def f(pred, y, valid):
+            g, h = grad_ops.grad_hess(pred, y, loss)
+            if g.ndim == 2:
+                v = valid[:, None]
+            else:
+                v = valid
+            return g * v, h * v  # pad rows contribute nothing anywhere
+
+        return f
+
+    def grad_hess(self, pred, y):
+        return self._grad_fn(pred, y, self._valid)
+
+    @functools.cached_property
+    def _grow_fn(self):
+        cfg = self.cfg
+        impl = hist_ops.resolve_hist_impl(cfg.hist_impl)
+        axis = AXIS if self.distributed else None
+
+        def grow(Xb, g, h):
+            tree = grow_ops.grow_tree(
+                Xb, g, h,
+                max_depth=cfg.max_depth,
+                n_bins=cfg.n_bins,
+                reg_lambda=cfg.reg_lambda,
+                min_child_weight=cfg.min_child_weight,
+                min_split_gain=cfg.min_split_gain,
+                hist_impl=impl,
+                input_dtype=self._input_dtype,
+                axis_name=axis,
+            )
+            delta = grow_ops.tree_predict_delta(tree, cfg.learning_rate)
+            return (
+                tree.feature, tree.threshold_bin, tree.is_leaf,
+                tree.leaf_value, delta,
+            )
+
+        if self.distributed:
+            grow = jax.shard_map(
+                grow,
+                mesh=self.mesh,
+                in_specs=(P(AXIS, None), P(AXIS), P(AXIS)),
+                out_specs=(P(), P(), P(), P(), P(AXIS)),
+            )
+        return jax.jit(grow)
+
+    def grow_tree(self, data, g, h) -> tuple[HostTree, Any]:
+        feature, thr, is_leaf, leaf_value, delta = self._grow_fn(data, g, h)
+        host = HostTree(
+            feature=np.asarray(feature),
+            threshold_bin=np.asarray(thr),
+            is_leaf=np.asarray(is_leaf),
+            leaf_value=np.asarray(leaf_value),
+        )
+        return host, delta
+
+    @functools.cached_property
+    def _apply_fn(self):
+        @functools.partial(jax.jit, static_argnames=("class_idx",), donate_argnums=(0,))
+        def f(pred, delta, class_idx):
+            if pred.ndim == 2:
+                return pred.at[:, class_idx].add(delta)
+            return pred + delta
+
+        return f
+
+    def apply_delta(self, pred, delta, class_idx: int):
+        return self._apply_fn(pred, delta, class_idx=class_idx)
+
+    @functools.cached_property
+    def _loss_fn(self):
+        loss = self.cfg.loss
+
+        @jax.jit
+        def f(pred, y, valid):
+            n = jnp.maximum(valid.sum(), 1)
+            if loss == "logloss":
+                yf = y.astype(jnp.float32)
+                # Numerically stable logistic loss: log(1+e^-|x|)+max(x,0)-x*y
+                per = jnp.logaddexp(0.0, pred) - pred * yf
+                return jnp.sum(per * valid) / n
+            if loss == "mse":
+                return jnp.sum(jnp.square(pred - y) * valid) / n
+            logp = jax.nn.log_softmax(pred, axis=1)
+            picked = jnp.take_along_axis(
+                logp, y.astype(jnp.int32)[:, None], axis=1
+            )[:, 0]
+            return -jnp.sum(picked * valid) / n
+
+        return f
+
+    def loss_value(self, pred, y) -> float:
+        return float(self._loss_fn(pred, y, self._valid))
+
+    # ------------------------------------------------------------------ #
+    # inference (TreeEnsemble.predict → gather+compare, row-sharded)
+    # ------------------------------------------------------------------ #
+
+    def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
+        R = Xb.shape[0]
+        C = ens.n_classes if ens.loss == "softmax" else 1
+        Xc = self._put_rows(Xb.astype(np.int32), extra_dims=1)
+        feat = jax.device_put(ens.feature.astype(np.int32), self._sharding())
+        thr = jax.device_put(ens.threshold_bin.astype(np.int32), self._sharding())
+        leaf = jax.device_put(ens.is_leaf, self._sharding())
+        val = jax.device_put(ens.leaf_value, self._sharding())
+        out = predict_ops.predict_raw(
+            feat, thr, leaf, val, Xc,
+            max_depth=ens.max_depth,
+            learning_rate=ens.learning_rate,
+            base=ens.base_score,
+            n_classes=C,
+        )
+        return np.asarray(out)[:R]

@@ -1,0 +1,181 @@
+"""Benchmark harness (SURVEY.md §2 "Benchmark harness", §3 "Benchmark entry").
+
+Measures the BASELINE.json metrics:
+- `histogram`: HistogramBuilder throughput, M-rows/sec/chip — warm-up jit,
+  then time K iterations of build_histograms alone (isolates metric #1 from
+  the driver loop, matching the reference's "CPU-reference histogram
+  throughput" comparison).
+- `train`: end-to-end Higgs-style 100-tree build wallclock.
+- `predict`: batch ensemble scoring rows/sec (the 10M-row × 1000-tree config).
+
+All entry points return plain dicts; the CLI and the repo-root bench.py emit
+them as JSON lines.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ddt_tpu.config import TrainConfig
+
+
+def _hist_inputs(rows, features, bins, n_nodes, seed):
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, bins, size=(rows, features), dtype=np.uint8)
+    g = rng.standard_normal(rows).astype(np.float32)
+    h = rng.random(rows).astype(np.float32) + 0.5
+    node_index = rng.integers(0, n_nodes, size=rows).astype(np.int32)
+    return Xb, g, h, node_index
+
+
+def bench_histogram(
+    backend: str = "tpu",
+    rows: int = 1_000_000,
+    features: int = 28,
+    bins: int = 255,
+    n_nodes: int = 32,
+    iters: int = 10,
+    partitions: int = 1,
+    hist_impl: str = "auto",
+    seed: int = 0,
+) -> dict:
+    """Time the HistogramBuilder kernel. n_nodes=32 ≈ the deepest (widest)
+    level of the depth-6 Higgs config — the shape that dominates runtime."""
+    from ddt_tpu.backends import get_backend
+
+    cfg = TrainConfig(
+        n_bins=bins, backend=backend, n_partitions=partitions,
+        hist_impl=hist_impl,
+    )
+    be = get_backend(cfg)
+    Xb, g, h, node_index = _hist_inputs(rows, features, bins, n_nodes, seed)
+
+    data = be.upload(Xb)
+    if backend == "tpu":
+        import jax
+
+        g_d = be._put_rows(g)
+        h_d = be._put_rows(h)
+        ni_d = be._put_rows(node_index)
+        out = be.build_histograms(data, g_d, h_d, ni_d, n_nodes)
+        jax.block_until_ready(out)          # warm-up: compile + first run
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = be.build_histograms(data, g_d, h_d, ni_d, n_nodes)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+    else:
+        be.build_histograms(data, g, h, node_index, n_nodes)  # warm caches
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            be.build_histograms(data, g, h, node_index, n_nodes)
+        dt = (time.perf_counter() - t0) / iters
+
+    n_chips = max(1, partitions)
+    mrows = rows / dt / 1e6 / n_chips
+    return {
+        "kernel": "histogram",
+        "backend": backend,
+        "impl": getattr(be, "_native", None) is not None
+        and "native-c++" or hist_impl,
+        "rows": rows, "features": features, "bins": bins, "n_nodes": n_nodes,
+        "iters": iters, "partitions": partitions,
+        "sec_per_build": dt,
+        "mrows_per_sec_per_chip": mrows,
+    }
+
+
+def bench_train(
+    backend: str = "tpu",
+    rows: int = 1_000_000,
+    features: int = 28,
+    bins: int = 255,
+    trees: int = 100,
+    depth: int = 6,
+    partitions: int = 1,
+    hist_impl: str = "auto",
+    seed: int = 0,
+) -> dict:
+    """End-to-end boosted-build wallclock (the Higgs-1M/depth-6/100-tree
+    config when called with defaults)."""
+    from ddt_tpu import api
+    from ddt_tpu.data import datasets
+    from ddt_tpu.data.quantizer import quantize
+
+    X, y = datasets.synthetic_binary(rows, n_features=features, seed=seed)
+    Xb, _ = quantize(X, n_bins=bins, seed=seed)
+    cfg = TrainConfig(
+        n_trees=trees, max_depth=depth, n_bins=bins, backend=backend,
+        n_partitions=partitions, hist_impl=hist_impl, seed=seed,
+    )
+    # Warm-up: compile the per-tree program on a 2-tree run, then time.
+    api.train(Xb, y, cfg.replace(n_trees=2), binned=True, log_every=10**9)
+    t0 = time.perf_counter()
+    res = api.train(Xb, y, cfg, binned=True, log_every=10**9)
+    dt = time.perf_counter() - t0
+    return {
+        "kernel": "train",
+        "backend": backend, "rows": rows, "trees": trees, "depth": depth,
+        "partitions": partitions,
+        "wallclock_s": dt,
+        "trees_per_sec": trees / dt,
+        "final_train_loss": res.history[-1]["train_loss"]
+        if res.history else None,
+    }
+
+
+def bench_predict(
+    backend: str = "tpu",
+    rows: int = 1_000_000,
+    features: int = 28,
+    bins: int = 255,
+    trees: int = 1000,
+    depth: int = 6,
+    partitions: int = 1,
+    seed: int = 0,
+) -> dict:
+    """Batch inference throughput (the 1000-tree × large-batch config)."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.models.tree import empty_ensemble
+
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, bins, size=(rows, features), dtype=np.uint8)
+    n_nodes = 2 ** (depth + 1) - 1
+    ens = empty_ensemble(trees, depth, features, 0.1, 0.0, "logloss")
+    # Random full trees (all internal nodes split; plausible worst case).
+    ens.feature[:] = rng.integers(0, features, size=(trees, n_nodes))
+    ens.threshold_bin[:] = rng.integers(0, bins - 1, size=(trees, n_nodes))
+    ens.is_leaf[:, (n_nodes // 2):] = True
+    ens.leaf_value[:] = rng.standard_normal((trees, n_nodes)).astype(np.float32)
+
+    cfg = TrainConfig(backend=backend, n_partitions=partitions, n_bins=bins)
+    be = get_backend(cfg)
+    be.predict_raw(ens, Xb[: min(rows, 4096)])      # warm-up compile
+    t0 = time.perf_counter()
+    out = be.predict_raw(ens, Xb)
+    dt = time.perf_counter() - t0
+    assert out.shape[0] == rows
+    return {
+        "kernel": "predict",
+        "backend": backend, "rows": rows, "trees": trees, "depth": depth,
+        "wallclock_s": dt,
+        "mrows_per_sec": rows / dt / 1e6,
+    }
+
+
+def run_bench(kernel: str = "histogram", **kw) -> dict:
+    if kernel == "histogram":
+        keys = ("backend", "rows", "features", "bins", "iters",
+                "partitions", "hist_impl", "seed")
+        return bench_histogram(**{k: kw[k] for k in keys if k in kw})
+    if kernel == "train":
+        keys = ("backend", "rows", "features", "bins", "trees", "depth",
+                "partitions", "hist_impl", "seed")
+        return bench_train(**{k: kw[k] for k in keys if k in kw})
+    if kernel == "predict":
+        keys = ("backend", "rows", "features", "bins", "trees", "depth",
+                "partitions", "seed")
+        return bench_predict(**{k: kw[k] for k in keys if k in kw})
+    raise ValueError(f"unknown bench kernel {kernel!r}")

@@ -1,0 +1,174 @@
+"""CLI (layer L8): train / predict / bench with the backend flag.
+
+SURVEY.md §1 L8 + [BASELINE] "backend selectable by flag":
+
+    python -m ddt_tpu.cli train   --backend=tpu --dataset=higgs --rows=1000000
+    python -m ddt_tpu.cli predict --model=ens.npz --dataset=higgs --rows=10000
+    python -m ddt_tpu.cli bench   --kernel=histogram --backend=tpu
+
+Datasets are the BASELINE.json configs, backed by seeded synthetic generators
+(data/datasets.py) since this environment has no network; a --data=path.npz
+escape hatch loads (X, y) from disk.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import sys
+import time
+
+import numpy as np
+
+from ddt_tpu import api
+from ddt_tpu.config import BACKENDS, LOSSES, TrainConfig
+from ddt_tpu.data import datasets
+from ddt_tpu.models.tree import TreeEnsemble
+
+
+def _load_dataset(args) -> tuple[np.ndarray, np.ndarray, int]:
+    """(X, y, n_classes) for the named dataset config."""
+    if args.data:
+        with np.load(args.data) as d:
+            X, y = d["X"], d["y"]
+        return X, y, int(y.max()) + 1 if args.loss == "softmax" else 2
+    if args.dataset == "higgs":
+        X, y = datasets.synthetic_binary(args.rows, seed=args.seed)
+        return X, y, 2
+    if args.dataset == "covertype":
+        X, y = datasets.synthetic_multiclass(args.rows, seed=args.seed)
+        return X, y, 7
+    if args.dataset == "criteo":
+        from ddt_tpu.data.categorical import bin_categoricals
+
+        Xn, Xc, y = datasets.synthetic_ctr(args.rows, seed=args.seed)
+        X = np.concatenate(
+            [Xn, bin_categoricals(Xc, n_bins=args.bins).astype(np.float32)],
+            axis=1,
+        )
+        return X, y, 2
+    if args.dataset == "regression":
+        X, y = datasets.synthetic_regression(args.rows, seed=args.seed)
+        return X, y, 1
+    raise SystemExit(f"unknown dataset {args.dataset!r}")
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--backend", choices=BACKENDS, default="tpu",
+                   help="device backend (the [BASELINE] flag)")
+    p.add_argument("--dataset",
+                   choices=["higgs", "covertype", "criteo", "regression"],
+                   default="higgs")
+    p.add_argument("--data", default=None,
+                   help="path to an .npz with arrays X, y (overrides --dataset)")
+    p.add_argument("--rows", type=int, default=100_000)
+    p.add_argument("--bins", type=int, default=255)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--loss", choices=LOSSES, default=None,
+                   help="default: inferred from dataset")
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    ap = argparse.ArgumentParser(prog="ddt_tpu",
+                                 description="TPU-native distributed GBDT")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    tp = sub.add_parser("train", help="train an ensemble")
+    _add_common(tp)
+    tp.add_argument("--trees", type=int, default=100)
+    tp.add_argument("--depth", type=int, default=6)
+    tp.add_argument("--lr", type=float, default=0.1)
+    tp.add_argument("--partitions", type=int, default=1,
+                    help="row partitions over the device mesh")
+    tp.add_argument("--hist-impl", default="auto",
+                    choices=["auto", "matmul", "segment", "pallas"])
+    tp.add_argument("--out", default="ensemble.npz")
+    tp.add_argument("--checkpoint-dir", default=None)
+
+    pp = sub.add_parser("predict", help="score a batch with a saved ensemble")
+    _add_common(pp)
+    pp.add_argument("--model", required=True)
+    pp.add_argument("--out", default=None, help="write scores to this .npy")
+
+    bp = sub.add_parser("bench", help="kernel/e2e benchmarks (JSON lines)")
+    _add_common(bp)
+    bp.add_argument("--kernel", default="histogram",
+                    choices=["histogram", "train", "predict"])
+    bp.add_argument("--features", type=int, default=28)
+    bp.add_argument("--trees", type=int, default=100)
+    bp.add_argument("--depth", type=int, default=6)
+    bp.add_argument("--iters", type=int, default=10)
+    bp.add_argument("--partitions", type=int, default=1)
+    bp.add_argument("--hist-impl", default="auto")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "train":
+        X, y, n_classes = _load_dataset(args)
+        loss = args.loss or (
+            "softmax" if args.dataset == "covertype"
+            else "mse" if args.dataset == "regression" else "logloss"
+        )
+        cfg = TrainConfig(
+            n_trees=args.trees, max_depth=args.depth, n_bins=args.bins,
+            learning_rate=args.lr, loss=loss,
+            n_classes=n_classes if loss == "softmax" else 2,
+            backend=args.backend, n_partitions=args.partitions,
+            hist_impl=args.hist_impl, seed=args.seed,
+        )
+        t0 = time.perf_counter()
+        res = api.train(X, y, cfg, checkpoint_dir=args.checkpoint_dir)
+        dt = time.perf_counter() - t0
+        res.ensemble.save(args.out)
+        print(json.dumps({
+            "cmd": "train", "backend": args.backend, "rows": len(y),
+            "trees": cfg.n_trees, "depth": cfg.max_depth,
+            "wallclock_s": round(dt, 3),
+            "final_train_loss": res.history[-1]["train_loss"]
+            if res.history else None,
+            "model": args.out,
+        }))
+        return 0
+
+    if args.cmd == "predict":
+        X, y, _ = _load_dataset(args)
+        ens = TreeEnsemble.load(args.model)
+        cfg = TrainConfig(backend=args.backend, loss=ens.loss,
+                          n_classes=max(ens.n_classes, 2))
+        from ddt_tpu.data.quantizer import fit_bin_mapper
+
+        mapper = fit_bin_mapper(X, n_bins=args.bins, seed=args.seed)
+        t0 = time.perf_counter()
+        scores = api.predict(ens, X, mapper=mapper, cfg=cfg)
+        dt = time.perf_counter() - t0
+        if args.out:
+            np.save(args.out, scores)
+        print(json.dumps({
+            "cmd": "predict", "backend": args.backend, "rows": len(X),
+            "trees": ens.n_trees, "wallclock_s": round(dt, 3),
+            "rows_per_sec": round(len(X) / dt, 1),
+        }))
+        return 0
+
+    if args.cmd == "bench":
+        from ddt_tpu.bench import run_bench
+
+        out = run_bench(
+            kernel=args.kernel, backend=args.backend, rows=args.rows,
+            features=args.features, bins=args.bins, trees=args.trees,
+            depth=args.depth, iters=args.iters, partitions=args.partitions,
+            hist_impl=args.hist_impl, seed=args.seed,
+        )
+        print(json.dumps(out))
+        return 0
+
+    return 2  # pragma: no cover
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,8 +44,10 @@ class TrainConfig:
     seed: int = 0
 
     # --- numerics ---
-    hist_dtype: str = "float32"     # accumulator dtype for histograms
-    matmul_input_dtype: str = "bfloat16"  # one-hot matmul input dtype on TPU
+    # Histogram accumulators are always float32 (preferred_element_type on the
+    # MXU); this knob controls the one-hot matmul INPUT dtype — bfloat16 rides
+    # the systolic array at full rate, float32 forces exact accumulation.
+    matmul_input_dtype: str = "bfloat16"
 
     def __post_init__(self) -> None:
         if self.loss not in LOSSES:

@@ -1,0 +1,142 @@
+"""Driver: the host-side tree-construction / boosting loop (layer L5).
+
+The reference's `Driver` grows trees level-by-level against a `DeviceBackend`
+and is explicitly "unchanged above the operator layer" when backends swap
+[BASELINE]. This Driver is that loop, shaped for TPU dispatch economics
+(SURVEY.md §3 call stack):
+
+    for round in 1..n_trees:                      (sequential, host)
+      g, h = backend.grad_hess(pred, y)           (device, fused elementwise)
+      for c in classes:                           (1 for binary/mse)
+        tree, delta = backend.grow_tree(data, g_c, h_c)   (ONE device dispatch:
+              histograms → [psum over mesh] → gains → splits → row routing,
+              all levels)
+        pred = backend.apply_delta(pred, delta, c)
+      ensemble[t] = tree                          (≈KBs to host)
+
+Boosting state (`pred`) is an opaque backend handle — on TPUDevice it lives
+sharded on device for the whole run; the Driver never sees a float of it.
+
+Observability (SURVEY.md §5): structured per-round log records (train loss,
+ms/tree) via `logging`, collected in `Driver.history`. Checkpoint/resume
+(SURVEY.md §5): pass `checkpoint_dir` — after every `checkpoint_every` rounds
+the partial ensemble + cursor is written; `fit` resumes from the cursor if a
+checkpoint exists (utils/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from ddt_tpu.backends.base import DeviceBackend
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
+from ddt_tpu.reference.numpy_trainer import base_score
+
+log = logging.getLogger("ddt_tpu.driver")
+
+
+class Driver:
+    """Backend-agnostic boosting driver (the L5→L4 contract consumer)."""
+
+    def __init__(
+        self,
+        backend: DeviceBackend,
+        cfg: TrainConfig,
+        log_every: int = 10,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 25,
+    ):
+        self.backend = backend
+        self.cfg = cfg
+        self.log_every = log_every
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        self.history: list[dict] = []
+
+    def fit(self, Xb: np.ndarray, y: np.ndarray) -> TreeEnsemble:
+        """Train on binned uint8 data. Returns the grown ensemble."""
+        cfg = self.cfg
+        R, F = Xb.shape
+        if Xb.dtype != np.uint8:
+            raise TypeError(f"Xb must be uint8 binned data, got {Xb.dtype}")
+        C = cfg.n_classes if cfg.loss == "softmax" else 1
+        bs = base_score(np.asarray(y), cfg.loss, cfg.n_classes)
+
+        data = self.backend.upload(Xb)
+        y_dev = self.backend.upload_labels(np.asarray(y))
+        pred = self.backend.init_pred(y_dev, bs)
+
+        ens = empty_ensemble(
+            cfg.n_trees * C, cfg.max_depth, F, cfg.learning_rate, bs,
+            cfg.loss, cfg.n_classes,
+        )
+
+        start_round = 0
+        if self.checkpoint_dir is not None:
+            from ddt_tpu.utils.checkpoint import try_resume
+
+            start_round = try_resume(self.checkpoint_dir, ens, cfg)
+            if start_round > 0:
+                # Reconstitute boosting state by rescoring the partial
+                # ensemble (deterministic: trees fix the leaf of every row).
+                import dataclasses
+
+                k = start_round * C
+                part = dataclasses.replace(
+                    ens,
+                    feature=ens.feature[:k],
+                    threshold_bin=ens.threshold_bin[:k],
+                    is_leaf=ens.is_leaf[:k],
+                    leaf_value=ens.leaf_value[:k],
+                )
+                pred = self.backend.load_pred(
+                    np.asarray(part.predict_raw(Xb, binned=True))
+                )
+                log.info("resumed from checkpoint at round %d", start_round)
+
+        t_out = start_round * C
+        for rnd in range(start_round, cfg.n_trees):
+            t0 = time.perf_counter()
+            g, h = self.backend.grad_hess(pred, y_dev)
+            for c in range(C):
+                gc = g[:, c] if C > 1 else g
+                hc = h[:, c] if C > 1 else h
+                tree, delta = self.backend.grow_tree(data, gc, hc)
+                pred = self.backend.apply_delta(pred, delta, c)
+                ens.feature[t_out] = tree["feature"]
+                ens.threshold_bin[t_out] = tree["threshold_bin"]
+                ens.is_leaf[t_out] = tree["is_leaf"]
+                ens.leaf_value[t_out] = tree["leaf_value"]
+                t_out += 1
+            dt = time.perf_counter() - t0
+
+            if (rnd + 1) % self.log_every == 0 or rnd == cfg.n_trees - 1:
+                loss = self.backend.loss_value(pred, y_dev)
+                rec = {
+                    "round": rnd + 1,
+                    "train_loss": loss,
+                    "ms_per_round": dt * 1e3,
+                }
+                self.history.append(rec)
+                log.info(
+                    "round %4d/%d  loss=%.6f  %.1f ms/round",
+                    rnd + 1, cfg.n_trees, loss, dt * 1e3,
+                )
+
+            if (
+                self.checkpoint_dir is not None
+                and (rnd + 1) % self.checkpoint_every == 0
+            ):
+                from ddt_tpu.utils.checkpoint import save_checkpoint
+
+                save_checkpoint(self.checkpoint_dir, ens, cfg, rnd + 1)
+
+        if self.checkpoint_dir is not None:
+            from ddt_tpu.utils.checkpoint import save_checkpoint
+
+            save_checkpoint(self.checkpoint_dir, ens, cfg, cfg.n_trees)
+        return ens

@@ -55,11 +55,20 @@ def best_splits(
     valid = (HL >= min_child_weight) & (HR >= min_child_weight)
     valid = valid & (jnp.arange(B) < B - 1)[None, None, :]
     valid = valid & ~jnp.isnan(gain)                # 0/0 when reg_lambda == 0
-    gain = jnp.where(valid, gain, -jnp.inf).astype(jnp.float32)
+    # Deterministic split selection: round gains to bfloat16 before argmax.
+    # Gains within float noise of each other (different cumsum algorithms,
+    # psum accumulation order across partitions, NumPy-vs-XLA rounding)
+    # collapse to EXACT ties, broken by the shared first-flattened-index rule
+    # — so every backend and every partition count picks identical splits.
+    # Selecting among candidates within bf16 resolution (~0.4%) of the max is
+    # immaterial to model quality; decision stability across devices is not.
+    gain = jnp.where(valid, gain, -jnp.inf).astype(jnp.bfloat16)
 
     flat = gain.reshape(n_nodes, F * B)
     best = jnp.argmax(flat, axis=1)
-    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0].astype(
+        jnp.float32
+    )
     return (
         best_gain,
         (best // B).astype(jnp.int32),

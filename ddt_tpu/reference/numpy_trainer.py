@@ -21,6 +21,7 @@ still differ; tests use small data where argmax ties are improbable).
 
 from __future__ import annotations
 
+import ml_dtypes  # ships with jax; used for the bf16 deterministic tie-break
 import numpy as np
 
 from ddt_tpu.config import TrainConfig
@@ -125,10 +126,13 @@ def best_splits(
     valid[:, :, B - 1] = False                 # cannot split on last bin
     # 0/0 with reg_lambda=0 yields NaN; NaN would win np.argmax — mask it.
     valid &= ~np.isnan(gain)
-    gain = np.where(valid, gain, -np.inf).astype(np.float32)
+    # Deterministic selection (see ops/split.py): bf16-rounded gains turn
+    # float-noise near-ties into exact ties with a shared first-index
+    # tie-break, so CPU/TPU/any-partition-count all pick identical splits.
+    gain = np.where(valid, gain, -np.inf).astype(ml_dtypes.bfloat16)
     flat = gain.reshape(n_nodes, F * B)
     best = np.argmax(flat, axis=1)
-    best_gain = flat[np.arange(n_nodes), best]
+    best_gain = flat[np.arange(n_nodes), best].astype(np.float32)
     return best_gain, (best // B).astype(np.int32), (best % B).astype(np.int32)
 
 

@@ -1,0 +1,89 @@
+"""Checkpoint/resume (SURVEY.md §5): per-tree restartable training.
+
+A GBDT ensemble is tiny (KBs–MBs of node arrays), so checkpointing is simply:
+after every K boosting rounds, atomically write the partial ensemble + a
+cursor (completed rounds, config fingerprint). Resume = load node arrays into
+the pre-allocated ensemble, rescore the partial ensemble to rebuild the
+boosting state (Driver does that part), and continue the loop. Exactly
+restartable because training is deterministic given the binned data
+(SURVEY.md §5 "checkpoint/resume"); the fault-injection test kills a training
+process mid-run and verifies the resumed ensemble matches an uninterrupted
+one (tests/test_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.models.tree import TreeEnsemble
+
+CKPT_FILE = "ensemble.npz"
+CURSOR_FILE = "cursor.json"
+
+
+def _cfg_fingerprint(cfg: TrainConfig) -> dict:
+    """The config fields that must match for a checkpoint to be resumable."""
+    d = dataclasses.asdict(cfg)
+    # System knobs may legitimately differ across resume (e.g. resume on a
+    # different partition count — distribution never changes results), and
+    # n_trees may grow (resuming to train further is the point of resuming).
+    for k in ("n_trees", "n_partitions", "hist_impl", "backend",
+              "matmul_input_dtype"):
+        d.pop(k, None)
+    return d
+
+
+def save_checkpoint(
+    ckpt_dir: str, ens: TreeEnsemble, cfg: TrainConfig, completed_rounds: int
+) -> None:
+    """Atomically persist the ensemble + cursor after `completed_rounds`."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    tmp = os.path.join(ckpt_dir, CKPT_FILE + ".tmp.npz")
+    final = os.path.join(ckpt_dir, CKPT_FILE)
+    np.savez_compressed(tmp, **ens.to_dict())
+    os.replace(tmp, final)
+    cur = {
+        "completed_rounds": int(completed_rounds),
+        "config": _cfg_fingerprint(cfg),
+    }
+    tmp_c = os.path.join(ckpt_dir, CURSOR_FILE + ".tmp")
+    with open(tmp_c, "w") as f:
+        json.dump(cur, f)
+    os.replace(tmp_c, os.path.join(ckpt_dir, CURSOR_FILE))
+
+
+def try_resume(ckpt_dir: str, ens: TreeEnsemble, cfg: TrainConfig) -> int:
+    """Load a checkpoint into `ens` (in place). Returns completed rounds
+    (0 = nothing to resume). Raises if the checkpoint's config is
+    incompatible — resuming a different run would corrupt it silently."""
+    cursor_path = os.path.join(ckpt_dir, CURSOR_FILE)
+    ckpt_path = os.path.join(ckpt_dir, CKPT_FILE)
+    if not (os.path.exists(cursor_path) and os.path.exists(ckpt_path)):
+        return 0
+    with open(cursor_path) as f:
+        cur = json.load(f)
+    if cur["config"] != _cfg_fingerprint(cfg):
+        raise ValueError(
+            f"checkpoint at {ckpt_dir} was written by an incompatible config; "
+            "refusing to resume. Delete the directory to start fresh."
+        )
+    saved = TreeEnsemble.load(ckpt_path)
+    rounds = int(cur["completed_rounds"])
+    if rounds > cfg.n_trees:
+        raise ValueError(
+            f"checkpoint at {ckpt_dir} has {rounds} completed rounds but "
+            f"cfg.n_trees={cfg.n_trees}; raise n_trees to resume (a finished "
+            "checkpoint cannot be shrunk in place)."
+        )
+    C = cfg.n_classes if cfg.loss == "softmax" else 1
+    k = rounds * C
+    ens.feature[:k] = saved.feature[:k]
+    ens.threshold_bin[:k] = saved.threshold_bin[:k]
+    ens.is_leaf[:k] = saved.is_leaf[:k]
+    ens.leaf_value[:k] = saved.leaf_value[:k]
+    return rounds

@@ -2,19 +2,29 @@
 
 Distributed tests run single-process multi-device on CPU (SURVEY.md §4
 "Distributed without a cluster"): 8 virtual XLA CPU devices via
---xla_force_host_platform_device_count. Must be set before jax imports.
+--xla_force_host_platform_device_count.
+
+CAVEAT: this image's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon already bound, so setting os.environ["JAX_PLATFORMS"] here
+is too late — the value was frozen into jax.config at sitecustomize time. The
+working override is jax.config.update("jax_platforms", ...). XLA_FLAGS, by
+contrast, is only read when the CPU client is first instantiated, so mutating
+the env before the first jax.devices() call still works.
 """
 
 import os
 import sys
 
-# Force CPU: the ambient environment pins JAX_PLATFORMS=axon (the real TPU
-# tunnel); the test suite needs the 8-virtual-device CPU mesh instead.
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

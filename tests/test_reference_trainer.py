@@ -61,7 +61,9 @@ def test_split_gain_hand_computed():
     g0 = 0.5 * (16 / 3 + 16 / 4 - parent)
     g1 = 0.5 * (9 / 4 + 9 / 3 - parent)
     assert g0 > g1
-    np.testing.assert_allclose(gains[0], g0, rtol=1e-6)
+    # Returned gain is bf16-rounded (deterministic selection, see
+    # ops/split.py) — compare at bf16 resolution.
+    np.testing.assert_allclose(gains[0], g0, rtol=1 / 128)
     assert feats[0] == 0 and bins[0] == 0
 
 
