@@ -1,0 +1,55 @@
+"""Headline benchmark: HistogramBuilder throughput vs the CPU reference.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric (BASELINE.json): Higgs-1M-shaped histogram build, M-rows/sec/chip —
+1M rows x 28 features x 255 bins x 32 nodes (the widest level of the depth-6
+config, which dominates training time). vs_baseline is the ratio to the CPU
+reference kernel's throughput measured on this same machine (BASELINE.md: the
+reference published no numbers; its CPU-reference comparison is the defined
+baseline, north-star target >= 5x).
+
+Runs on whatever platform jax defaults to (the real TPU chip under the
+driver). The CPU reference uses the native C++ kernel when built, else NumPy
+np.add.at — the stronger (faster) of the two is the honest baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    from ddt_tpu.bench import bench_histogram
+
+    rows, features, bins, n_nodes = 1_000_000, 28, 255, 32
+
+    tpu = bench_histogram(
+        backend="tpu", rows=rows, features=features, bins=bins,
+        n_nodes=n_nodes, iters=10,
+    )
+
+    # CPU reference baseline: fewer rows (np.add.at is slow; throughput is
+    # row-linear at this shape), normalised to M-rows/sec.
+    cpu = bench_histogram(
+        backend="cpu", rows=200_000, features=features, bins=bins,
+        n_nodes=n_nodes, iters=2,
+    )
+
+    value = tpu["mrows_per_sec_per_chip"]
+    baseline = cpu["mrows_per_sec_per_chip"]
+    print(json.dumps({
+        "metric": "higgs1m_histogram_throughput",
+        "value": round(value, 2),
+        "unit": "Mrows/s/chip",
+        "vs_baseline": round(value / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -136,12 +136,13 @@ class TPUDevice(DeviceBackend):
     @functools.cached_property
     def _hist_fn(self):
         cfg = self.cfg
-        impl = hist_ops.resolve_hist_impl(cfg.hist_impl)
 
         def hist(Xb, g, h, node_index, *, n_nodes):
+            # impl resolution happens inside build_histograms with the full
+            # shape (pallas only when its VMEM working set fits).
             out = hist_ops.build_histograms(
                 Xb, g, h, node_index, n_nodes, cfg.n_bins,
-                impl=impl, input_dtype=self._input_dtype,
+                impl=cfg.hist_impl, input_dtype=self._input_dtype,
             )
             if self.distributed:
                 out = jax.lax.psum(out, AXIS)  # the fabric-allreduce analog
@@ -222,7 +223,6 @@ class TPUDevice(DeviceBackend):
     @functools.cached_property
     def _grow_fn(self):
         cfg = self.cfg
-        impl = hist_ops.resolve_hist_impl(cfg.hist_impl)
         axis = AXIS if self.distributed else None
 
         def grow(Xb, g, h):
@@ -233,7 +233,7 @@ class TPUDevice(DeviceBackend):
                 reg_lambda=cfg.reg_lambda,
                 min_child_weight=cfg.min_child_weight,
                 min_split_gain=cfg.min_split_gain,
-                hist_impl=impl,
+                hist_impl=cfg.hist_impl,   # per-level shape-aware resolution
                 input_dtype=self._input_dtype,
                 axis_name=axis,
             )
@@ -312,11 +312,30 @@ class TPUDevice(DeviceBackend):
         thr = jax.device_put(ens.threshold_bin.astype(np.int32), self._sharding())
         leaf = jax.device_put(ens.is_leaf, self._sharding())
         val = jax.device_put(ens.leaf_value, self._sharding())
-        out = predict_ops.predict_raw(
-            feat, thr, leaf, val, Xc,
+        fn = functools.partial(
+            predict_ops.predict_raw,
             max_depth=ens.max_depth,
             learning_rate=ens.learning_rate,
             base=ens.base_score,
             n_classes=C,
         )
+        if self.distributed:
+            # Row-sharded scoring is embarrassingly parallel: trees are
+            # replicated, each shard traverses its own rows, no collectives
+            # (SURVEY.md §3 predict stack). shard_map makes the row-gather
+            # sharding explicit — XLA cannot infer it through the
+            # take_along_axis traversal.
+            out_spec = P(AXIS) if C == 1 else P(AXIS, None)
+            fn = jax.shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(P(), P(), P(), P(), P(AXIS, None)),
+                out_specs=out_spec,
+                # predict_raw's scan carry starts replicated (zeros) and
+                # becomes row-varying after the first accumulation; the
+                # static VMA checker rejects that even though it is sound
+                # here (no collectives anywhere in the traversal).
+                check_vma=False,
+            )
+        out = fn(feat, thr, leaf, val, Xc)
         return np.asarray(out)[:R]

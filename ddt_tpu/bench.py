@@ -54,17 +54,17 @@ def bench_histogram(
 
     data = be.upload(Xb)
     if backend == "tpu":
-        import jax
+        from ddt_tpu.utils.device import device_sync as sync
 
         g_d = be._put_rows(g)
         h_d = be._put_rows(h)
         ni_d = be._put_rows(node_index)
         out = be.build_histograms(data, g_d, h_d, ni_d, n_nodes)
-        jax.block_until_ready(out)          # warm-up: compile + first run
+        sync(out)                           # warm-up: compile + first run
         t0 = time.perf_counter()
         for _ in range(iters):
             out = be.build_histograms(data, g_d, h_d, ni_d, n_nodes)
-        jax.block_until_ready(out)
+        sync(out)
         dt = (time.perf_counter() - t0) / iters
     else:
         be.build_histograms(data, g, h, node_index, n_nodes)  # warm caches
@@ -73,13 +73,21 @@ def bench_histogram(
             be.build_histograms(data, g, h, node_index, n_nodes)
         dt = (time.perf_counter() - t0) / iters
 
+    if backend == "tpu":
+        from ddt_tpu.ops.histogram import resolve_hist_impl
+
+        impl = resolve_hist_impl(
+            hist_impl, n_nodes=n_nodes, n_features=features, n_bins=bins
+        )
+    else:
+        impl = "native-c++" if getattr(be, "_native", None) else "numpy"
+
     n_chips = max(1, partitions)
     mrows = rows / dt / 1e6 / n_chips
     return {
         "kernel": "histogram",
         "backend": backend,
-        "impl": getattr(be, "_native", None) is not None
-        and "native-c++" or hist_impl,
+        "impl": impl,
         "rows": rows, "features": features, "bins": bins, "n_nodes": n_nodes,
         "iters": iters, "partitions": partitions,
         "sec_per_build": dt,

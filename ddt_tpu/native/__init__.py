@@ -1,0 +1,114 @@
+"""ctypes bindings for the native CPU reference kernels (libddthist.so).
+
+The reference pairs its device kernels with a compiled CPU reference
+implementation [BASELINE]; this package is ours — C++ with OpenMP, built by
+`make -C ddt_tpu/native` (no pybind11: plain ctypes over an extern-C ABI, per
+the environment's binding constraints). On import: load the shared library,
+building it on the fly if the toolchain is present; importers (backends/cpu.py)
+catch ImportError and fall back to the NumPy oracle kernels.
+
+Exports:
+    histogram_native(Xb, g, h, node_index, n_nodes, n_bins) -> np.ndarray
+    traverse_native(Xb, feature, thr_bin, is_leaf, max_depth) -> np.ndarray
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libddthist.so")
+
+
+def _load() -> ctypes.CDLL:
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(
+                ["make", "-C", _DIR, "-s"], check=True,
+                capture_output=True, timeout=120,
+            )
+        except Exception as e:  # toolchain missing / build broke
+            raise ImportError(f"native kernel build failed: {e}") from e
+    return ctypes.CDLL(_SO)
+
+
+_lib = _load()
+
+_lib.ddt_build_histograms.argtypes = [
+    ctypes.POINTER(ctypes.c_uint8),   # Xb
+    ctypes.POINTER(ctypes.c_float),   # g
+    ctypes.POINTER(ctypes.c_float),   # h
+    ctypes.POINTER(ctypes.c_int32),   # node_index
+    ctypes.c_int64,                   # R
+    ctypes.c_int64,                   # F
+    ctypes.c_int32,                   # n_nodes
+    ctypes.c_int32,                   # n_bins
+    ctypes.POINTER(ctypes.c_float),   # out
+]
+_lib.ddt_build_histograms.restype = None
+
+_lib.ddt_traverse.argtypes = [
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+    ctypes.c_int32,
+    ctypes.POINTER(ctypes.c_int32),
+]
+_lib.ddt_traverse.restype = None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def histogram_native(
+    Xb: np.ndarray,
+    g: np.ndarray,
+    h: np.ndarray,
+    node_index: np.ndarray,
+    n_nodes: int,
+    n_bins: int,
+) -> np.ndarray:
+    """C++ HistogramBuilder; contract of numpy_trainer.build_histograms."""
+    R, F = Xb.shape
+    Xb = np.ascontiguousarray(Xb, np.uint8)
+    g = np.ascontiguousarray(g, np.float32)
+    h = np.ascontiguousarray(h, np.float32)
+    node_index = np.ascontiguousarray(node_index, np.int32)
+    out = np.zeros((n_nodes, F, n_bins, 2), np.float32)
+    _lib.ddt_build_histograms(
+        _ptr(Xb, ctypes.c_uint8), _ptr(g, ctypes.c_float),
+        _ptr(h, ctypes.c_float), _ptr(node_index, ctypes.c_int32),
+        R, F, n_nodes, n_bins, _ptr(out, ctypes.c_float),
+    )
+    return out
+
+
+def traverse_native(
+    Xb: np.ndarray,
+    feature: np.ndarray,
+    thr_bin: np.ndarray,
+    is_leaf: np.ndarray,
+    max_depth: int,
+) -> np.ndarray:
+    """C++ batch tree traversal: leaf heap-slot per (tree, row), int32 [T, R].
+    """
+    R, F = Xb.shape
+    T, N = feature.shape
+    Xb = np.ascontiguousarray(Xb, np.uint8)
+    feature = np.ascontiguousarray(feature, np.int32)
+    thr_bin = np.ascontiguousarray(thr_bin, np.int32)
+    leaf8 = np.ascontiguousarray(is_leaf, np.uint8)
+    out = np.empty((T, R), np.int32)
+    _lib.ddt_traverse(
+        _ptr(Xb, ctypes.c_uint8), _ptr(feature, ctypes.c_int32),
+        _ptr(thr_bin, ctypes.c_int32), _ptr(leaf8, ctypes.c_uint8),
+        R, F, T, N, max_depth, _ptr(out, ctypes.c_int32),
+    )
+    return out

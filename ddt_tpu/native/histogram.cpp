@@ -1,0 +1,138 @@
+// Native CPU reference kernels for the histogram-GBDT hot loop.
+//
+// The reference ships a compiled CPU implementation of the HistogramBuilder
+// and compares device throughput against it (BASELINE.md: ">=5x the repo's
+// CPU-reference histogram throughput"). A NumPy np.add.at baseline would be
+// dishonestly slow (~1 Mrows/s); this kernel is the real CPU contender the
+// TPU path must beat. Built by ddt_tpu/native/Makefile into libddthist.so,
+// loaded via ctypes (ddt_tpu/native/__init__.py) — no pybind11 dependency.
+//
+// Contract identical to ddt_tpu/reference/numpy_trainer.build_histograms:
+//   out[node, f, bin, {0,1}] += (g, h) over rows with node_index >= 0.
+// out is float32 [n_nodes, F, n_bins, 2], zero-initialised by the caller.
+//
+// Parallelisation: rows are chunked across OpenMP threads, each thread
+// accumulates into a private histogram copy, then copies are reduced. With
+// OMP_NUM_THREADS=1 (or no OpenMP) it runs the plain serial loop with no
+// allocation overhead.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+void ddt_build_histograms(
+    const uint8_t* Xb,         // [R, F] row-major binned features
+    const float* g,            // [R]
+    const float* h,            // [R]
+    const int32_t* node_index, // [R], -1 = frozen (skip row)
+    int64_t R,
+    int64_t F,
+    int32_t n_nodes,
+    int32_t n_bins,
+    float* out                 // [n_nodes, F, n_bins, 2], pre-zeroed
+) {
+    const int64_t node_stride = F * (int64_t)n_bins * 2;
+
+#ifdef _OPENMP
+    int n_threads = omp_get_max_threads();
+#else
+    int n_threads = 1;
+#endif
+
+    if (n_threads <= 1) {
+        for (int64_t r = 0; r < R; ++r) {
+            const int32_t n = node_index[r];
+            if (n < 0) continue;
+            const float gr = g[r];
+            const float hr = h[r];
+            const uint8_t* row = Xb + r * F;
+            float* base = out + (int64_t)n * node_stride;
+            for (int64_t f = 0; f < F; ++f) {
+                float* cell = base + (f * n_bins + row[f]) * 2;
+                cell[0] += gr;
+                cell[1] += hr;
+            }
+        }
+        return;
+    }
+
+#ifdef _OPENMP
+    const int64_t total = (int64_t)n_nodes * node_stride;
+    std::vector<std::vector<float>> privs(n_threads);
+
+#pragma omp parallel
+    {
+        const int t = omp_get_thread_num();
+        privs[t].assign(total, 0.0f);
+        float* priv = privs[t].data();
+
+#pragma omp for schedule(static)
+        for (int64_t r = 0; r < R; ++r) {
+            const int32_t n = node_index[r];
+            if (n < 0) continue;
+            const float gr = g[r];
+            const float hr = h[r];
+            const uint8_t* row = Xb + r * F;
+            float* base = priv + (int64_t)n * node_stride;
+            for (int64_t f = 0; f < F; ++f) {
+                float* cell = base + (f * n_bins + row[f]) * 2;
+                cell[0] += gr;
+                cell[1] += hr;
+            }
+        }
+
+        // Tree-free reduction: each thread owns a disjoint slice of `out`
+        // and sums all private copies into it.
+#pragma omp for schedule(static)
+        for (int64_t i = 0; i < total; ++i) {
+            float acc = 0.0f;
+            for (int tt = 0; tt < n_threads; ++tt) acc += privs[tt][i];
+            out[i] += acc;
+        }
+    }
+#endif
+}
+
+// Batch ensemble traversal (CPU reference of the gather+compare predict
+// path): complete-heap trees, node <- 2*node+1+(x > thr) unless leaf.
+// leaf_out is int32 [T, R] heap slots.
+void ddt_traverse(
+    const uint8_t* Xb,          // [R, F] binned rows
+    const int32_t* feature,     // [T, N] split feature (-1 on leaves)
+    const int32_t* thr_bin,     // [T, N]
+    const uint8_t* is_leaf,     // [T, N]
+    int64_t R,
+    int64_t F,
+    int64_t T,
+    int64_t N,
+    int32_t max_depth,
+    int32_t* leaf_out           // [T, R]
+) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t t = 0; t < T; ++t) {
+        const int32_t* feat_t = feature + t * N;
+        const int32_t* thr_t = thr_bin + t * N;
+        const uint8_t* leaf_t = is_leaf + t * N;
+        int32_t* out_t = leaf_out + t * R;
+        for (int64_t r = 0; r < R; ++r) {
+            const uint8_t* row = Xb + r * F;
+            int32_t node = 0;
+            for (int32_t d = 0; d < max_depth; ++d) {
+                if (leaf_t[node]) break;
+                const int32_t f = feat_t[node];
+                node = 2 * node + 1 + (row[f] > thr_t[node] ? 1 : 0);
+            }
+            out_t[r] = node;
+        }
+    }
+}
+
+}  // extern "C"

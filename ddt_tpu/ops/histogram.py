@@ -10,19 +10,21 @@ Xb [R, F], gradients g/h [R] float32 and a per-row level-local node index
 TPU realisation — XLA hates random-access scatter, so three interchangeable
 implementations (SURVEY.md §7 "hard parts (a)"):
 
+- "pallas": tiled VMEM kernel (ops/hist_pallas.py) that builds the bin
+  one-hot tile-by-tile in VMEM and feeds one dot_general per tile to the MXU
+  — nothing but Xb and the output ever touches HBM. The TPU default for
+  shapes whose working set fits VMEM (hist_pallas.pallas_fits); measured
+  ~2x the matmul path on v5e at the Higgs-1M shape (43-57 Mrows/s across
+  tile/row configs vs ~26).
 - "matmul": one-hot outer-product accumulation on the MXU. Per feature f the
   histogram is A^T @ Bf where A [R, 2N] stacks node-one-hot weighted by g and
   by h, and Bf [R, B] is the bin one-hot. Chunked over rows with lax.scan so
-  the one-hot never materialises more than `row_chunk` rows in HBM. This is
-  the TPU default: the FLOPs land on the systolic array, bf16 inputs with
-  float32 accumulation (`preferred_element_type`).
+  the one-hot never materialises more than `row_chunk` rows at once — but XLA
+  still round-trips it through HBM, which bounds throughput (~29 GB/build at
+  the Higgs-1M shape). The TPU fallback for shapes too large for the Pallas
+  kernel's VMEM accumulator, and the non-TPU accelerator default.
 - "segment": `jax.ops.segment_sum` over combined (node*B + bin) keys, vmapped
-  over features. Lowers to scatter-add; the fast path on CPU, the fallback on
-  TPU.
-- "pallas": tiled VMEM kernel (ops/hist_pallas.py) that fuses one-hot
-  construction into the matmul so nothing but Xb and the output ever touches
-  HBM. Opt-in via hist_impl="pallas"; "auto" picks matmul on TPU until the
-  bench shows pallas winning across shapes.
+  over features. Lowers to scatter-add; the fast path on CPU, slow on TPU.
 
 All return bit-identical shapes and (up to float addition order) the same
 values; parity vs the NumPy oracle is tests/test_ops.py.
@@ -170,15 +172,35 @@ def build_histograms_matmul(
 # dispatch
 # --------------------------------------------------------------------------- #
 
-def resolve_hist_impl(hist_impl: str, platform: str | None = None) -> str:
-    """'auto' -> the right implementation for the platform."""
+def resolve_hist_impl(
+    hist_impl: str,
+    platform: str | None = None,
+    n_nodes: int | None = None,
+    n_features: int | None = None,
+    n_bins: int | None = None,
+) -> str:
+    """'auto' -> the right implementation for the platform (and shape).
+
+    CPU: segment (scatter is fine there). TPU: the Pallas VMEM kernel when
+    the shape fits its accumulator budget (hist_pallas.pallas_fits), else the
+    chunked matmul. Other accelerators: matmul (the Pallas kernel is
+    TPU-only; off-TPU it would silently run interpreted, orders of magnitude
+    slower). Shape args omitted -> optimistic TPU answer ("pallas").
+    """
     if hist_impl != "auto":
         return hist_impl
     if platform is None:
         platform = jax.default_backend()
-    # Scatter is fine on CPU; MXU matmul wins on TPU. Pallas opted into
-    # explicitly until it beats matmul across shapes (bench decides).
-    return "segment" if platform == "cpu" else "matmul"
+    if platform == "cpu":
+        return "segment"
+    if platform != "tpu":
+        return "matmul"
+    if n_nodes is not None and n_features is not None and n_bins is not None:
+        from ddt_tpu.ops.hist_pallas import pallas_fits
+
+        if not pallas_fits(n_nodes, n_features, n_bins):
+            return "matmul"
+    return "pallas"
 
 
 def build_histograms(
@@ -193,7 +215,9 @@ def build_histograms(
     input_dtype: jnp.dtype = jnp.bfloat16,
 ) -> jax.Array:
     """Dispatching HistogramBuilder; see module docstring for impls."""
-    impl = resolve_hist_impl(impl)
+    impl = resolve_hist_impl(
+        impl, n_nodes=n_nodes, n_features=Xb.shape[1], n_bins=n_bins
+    )
     if impl == "segment":
         return build_histograms_segment(Xb, g, h, node_index, n_nodes, n_bins)
     if impl == "matmul":
@@ -203,5 +227,7 @@ def build_histograms(
         )
     if impl == "pallas":
         from ddt_tpu.ops.hist_pallas import build_histograms_pallas
-        return build_histograms_pallas(Xb, g, h, node_index, n_nodes, n_bins)
+        return build_histograms_pallas(
+            Xb, g, h, node_index, n_nodes, n_bins, input_dtype=input_dtype
+        )
     raise ValueError(f"unknown hist impl {impl!r}")

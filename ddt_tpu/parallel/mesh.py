@@ -1,0 +1,79 @@
+"""Mesh construction + multi-host initialisation (layers L2/L0 plumbing).
+
+SURVEY.md §5 "Distributed communication backend": the reference's on-FPGA
+100G fabric allreduce maps to XLA collectives over mesh axes — psum rides ICI
+within a slice; a second ("hosts") axis rides DCN across slices. A GBDT
+histogram is KBs–MBs and additive, so the same single psum works over a 1-D
+flattened mesh too; the 2-D constructor exists so multi-slice pods lay the
+reduce-scatter/all-reduce phases out along the fast axis first (XLA does this
+automatically for a 2-D mesh when axes are ordered (hosts, rows)).
+
+Multi-host: standard single-controller JAX — every host runs the same
+program, jax.distributed.initialize() wires the DCN bootstrap, and
+jax.devices() becomes the global device list. Training code is unchanged:
+TPUDevice row-shards over the global mesh and the Driver loop never knows.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+log = logging.getLogger("ddt_tpu.parallel")
+
+ROWS_AXIS = "rows"
+HOSTS_AXIS = "hosts"
+
+
+def make_row_mesh(
+    n_partitions: int, devices: list | None = None
+) -> jax.sharding.Mesh:
+    """1-D mesh over the data-parallel "rows" axis (the GBDT's only
+    parallelism dimension — SURVEY.md §2 "Parallelism strategies")."""
+    devs = devices if devices is not None else jax.devices()
+    if len(devs) < n_partitions:
+        raise ValueError(
+            f"n_partitions={n_partitions} but only {len(devs)} devices visible"
+        )
+    return jax.make_mesh((n_partitions,), (ROWS_AXIS,),
+                         devices=devs[:n_partitions])
+
+
+def make_pod_mesh(
+    n_hosts: int | None = None,
+    devices_per_host: int | None = None,
+) -> jax.sharding.Mesh:
+    """2-D (hosts, rows) mesh for multi-slice pods: "rows" is the intra-slice
+    ICI axis, "hosts" the cross-slice DCN axis. Histogram reduction becomes
+    psum over both axes; XLA phases it as ICI-reduce then DCN-allreduce."""
+    devs = jax.devices()
+    if n_hosts is None:
+        n_hosts = max(1, jax.process_count())
+    if devices_per_host is None:
+        devices_per_host = len(devs) // n_hosts
+    return jax.make_mesh(
+        (n_hosts, devices_per_host), (HOSTS_AXIS, ROWS_AXIS),
+    )
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """jax.distributed.initialize with arguments optional (TPU pods
+    auto-discover via the metadata service; explicit args for manual
+    bring-up). Safe to call once per process, before first device use."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    log.info(
+        "multihost initialised: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), len(jax.devices()),
+    )

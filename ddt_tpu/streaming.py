@@ -1,0 +1,240 @@
+"""Streaming trainer for datasets that don't fit in device (or host) memory.
+
+The 10B-row / 1024-feature stress config (BASELINE.json) cannot hold a binned
+matrix anywhere — 10 TB of uint8. SURVEY.md §5's "long axis" story: shard and
+STREAM the row axis with per-chunk histogram accumulation. Histograms are
+small (≤ MBs) and additive, so streaming needs no ring algorithms: per level,
+
+    hist = Σ_chunks build_histograms(chunk, g_chunk, h_chunk, node_of_row)
+
+with node_of_row recomputed per chunk by STATELESS traversal of the partial
+tree — a row's node at level d is fully determined by the tree grown so far,
+so no per-row state survives between chunks. Gradients are likewise stateless:
+pred of a row is the partial ensemble's score (optionally cached per chunk on
+host when it fits — cache_preds trades O(T²) rescoring for O(R) host RAM).
+
+The chunk source is a callable (chunk_idx) -> (Xb_chunk, y_chunk): pure, so
+any chunk can be regenerated on any host at any time (the deterministic
+synthetic generator data/datasets.stress_binned_chunk is one; a file-backed
+loader fits the same signature). Every chunk must have the same shape (pad
+the tail chunk). This trainer produces BIT-IDENTICAL trees to the in-memory
+Driver on the same data (tests/test_streaming.py) — the chunk sum enters the
+same bf16-rounded split selection (ops/split.py).
+
+Distribution composes: each chunk is row-sharded over the TPUDevice mesh like
+any other upload, so a v5e-64 pod streams 8 host-chunks in parallel while each
+chunk's histogram psum rides ICI (SURVEY.md §7 M6).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+import numpy as np
+
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
+from ddt_tpu.reference.numpy_trainer import grad_hess
+
+log = logging.getLogger("ddt_tpu.streaming")
+
+ChunkFn = Callable[[int], tuple[np.ndarray, np.ndarray]]
+
+
+def _traverse_partial(
+    Xb: np.ndarray,
+    feature: np.ndarray,
+    threshold_bin: np.ndarray,
+    is_leaf: np.ndarray,
+    depth: int,
+) -> np.ndarray:
+    """Stateless node assignment at `depth`: heap slot per row, or -1 when the
+    row froze at a leaf above this level. Mirrors the in-memory grow loop's
+    (node_id, frozen) evolution exactly."""
+    R = Xb.shape[0]
+    node = np.zeros(R, np.int64)
+    frozen = np.zeros(R, bool)
+    for d in range(depth):
+        live = ~frozen & ~is_leaf[node]
+        frozen |= is_leaf[node]
+        f = feature[node[live]]
+        go_right = Xb[live, f].astype(np.int64) > threshold_bin[node[live]]
+        node[live] = 2 * node[live] + 1 + go_right
+    offset = (1 << depth) - 1
+    out = (node - offset).astype(np.int32)
+    out[frozen] = -1
+    return out
+
+
+def fit_streaming(
+    chunk_fn: ChunkFn,
+    n_chunks: int,
+    cfg: TrainConfig,
+    backend=None,
+    cache_preds: bool = True,
+) -> TreeEnsemble:
+    """Train a GBDT over `n_chunks` streamed chunks (binary/mse losses).
+
+    backend=None uses the device histogram kernel via a fresh TPUDevice per
+    chunk shape; pass a CPUDevice to stream on host. Softmax streaming is the
+    same loop per class column — wired when a streaming multiclass config
+    exists ([BASELINE] lists only the binary stress config at this scale).
+    """
+    if cfg.loss == "softmax":
+        raise NotImplementedError(
+            "streaming softmax: no BASELINE config requires it yet"
+        )
+    if backend is None:
+        from ddt_tpu.backends import get_backend
+
+        backend = get_backend(cfg)
+
+    # Pass 0: base score from running label sums + shape discovery — no
+    # O(R) host state anywhere in this trainer except the optional preds
+    # cache (see below); at the 10B-row target everything else is O(chunk).
+    y_sum, y_cnt = 0.0, 0
+    chunk_lens = []
+    for c in range(n_chunks):
+        _, yc = chunk_fn(c)
+        y_sum += float(np.sum(yc))
+        y_cnt += len(yc)
+        chunk_lens.append(len(yc))
+    mean = y_sum / max(1, y_cnt)
+    if cfg.loss == "logloss":
+        p_ = float(np.clip(mean, 1e-6, 1 - 1e-6))
+        bs = float(np.log(p_ / (1 - p_)))
+    else:
+        bs = float(mean)
+    Xb0, _ = chunk_fn(0)
+    F = Xb0.shape[1]
+
+    ens = empty_ensemble(
+        cfg.n_trees, cfg.max_depth, F, cfg.learning_rate, bs,
+        cfg.loss, cfg.n_classes,
+    )
+
+    # The ONE optional O(R) structure: per-chunk cached raw scores (4 bytes/
+    # row). cache_preds=False recomputes scores from the partial ensemble
+    # instead (O(T) traversals per row per round) — choose by host RAM.
+    preds = (
+        [np.full(chunk_lens[c], bs, np.float32) for c in range(n_chunks)]
+        if cache_preds else None
+    )
+
+    for t in range(cfg.n_trees):
+        # Grow one tree level-by-level; histograms accumulate across chunks.
+        feature = np.full(cfg.n_nodes_total, -1, np.int32)
+        threshold_bin = np.zeros(cfg.n_nodes_total, np.int32)
+        is_leaf = np.zeros(cfg.n_nodes_total, bool)
+        leaf_value = np.zeros(cfg.n_nodes_total, np.float32)
+
+        def chunk_grads(c: int, Xc, yc):
+            pred_c = preds[c] if preds is not None else _rescore(
+                ens, t, Xc, bs
+            )
+            return grad_hess(pred_c, np.asarray(yc), cfg.loss)
+
+        for depth in range(cfg.max_depth):
+            n_level = 1 << depth
+            offset = n_level - 1
+            hist = None
+            for c in range(n_chunks):
+                Xc, yc = chunk_fn(c)
+                ni = _traverse_partial(
+                    Xc, feature, threshold_bin, is_leaf, depth
+                )
+                g, h = chunk_grads(c, Xc, yc)
+                data = backend.upload(Xc)
+                part = np.asarray(
+                    backend.build_histograms(data, g, h, ni, n_level)
+                )
+                hist = part if hist is None else hist + part
+            from ddt_tpu.reference.numpy_trainer import (
+                best_splits, node_totals,
+            )
+
+            G, H = node_totals(hist)
+            gains, feats, bins = best_splits(
+                hist, cfg.reg_lambda, cfg.min_child_weight
+            )
+            value = np.where(
+                H > 0, -G / (H + cfg.reg_lambda), 0.0
+            ).astype(np.float32)
+            do_split = (
+                (gains > cfg.min_split_gain) & np.isfinite(gains) & (H > 0)
+            )
+            for i in range(n_level):
+                slot = offset + i
+                if do_split[i]:
+                    feature[slot] = feats[i]
+                    threshold_bin[slot] = bins[i]
+                else:
+                    is_leaf[slot] = True
+                    leaf_value[slot] = value[i]
+
+        # Final level: per-terminal (G, H) aggregates streamed the same way.
+        n_last = 1 << cfg.max_depth
+        offset = n_last - 1
+        Gl = np.zeros(n_last, np.float32)
+        Hl = np.zeros(n_last, np.float32)
+        for c in range(n_chunks):
+            Xc, yc = chunk_fn(c)
+            ni = _traverse_partial(
+                Xc, feature, threshold_bin, is_leaf, cfg.max_depth
+            )
+            g, h = chunk_grads(c, Xc, yc)
+            act = ni >= 0
+            np.add.at(Gl, ni[act], g[act])
+            np.add.at(Hl, ni[act], h[act])
+        vals = np.where(Hl > 0, -Gl / (Hl + cfg.reg_lambda), 0.0)
+        is_leaf[offset:offset + n_last] = True
+        leaf_value[offset:offset + n_last] = vals.astype(np.float32)
+
+        ens.feature[t] = feature
+        ens.threshold_bin[t] = threshold_bin
+        ens.is_leaf[t] = is_leaf
+        ens.leaf_value[t] = leaf_value
+
+        if preds is not None:
+            # leaf slot per row = heap slot where traversal stopped: either
+            # offset+ni (made it to the last level) or the frozen leaf —
+            # rescore via the tree to keep it simple and exact.
+            for c in range(n_chunks):
+                Xc, _ = chunk_fn(c)
+                slot = _leaf_slot(
+                    Xc, feature, threshold_bin, is_leaf, cfg.max_depth
+                )
+                preds[c] += cfg.learning_rate * leaf_value[slot]
+
+        log.info("streaming: tree %d/%d done", t + 1, cfg.n_trees)
+
+    return ens
+
+
+def _leaf_slot(Xb, feature, threshold_bin, is_leaf, max_depth) -> np.ndarray:
+    """Heap slot where each row's traversal of one tree terminates."""
+    R = Xb.shape[0]
+    node = np.zeros(R, np.int64)
+    for _ in range(max_depth):
+        live = ~is_leaf[node]
+        f = feature[node[live]]
+        go_right = Xb[live, f].astype(np.int64) > threshold_bin[node[live]]
+        node[live] = 2 * node[live] + 1 + go_right
+    return node
+
+
+def _rescore(ens: TreeEnsemble, n_trees_done: int, Xb, bs) -> np.ndarray:
+    """Stateless pred of the first n_trees_done trees (cache_preds=False)."""
+    if n_trees_done == 0:
+        return np.full(Xb.shape[0], bs, np.float32)
+    import dataclasses
+
+    part = dataclasses.replace(
+        ens,
+        feature=ens.feature[:n_trees_done],
+        threshold_bin=ens.threshold_bin[:n_trees_done],
+        is_leaf=ens.is_leaf[:n_trees_done],
+        leaf_value=ens.leaf_value[:n_trees_done],
+    )
+    return part.predict_raw(Xb, binned=True).astype(np.float32)

@@ -1,0 +1,22 @@
+"""Device synchronisation helper.
+
+Measured on this image's tunneled TPU (v5e via the experimental "axon"
+platform): jax.block_until_ready() returned after 0.04 ms for a histogram
+build whose true device time is ~90 ms (verified by scalar readback — the
+same build measured 83–98 ms/iter when each iteration ended with
+float(jnp.sum(out))). The relay evidently acknowledges enqueue, not
+completion, so block_until_ready is NOT a barrier here. Every timing/sync
+point in this repo therefore funnels through device_sync(): a scalar-reduce
+readback, which cannot return before the producing program has executed.
+Device programs execute in submission order, so syncing on the last output
+of a sequence fences the whole sequence.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def device_sync(x) -> float:
+    """True device barrier: reduce `x` to a scalar and fetch it."""
+    return float(jnp.sum(x))

@@ -1,0 +1,86 @@
+"""CLI round-trips (layer L8) on the CPU platform."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ddt_tpu.cli import main
+
+
+def _run(capsys, argv):
+    rc = main(argv)
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1])
+
+
+def test_cli_train_predict_roundtrip(tmp_path, capsys):
+    model = str(tmp_path / "ens.npz")
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--dataset=higgs", "--rows=2000",
+        "--trees=4", "--depth=3", "--bins=31", f"--out={model}",
+    ])
+    assert rec["trees"] == 4 and rec["backend"] == "cpu"
+    assert rec["final_train_loss"] < 0.693  # below chance for logloss
+
+    scores = str(tmp_path / "scores.npy")
+    rec = _run(capsys, [
+        "predict", "--backend=cpu", f"--model={model}",
+        "--dataset=higgs", "--rows=500", "--bins=31", f"--out={scores}",
+    ])
+    assert rec["rows"] == 500
+    s = np.load(scores)
+    assert s.shape == (500,) and (0 <= s).all() and (s <= 1).all()
+
+
+def test_cli_train_tpu_backend_with_partitions(tmp_path, capsys):
+    """The [BASELINE] flag surface: same command, different --backend, and
+    a 4-partition run on the virtual device mesh."""
+    model = str(tmp_path / "ens.npz")
+    rec = _run(capsys, [
+        "train", "--backend=tpu", "--dataset=higgs", "--rows=2000",
+        "--trees=3", "--depth=3", "--bins=31", "--partitions=4",
+        f"--out={model}",
+    ])
+    assert rec["backend"] == "tpu"
+
+
+def test_cli_covertype_softmax(tmp_path, capsys):
+    model = str(tmp_path / "cov.npz")
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--dataset=covertype", "--rows=1500",
+        "--trees=2", "--depth=3", "--bins=31", f"--out={model}",
+    ])
+    from ddt_tpu.models.tree import TreeEnsemble
+
+    ens = TreeEnsemble.load(model)
+    assert ens.loss == "softmax" and ens.n_classes == 7
+    assert ens.n_trees == 2 * 7  # rounds x classes
+
+
+def test_cli_criteo_categoricals(tmp_path, capsys):
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--dataset=criteo", "--rows=2000",
+        "--trees=2", "--depth=3", "--bins=100",
+        f"--out={tmp_path / 'c.npz'}",
+    ])
+    assert rec["final_train_loss"] < 0.60  # ~25% CTR base rate entropy
+
+def test_cli_bench_histogram_cpu(capsys):
+    rec = _run(capsys, [
+        "bench", "--kernel=histogram", "--backend=cpu", "--rows=20000",
+        "--features=6", "--bins=31", "--iters=1",
+    ])
+    assert rec["kernel"] == "histogram"
+    assert rec["mrows_per_sec_per_chip"] > 0
+    assert rec["impl"] in ("native-c++", "numpy")
+
+
+def test_cli_fpga_backend_fails_loudly(tmp_path):
+    with pytest.raises(NotImplementedError, match="FPGA"):
+        main([
+            "train", "--backend=fpga", "--dataset=higgs", "--rows=100",
+            "--trees=1", "--depth=2", "--bins=15",
+            f"--out={tmp_path / 'x.npz'}",
+        ])

@@ -1,0 +1,104 @@
+"""Distributed-training invariants on the 8-virtual-device CPU mesh.
+
+SURVEY.md §4 "Distributed without a cluster": N-partition training (histogram
+psum over the mesh axis) must produce the SAME trees as 1-partition training —
+the allreduce is additively exact up to float ordering, and split selection is
+bf16-tie-break deterministic (ops/split.py), so distribution must not change
+results. This replaces the reference's multi-FPGA tests; the real-chip
+multi-host path compiles the identical program (driver dryrun_multichip).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ddt_tpu import api
+from ddt_tpu.backends import get_backend
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.data import datasets
+from ddt_tpu.data.quantizer import quantize
+from ddt_tpu.driver import Driver
+
+
+def _fit(n_partitions, Xb, y, **kw):
+    cfg = TrainConfig(
+        n_trees=4, max_depth=4, n_bins=31, backend="tpu",
+        n_partitions=n_partitions, **kw,
+    )
+    be = get_backend(cfg)
+    return Driver(be, cfg, log_every=10**9).fit(Xb, y)
+
+
+@pytest.mark.parametrize("n_partitions", [2, 4, 8])
+def test_partitioned_equals_single(n_partitions):
+    X, y = datasets.synthetic_binary(4096, n_features=10, seed=11)
+    Xb, _ = quantize(X, n_bins=31, seed=11)
+    e1 = _fit(1, Xb, y)
+    eN = _fit(n_partitions, Xb, y)
+    np.testing.assert_array_equal(e1.feature, eN.feature)
+    np.testing.assert_array_equal(e1.threshold_bin, eN.threshold_bin)
+    np.testing.assert_array_equal(e1.is_leaf, eN.is_leaf)
+    np.testing.assert_allclose(e1.leaf_value, eN.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_partitioned_rows_not_divisible():
+    """Row padding: R not a multiple of the partition count."""
+    X, y = datasets.synthetic_binary(4001, n_features=8, seed=5)
+    Xb, _ = quantize(X, n_bins=31, seed=5)
+    e1 = _fit(1, Xb, y)
+    e8 = _fit(8, Xb, y)
+    np.testing.assert_array_equal(e1.feature, e8.feature)
+    np.testing.assert_array_equal(e1.threshold_bin, e8.threshold_bin)
+
+
+def test_partitioned_softmax():
+    X, y = datasets.synthetic_multiclass(2000, n_features=12, seed=3)
+    Xb, _ = quantize(X, n_bins=31, seed=3)
+    e1 = _fit(1, Xb, y, loss="softmax", n_classes=7)
+    e4 = _fit(4, Xb, y, loss="softmax", n_classes=7)
+    np.testing.assert_array_equal(e1.feature, e4.feature)
+    np.testing.assert_array_equal(e1.threshold_bin, e4.threshold_bin)
+
+
+def test_distributed_histogram_is_global():
+    """The granular L4 kernel includes the cross-partition allreduce: the
+    sharded histogram equals the single-device histogram of all rows."""
+    from ddt_tpu.reference import numpy_trainer as ref
+
+    rng = np.random.default_rng(7)
+    R, F, B, N = 4096, 5, 16, 4
+    Xb = rng.integers(0, B, size=(R, F), dtype=np.uint8)
+    g = rng.standard_normal(R).astype(np.float32)
+    h = rng.random(R).astype(np.float32)
+    ni = rng.integers(-1, N, size=R).astype(np.int32)
+
+    cfg = TrainConfig(backend="tpu", n_bins=B, n_partitions=8)
+    be = get_backend(cfg)
+    data = be.upload(Xb)
+    got = np.asarray(be.build_histograms(data, g, h, ni, N))
+    want = ref.build_histograms(Xb, g, h, ni, N, B)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_mesh_uses_requested_devices():
+    cfg = TrainConfig(backend="tpu", n_partitions=8)
+    be = get_backend(cfg)
+    assert be.distributed
+    assert be.mesh.devices.size == 8
+    assert be.mesh.axis_names == ("rows",)
+    with pytest.raises(ValueError, match="devices"):
+        get_backend(TrainConfig(backend="tpu", n_partitions=16))
+
+
+def test_predict_raw_distributed():
+    """Row-sharded batch inference equals NumPy oracle scoring."""
+    X, y = datasets.synthetic_binary(3000, n_features=10, seed=2)
+    Xb, mapper = quantize(X, n_bins=31, seed=2)
+    res = api.train(Xb, y, binned=True, n_trees=6, max_depth=4, n_bins=31,
+                    backend="cpu", log_every=10**9)
+    cfg = TrainConfig(backend="tpu", n_partitions=8, n_bins=31)
+    be = get_backend(cfg)
+    got = be.predict_raw(res.ensemble, Xb)
+    want = res.ensemble.predict_raw(Xb, binned=True)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)

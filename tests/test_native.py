@@ -1,0 +1,68 @@
+"""Native C++ kernel parity vs the NumPy oracle (exact — same f32 op order).
+
+The native kernels are the honest CPU-reference baseline for the bench
+(BASELINE.md); skipped cleanly when the toolchain can't build them.
+"""
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip(
+    "ddt_tpu.native", reason="native kernels unavailable (no toolchain?)"
+)
+
+from ddt_tpu.reference import numpy_trainer as ref  # noqa: E402
+
+
+@pytest.mark.parametrize("R,F,B,N", [
+    (1000, 6, 31, 1),
+    (2048, 4, 255, 8),
+    (777, 3, 16, 32),     # odd row count
+])
+def test_native_histogram_exact(R, F, B, N):
+    rng = np.random.default_rng(1)
+    Xb = rng.integers(0, B, size=(R, F), dtype=np.uint8)
+    g = rng.standard_normal(R).astype(np.float32)
+    h = rng.random(R).astype(np.float32)
+    ni = rng.integers(-1, N, size=R).astype(np.int32)
+    want = ref.build_histograms(Xb, g, h, ni, N, B)
+    got = native.histogram_native(Xb, g, h, ni, N, B)
+    # Same accumulation order (row-major) → bit-exact.
+    np.testing.assert_array_equal(want, got)
+
+
+def test_native_traverse_matches_ensemble():
+    from ddt_tpu.models.tree import empty_ensemble
+
+    rng = np.random.default_rng(2)
+    R, F, B, depth, T = 3000, 8, 63, 5, 12
+    Xb = rng.integers(0, B, size=(R, F), dtype=np.uint8)
+    ens = empty_ensemble(T, depth, F, 0.1, 0.0, "logloss")
+    N = ens.feature.shape[1]
+    ens.feature[:] = rng.integers(0, F, size=(T, N))
+    ens.threshold_bin[:] = rng.integers(0, B - 1, size=(T, N))
+    # Random early leaves + all-leaf last level.
+    ens.is_leaf[:] = rng.random((T, N)) < 0.15
+    ens.is_leaf[:, (1 << depth) - 1:] = True
+    want = ens._traverse_np(Xb, binned=True)
+    got = native.traverse_native(
+        Xb, ens.feature, ens.threshold_bin, ens.is_leaf, depth
+    )
+    np.testing.assert_array_equal(want, got)
+
+
+def test_cpu_backend_uses_native():
+    """CPUDevice should pick the native kernel up automatically."""
+    from ddt_tpu.backends.cpu import CPUDevice
+    from ddt_tpu.config import TrainConfig
+
+    be = CPUDevice(TrainConfig(backend="cpu", n_bins=31))
+    assert be._native is not None
+    rng = np.random.default_rng(3)
+    Xb = rng.integers(0, 31, size=(500, 4), dtype=np.uint8)
+    g = rng.standard_normal(500).astype(np.float32)
+    h = rng.random(500).astype(np.float32)
+    ni = rng.integers(0, 4, size=500).astype(np.int32)
+    got = be.build_histograms(be.upload(Xb), g, h, ni, 4)
+    want = ref.build_histograms(Xb, g, h, ni, 4, 31)
+    np.testing.assert_array_equal(want, got)
