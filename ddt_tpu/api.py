@@ -28,6 +28,8 @@ class TrainResult:
     ensemble: TreeEnsemble
     mapper: BinMapper | None      # None when the caller passed binned data
     history: list[dict]           # per-round {round, train_loss, ms_per_round}
+    best_round: int | None = None   # 0-based; set when an eval_set was given
+    best_score: float | None = None
 
 
 def train(
@@ -41,6 +43,9 @@ def train(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 25,
     log_every: int = 10,
+    eval_set: tuple[np.ndarray, np.ndarray] | None = None,
+    eval_metric: str | None = None,
+    early_stopping_rounds: int | None = None,
     **cfg_overrides,
 ) -> TrainResult:
     """Train a GBDT. `X` is float features (quantized here) unless
@@ -66,6 +71,21 @@ def train(
                                     seed=cfg.seed)
         Xb = mapper.transform(np.asarray(X))
 
+    if eval_set is not None:
+        # eval_set binned-ness follows the training data's `binned` flag —
+        # never inferred from dtype (raw uint8 features are a real thing).
+        Xv, yv = eval_set
+        Xv = np.asarray(Xv)
+        if binned:
+            if Xv.dtype != np.uint8:
+                raise TypeError(
+                    "training data is pre-binned; eval_set must be uint8 "
+                    f"bin indices too, got {Xv.dtype}"
+                )
+        else:
+            Xv = mapper.transform(Xv)
+        eval_set = (Xv, np.asarray(yv))
+
     be = backend if backend is not None else get_backend(cfg)
     driver = Driver(
         be, cfg,
@@ -73,12 +93,20 @@ def train(
         checkpoint_dir=checkpoint_dir,
         checkpoint_every=checkpoint_every,
     )
-    ens = driver.fit(Xb, np.asarray(y))
+    ens = driver.fit(
+        Xb, np.asarray(y),
+        eval_set=eval_set,
+        eval_metric=eval_metric,
+        early_stopping_rounds=early_stopping_rounds,
+    )
     if mapper is not None:
         from ddt_tpu.reference.numpy_trainer import _fill_raw_thresholds
 
         _fill_raw_thresholds(ens, mapper)
-    return TrainResult(ensemble=ens, mapper=mapper, history=driver.history)
+    return TrainResult(
+        ensemble=ens, mapper=mapper, history=driver.history,
+        best_round=driver.best_round, best_score=driver.best_score,
+    )
 
 
 def predict(

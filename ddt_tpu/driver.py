@@ -22,6 +22,13 @@ ms/tree) via `logging`, collected in `Driver.history`. Checkpoint/resume
 (SURVEY.md §5): pass `checkpoint_dir` — after every `checkpoint_every` rounds
 the partial ensemble + cursor is written; `fit` resumes from the cursor if a
 checkpoint exists (utils/checkpoint.py).
+
+Validation tracking: `fit(..., eval_set=(Xb_val, y_val))` scores the held-out
+set every round by incremental host-side traversal of each freshly grown tree
+(O(rows·depth) NumPy — the val set never occupies device memory), records
+`valid_<metric>` in history, and with `early_stopping_rounds=k` stops when
+the metric hasn't improved in k rounds and truncates the ensemble to the best
+round (utils/metrics.py).
 """
 
 from __future__ import annotations
@@ -37,6 +44,25 @@ from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
 from ddt_tpu.reference.numpy_trainer import base_score
 
 log = logging.getLogger("ddt_tpu.driver")
+
+
+def _traverse_one(
+    feature: np.ndarray,
+    threshold_bin: np.ndarray,
+    is_leaf: np.ndarray,
+    Xb: np.ndarray,
+    max_depth: int,
+) -> np.ndarray:
+    """Leaf heap-slot per row for ONE tree (node arrays [n_nodes])."""
+    R = Xb.shape[0]
+    rows = np.arange(R)
+    node = np.zeros(R, np.int64)
+    for _ in range(max_depth):
+        leaf = is_leaf[node]
+        fv = Xb[rows, np.maximum(feature[node], 0)]
+        nxt = 2 * node + 1 + (fv > threshold_bin[node])
+        node = np.where(leaf, node, nxt)
+    return node
 
 
 class Driver:
@@ -56,8 +82,17 @@ class Driver:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
         self.history: list[dict] = []
+        self.best_round: int | None = None
+        self.best_score: float | None = None
 
-    def fit(self, Xb: np.ndarray, y: np.ndarray) -> TreeEnsemble:
+    def fit(
+        self,
+        Xb: np.ndarray,
+        y: np.ndarray,
+        eval_set: tuple[np.ndarray, np.ndarray] | None = None,
+        eval_metric: str | None = None,
+        early_stopping_rounds: int | None = None,
+    ) -> TreeEnsemble:
         """Train on binned uint8 data. Returns the grown ensemble."""
         cfg = self.cfg
         R, F = Xb.shape
@@ -83,22 +118,45 @@ class Driver:
             if start_round > 0:
                 # Reconstitute boosting state by rescoring the partial
                 # ensemble (deterministic: trees fix the leaf of every row).
-                import dataclasses
-
-                k = start_round * C
-                part = dataclasses.replace(
-                    ens,
-                    feature=ens.feature[:k],
-                    threshold_bin=ens.threshold_bin[:k],
-                    is_leaf=ens.is_leaf[:k],
-                    leaf_value=ens.leaf_value[:k],
-                )
+                part = ens.truncate(start_round * C)
                 pred = self.backend.load_pred(
                     np.asarray(part.predict_raw(Xb, binned=True))
                 )
                 log.info("resumed from checkpoint at round %d", start_round)
 
+        # --- validation-set state (host-side, incremental) ---
+        metric_name = None
+        val_raw = None
+        if eval_set is not None:
+            from ddt_tpu.utils.metrics import (
+                GREATER_IS_BETTER, default_metric, evaluate)
+
+            Xb_val, y_val = eval_set
+            Xb_val = np.asarray(Xb_val)
+            y_val = np.asarray(y_val)
+            if Xb_val.dtype != np.uint8:
+                raise TypeError("eval_set features must be uint8 binned data")
+            metric_name = eval_metric or default_metric(cfg.loss)
+            if metric_name not in GREATER_IS_BETTER:
+                raise ValueError(
+                    f"unknown metric {metric_name!r}; "
+                    f"have {sorted(GREATER_IS_BETTER)}"
+                )
+            sign = 1.0 if GREATER_IS_BETTER[metric_name] else -1.0
+            if C > 1:
+                val_raw = np.full((Xb_val.shape[0], C), bs, np.float32)
+            else:
+                val_raw = np.full(Xb_val.shape[0], bs, np.float32)
+            if start_round > 0:
+                k = start_round * C
+                val_raw = ens.truncate(k).predict_raw(
+                    Xb_val, binned=True).astype(np.float32)
+            best = -np.inf
+        elif early_stopping_rounds is not None:
+            raise ValueError("early_stopping_rounds requires an eval_set")
+
         t_out = start_round * C
+        completed_rounds = cfg.n_trees
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
             g, h = self.backend.grad_hess(pred, y_dev)
@@ -111,8 +169,26 @@ class Driver:
                 ens.threshold_bin[t_out] = tree["threshold_bin"]
                 ens.is_leaf[t_out] = tree["is_leaf"]
                 ens.leaf_value[t_out] = tree["leaf_value"]
+                if val_raw is not None:
+                    leaf = _traverse_one(
+                        tree["feature"], tree["threshold_bin"],
+                        tree["is_leaf"], Xb_val, cfg.max_depth,
+                    )
+                    dv = cfg.learning_rate * tree["leaf_value"][leaf]
+                    if C > 1:
+                        val_raw[:, c] += dv
+                    else:
+                        val_raw += dv
                 t_out += 1
             dt = time.perf_counter() - t0
+
+            val_score = None
+            if val_raw is not None:
+                val_score = evaluate(metric_name, y_val, val_raw)
+                if sign * val_score > best:
+                    best = sign * val_score
+                    self.best_round = rnd
+                    self.best_score = val_score
 
             if (rnd + 1) % self.log_every == 0 or rnd == cfg.n_trees - 1:
                 loss = self.backend.loss_value(pred, y_dev)
@@ -121,11 +197,28 @@ class Driver:
                     "train_loss": loss,
                     "ms_per_round": dt * 1e3,
                 }
+                if val_score is not None:
+                    rec[f"valid_{metric_name}"] = val_score
                 self.history.append(rec)
                 log.info(
-                    "round %4d/%d  loss=%.6f  %.1f ms/round",
+                    "round %4d/%d  loss=%.6f  %.1f ms/round%s",
                     rnd + 1, cfg.n_trees, loss, dt * 1e3,
+                    f"  valid_{metric_name}={val_score:.6f}"
+                    if val_score is not None else "",
                 )
+
+            if (
+                early_stopping_rounds is not None
+                and rnd - self.best_round >= early_stopping_rounds
+            ):
+                log.info(
+                    "early stop at round %d (best %s=%.6f at round %d)",
+                    rnd + 1, metric_name, self.best_score,
+                    self.best_round + 1,
+                )
+                ens = ens.truncate((self.best_round + 1) * C)
+                completed_rounds = self.best_round + 1
+                break
 
             if (
                 self.checkpoint_dir is not None
@@ -138,5 +231,5 @@ class Driver:
         if self.checkpoint_dir is not None:
             from ddt_tpu.utils.checkpoint import save_checkpoint
 
-            save_checkpoint(self.checkpoint_dir, ens, cfg, cfg.n_trees)
+            save_checkpoint(self.checkpoint_dir, ens, cfg, completed_rounds)
         return ens

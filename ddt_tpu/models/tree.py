@@ -151,6 +151,17 @@ class TreeEnsemble:
         with np.load(path) as d:
             return TreeEnsemble.from_dict(dict(d))
 
+    def truncate(self, n_trees: int) -> "TreeEnsemble":
+        """First `n_trees` trees (early stopping keeps the best round)."""
+        return dataclasses.replace(
+            self,
+            feature=self.feature[:n_trees],
+            threshold_bin=self.threshold_bin[:n_trees],
+            threshold_raw=self.threshold_raw[:n_trees],
+            is_leaf=self.is_leaf[:n_trees],
+            leaf_value=self.leaf_value[:n_trees],
+        )
+
     @staticmethod
     def concat(ensembles: list["TreeEnsemble"]) -> "TreeEnsemble":
         """Stack ensembles trained sequentially (used by checkpoint resume)."""

@@ -1,0 +1,105 @@
+"""Evaluation metrics (SURVEY.md §5 "Metrics/logging/observability").
+
+The reference's observability story implies per-round train/valid metric
+tracking (the standard GBDT trainer surface: LightGBM's `eval_set` /
+`early_stopping_rounds`). NumPy implementations — metric evaluation runs on
+host over small per-round outputs, never inside the jitted device path.
+
+Each metric takes (y_true, score) where `score` is the model's RAW margin
+output (TreeEnsemble.predict_raw): [R] for binary/regression, [R, C] for
+softmax. `GREATER_IS_BETTER` drives the early-stopping direction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _sigmoid(z: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def _softmax(z: np.ndarray) -> np.ndarray:
+    z = z - z.max(axis=1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def auc(y_true: np.ndarray, score: np.ndarray) -> float:
+    """Binary ROC-AUC via the rank (Mann-Whitney U) formulation, with
+    average ranks on ties — matches sklearn.metrics.roc_auc_score."""
+    y = np.asarray(y_true).astype(bool).ravel()
+    s = np.asarray(score, np.float64).ravel()
+    n_pos = int(y.sum())
+    n_neg = y.size - n_pos
+    if n_pos == 0 or n_neg == 0:
+        raise ValueError("AUC needs both classes present")
+    order = np.argsort(s, kind="mergesort")
+    # average (1-based) rank per tied-score group, fully vectorized — this
+    # runs once per boosting round under eval_set, so no Python loops
+    s_sorted = s[order]
+    is_start = np.empty(y.size, bool)
+    is_start[0] = True
+    np.not_equal(s_sorted[1:], s_sorted[:-1], out=is_start[1:])
+    starts = np.flatnonzero(is_start)
+    ends = np.concatenate([starts[1:], [y.size]])
+    avg_rank = 0.5 * (starts + ends + 1)            # group average rank
+    group_id = np.cumsum(is_start) - 1
+    ranks = np.empty(y.size, np.float64)
+    ranks[order] = avg_rank[group_id]
+    u = ranks[y].sum() - n_pos * (n_pos + 1) / 2.0
+    return float(u / (n_pos * n_neg))
+
+
+def accuracy(y_true: np.ndarray, score: np.ndarray) -> float:
+    y = np.asarray(y_true).ravel()
+    s = np.asarray(score)
+    pred = s.argmax(axis=1) if s.ndim == 2 else (s > 0).astype(y.dtype)
+    return float(np.mean(pred == y))
+
+
+def rmse(y_true: np.ndarray, score: np.ndarray) -> float:
+    y = np.asarray(y_true, np.float64).ravel()
+    return float(np.sqrt(np.mean((np.asarray(score, np.float64) - y) ** 2)))
+
+
+def logloss(y_true: np.ndarray, score: np.ndarray) -> float:
+    """Binary or multiclass cross-entropy from raw margins."""
+    y = np.asarray(y_true).ravel()
+    s = np.asarray(score, np.float64)
+    eps = 1e-12
+    if s.ndim == 2:
+        p = np.clip(_softmax(s), eps, 1.0)
+        return float(-np.mean(np.log(p[np.arange(y.size), y.astype(int)])))
+    p = np.clip(_sigmoid(s), eps, 1 - eps)
+    return float(-np.mean(np.where(y > 0.5, np.log(p), np.log1p(-p))))
+
+
+METRICS = {
+    "auc": auc,
+    "accuracy": accuracy,
+    "rmse": rmse,
+    "logloss": logloss,
+}
+
+GREATER_IS_BETTER = {
+    "auc": True,
+    "accuracy": True,
+    "rmse": False,
+    "logloss": False,
+}
+
+
+def default_metric(loss: str) -> str:
+    """Metric used for eval_set tracking when the caller names none."""
+    return {"logloss": "logloss", "softmax": "logloss", "mse": "rmse"}[loss]
+
+
+def evaluate(name: str, y_true: np.ndarray, raw_score: np.ndarray) -> float:
+    try:
+        fn = METRICS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown metric {name!r}; have {sorted(METRICS)}"
+        ) from None
+    return fn(y_true, raw_score)

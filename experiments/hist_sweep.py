@@ -1,0 +1,261 @@
+"""On-chip sweep of Pallas histogram kernel variants (perf scratchpad).
+
+Run on the real TPU: python experiments/hist_sweep.py
+Shapes = the headline bench shape (1M x 28 feat x 255 bins x 32 nodes).
+
+Variants:
+  v0   current library kernel (concat of per-feature one-hot slabs)
+  v1   fused one-hot: broadcast-compare [T,F,Bp] -> reshape (no concat copies)
+  v2   2-D grid (row tiles x feature groups): smaller OH per step -> larger
+       tile_r -> larger K per matmul, fewer grid steps
+  v3   v2 + weighted node one-hot A built in-kernel (saves ~256 MB/build of
+       HBM traffic for the A operand)
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+from ddt_tpu.ops.hist_pallas import _bins_pad, build_histograms_pallas
+from ddt_tpu.utils.device import device_sync
+
+R, F, B, N = 1_000_000, 28, 255, 32
+ITERS = 10
+
+
+# ---------------------------------------------------------------- v1: fused
+def _kernel_v1(xb_ref, a_ref, out_ref, *, n_feat, bins_pad):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = xb_ref[:]                                        # [T, F] int32
+    t = x.shape[0]
+    iota3 = jax.lax.broadcasted_iota(jnp.int32, (t, n_feat, bins_pad), 2)
+    oh = (x[:, :, None] == iota3).astype(jnp.bfloat16).reshape(
+        t, n_feat * bins_pad)
+    out_ref[:] += jax.lax.dot_general(
+        a_ref[:], oh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "n_bins", "tile_r"))
+def hist_v1(Xb, g, h, node_index, n_nodes, n_bins, tile_r):
+    R_, F_ = Xb.shape
+    bins_pad = _bins_pad(n_bins)
+    active = node_index >= 0
+    idx = jnp.where(active, node_index, 0).astype(jnp.int32)
+    gz = jnp.where(active, g, 0.0)
+    hz = jnp.where(active, h, 0.0)
+    node_oh = jax.nn.one_hot(idx, n_nodes, dtype=jnp.float32)
+    A = jnp.concatenate(
+        [node_oh * gz[:, None], node_oh * hz[:, None]], axis=1
+    ).astype(jnp.bfloat16)
+    Xi = Xb.astype(jnp.int32)
+    n_tiles = -(-R_ // tile_r)
+    pad = n_tiles * tile_r - R_
+    if pad:
+        Xi = jnp.pad(Xi, ((0, pad), (0, 0)))
+        A = jnp.pad(A, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_kernel_v1, n_feat=F_, bins_pad=bins_pad),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile_r, F_), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 2 * n_nodes), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((2 * n_nodes, F_ * bins_pad), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((2 * n_nodes, F_ * bins_pad),
+                                       jnp.float32),
+    )(Xi, A)
+    out = out.reshape(2, n_nodes, F_, bins_pad)[..., :n_bins]
+    return out.transpose(1, 2, 3, 0)
+
+
+# ------------------------------------------------------------- v2: 2-D grid
+def _kernel_v2(xb_ref, a_ref, out_ref, *, fg, bins_pad):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = xb_ref[:]                                        # [T, fg] int32
+    t = x.shape[0]
+    iota3 = jax.lax.broadcasted_iota(jnp.int32, (t, fg, bins_pad), 2)
+    oh = (x[:, :, None] == iota3).astype(jnp.bfloat16).reshape(
+        t, fg * bins_pad)
+    out_ref[:] += jax.lax.dot_general(
+        a_ref[:], oh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_nodes", "n_bins", "tile_r", "fg"))
+def hist_v2(Xb, g, h, node_index, n_nodes, n_bins, tile_r, fg):
+    R_, F_ = Xb.shape
+    assert F_ % fg == 0
+    bins_pad = _bins_pad(n_bins)
+    active = node_index >= 0
+    idx = jnp.where(active, node_index, 0).astype(jnp.int32)
+    gz = jnp.where(active, g, 0.0)
+    hz = jnp.where(active, h, 0.0)
+    node_oh = jax.nn.one_hot(idx, n_nodes, dtype=jnp.float32)
+    A = jnp.concatenate(
+        [node_oh * gz[:, None], node_oh * hz[:, None]], axis=1
+    ).astype(jnp.bfloat16)
+    Xi = Xb.astype(jnp.int32)
+    n_tiles = -(-R_ // tile_r)
+    pad = n_tiles * tile_r - R_
+    if pad:
+        Xi = jnp.pad(Xi, ((0, pad), (0, 0)))
+        A = jnp.pad(A, ((0, pad), (0, 0)))
+    n_fg = F_ // fg
+    out = pl.pallas_call(
+        functools.partial(_kernel_v2, fg=fg, bins_pad=bins_pad),
+        grid=(n_tiles, n_fg),
+        in_specs=[
+            pl.BlockSpec((tile_r, fg), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 2 * n_nodes), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((2 * n_nodes, fg * bins_pad),
+                               lambda i, j: (0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((2 * n_nodes, F_ * bins_pad),
+                                       jnp.float32),
+    )(Xi, A)
+    out = out.reshape(2, n_nodes, F_, bins_pad)[..., :n_bins]
+    return out.transpose(1, 2, 3, 0)
+
+
+# ----------------------------------------------- v3: v2 + in-kernel A build
+def _kernel_v3(xb_ref, ni_ref, gh_ref, out_ref, *, fg, bins_pad, n_nodes):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = xb_ref[:]                                        # [T, fg] int32
+    t = x.shape[0]
+    ni = ni_ref[:]                                       # [T, 1] int32
+    gh = gh_ref[:]                                       # [T, 2] f32
+    node_iota = jax.lax.broadcasted_iota(jnp.int32, (t, n_nodes), 1)
+    m = (node_iota == ni).astype(jnp.float32)            # [T, N]
+    A = jnp.concatenate(
+        [m * gh[:, 0:1], m * gh[:, 1:2]], axis=1
+    ).astype(jnp.bfloat16)                               # [T, 2N]
+    iota3 = jax.lax.broadcasted_iota(jnp.int32, (t, fg, bins_pad), 2)
+    oh = (x[:, :, None] == iota3).astype(jnp.bfloat16).reshape(
+        t, fg * bins_pad)
+    out_ref[:] += jax.lax.dot_general(
+        A, oh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_nodes", "n_bins", "tile_r", "fg"))
+def hist_v3(Xb, g, h, node_index, n_nodes, n_bins, tile_r, fg):
+    R_, F_ = Xb.shape
+    assert F_ % fg == 0
+    bins_pad = _bins_pad(n_bins)
+    active = node_index >= 0
+    ni = jnp.where(active, node_index, -1).astype(jnp.int32)[:, None]
+    gz = jnp.where(active, g, 0.0)
+    hz = jnp.where(active, h, 0.0)
+    gh = jnp.stack([gz, hz], axis=1).astype(jnp.float32)  # [R, 2]
+    Xi = Xb.astype(jnp.int32)
+    n_tiles = -(-R_ // tile_r)
+    pad = n_tiles * tile_r - R_
+    if pad:
+        Xi = jnp.pad(Xi, ((0, pad), (0, 0)))
+        ni = jnp.pad(ni, ((0, pad), (0, 0)), constant_values=-1)
+        gh = jnp.pad(gh, ((0, pad), (0, 0)))
+    n_fg = F_ // fg
+    out = pl.pallas_call(
+        functools.partial(_kernel_v3, fg=fg, bins_pad=bins_pad,
+                          n_nodes=n_nodes),
+        grid=(n_tiles, n_fg),
+        in_specs=[
+            pl.BlockSpec((tile_r, fg), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 2), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((2 * n_nodes, fg * bins_pad),
+                               lambda i, j: (0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((2 * n_nodes, F_ * bins_pad),
+                                       jnp.float32),
+    )(Xi, ni, gh)
+    out = out.reshape(2, n_nodes, F_, bins_pad)[..., :n_bins]
+    return out.transpose(1, 2, 3, 0)
+
+
+def bench(fn, name, ref=None):
+    try:
+        out = fn()
+        s = device_sync(out)
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:34s} FAILED: {type(e).__name__}: {str(e)[:140]}")
+        return
+    if ref is not None:
+        ok = bool(jnp.allclose(out, ref, rtol=2e-2, atol=2e-2))
+        if not ok:
+            print(f"{name:34s} WRONG RESULT (sum={s:.3f})")
+            return
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn()
+    device_sync(out)
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:34s} {dt*1e3:8.2f} ms  {R/dt/1e6:7.1f} Mrows/s")
+
+
+def main():
+    rng = np.random.default_rng(0)
+    Xb = jnp.asarray(rng.integers(0, B, size=(R, F), dtype=np.uint8))
+    g = jnp.asarray(rng.standard_normal(R).astype(np.float32))
+    h = jnp.asarray((rng.random(R) + 0.5).astype(np.float32))
+    ni = jnp.asarray(rng.integers(0, N, size=R).astype(np.int32))
+
+    ref = build_histograms_pallas(Xb, g, h, ni, N, B, tile_r=512)
+    device_sync(ref)
+
+    for tr in (256, 512, 768):
+        bench(lambda tr=tr: build_histograms_pallas(
+            Xb, g, h, ni, N, B, tile_r=tr), f"v0 concat      tile_r={tr}", ref)
+    for tr in (256, 512, 768):
+        bench(lambda tr=tr: hist_v1(Xb, g, h, ni, N, B, tr),
+              f"v1 fused       tile_r={tr}", ref)
+    for tr, fg in ((512, 7), (1024, 7), (2048, 7), (4096, 7),
+                   (1024, 14), (2048, 14), (2048, 4), (4096, 4)):
+        bench(lambda tr=tr, fg=fg: hist_v2(Xb, g, h, ni, N, B, tr, fg),
+              f"v2 2Dgrid      tile_r={tr} fg={fg}", ref)
+    for tr, fg in ((1024, 7), (2048, 7), (4096, 7), (2048, 14), (4096, 4),
+                   (8192, 4), (8192, 2)):
+        bench(lambda tr=tr, fg=fg: hist_v3(Xb, g, h, ni, N, B, tr, fg),
+              f"v3 inkernel-A  tile_r={tr} fg={fg}", ref)
+
+
+if __name__ == "__main__":
+    main()

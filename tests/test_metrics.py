@@ -1,0 +1,164 @@
+"""Metrics + eval_set/early-stopping tests (SURVEY.md §4 "Algorithm-level"
+and §5 observability). sklearn is the external oracle for metric values and
+for whole-trainer quality (HistGradientBoosting — the same histogram-GBDT
+family as the reference)."""
+
+import numpy as np
+import pytest
+
+from ddt_tpu import api
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.data.datasets import synthetic_binary, synthetic_multiclass
+from ddt_tpu.data.quantizer import quantize
+from ddt_tpu.utils import metrics
+
+
+def test_auc_matches_sklearn():
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, size=500)
+    # include ties: coarse-quantized scores
+    s = np.round(rng.standard_normal(500) + y, 1)
+    assert metrics.auc(y, s) == pytest.approx(roc_auc_score(y, s), abs=1e-12)
+
+
+def test_logloss_matches_sklearn_binary_and_multi():
+    from sklearn.metrics import log_loss
+
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 2, size=300)
+    s = rng.standard_normal(300)
+    p = 1 / (1 + np.exp(-s))
+    assert metrics.logloss(y, s) == pytest.approx(
+        log_loss(y, p), rel=1e-6)
+
+    y3 = rng.integers(0, 3, size=300)
+    s3 = rng.standard_normal((300, 3))
+    e = np.exp(s3 - s3.max(1, keepdims=True))
+    p3 = e / e.sum(1, keepdims=True)
+    assert metrics.logloss(y3, s3) == pytest.approx(
+        log_loss(y3, p3, labels=[0, 1, 2]), rel=1e-6)
+
+
+def test_accuracy_rmse():
+    y = np.array([0, 1, 1, 0])
+    s = np.array([-1.0, 2.0, -0.5, -2.0])
+    assert metrics.accuracy(y, s) == pytest.approx(0.75)
+    assert metrics.rmse(np.zeros(2), np.array([3.0, 4.0])) == pytest.approx(
+        np.sqrt(12.5))
+
+
+def _split(X, y, frac=0.2, seed=0):
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(y))
+    k = int(len(y) * frac)
+    va, tr = idx[:k], idx[k:]
+    return X[tr], y[tr], X[va], y[va]
+
+
+def test_eval_set_history_and_final_score():
+    X, y = synthetic_binary(4000, n_features=10, seed=3)
+    Xt, yt, Xv, yv = _split(X, y)
+    res = api.train(
+        Xt, yt, n_trees=20, max_depth=4, n_bins=63, backend="cpu",
+        eval_set=(Xv, yv), eval_metric="auc", log_every=5,
+    )
+    aucs = [r["valid_auc"] for r in res.history if "valid_auc" in r]
+    assert len(aucs) >= 3
+    # trained-model AUC must beat chance comfortably and match the last
+    # recorded incremental value (incremental scoring == full rescoring)
+    raw = res.ensemble.predict_raw(res.mapper.transform(Xv), binned=True)
+    assert metrics.auc(yv, raw) == pytest.approx(aucs[-1], abs=1e-6)
+    assert aucs[-1] > 0.8
+    assert res.best_round is not None
+
+
+def test_early_stopping_truncates_to_best_round():
+    # tiny noisy data + many trees => validation metric degrades, stop early
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((300, 5)).astype(np.float32)
+    y = (rng.random(300) < 0.5).astype(np.int64)   # pure noise labels
+    Xt, yt, Xv, yv = _split(X, y, frac=0.3, seed=1)
+    res = api.train(
+        Xt, yt, n_trees=100, max_depth=3, n_bins=31, backend="cpu",
+        eval_set=(Xv, yv), eval_metric="logloss", early_stopping_rounds=5,
+        log_every=10 ** 9,
+    )
+    assert res.ensemble.n_trees < 100
+    assert res.ensemble.n_trees == res.best_round + 1
+
+
+def test_early_stopping_multiclass_counts_trees_per_class():
+    X, y = synthetic_multiclass(1200, n_features=8, n_classes=3, seed=7)
+    Xt, yt, Xv, yv = _split(X, y)
+    res = api.train(
+        Xt, yt, n_trees=30, max_depth=3, n_bins=31, backend="cpu",
+        loss="softmax", n_classes=3,
+        eval_set=(Xv, yv), early_stopping_rounds=4, log_every=10 ** 9,
+    )
+    assert res.ensemble.n_trees % 3 == 0
+    raw = res.ensemble.predict_raw(res.mapper.transform(Xv), binned=True)
+    assert raw.shape == (len(yv), 3)
+    assert metrics.accuracy(yv, raw) > 0.5
+
+
+def test_quality_parity_vs_sklearn_hist_gbdt():
+    """Whole-trainer check vs sklearn's HistGradientBoostingClassifier with
+    matched capacity (same family: histogram GBDT, 255 bins)."""
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    from sklearn.metrics import roc_auc_score
+
+    X, y = synthetic_binary(6000, n_features=12, seed=11)
+    Xt, yt, Xv, yv = _split(X, y)
+
+    res = api.train(
+        Xt, yt, n_trees=60, max_depth=6, n_bins=255, learning_rate=0.2,
+        backend="cpu", log_every=10 ** 9,
+    )
+    ours = metrics.auc(
+        yv, res.ensemble.predict_raw(res.mapper.transform(Xv), binned=True))
+
+    sk = HistGradientBoostingClassifier(
+        max_iter=60, max_depth=6, max_bins=255, learning_rate=0.2,
+        early_stopping=False, min_samples_leaf=1, l2_regularization=1.0,
+    ).fit(Xt, yt)
+    theirs = roc_auc_score(yv, sk.decision_function(Xv))
+
+    assert ours > 0.85
+    assert ours >= theirs - 0.02   # within 2 AUC points of sklearn
+
+
+def test_early_stop_with_checkpoint_dir_resumes_cleanly(tmp_path):
+    """Early stop must write a cursor matching the truncated ensemble, so a
+    follow-up train with higher n_trees resumes without shape errors."""
+    rng = np.random.default_rng(9)
+    X = rng.standard_normal((300, 5)).astype(np.float32)
+    y = (rng.random(300) < 0.5).astype(np.int64)   # noise => early stop
+    Xt, yt, Xv, yv = _split(X, y, frac=0.3, seed=2)
+    d = str(tmp_path / "ck")
+    res = api.train(
+        Xt, yt, n_trees=50, max_depth=3, n_bins=31, backend="cpu",
+        eval_set=(Xv, yv), early_stopping_rounds=3, log_every=10 ** 9,
+        checkpoint_dir=d, checkpoint_every=10 ** 9, seed=4,
+    )
+    kept = res.ensemble.n_trees
+    assert kept < 50
+    # resume-and-continue (no early stopping this time) picks up at `kept`
+    res2 = api.train(
+        Xt, yt, n_trees=kept + 2, max_depth=3, n_bins=31, backend="cpu",
+        log_every=10 ** 9, checkpoint_dir=d, seed=4,
+    )
+    assert res2.ensemble.n_trees == kept + 2
+    np.testing.assert_array_equal(
+        res2.ensemble.feature[:kept], res.ensemble.feature)
+
+
+def test_eval_set_binned_path():
+    X, y = synthetic_binary(2000, n_features=6, seed=2)
+    Xb, _ = quantize(X, n_bins=31)
+    Xt, yt, Xv, yv = Xb[:1600], y[:1600], Xb[1600:], y[1600:]
+    cfg = TrainConfig(n_trees=10, max_depth=3, n_bins=31, backend="cpu")
+    res = api.train(Xt, yt, cfg, binned=True, eval_set=(Xv, yv),
+                    log_every=10 ** 9)
+    assert res.best_score is not None
