@@ -50,7 +50,8 @@ from ddt_tpu.ops import split as split_ops
 
 P = jax.sharding.PartitionSpec
 
-AXIS = "rows"  # the data-parallel mesh axis (SURVEY.md §2 "Mesh axes")
+AXIS = "rows"    # the data-parallel mesh axis (SURVEY.md §2 "Mesh axes")
+FAXIS = "features"  # optional TP-analog axis: column-sharded histogramming
 
 
 class TPUDevice(DeviceBackend):
@@ -66,19 +67,29 @@ class TPUDevice(DeviceBackend):
     ):
         super().__init__(cfg)
         self.n_partitions = max(1, cfg.n_partitions)
+        self.feature_partitions = max(1, cfg.feature_partitions)
         if mesh is not None:
             self.mesh = mesh
-            self.n_partitions = mesh.devices.size
-        elif self.n_partitions > 1:
+            if FAXIS in mesh.axis_names:
+                self.feature_partitions = mesh.shape[FAXIS]
+            else:
+                self.feature_partitions = 1
+            self.n_partitions = mesh.devices.size // self.feature_partitions
+        elif self.n_partitions > 1 or self.feature_partitions > 1:
+            n_dev = self.n_partitions * self.feature_partitions
             devs = devices if devices is not None else jax.devices()
-            if len(devs) < self.n_partitions:
+            if len(devs) < n_dev:
                 raise ValueError(
-                    f"n_partitions={self.n_partitions} but only "
-                    f"{len(devs)} devices visible"
+                    f"n_partitions={self.n_partitions} x feature_partitions="
+                    f"{self.feature_partitions} needs {n_dev} devices but "
+                    f"only {len(devs)} visible"
                 )
+            # rows outermost: row shards land on far mesh dims (DCN-friendly),
+            # the feature axis stays innermost (ICI-adjacent) — the feature
+            # psum/all_gather per level is latency-sensitive.
             self.mesh = jax.make_mesh(
-                (self.n_partitions,), (AXIS,),
-                devices=devs[: self.n_partitions],
+                (self.n_partitions, self.feature_partitions), (AXIS, FAXIS),
+                devices=devs[:n_dev],
             )
         else:
             self.mesh = None
@@ -118,7 +129,18 @@ class TPUDevice(DeviceBackend):
         if Xb.dtype != np.uint8:
             raise TypeError(f"binned data must be uint8, got {Xb.dtype}")
         R = Xb.shape[0]
-        data = self._put_rows(Xb, extra_dims=1)
+        if self.feature_partitions > 1:
+            # Column-shard over the feature axis (pad F to a multiple; padded
+            # columns are all-zeros => their best gain is exactly 0 with an
+            # empty right child, so they are never chosen as splits).
+            F = Xb.shape[1]
+            Fp = -(-F // self.feature_partitions) * self.feature_partitions
+            if Fp != F:
+                Xb = np.pad(Xb, ((0, 0), (0, Fp - F)))
+            Xp = self._pad_rows(np.ascontiguousarray(Xb))
+            data = jax.device_put(Xp, self._sharding(AXIS, FAXIS))
+        else:
+            data = self._put_rows(Xb, extra_dims=1)
         # Validity mask for the training rows this upload defines.
         valid = np.zeros(data.shape[0], bool)
         valid[:R] = True
@@ -136,6 +158,15 @@ class TPUDevice(DeviceBackend):
     @functools.cached_property
     def _hist_fn(self):
         cfg = self.cfg
+
+        if self.feature_partitions > 1:
+            def unsupported(*a, **k):
+                raise NotImplementedError(
+                    "the granular build_histograms surface is row-parallel "
+                    "only; feature_partitions > 1 is handled inside "
+                    "grow_tree (the Driver path)"
+                )
+            return unsupported
 
         def hist(Xb, g, h, node_index, *, n_nodes):
             # impl resolution happens inside build_histograms with the full
@@ -224,6 +255,7 @@ class TPUDevice(DeviceBackend):
     def _grow_fn(self):
         cfg = self.cfg
         axis = AXIS if self.distributed else None
+        faxis = FAXIS if self.feature_partitions > 1 else None
 
         def grow(Xb, g, h):
             tree = grow_ops.grow_tree(
@@ -236,6 +268,7 @@ class TPUDevice(DeviceBackend):
                 hist_impl=cfg.hist_impl,   # per-level shape-aware resolution
                 input_dtype=self._input_dtype,
                 axis_name=axis,
+                feature_axis_name=faxis,
             )
             delta = grow_ops.tree_predict_delta(tree, cfg.learning_rate)
             return (
@@ -244,11 +277,20 @@ class TPUDevice(DeviceBackend):
             )
 
         if self.distributed:
+            data_spec = P(AXIS, FAXIS) if faxis else P(AXIS, None)
             grow = jax.shard_map(
                 grow,
                 mesh=self.mesh,
-                in_specs=(P(AXIS, None), P(AXIS), P(AXIS)),
+                in_specs=(data_spec, P(AXIS), P(AXIS)),
                 out_specs=(P(), P(), P(), P(), P(AXIS)),
+                # Feature-parallel growth replicates every output across the
+                # feature axis BIT-IDENTICALLY by construction (split triples
+                # come out of an all_gather + argmax every shard computes the
+                # same way; node totals/leaf sums are segment_sums of
+                # feature-invariant row vectors; routing values ride a psum).
+                # The static VMA checker cannot see through the gathered
+                # argmax, so it is disabled for this path only.
+                check_vma=faxis is None,
             )
         return jax.jit(grow)
 

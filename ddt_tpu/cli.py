@@ -85,10 +85,19 @@ def main(argv: list[str] | None = None) -> int:
     tp.add_argument("--lr", type=float, default=0.1)
     tp.add_argument("--partitions", type=int, default=1,
                     help="row partitions over the device mesh")
+    tp.add_argument("--feature-partitions", type=int, default=1,
+                    help="column partitions (TP-analog mesh axis); uses "
+                         "partitions x feature-partitions devices")
     tp.add_argument("--hist-impl", default="auto",
                     choices=["auto", "matmul", "segment", "pallas"])
     tp.add_argument("--out", default="ensemble.npz")
     tp.add_argument("--checkpoint-dir", default=None)
+    tp.add_argument("--valid-frac", type=float, default=0.0,
+                    help="hold out this fraction as a validation set")
+    tp.add_argument("--metric", default=None,
+                    help="validation metric (auc/accuracy/rmse/logloss)")
+    tp.add_argument("--early-stop", type=int, default=None,
+                    help="stop after this many rounds without improvement")
 
     pp = sub.add_parser("predict", help="score a batch with a saved ensemble")
     _add_common(pp)
@@ -119,20 +128,36 @@ def main(argv: list[str] | None = None) -> int:
             learning_rate=args.lr, loss=loss,
             n_classes=n_classes if loss == "softmax" else 2,
             backend=args.backend, n_partitions=args.partitions,
+            feature_partitions=args.feature_partitions,
             hist_impl=args.hist_impl, seed=args.seed,
         )
+        eval_set = None
+        if args.valid_frac > 0:
+            rng = np.random.default_rng(args.seed)
+            idx = rng.permutation(len(y))
+            k = int(len(y) * args.valid_frac)
+            va, tr = idx[:k], idx[k:]
+            X, y, eval_set = X[tr], y[tr], (X[va], y[va])
         t0 = time.perf_counter()
-        res = api.train(X, y, cfg, checkpoint_dir=args.checkpoint_dir)
+        res = api.train(
+            X, y, cfg, checkpoint_dir=args.checkpoint_dir,
+            eval_set=eval_set, eval_metric=args.metric,
+            early_stopping_rounds=args.early_stop,
+        )
         dt = time.perf_counter() - t0
         res.ensemble.save(args.out)
-        print(json.dumps({
+        out = {
             "cmd": "train", "backend": args.backend, "rows": len(y),
-            "trees": cfg.n_trees, "depth": cfg.max_depth,
+            "trees": res.ensemble.n_trees, "depth": cfg.max_depth,
             "wallclock_s": round(dt, 3),
             "final_train_loss": res.history[-1]["train_loss"]
             if res.history else None,
             "model": args.out,
-        }))
+        }
+        if res.best_score is not None:
+            out["best_round"] = res.best_round + 1
+            out["best_score"] = round(res.best_score, 6)
+        print(json.dumps(out))
         return 0
 
     if args.cmd == "predict":

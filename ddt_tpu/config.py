@@ -40,6 +40,8 @@ class TrainConfig:
     # --- system ---
     backend: str = "tpu"        # cpu | tpu | fpga(stub)
     n_partitions: int = 1       # row partitions (data parallel over mesh axis)
+    feature_partitions: int = 1  # column partitions (TP-analog mesh axis);
+    #   total devices used = n_partitions * feature_partitions
     hist_impl: str = "auto"     # auto | matmul | segment | pallas
     seed: int = 0
 
@@ -62,6 +64,8 @@ class TrainConfig:
             raise ValueError("max_depth must be >= 1")
         if self.loss == "softmax" and self.n_classes < 2:
             raise ValueError("softmax needs n_classes >= 2")
+        if self.n_partitions < 1 or self.feature_partitions < 1:
+            raise ValueError("partition counts must be >= 1")
 
     @property
     def n_nodes_total(self) -> int:

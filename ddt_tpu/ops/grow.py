@@ -18,6 +18,18 @@ Row routing keeps a dense per-row heap node-id vector ("partition_rows" as a
 jnp.where update — SURVEY.md §2 "Node partitioner": no data movement, static
 shapes; rows frozen at early leaves are masked out of histograms by the
 node_index = -1 sentinel).
+
+Feature parallelism (SURVEY.md §2 "Parallelism strategies": the optional
+`features` mesh axis, the TP-analog for histogram GBDT): pass
+`feature_axis_name` when Xb is COLUMN-sharded over a second mesh axis. Each
+shard histograms only its own features (splitting the hot loop's F dimension
+across chips), local per-node best splits are combined with an `all_gather`
+of the (gain, feature, bin) triples — tiny: [n_shards, n_level] — and row
+routing recovers the winning feature's values via a masked `psum` over the
+feature axis (exactly one shard owns each winning column, all others
+contribute zero). Tie-break stays bit-identical to single-device: within a
+shard argmax picks the first flattened (feature, bin); across shards the
+first shard wins ties, which IS global first-feature order.
 """
 
 from __future__ import annotations
@@ -55,9 +67,13 @@ def grow_tree(
     row_chunk: int = 32_768,
     input_dtype=jnp.bfloat16,
     axis_name: str | None = None,
+    feature_axis_name: str | None = None,
 ) -> TreeArrays:
     """Grow one complete-heap tree. Trace under jit (and shard_map if
-    axis_name is set). Matches reference/numpy_trainer.grow_tree decisions."""
+    axis_name is set). Matches reference/numpy_trainer.grow_tree decisions.
+
+    With feature_axis_name, Xb is the [R_loc, F_loc] column shard and the
+    returned tree's feature indices are GLOBAL (shard offset applied)."""
     R, F = Xb.shape
     N = 2 ** (max_depth + 1) - 1
 
@@ -72,6 +88,10 @@ def grow_tree(
     def allreduce(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
+    if feature_axis_name is not None:
+        f_shard = jax.lax.axis_index(feature_axis_name)
+        f_lo = f_shard * F                 # global index of local column 0
+
     for depth in range(max_depth):         # unrolled: static 2^d nodes/level
         offset = (1 << depth) - 1
         n_level = 1 << depth
@@ -81,8 +101,32 @@ def grow_tree(
             impl=hist_impl, row_chunk=row_chunk, input_dtype=input_dtype,
         )
         hist = allreduce(hist)             # the cross-partition allreduce
-        G, Hh = S.node_totals(hist)
+        if feature_axis_name is None:
+            G, Hh = S.node_totals(hist)
+        else:
+            # Node totals from the row vectors, not the histogram: local
+            # histograms hold different COLUMNS per shard, so their bin sums
+            # agree only up to float add order — this form is bit-identical
+            # (and provably feature-axis-invariant) on every shard.
+            act = node_index >= 0
+            seg = jnp.clip(node_index, 0, n_level - 1)
+            G = allreduce(jax.ops.segment_sum(
+                jnp.where(act, g, 0.0), seg, num_segments=n_level))
+            Hh = allreduce(jax.ops.segment_sum(
+                jnp.where(act, h, 0.0), seg, num_segments=n_level))
         gains, feats, bins = S.best_splits(hist, reg_lambda, min_child_weight)
+        if feature_axis_name is not None:
+            # Combine per-shard winners: all_gather the (gain, feat, bin)
+            # triples (tiny), argmax over shards — first shard wins ties,
+            # preserving the global first-(feature,bin) tie-break rule.
+            feats = feats + f_lo
+            ga = jax.lax.all_gather(gains, feature_axis_name)  # [S, n_level]
+            fa = jax.lax.all_gather(feats, feature_axis_name)
+            ba = jax.lax.all_gather(bins, feature_axis_name)
+            w = jnp.argmax(ga, axis=0)                         # [n_level]
+            gains = jnp.take_along_axis(ga, w[None], axis=0)[0]
+            feats = jnp.take_along_axis(fa, w[None], axis=0)[0]
+            bins = jnp.take_along_axis(ba, w[None], axis=0)[0]
         value = -G / (Hh + reg_lambda)
 
         do_split = (
@@ -99,8 +143,21 @@ def grow_tree(
         split_here = do_split[idx_c] & ~frozen
         feat_r = feats[idx_c]
         bin_r = bins[idx_c]
-        fv = jnp.take_along_axis(Xb, feat_r[:, None].clip(0), axis=1)[:, 0]
-        go_right = (fv.astype(jnp.int32) > bin_r).astype(jnp.int32)
+        if feature_axis_name is None:
+            fv = jnp.take_along_axis(
+                Xb, feat_r[:, None].clip(0), axis=1)[:, 0].astype(jnp.int32)
+        else:
+            # Winning columns live on exactly one feature shard: the owner
+            # contributes the value, everyone else zero; psum broadcasts.
+            loc = feat_r - f_lo
+            is_local = (loc >= 0) & (loc < F)
+            fv_loc = jnp.take_along_axis(
+                Xb, jnp.clip(loc, 0, F - 1)[:, None], axis=1
+            )[:, 0].astype(jnp.int32)
+            fv = jax.lax.psum(
+                jnp.where(is_local, fv_loc, 0), feature_axis_name
+            )
+        go_right = (fv > bin_r).astype(jnp.int32)
         node_id = jnp.where(split_here, 2 * node_id + 1 + go_right, node_id)
         frozen = frozen | ~split_here
 

@@ -46,6 +46,21 @@ def test_cli_train_tpu_backend_with_partitions(tmp_path, capsys):
     assert rec["backend"] == "tpu"
 
 
+def test_cli_train_feature_partitions_and_early_stop(tmp_path, capsys):
+    out = str(tmp_path / "m.npz")
+    rc = main([
+        "train", "--backend=tpu", "--dataset=higgs", "--rows=2000",
+        "--bins=31", "--trees=12", "--depth=3", "--partitions=2",
+        "--feature-partitions=2", "--out", out,
+        "--valid-frac=0.2", "--metric=auc", "--early-stop=8",
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["best_round"] >= 1
+    assert 0.5 < rec["best_score"] <= 1.0
+    assert rec["trees"] <= 12
+
+
 def test_cli_covertype_softmax(tmp_path, capsys):
     model = str(tmp_path / "cov.npz")
     rec = _run(capsys, [

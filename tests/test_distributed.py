@@ -52,6 +52,42 @@ def test_partitioned_rows_not_divisible():
     np.testing.assert_array_equal(e1.threshold_bin, e8.threshold_bin)
 
 
+@pytest.mark.parametrize("np_,fp", [(1, 2), (1, 4), (2, 2), (4, 2)])
+def test_feature_parallel_equals_single(np_, fp):
+    """2-D mesh (rows x features): column-sharded histogramming + gathered
+    split argmax + psum row routing must grow identical trees (SURVEY.md §2
+    'Parallelism strategies': the optional features axis)."""
+    X, y = datasets.synthetic_binary(4096, n_features=10, seed=21)
+    Xb, _ = quantize(X, n_bins=31, seed=21)
+    e1 = _fit(1, Xb, y)
+    eN = _fit(np_, Xb, y, feature_partitions=fp)
+    np.testing.assert_array_equal(e1.feature, eN.feature)
+    np.testing.assert_array_equal(e1.threshold_bin, eN.threshold_bin)
+    np.testing.assert_array_equal(e1.is_leaf, eN.is_leaf)
+    np.testing.assert_allclose(e1.leaf_value, eN.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_feature_parallel_pads_nondivisible_columns():
+    """F=9 over 4 feature shards: padded all-zero columns are never chosen."""
+    X, y = datasets.synthetic_binary(2048, n_features=9, seed=23)
+    Xb, _ = quantize(X, n_bins=31, seed=23)
+    e1 = _fit(1, Xb, y)
+    eN = _fit(2, Xb, y, feature_partitions=4)
+    np.testing.assert_array_equal(e1.feature, eN.feature)
+    assert e1.feature.max() < 9
+    np.testing.assert_array_equal(e1.threshold_bin, eN.threshold_bin)
+
+
+def test_feature_parallel_softmax():
+    X, y = datasets.synthetic_multiclass(2000, n_features=12, seed=3)
+    Xb, _ = quantize(X, n_bins=31, seed=3)
+    e1 = _fit(1, Xb, y, loss="softmax", n_classes=7)
+    eN = _fit(2, Xb, y, loss="softmax", n_classes=7, feature_partitions=2)
+    np.testing.assert_array_equal(e1.feature, eN.feature)
+    np.testing.assert_array_equal(e1.threshold_bin, eN.threshold_bin)
+
+
 def test_partitioned_softmax():
     X, y = datasets.synthetic_multiclass(2000, n_features=12, seed=3)
     Xb, _ = quantize(X, n_bins=31, seed=3)
@@ -86,7 +122,8 @@ def test_mesh_uses_requested_devices():
     be = get_backend(cfg)
     assert be.distributed
     assert be.mesh.devices.size == 8
-    assert be.mesh.axis_names == ("rows",)
+    assert be.mesh.axis_names == ("rows", "features")
+    assert be.mesh.shape == {"rows": 8, "features": 1}
     with pytest.raises(ValueError, match="devices"):
         get_backend(TrainConfig(backend="tpu", n_partitions=16))
 
