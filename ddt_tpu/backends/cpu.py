@@ -34,16 +34,20 @@ class CPUDevice(DeviceBackend):
 
     def __init__(self, cfg: TrainConfig, use_native: bool | None = None):
         super().__init__(cfg)
-        self._native = None
+        self._native = None          # histogram kernel
+        self._native_split = None    # split-gain kernel
+        self._native_traverse = None  # batch predict traversal
         if use_native is not False:
             try:
-                from ddt_tpu.native import histogram_native
+                from ddt_tpu.native import (
+                    histogram_native, split_gain_native, traverse_native)
 
                 self._native = histogram_native
+                self._native_split = split_gain_native
+                self._native_traverse = traverse_native
             except Exception:
                 if use_native:  # explicitly requested → surface the failure
                     raise
-                self._native = None
 
     # ------------------------------------------------------------------ #
 
@@ -68,6 +72,10 @@ class CPUDevice(DeviceBackend):
         )
 
     def best_splits(self, hist):
+        if self._native_split is not None:
+            return self._native_split(
+                hist, self.cfg.reg_lambda, self.cfg.min_child_weight
+            )
         return ref.best_splits(
             hist, self.cfg.reg_lambda, self.cfg.min_child_weight
         )
@@ -87,7 +95,10 @@ class CPUDevice(DeviceBackend):
         return ref.grad_hess(pred, y, self.cfg.loss)
 
     def grow_tree(self, data, g, h) -> tuple[HostTree, Any]:
-        tree = ref.grow_tree(data, g, h, self.cfg)
+        tree = ref.grow_tree(
+            data, g, h, self.cfg,
+            hist_fn=self.build_histograms, split_fn=self.best_splits,
+        )
         delta = (
             self.cfg.learning_rate * tree["leaf_value"][tree["leaf_of_row"]]
         ).astype(np.float32)
@@ -121,4 +132,20 @@ class CPUDevice(DeviceBackend):
     # ------------------------------------------------------------------ #
 
     def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
-        return ens.predict_raw(Xb, binned=True)
+        if self._native_traverse is None:
+            return ens.predict_raw(Xb, binned=True)
+        # C++ batch traversal (the CPU twin of the device gather+compare
+        # path); leaf-value aggregation mirrors TreeEnsemble.predict_raw.
+        leaf = self._native_traverse(
+            Xb, ens.feature, ens.threshold_bin, ens.is_leaf, ens.max_depth
+        )                                                       # [T, R]
+        vals = np.take_along_axis(
+            ens.leaf_value, leaf.astype(np.int64), axis=1
+        ) * ens.learning_rate
+        if ens.loss == "softmax":
+            C = ens.n_classes
+            out = np.full((Xb.shape[0], C), ens.base_score, np.float32)
+            for t in range(ens.n_trees):
+                out[:, t % C] += vals[t]
+            return out
+        return (ens.base_score + vals.sum(axis=0)).astype(np.float32)

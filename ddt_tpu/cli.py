@@ -92,6 +92,8 @@ def main(argv: list[str] | None = None) -> int:
                     choices=["auto", "matmul", "segment", "pallas"])
     tp.add_argument("--out", default="ensemble.npz")
     tp.add_argument("--checkpoint-dir", default=None)
+    tp.add_argument("--checkpoint-every", type=int, default=25,
+                    help="write a checkpoint every K boosting rounds")
     tp.add_argument("--valid-frac", type=float, default=0.0,
                     help="hold out this fraction as a validation set")
     tp.add_argument("--metric", default=None,
@@ -141,6 +143,7 @@ def main(argv: list[str] | None = None) -> int:
         t0 = time.perf_counter()
         res = api.train(
             X, y, cfg, checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
             eval_set=eval_set, eval_metric=args.metric,
             early_stopping_rounds=args.early_stop,
         )

@@ -10,6 +10,8 @@ catch ImportError and fall back to the NumPy oracle kernels.
 Exports:
     histogram_native(Xb, g, h, node_index, n_nodes, n_bins) -> np.ndarray
     traverse_native(Xb, feature, thr_bin, is_leaf, max_depth) -> np.ndarray
+    split_gain_native(hist, reg_lambda, min_child_weight)
+        -> (gain, feature, bin)
 """
 
 from __future__ import annotations
@@ -62,6 +64,19 @@ _lib.ddt_traverse.argtypes = [
 ]
 _lib.ddt_traverse.restype = None
 
+_lib.ddt_split_gain.argtypes = [
+    ctypes.POINTER(ctypes.c_float),   # hist
+    ctypes.c_int32,                   # n_nodes
+    ctypes.c_int64,                   # F
+    ctypes.c_int32,                   # B
+    ctypes.c_float,                   # reg_lambda
+    ctypes.c_float,                   # min_child_weight
+    ctypes.POINTER(ctypes.c_float),   # best_gain
+    ctypes.POINTER(ctypes.c_int32),   # best_feature
+    ctypes.POINTER(ctypes.c_int32),   # best_bin
+]
+_lib.ddt_split_gain.restype = None
+
 
 def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
@@ -88,6 +103,27 @@ def histogram_native(
         R, F, n_nodes, n_bins, _ptr(out, ctypes.c_float),
     )
     return out
+
+
+def split_gain_native(
+    hist: np.ndarray,
+    reg_lambda: float,
+    min_child_weight: float,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """C++ SplitGain; bit-parity with numpy_trainer.best_splits (same f32
+    cumsum order + bf16-rounded deterministic tie-break)."""
+    n_nodes, F, B, _ = hist.shape
+    hist = np.ascontiguousarray(hist, np.float32)
+    gain = np.empty(n_nodes, np.float32)
+    feat = np.empty(n_nodes, np.int32)
+    bin_ = np.empty(n_nodes, np.int32)
+    _lib.ddt_split_gain(
+        _ptr(hist, ctypes.c_float), n_nodes, F, B,
+        reg_lambda, min_child_weight,
+        _ptr(gain, ctypes.c_float), _ptr(feat, ctypes.c_int32),
+        _ptr(bin_, ctypes.c_int32),
+    )
+    return gain, feat, bin_
 
 
 def traverse_native(

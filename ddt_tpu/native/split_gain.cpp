@@ -1,0 +1,96 @@
+// Native CPU SplitGain kernel — the reference's second named kernel
+// [BASELINE: "SplitGain"], CPU edition (the TPU edition is ops/split.py).
+//
+// BIT-PARITY CONTRACT with reference/numpy_trainer.best_splits: float32
+// sequential cumsum over bins, float32 gain arithmetic, bfloat16
+// round-to-nearest-even rounding of gains before a first-occurrence argmax
+// over the flattened (feature, bin) axis. This is what makes the native CPU
+// training path grow trees identical to the NumPy oracle and to the TPU
+// backend (the repo-wide deterministic-split rule, see ops/split.py).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+// Round float32 -> bfloat16 (round-to-nearest-even), returned as the
+// float32 value the bf16 bits represent. Matches ml_dtypes/XLA semantics
+// for finite values; -inf passes through; NaN never reaches this (masked).
+inline float to_bf16(float x) {
+    uint32_t bits;
+    std::memcpy(&bits, &x, 4);
+    uint32_t rounded = bits + 0x7FFFu + ((bits >> 16) & 1u);
+    rounded &= 0xFFFF0000u;
+    float out;
+    std::memcpy(&out, &rounded, 4);
+    return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void ddt_split_gain(
+    const float* hist,        // [n_nodes, F, B, 2] (g, h) sums
+    int32_t n_nodes,
+    int64_t F,
+    int32_t B,
+    float reg_lambda,
+    float min_child_weight,
+    float* best_gain,         // [n_nodes] (bf16-valued float32; -inf if none)
+    int32_t* best_feature,    // [n_nodes]
+    int32_t* best_bin         // [n_nodes]
+) {
+    const int64_t fstride = (int64_t)B * 2;
+    const int64_t nstride = F * fstride;
+    const float NEG_INF = -INFINITY;
+
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int32_t n = 0; n < n_nodes; ++n) {
+        const float* hn = hist + (int64_t)n * nstride;
+        // Node totals from feature 0 (any feature sums the same rows) in
+        // the same sequential order as np.cumsum's last element.
+        float G = 0.0f, H = 0.0f;
+        for (int32_t b = 0; b < B; ++b) {
+            G += hn[b * 2 + 0];
+            H += hn[b * 2 + 1];
+        }
+        const float parent = (G * G) / (H + reg_lambda);
+
+        float bg = NEG_INF;
+        int64_t bidx = -1;
+        for (int64_t f = 0; f < F; ++f) {
+            const float* hf = hn + f * fstride;
+            float GL = 0.0f, HL = 0.0f;
+            for (int32_t b = 0; b < B - 1; ++b) {  // last bin never valid
+                GL += hf[b * 2 + 0];
+                HL += hf[b * 2 + 1];
+                const float GR = G - GL;
+                const float HR = H - HL;
+                if (HL < min_child_weight || HR < min_child_weight) continue;
+                float gain = 0.5f * (
+                    (GL * GL) / (HL + reg_lambda)
+                    + (GR * GR) / (HR + reg_lambda)
+                    - parent);
+                if (std::isnan(gain)) continue;    // 0/0 when reg_lambda == 0
+                gain = to_bf16(gain);
+                if (gain > bg) {                   // strict >: first index wins
+                    bg = gain;
+                    bidx = f * B + b;
+                }
+            }
+        }
+        best_gain[n] = bg;
+        best_feature[n] = bidx < 0 ? 0 : (int32_t)(bidx / B);
+        best_bin[n] = bidx < 0 ? 0 : (int32_t)(bidx % B);
+    }
+}
+
+}  // extern "C"

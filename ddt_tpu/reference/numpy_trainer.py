@@ -146,9 +146,18 @@ def node_totals(hist: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 # --------------------------------------------------------------------------- #
 
 def grow_tree(
-    Xb: np.ndarray, g: np.ndarray, h: np.ndarray, cfg: TrainConfig
+    Xb: np.ndarray,
+    g: np.ndarray,
+    h: np.ndarray,
+    cfg: TrainConfig,
+    hist_fn=None,
+    split_fn=None,
 ) -> dict:
     """Grow one complete-heap tree. Returns dict of node arrays [n_nodes_total].
+
+    hist_fn/split_fn inject alternate L3 kernels with the same contract
+    (CPUDevice passes the native C++ ones — bit-parity guaranteed); defaults
+    are the NumPy oracle kernels in this module.
     """
     R, F = Xb.shape
     N = cfg.n_nodes_total
@@ -164,11 +173,17 @@ def grow_tree(
         offset = (1 << depth) - 1
         n_level = 1 << depth
         node_index = np.where(frozen, -1, node_id - offset).astype(np.int32)
-        hist = build_histograms(Xb, g, h, node_index, n_level, cfg.n_bins)
+        if hist_fn is not None:
+            hist = hist_fn(Xb, g, h, node_index, n_level)
+        else:
+            hist = build_histograms(Xb, g, h, node_index, n_level, cfg.n_bins)
         G, H = node_totals(hist)
-        gains, feats, bins = best_splits(
-            hist, cfg.reg_lambda, cfg.min_child_weight
-        )
+        if split_fn is not None:
+            gains, feats, bins = split_fn(hist)
+        else:
+            gains, feats, bins = best_splits(
+                hist, cfg.reg_lambda, cfg.min_child_weight
+            )
         value = -G / (H + cfg.reg_lambda)
 
         do_split = (gains > cfg.min_split_gain) & np.isfinite(gains) & (H > 0)
