@@ -31,14 +31,14 @@ def main() -> None:
 
     tpu = bench_histogram(
         backend="tpu", rows=rows, features=features, bins=bins,
-        n_nodes=n_nodes, iters=10,
+        n_nodes=n_nodes, iters=15, reps=8,
     )
 
     # CPU reference baseline: fewer rows (np.add.at is slow; throughput is
     # row-linear at this shape), normalised to M-rows/sec.
     cpu = bench_histogram(
         backend="cpu", rows=200_000, features=features, bins=bins,
-        n_nodes=n_nodes, iters=2,
+        n_nodes=n_nodes, iters=2, reps=8,
     )
 
     value = tpu["mrows_per_sec_per_chip"]

@@ -135,17 +135,8 @@ class CPUDevice(DeviceBackend):
         if self._native_traverse is None:
             return ens.predict_raw(Xb, binned=True)
         # C++ batch traversal (the CPU twin of the device gather+compare
-        # path); leaf-value aggregation mirrors TreeEnsemble.predict_raw.
+        # path); aggregation shared with TreeEnsemble.predict_raw.
         leaf = self._native_traverse(
             Xb, ens.feature, ens.threshold_bin, ens.is_leaf, ens.max_depth
         )                                                       # [T, R]
-        vals = np.take_along_axis(
-            ens.leaf_value, leaf.astype(np.int64), axis=1
-        ) * ens.learning_rate
-        if ens.loss == "softmax":
-            C = ens.n_classes
-            out = np.full((Xb.shape[0], C), ens.base_score, np.float32)
-            for t in range(ens.n_trees):
-                out[:, t % C] += vals[t]
-            return out
-        return (ens.base_score + vals.sum(axis=0)).astype(np.float32)
+        return ens.aggregate_leaves(leaf)

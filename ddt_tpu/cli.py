@@ -28,6 +28,13 @@ from ddt_tpu.data import datasets
 from ddt_tpu.models.tree import TreeEnsemble
 
 
+def _positive_int(v: str) -> int:
+    i = int(v)
+    if i < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {i}")
+    return i
+
+
 def _load_dataset(args) -> tuple[np.ndarray, np.ndarray, int]:
     """(X, y, n_classes) for the named dataset config."""
     if args.data:
@@ -92,8 +99,8 @@ def main(argv: list[str] | None = None) -> int:
                     choices=["auto", "matmul", "segment", "pallas"])
     tp.add_argument("--out", default="ensemble.npz")
     tp.add_argument("--checkpoint-dir", default=None)
-    tp.add_argument("--checkpoint-every", type=int, default=25,
-                    help="write a checkpoint every K boosting rounds")
+    tp.add_argument("--checkpoint-every", type=_positive_int, default=25,
+                    help="write a checkpoint every K boosting rounds (>= 1)")
     tp.add_argument("--valid-frac", type=float, default=0.0,
                     help="hold out this fraction as a validation set")
     tp.add_argument("--metric", default=None,

@@ -117,10 +117,13 @@ class Driver:
             start_round = try_resume(self.checkpoint_dir, ens, cfg)
             if start_round > 0:
                 # Reconstitute boosting state by rescoring the partial
-                # ensemble (deterministic: trees fix the leaf of every row).
+                # ensemble with fit's own per-round accumulation order, so
+                # resumed training is BIT-identical to an uninterrupted run
+                # (pairwise-summed predict_raw differs in ULPs, which could
+                # flip a bf16-boundary gain downstream).
                 part = ens.truncate(start_round * C)
                 pred = self.backend.load_pred(
-                    np.asarray(part.predict_raw(Xb, binned=True))
+                    np.asarray(part.predict_raw_roundwise(Xb, binned=True))
                 )
                 log.info("resumed from checkpoint at round %d", start_round)
 
@@ -149,7 +152,7 @@ class Driver:
                 val_raw = np.full(Xb_val.shape[0], bs, np.float32)
             if start_round > 0:
                 k = start_round * C
-                val_raw = ens.truncate(k).predict_raw(
+                val_raw = ens.truncate(k).predict_raw_roundwise(
                     Xb_val, binned=True).astype(np.float32)
             best = -np.inf
         elif early_stopping_rounds is not None:

@@ -80,20 +80,47 @@ class TreeEnsemble:
             node = np.where(leaf, node, nxt)
         return node.astype(np.int32)
 
-    def predict_raw(self, X: np.ndarray, binned: bool = False) -> np.ndarray:
-        """Raw (margin) scores. Binary/regression: [R]; softmax: [R, C]."""
-        leaf_idx = self._traverse_np(X, binned=binned)  # [T, R]
+    def aggregate_leaves(self, leaf_idx: np.ndarray) -> np.ndarray:
+        """Raw scores from precomputed leaf indices [T, R] — the single home
+        of the leaf-value aggregation rule (lr scale, base score, softmax
+        tree-to-class interleave: tree t scores class t % n_classes). Used
+        by predict_raw here and by the native-traversal CPU backend path."""
         vals = np.take_along_axis(self.leaf_value, leaf_idx.astype(np.int64),
                                   axis=1)               # [T, R]
         vals = vals * self.learning_rate
         if self.loss == "softmax":
             C = self.n_classes
-            R = X.shape[0]
+            R = leaf_idx.shape[1]
             out = np.full((R, C), self.base_score, dtype=np.float32)
             for t in range(self.n_trees):
                 out[:, t % C] += vals[t]
             return out
         return (self.base_score + vals.sum(axis=0)).astype(np.float32)
+
+    def predict_raw(self, X: np.ndarray, binned: bool = False) -> np.ndarray:
+        """Raw (margin) scores. Binary/regression: [R]; softmax: [R, C]."""
+        return self.aggregate_leaves(self._traverse_np(X, binned=binned))
+
+    def predict_raw_roundwise(self, X: np.ndarray,
+                              binned: bool = False) -> np.ndarray:
+        """predict_raw with the SAME float32 accumulation order as the
+        Driver's fit loop (one sequential add per tree, in tree order) —
+        aggregate_leaves' vals.sum(axis=0) uses NumPy pairwise summation,
+        whose ULP-level differences would make checkpoint resume only
+        approximately equal to an uninterrupted run. Used to reconstitute
+        boosting state on resume so recovery is bit-exact."""
+        leaf_idx = self._traverse_np(X, binned=binned)          # [T, R]
+        if self.loss == "softmax":
+            # aggregate_leaves' softmax branch is already a sequential
+            # per-tree loop in tree order — identical accumulation.
+            return self.aggregate_leaves(leaf_idx)
+        vals = np.take_along_axis(self.leaf_value,
+                                  leaf_idx.astype(np.int64), axis=1)
+        vals = (vals * self.learning_rate).astype(np.float32)
+        out = np.full((leaf_idx.shape[1],), self.base_score, dtype=np.float32)
+        for t in range(self.n_trees):
+            out += vals[t]
+        return out
 
     def predict(self, X: np.ndarray, binned: bool = False) -> np.ndarray:
         """Probability predictions (or raw values for mse)."""

@@ -26,16 +26,32 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libddthist.so")
 
 
+_SYMBOLS = ("ddt_build_histograms", "ddt_traverse", "ddt_split_gain")
+
+
 def _load() -> ctypes.CDLL:
-    if not os.path.exists(_SO):
-        try:
-            subprocess.run(
-                ["make", "-C", _DIR, "-s"], check=True,
-                capture_output=True, timeout=120,
-            )
-        except Exception as e:  # toolchain missing / build broke
+    # Always run make BEFORE the first dlopen: the Makefile's dependency
+    # tracking makes this a no-op when libddthist.so is fresh, and it
+    # rebuilds a stale gitignored .so from an older source tree. (Rebuilding
+    # after dlopen cannot work — dlopen dedupes by path and ctypes never
+    # dlcloses, so a reload would return the old handle.)
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, "-s"], check=True,
+            capture_output=True, timeout=120,
+        )
+    except Exception as e:  # toolchain missing / build broke
+        if not os.path.exists(_SO):
             raise ImportError(f"native kernel build failed: {e}") from e
-    return ctypes.CDLL(_SO)
+        # No toolchain but an existing .so: use it if it is complete.
+    lib = ctypes.CDLL(_SO)
+    missing = [s for s in _SYMBOLS if not hasattr(lib, s)]
+    if missing:
+        raise ImportError(
+            f"libddthist.so lacks {missing} (stale build, no toolchain to "
+            f"refresh it); run `make -C {_DIR} clean libddthist.so`"
+        )
+    return lib
 
 
 _lib = _load()

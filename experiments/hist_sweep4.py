@@ -1,0 +1,256 @@
+"""Sweep round 4: attack the VPU one-hot build (the measured bottleneck).
+
+Hypothesis from sweep3: v0 (concat + one dot) is VPU-bound — per tile the
+one-hot costs ~3 full passes over [T, F*Bp] (compare, select, concat copy)
+vs ~1 MXU-equivalent pass for the dot, and the single long dependency chain
+limits VPU/MXU overlap. Candidates:
+
+  v0   library kernel (baseline)
+  vA   full-width one-hot in ONE compare: lane-repeat x to [T, F*Bp] once,
+       compare against (iota & 255) — drops the concat pass
+  vB   slabs written straight into a VMEM scratch at lane offsets (the write
+       IS the concat), one dot from scratch
+  vC   explicit 2-stage software pipeline: build half-1 one-hot, dot half-1,
+       build half-2, dot half-2 — gives Mosaic an MXU op to overlap with the
+       second build
+  vE   feature-split grid (n_tiles, 2): half the features per step, half the
+       one-hot VMEM -> allows tile_r=1024 at the same budget
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+from ddt_tpu.ops.hist_pallas import _bins_pad, build_histograms_pallas
+from ddt_tpu.utils.device import device_sync
+
+R, F, B, N = 1_000_000, 28, 255, 32
+ITERS = 10
+REPS = 3
+
+
+def _prologue(Xb, g, h, ni, n_nodes, tile_r):
+    Rr, Fq = Xb.shape
+    active = ni >= 0
+    idx = jnp.where(active, ni, 0).astype(jnp.int32)
+    gz = jnp.where(active, g, 0.0).astype(jnp.float32)
+    hz = jnp.where(active, h, 0.0).astype(jnp.float32)
+    noh = jax.nn.one_hot(idx, n_nodes, dtype=jnp.float32)
+    A = jnp.concatenate([noh * gz[:, None], noh * hz[:, None]],
+                        axis=1).astype(jnp.bfloat16)
+    Xi = Xb.astype(jnp.int32)
+    n_tiles = -(-Rr // tile_r)
+    pad = n_tiles * tile_r - Rr
+    if pad:
+        Xi = jnp.pad(Xi, ((0, pad), (0, 0)))
+        A = jnp.pad(A, ((0, pad), (0, 0)))
+    return Xi, A, n_tiles
+
+
+def _epilogue(out, n_nodes, n_feat, bins_pad):
+    out = out.reshape(2, n_nodes, n_feat, bins_pad)[..., :B]
+    return out.transpose(1, 2, 3, 0)
+
+
+# ---------------------------------------------------------------- vA: repeat
+def _kernel_vA(xb_ref, a_ref, out_ref, *, n_feat, bins_pad):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = xb_ref[:]                              # [T, F]
+    t = x.shape[0]
+    xr = pltpu.repeat(x, bins_pad, axis=1)     # [T, F*Bp] lane-repeat
+    lane = jax.lax.broadcasted_iota(jnp.int32, (t, n_feat * bins_pad), 1)
+    oh = (xr == (lane & (bins_pad - 1))).astype(jnp.bfloat16)
+    out_ref[:] += jax.lax.dot_general(
+        a_ref[:], oh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------- vB: scratch
+def _kernel_vB(xb_ref, a_ref, out_ref, oh_ref, *, n_feat, bins_pad):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = xb_ref[:]
+    t = x.shape[0]
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (t, bins_pad), 1)
+    for f in range(n_feat):
+        oh_ref[:, f * bins_pad:(f + 1) * bins_pad] = (
+            x[:, f][:, None] == bin_iota).astype(jnp.bfloat16)
+    out_ref[:] += jax.lax.dot_general(
+        a_ref[:], oh_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------- vC: 2-stage
+def _kernel_vC(xb_ref, a_ref, out_ref, *, n_feat, bins_pad, stages):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = xb_ref[:]
+    t = x.shape[0]
+    a = a_ref[:]
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (t, bins_pad), 1)
+    fs = -(-n_feat // stages)
+    for s in range(stages):
+        f0, f1 = s * fs, min((s + 1) * fs, n_feat)
+        slabs = [(x[:, f][:, None] == bin_iota).astype(jnp.bfloat16)
+                 for f in range(f0, f1)]
+        oh = jnp.concatenate(slabs, axis=1) if len(slabs) > 1 else slabs[0]
+        out_ref[:, f0 * bins_pad:f1 * bins_pad] += jax.lax.dot_general(
+            a, oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------- vE: f-grid
+def _kernel_vE(xb_ref, a_ref, out_ref, *, f_half, bins_pad):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = xb_ref[:]                              # [T, f_half] window
+    t = x.shape[0]
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (t, bins_pad), 1)
+    slabs = [(x[:, f][:, None] == bin_iota).astype(jnp.bfloat16)
+             for f in range(f_half)]
+    oh = jnp.concatenate(slabs, axis=1)
+    out_ref[:] += jax.lax.dot_general(
+        a_ref[:], oh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "tile_r", "which",
+                                             "stages"))
+def hist_v(Xb, g, h, ni, n_nodes, tile_r, which, stages=2):
+    Rr, Fq = Xb.shape
+    bins_pad = _bins_pad(B)
+    Xi, A, n_tiles = _prologue(Xb, g, h, ni, n_nodes, tile_r)
+    shape = jax.ShapeDtypeStruct((2 * n_nodes, Fq * bins_pad), jnp.float32)
+    xspec = pl.BlockSpec((tile_r, Fq), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    aspec = pl.BlockSpec((tile_r, 2 * n_nodes), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    ospec = pl.BlockSpec((2 * n_nodes, Fq * bins_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM)
+    cost = pl.CostEstimate(
+        flops=2 * 2 * n_nodes * Fq * bins_pad * n_tiles * tile_r,
+        bytes_accessed=Rr * Fq * 4 + Rr * 4 * n_nodes
+        + 2 * n_nodes * Fq * bins_pad * 4,
+        transcendentals=0)
+
+    if which == "vA":
+        out = pl.pallas_call(
+            functools.partial(_kernel_vA, n_feat=Fq, bins_pad=bins_pad),
+            grid=(n_tiles,), in_specs=[xspec, aspec], out_specs=ospec,
+            out_shape=shape, cost_estimate=cost)(Xi, A)
+    elif which == "vB":
+        out = pl.pallas_call(
+            functools.partial(_kernel_vB, n_feat=Fq, bins_pad=bins_pad),
+            grid=(n_tiles,), in_specs=[xspec, aspec], out_specs=ospec,
+            out_shape=shape, cost_estimate=cost,
+            scratch_shapes=[pltpu.VMEM((tile_r, Fq * bins_pad),
+                                       jnp.bfloat16)])(Xi, A)
+    elif which == "vC":
+        out = pl.pallas_call(
+            functools.partial(_kernel_vC, n_feat=Fq, bins_pad=bins_pad,
+                              stages=stages),
+            grid=(n_tiles,), in_specs=[xspec, aspec], out_specs=ospec,
+            out_shape=shape, cost_estimate=cost)(Xi, A)
+    elif which == "vE":
+        assert Fq % 2 == 0
+        fh = Fq // 2
+        out = pl.pallas_call(
+            functools.partial(_kernel_vE, f_half=fh, bins_pad=bins_pad),
+            grid=(n_tiles, 2),
+            in_specs=[
+                pl.BlockSpec((tile_r, fh), lambda i, j: (i, j),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((tile_r, 2 * n_nodes), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((2 * n_nodes, fh * bins_pad),
+                                   lambda i, j: (0, j),
+                                   memory_space=pltpu.VMEM),
+            out_shape=shape, cost_estimate=cost)(Xi, A)
+    else:
+        raise ValueError(which)
+    return _epilogue(out, n_nodes, Fq, bins_pad)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    Xb = jnp.asarray(rng.integers(0, B, size=(R, F), dtype=np.uint8))
+    g = jnp.asarray(rng.standard_normal(R).astype(np.float32))
+    h = jnp.asarray((rng.random(R) + 0.5).astype(np.float32))
+    ni = jnp.asarray(rng.integers(0, N, size=R).astype(np.int32))
+
+    ref = build_histograms_pallas(Xb, g, h, ni, N, B, tile_r=512)
+    device_sync(ref)
+
+    cands = [("v0 concat   tile_r=512",
+              lambda: build_histograms_pallas(Xb, g, h, ni, N, B,
+                                              tile_r=512))]
+    for tr in (512, 768):
+        cands.append((f"vA repeat   tile_r={tr}",
+                      lambda tr=tr: hist_v(Xb, g, h, ni, N, tr, "vA")))
+        cands.append((f"vB scratch  tile_r={tr}",
+                      lambda tr=tr: hist_v(Xb, g, h, ni, N, tr, "vB")))
+        cands.append((f"vC stage2   tile_r={tr}",
+                      lambda tr=tr: hist_v(Xb, g, h, ni, N, tr, "vC", 2)))
+        cands.append((f"vC stage4   tile_r={tr}",
+                      lambda tr=tr: hist_v(Xb, g, h, ni, N, tr, "vC", 4)))
+    for tr in (512, 1024):
+        cands.append((f"vE f-grid   tile_r={tr}",
+                      lambda tr=tr: hist_v(Xb, g, h, ni, N, tr, "vE")))
+
+    best = {}
+    live = []
+    for name, fn in cands:
+        try:
+            out = fn()
+            device_sync(out)
+            if not bool(jnp.allclose(out, ref, rtol=2e-2, atol=2e-2)):
+                print(f"{name:28s} WRONG RESULT")
+                continue
+            live.append((name, fn))
+            best[name] = np.inf
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:28s} FAILED: {type(e).__name__}: {str(e)[:120]}")
+
+    for _ in range(REPS):
+        for name, fn in live:
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                out = fn()
+            device_sync(out)
+            dt = (time.perf_counter() - t0) / ITERS
+            best[name] = min(best[name], dt)
+    for name, _ in live:
+        dt = best[name]
+        print(f"{name:28s} {dt*1e3:8.2f} ms  {R/dt/1e6:7.1f} Mrows/s")
+
+
+if __name__ == "__main__":
+    main()

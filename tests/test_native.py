@@ -121,6 +121,15 @@ def test_native_predict_matches_numpy_predict():
     np.testing.assert_allclose(
         be.predict_raw(ens, Xb), ens.predict_raw(Xb, binned=True),
         rtol=1e-6, atol=1e-6)
+
+
+def test_cpu_backend_histogram_exact():
+    """be.build_histograms through the backend (not the raw kernel) is
+    bit-exact vs the NumPy oracle."""
+    from ddt_tpu.backends.cpu import CPUDevice
+    from ddt_tpu.config import TrainConfig
+
+    be = CPUDevice(TrainConfig(backend="cpu", n_bins=31), use_native=True)
     rng = np.random.default_rng(3)
     Xb = rng.integers(0, 31, size=(500, 4), dtype=np.uint8)
     g = rng.standard_normal(500).astype(np.float32)
