@@ -25,7 +25,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def main() -> None:
+    from ddt_tpu.backends.tpu import enable_persistent_compile_cache
     from ddt_tpu.bench import bench_histogram
+
+    enable_persistent_compile_cache()
 
     rows, features, bins, n_nodes = 1_000_000, 28, 255, 32
 

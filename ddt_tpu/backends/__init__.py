@@ -33,19 +33,55 @@ class FPGADevice(DeviceBackend):
     loss_value = predict_raw = None  # type: ignore
 
 
-def get_backend(cfg: TrainConfig, **kwargs) -> DeviceBackend:
-    """Instantiate the backend named by cfg.backend (the flag)."""
+# Backend instances are cached on the config fields that shape their traced
+# programs: a TPUDevice's jitted grow/grad/predict functions live on the
+# instance, and recompiling them costs seconds (tens of seconds through a
+# remote-attached chip) — far more than any training round. Fields like
+# n_trees or seed never enter a trace, so two train() calls differing only
+# there share one compiled backend.
+_JIT_FIELDS = (
+    "backend", "n_partitions", "feature_partitions",
+    "max_depth", "n_bins", "learning_rate", "loss", "n_classes",
+    "reg_lambda", "min_child_weight", "min_split_gain",
+    "hist_impl", "matmul_input_dtype",
+)
+# LRU-bounded: each cached TPUDevice pins its compiled executables (and any
+# upload-derived device state) for its lifetime, so a hyperparameter sweep
+# over many configs must evict old entries. The cached instance's cfg is
+# NEVER mutated — backends read only _JIT_FIELDS (all part of the key), and
+# non-trace fields (n_trees, seed, checkpoint paths) live on the Driver's
+# own cfg.
+_CACHE_MAX = 8
+_CACHE: "dict" = {}
+
+
+def get_backend(cfg: TrainConfig, use_cache: bool = True,
+                **kwargs) -> DeviceBackend:
+    """Instantiate (or reuse) the backend named by cfg.backend (the flag)."""
+    key = None
+    if use_cache and not kwargs:
+        key = tuple(getattr(cfg, f) for f in _JIT_FIELDS)
+        hit = _CACHE.pop(key, None)
+        if hit is not None:
+            _CACHE[key] = hit      # re-insert: most-recently-used
+            return hit
     if cfg.backend == "cpu":
         from ddt_tpu.backends.cpu import CPUDevice
 
-        return CPUDevice(cfg, **kwargs)
-    if cfg.backend == "tpu":
+        be: DeviceBackend = CPUDevice(cfg, **kwargs)
+    elif cfg.backend == "tpu":
         from ddt_tpu.backends.tpu import TPUDevice
 
-        return TPUDevice(cfg, **kwargs)
-    if cfg.backend == "fpga":
+        be = TPUDevice(cfg, **kwargs)
+    elif cfg.backend == "fpga":
         return FPGADevice(cfg)
-    raise ValueError(f"unknown backend {cfg.backend!r}")
+    else:
+        raise ValueError(f"unknown backend {cfg.backend!r}")
+    if key is not None:
+        _CACHE[key] = be
+        while len(_CACHE) > _CACHE_MAX:
+            _CACHE.pop(next(iter(_CACHE)))    # evict least-recently-used
+    return be
 
 
 __all__ = ["DeviceBackend", "HostTree", "FPGADevice", "get_backend"]

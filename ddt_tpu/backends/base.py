@@ -106,14 +106,25 @@ class DeviceBackend(abc.ABC):
         """Loss gradients/hessians at `pred`: float32 [R] or [R, C]."""
 
     @abc.abstractmethod
-    def grow_tree(self, data: Any, g: Any, h: Any) -> tuple[HostTree, Any]:
+    def grow_tree(self, data: Any, g: Any, h: Any) -> tuple[Any, Any]:
         """Grow one complete-heap tree from (sharded) data + grads.
 
-        Returns (host_tree, delta): the tree's node arrays on host, and the
-        per-row raw-score increment lr * leaf_value[leaf_of_row] as an opaque
-        device array aligned with `pred` (used by apply_delta). For softmax,
-        g/h are the single class column being boosted.
+        Returns (tree_handle, delta): a backend-opaque handle to the tree's
+        node arrays (resolve with fetch_tree), and the per-row raw-score
+        increment lr * leaf_value[leaf_of_row] as an opaque device array
+        aligned with `pred` (used by apply_delta). For softmax, g/h are the
+        single class column being boosted.
+
+        The handle lets device backends defer the device→host copy: the
+        Driver resolves it one round later, hiding the transfer round-trip
+        (~tens of ms on a remote-attached chip) under the next tree's
+        compute. CPU-resident backends just return the HostTree itself.
         """
+
+    def fetch_tree(self, handle: Any) -> HostTree:
+        """Resolve a grow_tree handle to host node arrays. Default: the
+        handle already is the HostTree (CPU-resident backends)."""
+        return handle
 
     @abc.abstractmethod
     def apply_delta(self, pred: Any, delta: Any, class_idx: int) -> Any:

@@ -33,6 +33,7 @@ fpga/tpu selection [BASELINE].
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any
 
 import jax
@@ -54,6 +55,31 @@ AXIS = "rows"    # the data-parallel mesh axis (SURVEY.md §2 "Mesh axes")
 FAXIS = "features"  # optional TP-analog axis: column-sharded histogramming
 
 
+def enable_persistent_compile_cache() -> None:
+    """Point XLA's persistent compilation cache at a local directory (unless
+    the user already configured one). Compiling the fused grow program costs
+    seconds — tens of seconds through a remote-attached chip — and the cache
+    makes every process after the first skip it entirely.
+
+    Mutates process-global JAX config, so the LIBRARY never calls it
+    implicitly: our own entry points (cli, bench, __graft_entry__) do, and
+    embedders opt in by calling it or setting $DDT_COMPILATION_CACHE
+    (honored in TPUDevice.__init__)."""
+    try:
+        if jax.config.jax_compilation_cache_dir is None:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get(
+                    "DDT_COMPILATION_CACHE",
+                    os.path.expanduser("~/.cache/ddt_tpu/xla"),
+                ),
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:    # unsupported jax version / read-only FS: non-fatal
+        pass
+
+
 class TPUDevice(DeviceBackend):
     """XLA backend; single-chip or row-sharded over a device mesh."""
 
@@ -66,6 +92,8 @@ class TPUDevice(DeviceBackend):
         mesh: jax.sharding.Mesh | None = None,
     ):
         super().__init__(cfg)
+        if "DDT_COMPILATION_CACHE" in os.environ:
+            enable_persistent_compile_cache()
         self.n_partitions = max(1, cfg.n_partitions)
         self.feature_partitions = max(1, cfg.feature_partitions)
         if mesh is not None:
@@ -271,10 +299,19 @@ class TPUDevice(DeviceBackend):
                 feature_axis_name=faxis,
             )
             delta = grow_ops.tree_predict_delta(tree, cfg.learning_rate)
-            return (
-                tree.feature, tree.threshold_bin, tree.is_leaf,
-                tree.leaf_value, delta,
-            )
+            # Pack the four tiny node arrays into ONE f32 array so the host
+            # needs a single device→host fetch per tree (four separate
+            # np.asarray calls each pay the full transfer round-trip —
+            # measured ~90 ms apiece through a remote-attached chip, 4x the
+            # tree's compute). int32 features/bins and booleans are exact
+            # in f32 (values << 2^24).
+            packed = jnp.stack([
+                tree.feature.astype(jnp.float32),
+                tree.threshold_bin.astype(jnp.float32),
+                tree.is_leaf.astype(jnp.float32),
+                tree.leaf_value,
+            ])
+            return packed, delta
 
         if self.distributed:
             data_spec = P(AXIS, FAXIS) if faxis else P(AXIS, None)
@@ -282,7 +319,7 @@ class TPUDevice(DeviceBackend):
                 grow,
                 mesh=self.mesh,
                 in_specs=(data_spec, P(AXIS), P(AXIS)),
-                out_specs=(P(), P(), P(), P(), P(AXIS)),
+                out_specs=(P(), P(AXIS)),
                 # Feature-parallel growth replicates every output across the
                 # feature axis BIT-IDENTICALLY by construction (split triples
                 # come out of an all_gather + argmax every shard computes the
@@ -294,15 +331,19 @@ class TPUDevice(DeviceBackend):
             )
         return jax.jit(grow)
 
-    def grow_tree(self, data, g, h) -> tuple[HostTree, Any]:
-        feature, thr, is_leaf, leaf_value, delta = self._grow_fn(data, g, h)
-        host = HostTree(
-            feature=np.asarray(feature),
-            threshold_bin=np.asarray(thr),
-            is_leaf=np.asarray(is_leaf),
-            leaf_value=np.asarray(leaf_value),
+    def grow_tree(self, data, g, h) -> tuple[Any, Any]:
+        """Returns (device packed-tree handle, delta) — no host sync here;
+        the Driver resolves the handle via fetch_tree one round later."""
+        return self._grow_fn(data, g, h)
+
+    def fetch_tree(self, handle) -> HostTree:
+        packed = np.asarray(handle)                      # ONE fetch
+        return HostTree(
+            feature=packed[0].astype(np.int32),
+            threshold_bin=packed[1].astype(np.int32),
+            is_leaf=packed[2].astype(bool),
+            leaf_value=packed[3].astype(np.float32),
         )
-        return host, delta
 
     @functools.cached_property
     def _apply_fn(self):
@@ -346,8 +387,21 @@ class TPUDevice(DeviceBackend):
     # inference (TreeEnsemble.predict → gather+compare, row-sharded)
     # ------------------------------------------------------------------ #
 
+    # Host-side row chunk for batch scoring: bounds the device working set
+    # (node state is [tree_chunk, rows_chunk] int32 plus traversal
+    # temporaries) independently of how many rows the caller scores — the
+    # 10M-row x 1000-tree config [BASELINE] OOM-kills the chip if scored in
+    # one dispatch. 2M rows/chip/call keeps the peak well under 1 GB.
+    PREDICT_ROW_CHUNK = 2_000_000
+
     def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
         R = Xb.shape[0]
+        chunk = self.PREDICT_ROW_CHUNK * max(1, self.n_partitions)
+        if R > chunk:
+            return np.concatenate([
+                self.predict_raw(ens, Xb[i:i + chunk])
+                for i in range(0, R, chunk)
+            ])
         C = ens.n_classes if ens.loss == "softmax" else 1
         Xc = self._put_rows(Xb.astype(np.int32), extra_dims=1)
         feat = jax.device_put(ens.feature.astype(np.int32), self._sharding())

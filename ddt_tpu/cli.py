@@ -81,6 +81,9 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
+    from ddt_tpu.backends.tpu import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()   # our process: cache XLA compiles
     ap = argparse.ArgumentParser(prog="ddt_tpu",
                                  description="TPU-native distributed GBDT")
     sub = ap.add_subparsers(dest="cmd", required=True)

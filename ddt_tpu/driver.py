@@ -8,11 +8,13 @@ and is explicitly "unchanged above the operator layer" when backends swap
     for round in 1..n_trees:                      (sequential, host)
       g, h = backend.grad_hess(pred, y)           (device, fused elementwise)
       for c in classes:                           (1 for binary/mse)
-        tree, delta = backend.grow_tree(data, g_c, h_c)   (ONE device dispatch:
+        handle, delta = backend.grow_tree(data, g_c, h_c) (ONE device dispatch:
               histograms → [psum over mesh] → gains → splits → row routing,
               all levels)
         pred = backend.apply_delta(pred, delta, c)
-      ensemble[t] = tree                          (≈KBs to host)
+      ensemble[t-1] = backend.fetch_tree(prev_handle)     (≈KBs to host, ONE
+              transfer, pipelined one round behind so the device→host
+              round-trip hides under the next tree's compute)
 
 Boosting state (`pred`) is an opaque backend handle — on TPUDevice it lives
 sharded on device for the whole run; the Driver never sees a float of it.
@@ -160,19 +162,31 @@ class Driver:
 
         t_out = start_round * C
         completed_rounds = cfg.n_trees
+        # One-deep fetch pipeline: a device backend's grow_tree returns an
+        # unresolved handle; resolving it costs a device→host round-trip
+        # (~tens of ms on a remote-attached chip), so we fetch tree k while
+        # tree k+1 computes. With an eval_set the tree is needed immediately
+        # for incremental validation scoring, so the pipeline is bypassed.
+        pending: tuple | None = None   # (handle, ensemble slot)
+
+        def _store(handle, slot):
+            tree = self.backend.fetch_tree(handle)
+            ens.feature[slot] = tree["feature"]
+            ens.threshold_bin[slot] = tree["threshold_bin"]
+            ens.is_leaf[slot] = tree["is_leaf"]
+            ens.leaf_value[slot] = tree["leaf_value"]
+            return tree
+
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
             g, h = self.backend.grad_hess(pred, y_dev)
             for c in range(C):
                 gc = g[:, c] if C > 1 else g
                 hc = h[:, c] if C > 1 else h
-                tree, delta = self.backend.grow_tree(data, gc, hc)
+                handle, delta = self.backend.grow_tree(data, gc, hc)
                 pred = self.backend.apply_delta(pred, delta, c)
-                ens.feature[t_out] = tree["feature"]
-                ens.threshold_bin[t_out] = tree["threshold_bin"]
-                ens.is_leaf[t_out] = tree["is_leaf"]
-                ens.leaf_value[t_out] = tree["leaf_value"]
                 if val_raw is not None:
+                    tree = _store(handle, t_out)
                     leaf = _traverse_one(
                         tree["feature"], tree["threshold_bin"],
                         tree["is_leaf"], Xb_val, cfg.max_depth,
@@ -182,6 +196,10 @@ class Driver:
                         val_raw[:, c] += dv
                     else:
                         val_raw += dv
+                else:
+                    if pending is not None:
+                        _store(*pending)
+                    pending = (handle, t_out)
                 t_out += 1
             dt = time.perf_counter() - t0
 
@@ -229,7 +247,14 @@ class Driver:
             ):
                 from ddt_tpu.utils.checkpoint import save_checkpoint
 
+                if pending is not None:        # flush the fetch pipeline
+                    _store(*pending)
+                    pending = None
                 save_checkpoint(self.checkpoint_dir, ens, cfg, rnd + 1)
+
+        if pending is not None:                # flush the fetch pipeline
+            _store(*pending)
+            pending = None
 
         if self.checkpoint_dir is not None:
             from ddt_tpu.utils.checkpoint import save_checkpoint

@@ -29,21 +29,39 @@ _SO = os.path.join(_DIR, "libddthist.so")
 _SYMBOLS = ("ddt_build_histograms", "ddt_traverse", "ddt_split_gain")
 
 
+def _stale() -> bool:
+    """libddthist.so missing or older than any source/Makefile."""
+    if not os.path.exists(_SO):
+        return True
+    so_m = os.path.getmtime(_SO)
+    deps = [os.path.join(_DIR, "Makefile")] + [
+        os.path.join(_DIR, f) for f in os.listdir(_DIR)
+        if f.endswith((".cpp", ".h", ".hpp"))
+    ]
+    return any(os.path.getmtime(d) > so_m for d in deps if os.path.exists(d))
+
+
 def _load() -> ctypes.CDLL:
-    # Always run make BEFORE the first dlopen: the Makefile's dependency
-    # tracking makes this a no-op when libddthist.so is fresh, and it
-    # rebuilds a stale gitignored .so from an older source tree. (Rebuilding
-    # after dlopen cannot work — dlopen dedupes by path and ctypes never
-    # dlcloses, so a reload would return the old handle.)
-    try:
-        subprocess.run(
-            ["make", "-C", _DIR, "-s"], check=True,
-            capture_output=True, timeout=120,
-        )
-    except Exception as e:  # toolchain missing / build broke
-        if not os.path.exists(_SO):
-            raise ImportError(f"native kernel build failed: {e}") from e
-        # No toolchain but an existing .so: use it if it is complete.
+    # Rebuild (BEFORE the first dlopen — dlopen dedupes by path and ctypes
+    # never dlcloses, so a post-load rebuild could not be picked up) only
+    # when the gitignored .so is missing or older than the sources; a fresh
+    # library costs no subprocess on import. An flock serialises concurrent
+    # builders (cc writes the .so in place, non-atomically).
+    if _stale():
+        try:
+            import fcntl
+
+            with open(os.path.join(_DIR, ".build.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                if _stale():               # may have been built while waiting
+                    subprocess.run(
+                        ["make", "-C", _DIR, "-s"], check=True,
+                        capture_output=True, timeout=120,
+                    )
+        except Exception as e:  # toolchain missing / build broke
+            if not os.path.exists(_SO):
+                raise ImportError(f"native kernel build failed: {e}") from e
+            # No toolchain but an existing .so: use it if it is complete.
     lib = ctypes.CDLL(_SO)
     missing = [s for s in _SYMBOLS if not hasattr(lib, s)]
     if missing:

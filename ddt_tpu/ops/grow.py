@@ -138,24 +138,35 @@ def grow_tree(
         is_leaf = is_leaf.at[sl].set(~do_split)
         leaf_value = leaf_value.at[sl].set(jnp.where(do_split, 0.0, value))
 
-        # Route rows through the new splits (dense node-id update).
+        # Route rows through the new splits (dense node-id update). All
+        # per-row lookups are one-hot compare+reduce instead of gathers:
+        # TPU gathers (even from a 32-entry table) each cost ~10-20 ms at
+        # 1M rows, while the [R, n_level] masked reductions are a few ms
+        # total — and integer one-hot sums are EXACT, so routing is
+        # bit-identical to the gather formulation.
         idx_c = jnp.clip(node_id - offset, 0, n_level - 1)
-        split_here = do_split[idx_c] & ~frozen
-        feat_r = feats[idx_c]
-        bin_r = bins[idx_c]
+        noh = idx_c[:, None] == jnp.arange(n_level, dtype=jnp.int32)[None, :]
+        split_here = jnp.any(noh & do_split[None, :], axis=1) & ~frozen
+        feat_r = jnp.sum(jnp.where(noh, feats[None, :], 0), axis=1)
+        bin_r = jnp.sum(jnp.where(noh, bins[None, :], 0), axis=1)
         if feature_axis_name is None:
-            fv = jnp.take_along_axis(
-                Xb, feat_r[:, None].clip(0), axis=1)[:, 0].astype(jnp.int32)
+            foh = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
+                == feat_r[:, None]
+            )
+            fv = jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0), axis=1)
         else:
-            # Winning columns live on exactly one feature shard: the owner
-            # contributes the value, everyone else zero; psum broadcasts.
+            # Winning columns live on exactly one feature shard: lanes only
+            # match on the owner (out-of-range local index matches nothing),
+            # everyone else contributes zero; psum broadcasts.
             loc = feat_r - f_lo
-            is_local = (loc >= 0) & (loc < F)
-            fv_loc = jnp.take_along_axis(
-                Xb, jnp.clip(loc, 0, F - 1)[:, None], axis=1
-            )[:, 0].astype(jnp.int32)
+            foh = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
+                == loc[:, None]
+            )
             fv = jax.lax.psum(
-                jnp.where(is_local, fv_loc, 0), feature_axis_name
+                jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0), axis=1),
+                feature_axis_name,
             )
         go_right = (fv > bin_r).astype(jnp.int32)
         node_id = jnp.where(split_here, 2 * node_id + 1 + go_right, node_id)

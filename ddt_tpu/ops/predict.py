@@ -1,19 +1,28 @@
-"""Batch ensemble prediction: depth-unrolled gather+compare on XLA.
+"""Batch ensemble prediction: depth-unrolled compare+select on XLA.
 
 Layer L3/L6 (SURVEY.md §3 "predict"): the reference's `TreeEnsemble.predict`
-batch-scoring path, lowered exactly as the north star prescribes — "Batch
-ensemble inference (TreeEnsemble.predict) lowers to XLA gather+compare"
-[BASELINE]. Complete-heap node layout makes traversal branch-free:
+batch-scoring path. The north star calls this "gather+compare" [BASELINE] —
+but on TPU a literal per-(tree,row) `take_along_axis` traversal lowers to
+scalar-loop gathers (measured ~10 M lookups/s on a v5e: 28 s for 200k rows x
+100 trees, and the 10M x 1000 config killed the chip). So the gathers are
+re-expressed as one-hot compare+reduce, which vectorises on the VPU and is
+EXACT (integer sums select a single matching lane):
 
-    node <- is_leaf[node] ? node : 2*node + 1 + (x[feat[node]] > thr[node])
+1. Leaf-chain pushdown (`_effective_arrays`): descendants of a leaf inherit
+   its value/slot; leaves themselves get feature=-1, thr=+inf so every row
+   walks all the way to the bottom level (always-left below a leaf). This
+   removes the frozen-node case, so at level d a row's node is exactly its
+   d-bit relative index — all lookups stay inside the level's 2^d-wide slice.
+2. Per level: node-relative one-hot [T, R, 2^d] selects (feature, thr) from
+   the level slice; a feature one-hot [T, R, F] selects the row's bin value
+   (feature=-1 matches no lane -> fv=0 < thr=+inf -> go left). All
+   compare+select+reduce chains fuse — nothing [T, R, *]-shaped reaches HBM.
+3. Bottom level: one-hot select of the (pushed-down) leaf value per row.
 
-unrolled max_depth times with fully static shapes, vmapped over trees via
-take_along_axis gathers. The 10M-row / 1000-tree inference config shards the
-row axis across the mesh (parallel/inference.py); no collectives needed —
-row-sharded scoring is embarrassingly parallel.
-
-Tree-chunked via lax.scan when n_trees is large so the [T, R] working set
-stays bounded (1000 trees x 10M rows of int32 would be 40 GB).
+Doubly chunked via lax.scan — trees in chunks of `tree_chunk`, rows in chunks
+of `row_chunk` — so the working set stays bounded for the 10M-row x
+1000-tree inference config [BASELINE] (a flat [1000, 10M] int32 node state
+alone would be 40 GB).
 """
 
 from __future__ import annotations
@@ -24,24 +33,67 @@ import jax
 import jax.numpy as jnp
 
 
-def _traverse_level(node, feature, thr, is_leaf, Xc):
-    """One gather+compare step for all (tree, row) pairs. node: int32 [T, R]."""
-    feat = jnp.take_along_axis(feature, node, axis=1)            # [T, R]
-    t = jnp.take_along_axis(thr, node, axis=1)
-    leaf = jnp.take_along_axis(is_leaf, node, axis=1)
-    # Gather feature values: fv[k, r] = Xc[r, feat[k, r]] (clip handles the
-    # -1 sentinel on leaves; the result is masked by `leaf` anyway).
-    fv = Xc.T[feat.clip(0), jnp.arange(Xc.shape[0])[None, :]]    # [T, R]
-    go_right = (fv > t).astype(node.dtype)
-    nxt = 2 * node + 1 + go_right
-    return jnp.where(leaf, node, nxt)
+def _effective_arrays(feature, thr, is_leaf, leaf_value, max_depth):
+    """Push leaves down the heap: returns (eff_feat, eff_thr, eff_val,
+    eff_slot) where every node below a leaf inherits the leaf's value and
+    original slot, leaf/inherited nodes carry feature=-1 and thr=+BIG.
+
+    All ops are on tiny [T, N] arrays (N = 2^(D+1)-1); the per-level parent
+    indexing uses STATIC index vectors, which XLA lowers to cheap slices.
+    """
+    T, N = feature.shape
+    big = (
+        jnp.asarray(jnp.inf, thr.dtype)
+        if jnp.issubdtype(thr.dtype, jnp.floating)
+        else jnp.asarray(2 ** 30, thr.dtype)
+    )
+    dead = is_leaf
+    eff_feat = jnp.where(dead, -1, feature)
+    eff_thr = jnp.where(dead, big, thr)
+    eff_val = leaf_value
+    eff_slot = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32), (T, N))
+    chained = is_leaf
+    for d in range(1, max_depth + 1):
+        lo, hi = (1 << d) - 1, (1 << (d + 1)) - 1
+        par = (jnp.arange(lo, hi) - 1) // 2            # static indices
+        pch = chained[:, par]                          # parent leaf/chained
+        eff_feat = eff_feat.at[:, lo:hi].set(
+            jnp.where(pch, -1, eff_feat[:, lo:hi]))
+        eff_thr = eff_thr.at[:, lo:hi].set(
+            jnp.where(pch, big, eff_thr[:, lo:hi]))
+        eff_val = eff_val.at[:, lo:hi].set(
+            jnp.where(pch, eff_val[:, par], eff_val[:, lo:hi]))
+        eff_slot = eff_slot.at[:, lo:hi].set(
+            jnp.where(pch, eff_slot[:, par], eff_slot[:, lo:hi]))
+        chained = chained.at[:, lo:hi].set(pch | is_leaf[:, lo:hi])
+    return eff_feat, eff_thr, eff_val, eff_slot
 
 
-def _traverse(feature, thr, is_leaf, Xc, max_depth):
-    node = jnp.zeros((feature.shape[0], Xc.shape[0]), jnp.int32)
-    for _ in range(max_depth):
-        node = _traverse_level(node, feature, thr, is_leaf, Xc)
-    return node
+def _select_level(k, table):
+    """table[t, k[t, r]] for a level-local table [T, w] — one-hot
+    compare+reduce (exact: k matches exactly one lane)."""
+    w = table.shape[1]
+    noh = k[:, :, None] == jnp.arange(w, dtype=jnp.int32)[None, None, :]
+    zero = jnp.zeros((), table.dtype)
+    return jnp.sum(jnp.where(noh, table[:, None, :], zero), axis=-1)
+
+
+def _descend(eff_feat, eff_thr, Xc, max_depth):
+    """Relative node index at the bottom level: int32 [T, R]."""
+    Tc = eff_feat.shape[0]
+    R, F = Xc.shape
+    k = jnp.zeros((Tc, R), jnp.int32)
+    f_iota = jnp.arange(F, dtype=jnp.int32)[None, None, :]
+    for d in range(max_depth):
+        lo, w = (1 << d) - 1, 1 << d
+        feat_r = _select_level(k, eff_feat[:, lo:lo + w])         # [T, R]
+        thr_r = _select_level(k, eff_thr[:, lo:lo + w])
+        foh = feat_r[:, :, None] == f_iota                        # [T, R, F]
+        fv = jnp.sum(
+            jnp.where(foh, Xc[None, :, :], jnp.zeros((), Xc.dtype)), axis=-1
+        )
+        k = 2 * k + (fv > thr_r).astype(jnp.int32)
+    return k
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
@@ -52,12 +104,19 @@ def traverse(
     Xc: jax.Array,             # [R, F] int32 (binned) or float32 (raw)
     max_depth: int,
 ) -> jax.Array:
-    """Leaf slot per (tree, row): int32 [T, R]."""
-    return _traverse(feature, thr, is_leaf, Xc, max_depth)
+    """Leaf slot per (tree, row): int32 [T, R] (the ORIGINAL heap slot the
+    row lands in, as with explicit frozen-node traversal)."""
+    eff_feat, eff_thr, _, eff_slot = _effective_arrays(
+        feature, thr, is_leaf, jnp.zeros(feature.shape, jnp.float32),
+        max_depth)
+    k = _descend(eff_feat, eff_thr, Xc, max_depth)
+    lo = (1 << max_depth) - 1
+    return _select_level(k, eff_slot[:, lo:])
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_depth", "n_classes", "tree_chunk")
+    jax.jit,
+    static_argnames=("max_depth", "n_classes", "tree_chunk", "row_chunk"),
 )
 def predict_raw(
     feature: jax.Array,        # int32 [T, N]
@@ -70,51 +129,67 @@ def predict_raw(
     base: float,
     n_classes: int = 1,        # 1 = scalar output; C = softmax round-major
     tree_chunk: int = 64,
+    row_chunk: int = 65_536,
 ) -> jax.Array:
     """Raw margin scores: [R] (n_classes==1) or [R, C].
 
-    Trees are processed in chunks of `tree_chunk` via lax.scan to bound the
-    [chunk, R] traversal working set; per-chunk leaf values are accumulated
-    into the per-class output (round-major tree->class interleave for
-    softmax, matching reference/numpy_trainer.fit).
+    Doubly lax.scan-chunked (rows outer, trees inner); per-chunk leaf values
+    are accumulated into the per-class output (round-major tree->class
+    interleave for softmax, matching reference/numpy_trainer.fit).
     """
     T = feature.shape[0]
-    R = Xc.shape[0]
+    R, F = Xc.shape
     C = n_classes
-    n_chunks = -(-T // tree_chunk)
-    pad = n_chunks * tree_chunk - T
+    if R == 0:
+        out = jnp.full((0, C), base, jnp.float32)
+        return out[:, 0] if C == 1 else out
+    n_tc = -(-T // tree_chunk)
+    tpad = n_tc * tree_chunk - T
 
     def pad_t(a, fill=0):
-        return jnp.pad(a, ((0, pad), (0, 0)), constant_values=fill)
+        return jnp.pad(a, ((0, tpad), (0, 0)), constant_values=fill)
 
-    # Padded trees are all-leaf at the root with value 0 -> contribute nothing.
-    featp = pad_t(feature, -1).reshape(n_chunks, tree_chunk, -1)
-    thrp = pad_t(thr).reshape(n_chunks, tree_chunk, -1)
-    leafp = pad_t(is_leaf, True).reshape(n_chunks, tree_chunk, -1)
-    valp = pad_t(leaf_value).reshape(n_chunks, tree_chunk, -1)
-    # Class of tree t is t % C (round-major interleave).
-    cls = (jnp.arange(n_chunks * tree_chunk, dtype=jnp.int32) % C).reshape(
-        n_chunks, tree_chunk
+    # Padded trees are all-leaf at the root with value 0 -> contribute 0.
+    ef, et, ev, _ = _effective_arrays(
+        pad_t(feature, -1), pad_t(thr), pad_t(is_leaf, True),
+        pad_t(leaf_value), max_depth,
     )
+    featp = ef.reshape(n_tc, tree_chunk, -1)
+    thrp = et.reshape(n_tc, tree_chunk, -1)
+    lo = (1 << max_depth) - 1
+    valp = ev[:, lo:].reshape(n_tc, tree_chunk, -1)   # bottom level only
+    # Class of tree t is t % C (round-major interleave).
+    cls = (jnp.arange(n_tc * tree_chunk, dtype=jnp.int32) % C).reshape(
+        n_tc, tree_chunk
+    )
+    cls_oh = jax.nn.one_hot(cls, C, dtype=jnp.float32)  # [n_tc, chunk, C]
 
-    def body(acc, args):
-        f, t, l, v, c = args
-        node = _traverse(f, t, l, Xc, max_depth)
-        vals = jnp.take_along_axis(v, node, axis=1)              # [chunk, R]
-        # Scatter chunk sums into classes: one_hot [chunk, C] matmul.
-        cls_oh = jax.nn.one_hot(c, C, dtype=vals.dtype)          # [chunk, C]
-        acc = acc + jax.lax.dot_general(
-            vals, cls_oh, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            # Exact: one operand is a 0/1 one-hot, so HIGHEST costs little
-            # and keeps predictions bit-stable across platforms.
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                        # [R, C]
-        return acc, None
+    row_chunk = min(row_chunk, R)
+    n_rc = -(-R // row_chunk)
+    rpad = n_rc * row_chunk - R
+    Xp = jnp.pad(Xc, ((0, rpad), (0, 0))).reshape(n_rc, row_chunk, F)
 
-    acc0 = jnp.zeros((R, C), jnp.float32)
-    acc, _ = jax.lax.scan(body, acc0, (featp, thrp, leafp, valp, cls))
-    out = base + learning_rate * acc
+    def row_body(_, xrc):
+        def tree_body(acc, args):
+            f, t, v, coh = args
+            k = _descend(f, t, xrc, max_depth)
+            vals = _select_level(k, v)                       # [chunk, Rc]
+            # Scatter chunk sums into classes: one_hot [chunk, C] matmul.
+            acc = acc + jax.lax.dot_general(
+                vals, coh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                # Exact: one operand is a 0/1 one-hot, so HIGHEST costs
+                # little and keeps predictions bit-stable across platforms.
+                precision=jax.lax.Precision.HIGHEST,
+            )                                                # [Rc, C]
+            return acc, None
+
+        acc0 = jnp.zeros((row_chunk, C), jnp.float32)
+        acc, _ = jax.lax.scan(tree_body, acc0, (featp, thrp, valp, cls_oh))
+        return None, acc
+
+    _, accs = jax.lax.scan(row_body, None, Xp)               # [n_rc, Rc, C]
+    out = base + learning_rate * accs.reshape(n_rc * row_chunk, C)[:R]
     return out[:, 0] if C == 1 else out
 
 
