@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +53,15 @@ P = jax.sharding.PartitionSpec
 
 AXIS = "rows"    # the data-parallel mesh axis (SURVEY.md §2 "Mesh axes")
 FAXIS = "features"  # optional TP-analog axis: column-sharded histogramming
+
+
+class LabelHandle(NamedTuple):
+    """Labels + pad-row validity mask, row-sharded — the opaque `y` handle
+    the Driver threads through grad_hess/loss_value. Per-dataset state lives
+    here, NOT on the backend instance (instances are cached and shared)."""
+
+    y: jax.Array
+    valid: jax.Array
 
 
 def enable_persistent_compile_cache() -> None:
@@ -122,8 +131,6 @@ class TPUDevice(DeviceBackend):
         else:
             self.mesh = None
         self.distributed = self.mesh is not None
-        self._valid = None       # [Rp] bool row-validity mask (pad exclusion)
-        self._n_rows = None      # real (unpadded) training row count
         self._input_dtype = jnp.dtype(cfg.matmul_input_dtype)
 
     # ------------------------------------------------------------------ #
@@ -169,15 +176,17 @@ class TPUDevice(DeviceBackend):
             data = jax.device_put(Xp, self._sharding(AXIS, FAXIS))
         else:
             data = self._put_rows(Xb, extra_dims=1)
-        # Validity mask for the training rows this upload defines.
-        valid = np.zeros(data.shape[0], bool)
-        valid[:R] = True
-        self._valid = self._put_rows(valid)
-        self._n_rows = R
         return data
 
-    def upload_labels(self, y: np.ndarray) -> jax.Array:
-        return self._put_rows(np.asarray(y))
+    def upload_labels(self, y: np.ndarray) -> "LabelHandle":
+        # The pad-row validity mask travels WITH the labels (not on the
+        # backend instance): backend instances are cached and shared across
+        # fits, so per-dataset state must live in the opaque handles the
+        # Driver threads through grad_hess/loss_value.
+        y = np.asarray(y)
+        valid = np.zeros(self._pad_rows(y).shape[0], bool)
+        valid[: y.shape[0]] = True
+        return LabelHandle(self._put_rows(y), self._put_rows(valid))
 
     # ------------------------------------------------------------------ #
     # granular L3 kernels (parity/bench surface)
@@ -248,7 +257,7 @@ class TPUDevice(DeviceBackend):
     # ------------------------------------------------------------------ #
 
     def init_pred(self, y, base: float):
-        Rp = y.shape[0]
+        Rp = y.y.shape[0]
         if self.cfg.loss == "softmax":
             z = np.zeros((Rp, self.cfg.n_classes), np.float32)
             sh = self._sharding(AXIS, None)
@@ -277,7 +286,7 @@ class TPUDevice(DeviceBackend):
         return f
 
     def grad_hess(self, pred, y):
-        return self._grad_fn(pred, y, self._valid)
+        return self._grad_fn(pred, y.y, y.valid)
 
     @functools.cached_property
     def _grow_fn(self):
@@ -381,7 +390,7 @@ class TPUDevice(DeviceBackend):
         return f
 
     def loss_value(self, pred, y) -> float:
-        return float(self._loss_fn(pred, y, self._valid))
+        return float(self._loss_fn(pred, y.y, y.valid))
 
     # ------------------------------------------------------------------ #
     # inference (TreeEnsemble.predict → gather+compare, row-sharded)
@@ -397,13 +406,34 @@ class TPUDevice(DeviceBackend):
     def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
         R = Xb.shape[0]
         chunk = self.PREDICT_ROW_CHUNK * max(1, self.n_partitions)
+        fn, ens_dev = self._predict_fn(ens)     # upload the ensemble ONCE
         if R > chunk:
-            return np.concatenate([
-                self.predict_raw(ens, Xb[i:i + chunk])
-                for i in range(0, R, chunk)
-            ])
+            if self.distributed:
+                # Per-chunk host→device upload (each chunk must be laid out
+                # over the mesh); ensemble arrays + shard_map fn hoisted.
+                outs = [
+                    fn(*ens_dev, self._put_rows(Xb[i:i + chunk],
+                                                extra_dims=1)
+                       )[:min(chunk, R - i)]       # drop per-chunk pad rows
+                    for i in range(0, R, chunk)
+                ]
+            else:
+                # Single chip: upload the whole batch ONCE (uint8 — 4x less
+                # host→device traffic than int32, which dominates wallclock
+                # on a remote-attached chip), slice chunks on device, fetch
+                # all outputs in one device→host transfer at the end.
+                Xd = jax.device_put(np.ascontiguousarray(Xb))
+                outs = [
+                    fn(*ens_dev, Xd[i:i + chunk]) for i in range(0, R, chunk)
+                ]
+            return np.asarray(jnp.concatenate(outs))[:R]
+        Xc = self._put_rows(Xb, extra_dims=1)       # uint8; ops widen it
+        out = fn(*ens_dev, Xc)
+        return np.asarray(out)[:R]
+
+    def _predict_fn(self, ens: TreeEnsemble):
+        """(jittable scoring fn, device-resident ensemble arrays)."""
         C = ens.n_classes if ens.loss == "softmax" else 1
-        Xc = self._put_rows(Xb.astype(np.int32), extra_dims=1)
         feat = jax.device_put(ens.feature.astype(np.int32), self._sharding())
         thr = jax.device_put(ens.threshold_bin.astype(np.int32), self._sharding())
         leaf = jax.device_put(ens.is_leaf, self._sharding())
@@ -433,5 +463,4 @@ class TPUDevice(DeviceBackend):
                 # here (no collectives anywhere in the traversal).
                 check_vma=False,
             )
-        out = fn(feat, thr, leaf, val, Xc)
-        return np.asarray(out)[:R]
+        return fn, (feat, thr, leaf, val)

@@ -169,7 +169,11 @@ def bench_predict(
 
     cfg = TrainConfig(backend=backend, n_partitions=partitions, n_bins=bins)
     be = get_backend(cfg)
-    be.predict_raw(ens, Xb[: min(rows, 4096)])      # warm-up compile
+    # Warm-up with one FULL untimed pass: jit caches are shape-keyed and
+    # device backends chunk rows internally, so only an identical call is
+    # guaranteed to compile every shape (incl. a remainder chunk) the timed
+    # run will hit.
+    be.predict_raw(ens, Xb)
     t0 = time.perf_counter()
     out = be.predict_raw(ens, Xb)
     dt = time.perf_counter() - t0

@@ -81,9 +81,13 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
-    from ddt_tpu.backends.tpu import enable_persistent_compile_cache
+    try:    # our process: cache XLA compiles (keep jax a soft dependency —
+        # cpu-backend CLI use must work without it)
+        from ddt_tpu.backends.tpu import enable_persistent_compile_cache
 
-    enable_persistent_compile_cache()   # our process: cache XLA compiles
+        enable_persistent_compile_cache()
+    except ImportError:
+        pass
     ap = argparse.ArgumentParser(prog="ddt_tpu",
                                  description="TPU-native distributed GBDT")
     sub = ap.add_subparsers(dest="cmd", required=True)

@@ -137,7 +137,9 @@ def predict_raw(
     are accumulated into the per-class output (round-major tree->class
     interleave for softmax, matching reference/numpy_trainer.fit).
     """
-    T = feature.shape[0]
+    if jnp.issubdtype(Xc.dtype, jnp.integer):
+        Xc = Xc.astype(jnp.int32)      # uint8 uploads are 4x cheaper; widen
+    T = feature.shape[0]               # on device where casts are free
     R, F = Xc.shape
     C = n_classes
     if R == 0:
