@@ -13,6 +13,7 @@ Public surface (layer L8):
 from ddt_tpu.api import TrainResult, predict, train
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble
+from ddt_tpu.sklearn import DDTClassifier, DDTRegressor
 
 __version__ = "0.1.0"
 
@@ -22,5 +23,7 @@ __all__ = [
     "TrainResult",
     "TrainConfig",
     "TreeEnsemble",
+    "DDTClassifier",
+    "DDTRegressor",
     "__version__",
 ]

@@ -105,9 +105,21 @@ class DeviceBackend(abc.ABC):
     def grad_hess(self, pred: Any, y: Any) -> tuple[Any, Any]:
         """Loss gradients/hessians at `pred`: float32 [R] or [R, C]."""
 
+    def apply_row_mask(self, g: Any, h: Any, mask: np.ndarray):
+        """(g * mask, h * mask) — per-round row bagging (cfg.subsample).
+        `mask` is a host bool [R]; device backends upload + fuse the
+        multiply. Default: NumPy elementwise."""
+        m = mask.astype(np.float32)
+        if getattr(g, "ndim", 1) == 2:
+            m = m[:, None]
+        return g * m, h * m
+
     @abc.abstractmethod
-    def grow_tree(self, data: Any, g: Any, h: Any) -> tuple[Any, Any]:
+    def grow_tree(self, data: Any, g: Any, h: Any,
+                  feature_mask: np.ndarray | None = None) -> tuple[Any, Any]:
         """Grow one complete-heap tree from (sharded) data + grads.
+        feature_mask (host bool [F], or None) excludes features from split
+        selection — cfg.colsample_bytree.
 
         Returns (tree_handle, delta): a backend-opaque handle to the tree's
         node arrays (resolve with fetch_tree), and the per-row raw-score

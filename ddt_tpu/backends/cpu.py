@@ -94,10 +94,12 @@ class CPUDevice(DeviceBackend):
     def grad_hess(self, pred, y):
         return ref.grad_hess(pred, y, self.cfg.loss)
 
-    def grow_tree(self, data, g, h) -> tuple[HostTree, Any]:
+    def grow_tree(self, data, g, h,
+                  feature_mask=None) -> tuple[HostTree, Any]:
         tree = ref.grow_tree(
             data, g, h, self.cfg,
             hist_fn=self.build_histograms, split_fn=self.best_splits,
+            feature_mask=feature_mask,
         )
         delta = (
             self.cfg.learning_rate * tree["leaf_value"][tree["leaf_of_row"]]

@@ -290,11 +290,18 @@ class TPUDevice(DeviceBackend):
 
     @functools.cached_property
     def _grow_fn(self):
+        return self._build_grow_fn(with_mask=False)
+
+    @functools.cached_property
+    def _grow_masked_fn(self):
+        return self._build_grow_fn(with_mask=True)
+
+    def _build_grow_fn(self, with_mask: bool):
         cfg = self.cfg
         axis = AXIS if self.distributed else None
         faxis = FAXIS if self.feature_partitions > 1 else None
 
-        def grow(Xb, g, h):
+        def grow(Xb, g, h, fmask=None):
             tree = grow_ops.grow_tree(
                 Xb, g, h,
                 max_depth=cfg.max_depth,
@@ -306,6 +313,7 @@ class TPUDevice(DeviceBackend):
                 input_dtype=self._input_dtype,
                 axis_name=axis,
                 feature_axis_name=faxis,
+                feature_mask=fmask,
             )
             delta = grow_ops.tree_predict_delta(tree, cfg.learning_rate)
             # Pack the four tiny node arrays into ONE f32 array so the host
@@ -322,12 +330,21 @@ class TPUDevice(DeviceBackend):
             ])
             return packed, delta
 
+        if not with_mask:
+            inner = grow
+
+            def grow(Xb, g, h):          # noqa: F811 — 3-arg jit signature
+                return inner(Xb, g, h, None)
+
         if self.distributed:
             data_spec = P(AXIS, FAXIS) if faxis else P(AXIS, None)
+            in_specs = (data_spec, P(AXIS), P(AXIS))
+            if with_mask:
+                in_specs = in_specs + (P(),)       # mask replicated
             grow = jax.shard_map(
                 grow,
                 mesh=self.mesh,
-                in_specs=(data_spec, P(AXIS), P(AXIS)),
+                in_specs=in_specs,
                 out_specs=(P(), P(AXIS)),
                 # Feature-parallel growth replicates every output across the
                 # feature axis BIT-IDENTICALLY by construction (split triples
@@ -340,10 +357,34 @@ class TPUDevice(DeviceBackend):
             )
         return jax.jit(grow)
 
-    def grow_tree(self, data, g, h) -> tuple[Any, Any]:
+    def grow_tree(self, data, g, h,
+                  feature_mask=None) -> tuple[Any, Any]:
         """Returns (device packed-tree handle, delta) — no host sync here;
         the Driver resolves the handle via fetch_tree one round later."""
-        return self._grow_fn(data, g, h)
+        if feature_mask is None:
+            return self._grow_fn(data, g, h)
+        # Pad the host mask to the (padded, global) feature count; padded
+        # columns stay masked out.
+        Fg = data.shape[1]
+        m = np.zeros(Fg, bool)
+        m[: feature_mask.shape[0]] = feature_mask
+        return self._grow_masked_fn(data, g, h, jax.device_put(m))
+
+    def apply_row_mask(self, g, h, mask):
+        # Upload bool (1 byte/row); the cast to f32 is a free fused device op.
+        m = self._put_rows(mask.astype(bool))
+        return self._row_mask_fn(g, h, m)
+
+    @functools.cached_property
+    def _row_mask_fn(self):
+        @jax.jit
+        def f(g, h, m):
+            m = m.astype(jnp.float32)
+            if g.ndim == 2:
+                m = m[:, None]
+            return g * m, h * m
+
+        return f
 
     def fetch_tree(self, handle) -> HostTree:
         packed = np.asarray(handle)                      # ONE fetch

@@ -102,6 +102,10 @@ def main(argv: list[str] | None = None) -> int:
     tp.add_argument("--feature-partitions", type=int, default=1,
                     help="column partitions (TP-analog mesh axis); uses "
                          "partitions x feature-partitions devices")
+    tp.add_argument("--subsample", type=float, default=1.0,
+                    help="row fraction per boosting round (bagging)")
+    tp.add_argument("--colsample-bytree", type=float, default=1.0,
+                    help="feature fraction per tree")
     tp.add_argument("--hist-impl", default="auto",
                     choices=["auto", "matmul", "segment", "pallas"])
     tp.add_argument("--out", default="ensemble.npz")
@@ -145,6 +149,8 @@ def main(argv: list[str] | None = None) -> int:
             n_classes=n_classes if loss == "softmax" else 2,
             backend=args.backend, n_partitions=args.partitions,
             feature_partitions=args.feature_partitions,
+            subsample=args.subsample,
+            colsample_bytree=args.colsample_bytree,
             hist_impl=args.hist_impl, seed=args.seed,
         )
         eval_set = None

@@ -37,6 +37,10 @@ class TrainConfig:
     min_child_weight: float = 1e-3   # min hessian sum per child
     min_split_gain: float = 0.0      # split only if gain > this
 
+    # --- stochastic training (LightGBM/XGBoost-style bagging) ---
+    subsample: float = 1.0           # row fraction per boosting round
+    colsample_bytree: float = 1.0    # feature fraction per tree
+
     # --- system ---
     backend: str = "tpu"        # cpu | tpu | fpga(stub)
     n_partitions: int = 1       # row partitions (data parallel over mesh axis)
@@ -66,6 +70,10 @@ class TrainConfig:
             raise ValueError("softmax needs n_classes >= 2")
         if self.n_partitions < 1 or self.feature_partitions < 1:
             raise ValueError("partition counts must be >= 1")
+        if not (0.0 < self.subsample <= 1.0):
+            raise ValueError("subsample must be in (0, 1]")
+        if not (0.0 < self.colsample_bytree <= 1.0):
+            raise ValueError("colsample_bytree must be in (0, 1]")
 
     @property
     def n_nodes_total(self) -> int:

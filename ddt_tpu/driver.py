@@ -177,13 +177,36 @@ class Driver:
             ens.leaf_value[slot] = tree["leaf_value"]
             return tree
 
+        # Stochastic training (cfg.subsample / cfg.colsample_bytree): masks
+        # are drawn host-side from per-(seed, round[, class]) generators, so
+        # they are identical on every backend/partition layout AND across
+        # checkpoint resume (no RNG stream to fast-forward).
+        bagging = cfg.subsample < 1.0
+        colsample = cfg.colsample_bytree < 1.0
+
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
             g, h = self.backend.grad_hess(pred, y_dev)
+            if bagging:
+                rmask = (
+                    np.random.default_rng((cfg.seed, 7919, rnd)).random(R)
+                    < cfg.subsample
+                )
+                g, h = self.backend.apply_row_mask(g, h, rmask)
             for c in range(C):
                 gc = g[:, c] if C > 1 else g
                 hc = h[:, c] if C > 1 else h
-                handle, delta = self.backend.grow_tree(data, gc, hc)
+                fmask = None
+                if colsample:
+                    fmask = (
+                        np.random.default_rng(
+                            (cfg.seed, 104729, rnd, c)).random(F)
+                        < cfg.colsample_bytree
+                    )
+                    if not fmask.any():     # degenerate draw: keep 1 feature
+                        fmask[rnd % F] = True
+                handle, delta = self.backend.grow_tree(
+                    data, gc, hc, feature_mask=fmask)
                 pred = self.backend.apply_delta(pred, delta, c)
                 if val_raw is not None:
                     tree = _store(handle, t_out)

@@ -137,6 +137,19 @@ class TreeEnsemble:
     # Serialization (SURVEY.md §5 checkpoint/resume: ensembles are tiny)
     # ------------------------------------------------------------------ #
 
+    def feature_importances(self, kind: str = "split") -> np.ndarray:
+        """Normalized per-feature importance, float32 [n_features].
+
+        kind="split": fraction of internal-node splits using the feature
+        (LightGBM's importance_type="split")."""
+        if kind != "split":
+            raise ValueError(f"unknown importance kind {kind!r}")
+        used = self.feature[(~self.is_leaf) & (self.feature >= 0)]
+        counts = np.bincount(used, minlength=self.n_features)
+        counts = counts[: self.n_features].astype(np.float64)
+        tot = counts.sum()
+        return (counts / tot if tot > 0 else counts).astype(np.float32)
+
     def to_dict(self) -> dict:
         return {
             "feature": self.feature,

@@ -68,12 +68,14 @@ def grow_tree(
     input_dtype=jnp.bfloat16,
     axis_name: str | None = None,
     feature_axis_name: str | None = None,
+    feature_mask: jax.Array | None = None,   # bool [F global]; colsample
 ) -> TreeArrays:
     """Grow one complete-heap tree. Trace under jit (and shard_map if
     axis_name is set). Matches reference/numpy_trainer.grow_tree decisions.
 
     With feature_axis_name, Xb is the [R_loc, F_loc] column shard and the
-    returned tree's feature indices are GLOBAL (shard offset applied)."""
+    returned tree's feature indices are GLOBAL (shard offset applied);
+    feature_mask is indexed globally and sliced to the local columns."""
     R, F = Xb.shape
     N = 2 ** (max_depth + 1) - 1
 
@@ -91,6 +93,9 @@ def grow_tree(
     if feature_axis_name is not None:
         f_shard = jax.lax.axis_index(feature_axis_name)
         f_lo = f_shard * F                 # global index of local column 0
+        if feature_mask is not None:
+            feature_mask = jax.lax.dynamic_slice_in_dim(
+                feature_mask, f_lo, F)     # this shard's columns
 
     for depth in range(max_depth):         # unrolled: static 2^d nodes/level
         offset = (1 << depth) - 1
@@ -114,7 +119,8 @@ def grow_tree(
                 jnp.where(act, g, 0.0), seg, num_segments=n_level))
             Hh = allreduce(jax.ops.segment_sum(
                 jnp.where(act, h, 0.0), seg, num_segments=n_level))
-        gains, feats, bins = S.best_splits(hist, reg_lambda, min_child_weight)
+        gains, feats, bins = S.best_splits(
+            hist, reg_lambda, min_child_weight, feature_mask)
         if feature_axis_name is not None:
             # Combine per-shard winners: all_gather the (gain, feat, bin)
             # triples (tiny), argmax over shards — first shard wins ties,

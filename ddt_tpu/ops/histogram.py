@@ -14,7 +14,7 @@ implementations (SURVEY.md §7 "hard parts (a)"):
   one-hot tile-by-tile in VMEM and feeds one dot_general per tile to the MXU
   — nothing but Xb and the output ever touches HBM. The TPU default for
   shapes whose working set fits VMEM (hist_pallas.pallas_fits); measured
-  ~2x the matmul path on v5e at the Higgs-1M shape (43-57 Mrows/s across
+  ~2x the matmul path on v5e at the Higgs-1M shape (46-62 Mrows/s across
   tile/row configs vs ~26).
 - "matmul": one-hot outer-product accumulation on the MXU. Per feature f the
   histogram is A^T @ Bf where A [R, 2N] stacks node-one-hot weighted by g and

@@ -32,12 +32,14 @@ def best_splits(
     hist: jax.Array,            # float32 [n_nodes, F, B, 2]
     reg_lambda: float,
     min_child_weight: float,
+    feature_mask: jax.Array | None = None,   # bool [F]; False = excluded
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-node best split: (gain [n], feature [n] int32, bin [n] int32).
 
     gain = 0.5 * (GL^2/(HL+l) + GR^2/(HR+l) - G^2/(H+l)); split at bin b
     sends bins <= b left; last bin invalid (empty right child); children must
     carry >= min_child_weight hessian mass. Invalid positions score -inf.
+    feature_mask implements colsample_bytree: masked features never win.
     """
     n_nodes, F, B, _ = hist.shape
     GL = jnp.cumsum(hist[..., 0], axis=2)           # [n, F, B]
@@ -55,6 +57,8 @@ def best_splits(
     valid = (HL >= min_child_weight) & (HR >= min_child_weight)
     valid = valid & (jnp.arange(B) < B - 1)[None, None, :]
     valid = valid & ~jnp.isnan(gain)                # 0/0 when reg_lambda == 0
+    if feature_mask is not None:
+        valid = valid & feature_mask[None, :, None]
     # Deterministic split selection: round gains to bfloat16 before argmax.
     # Gains within float noise of each other (different cumsum algorithms,
     # psum accumulation order across partitions, NumPy-vs-XLA rounding)

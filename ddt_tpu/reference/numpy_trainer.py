@@ -100,6 +100,7 @@ def best_splits(
     hist: np.ndarray,          # [n_nodes, F, B, 2]
     reg_lambda: float,
     min_child_weight: float,
+    feature_mask: np.ndarray | None = None,   # bool [F]; False = excluded
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Reference SplitGain: per-node best (gain, feature, threshold_bin).
 
@@ -126,6 +127,8 @@ def best_splits(
     valid[:, :, B - 1] = False                 # cannot split on last bin
     # 0/0 with reg_lambda=0 yields NaN; NaN would win np.argmax — mask it.
     valid &= ~np.isnan(gain)
+    if feature_mask is not None:
+        valid &= feature_mask[None, :, None]
     # Deterministic selection (see ops/split.py): bf16-rounded gains turn
     # float-noise near-ties into exact ties with a shared first-index
     # tie-break, so CPU/TPU/any-partition-count all pick identical splits.
@@ -152,12 +155,15 @@ def grow_tree(
     cfg: TrainConfig,
     hist_fn=None,
     split_fn=None,
+    feature_mask: np.ndarray | None = None,
 ) -> dict:
     """Grow one complete-heap tree. Returns dict of node arrays [n_nodes_total].
 
     hist_fn/split_fn inject alternate L3 kernels with the same contract
     (CPUDevice passes the native C++ ones — bit-parity guaranteed); defaults
-    are the NumPy oracle kernels in this module.
+    are the NumPy oracle kernels in this module. feature_mask
+    (colsample_bytree) falls back to the NumPy SplitGain — the native kernel
+    has no mask parameter — which is bit-identical anyway.
     """
     R, F = Xb.shape
     N = cfg.n_nodes_total
@@ -178,11 +184,11 @@ def grow_tree(
         else:
             hist = build_histograms(Xb, g, h, node_index, n_level, cfg.n_bins)
         G, H = node_totals(hist)
-        if split_fn is not None:
+        if split_fn is not None and feature_mask is None:
             gains, feats, bins = split_fn(hist)
         else:
             gains, feats, bins = best_splits(
-                hist, cfg.reg_lambda, cfg.min_child_weight
+                hist, cfg.reg_lambda, cfg.min_child_weight, feature_mask
             )
         value = -G / (H + cfg.reg_lambda)
 

@@ -1,0 +1,159 @@
+"""scikit-learn-style estimator facade over the L8 train/predict API.
+
+`DDTClassifier` / `DDTRegressor` wrap quantization + training + scoring in
+the fit/predict idiom so the framework drops into sklearn-shaped pipelines
+(the reference exposes a train/predict CLI; this is the adoption-surface
+equivalent for Python users). Not a full sklearn BaseEstimator — no sklearn
+dependency — but follows its conventions: constructor stores hyperparams
+verbatim, fit() learns state on `self`, fitted attributes end in "_".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ddt_tpu.config import TrainConfig
+
+
+class _DDTBase:
+    _LOSS: str = ""
+
+    def __init__(
+        self,
+        n_trees: int = 100,
+        max_depth: int = 6,
+        n_bins: int = 255,
+        learning_rate: float = 0.1,
+        reg_lambda: float = 1.0,
+        min_child_weight: float = 1e-3,
+        min_split_gain: float = 0.0,
+        subsample: float = 1.0,
+        colsample_bytree: float = 1.0,
+        backend: str = "tpu",
+        n_partitions: int = 1,
+        seed: int = 0,
+    ):
+        self.n_trees = n_trees
+        self.max_depth = max_depth
+        self.n_bins = n_bins
+        self.learning_rate = learning_rate
+        self.reg_lambda = reg_lambda
+        self.min_child_weight = min_child_weight
+        self.min_split_gain = min_split_gain
+        self.subsample = subsample
+        self.colsample_bytree = colsample_bytree
+        self.backend = backend
+        self.n_partitions = n_partitions
+        self.seed = seed
+
+    def _cfg(self, **extra) -> TrainConfig:
+        extra.setdefault("loss", self._LOSS)
+        return TrainConfig(
+            n_trees=self.n_trees,
+            max_depth=self.max_depth,
+            n_bins=self.n_bins,
+            learning_rate=self.learning_rate,
+            reg_lambda=self.reg_lambda,
+            min_child_weight=self.min_child_weight,
+            min_split_gain=self.min_split_gain,
+            subsample=self.subsample,
+            colsample_bytree=self.colsample_bytree,
+            backend=self.backend,
+            n_partitions=self.n_partitions,
+            seed=self.seed,
+            **extra,
+        )
+
+    def fit(self, X, y, eval_set=None, early_stopping_rounds=None):
+        from ddt_tpu import api
+
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y)
+        cfg = self._cfg(**self._fit_cfg_extra(y))
+        if eval_set is not None:
+            eval_set = (np.asarray(eval_set[0], np.float32),
+                        np.asarray(eval_set[1]))
+        # early_stopping_rounds passes through even without an eval_set so
+        # the Driver's "requires an eval_set" error reaches the user.
+        res = api.train(X, y, cfg, log_every=10 ** 9, eval_set=eval_set,
+                        early_stopping_rounds=early_stopping_rounds)
+        self.ensemble_ = res.ensemble
+        self.mapper_ = res.mapper
+        self.n_features_in_ = X.shape[1]
+        self.feature_importances_ = self.ensemble_.feature_importances()
+        return self
+
+    def _fit_cfg_extra(self, y) -> dict:
+        return {}
+
+    def _raw(self, X) -> np.ndarray:
+        from ddt_tpu import api
+
+        return api.predict(self.ensemble_, np.asarray(X, np.float32),
+                           mapper=self.mapper_, raw=True)
+
+
+class DDTClassifier(_DDTBase):
+    """Gradient-boosted decision-tree classifier (binary or multiclass)."""
+
+    _LOSS = "logloss"
+
+    def _fit_cfg_extra(self, y) -> dict:
+        n = len(np.unique(y))
+        if n > 2:
+            return {"loss": "softmax", "n_classes": n}
+        return {}
+
+    def fit(self, X, y, eval_set=None, early_stopping_rounds=None):
+        y = np.asarray(y)
+        classes = np.unique(y)
+        # Map labels to 0..C-1 for training; predictions map back.
+        y_enc = np.searchsorted(classes, y)
+        if eval_set is not None:
+            yv = np.asarray(eval_set[1])
+            unseen = ~np.isin(yv, classes)
+            if unseen.any():
+                raise ValueError(
+                    f"eval_set contains labels not present in y: "
+                    f"{np.unique(yv[unseen])!r}"
+                )
+            eval_set = (eval_set[0], np.searchsorted(classes, yv))
+        super().fit(X, y_enc, eval_set=eval_set,
+                    early_stopping_rounds=early_stopping_rounds)
+        self.classes_ = classes
+        return self
+
+    def predict_proba(self, X) -> np.ndarray:
+        from ddt_tpu import api
+
+        # The raw->probability transform lives in TreeEnsemble.predict
+        # (api.predict raw=False); binary returns p(class 1), stacked here.
+        p = api.predict(self.ensemble_, np.asarray(X, np.float32),
+                        mapper=self.mapper_)
+        if p.ndim == 2:            # softmax: already a distribution
+            return p
+        return np.stack([1.0 - p, p], axis=1)
+
+    def predict(self, X) -> np.ndarray:
+        return self.classes_[self.predict_proba(X).argmax(axis=1)]
+
+    def score(self, X, y) -> float:
+        """Mean accuracy."""
+        return float((self.predict(X) == np.asarray(y)).mean())
+
+
+class DDTRegressor(_DDTBase):
+    """Gradient-boosted decision-tree regressor (squared error)."""
+
+    _LOSS = "mse"
+
+    def predict(self, X) -> np.ndarray:
+        return self._raw(X)
+
+    def score(self, X, y) -> float:
+        """R^2 coefficient of determination."""
+        y = np.asarray(y, np.float64)
+        pred = self.predict(X).astype(np.float64)
+        ss_res = float(np.square(y - pred).sum())
+        ss_tot = float(np.square(y - y.mean()).sum())
+        return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0

@@ -1,0 +1,116 @@
+"""Stochastic training (subsample / colsample_bytree), feature importance,
+and the sklearn-style estimator facade."""
+
+import numpy as np
+import pytest
+
+from ddt_tpu import api
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.data.datasets import synthetic_binary, synthetic_multiclass
+from ddt_tpu.data.quantizer import quantize
+
+
+def _data(n=4000, f=8, seed=3):
+    X, y = synthetic_binary(n, n_features=f, seed=seed)
+    Xb, m = quantize(X, n_bins=63, seed=seed)
+    return X, Xb, y, m
+
+
+def test_config_validates_sampling_fractions():
+    for bad in (dict(subsample=0.0), dict(subsample=1.5),
+                dict(colsample_bytree=0.0), dict(colsample_bytree=-1)):
+        with pytest.raises(ValueError):
+            TrainConfig(**bad)
+
+
+def test_colsample_masks_features_in_split_selection():
+    from ddt_tpu.reference import numpy_trainer as ref
+
+    rng = np.random.default_rng(0)
+    hist = np.abs(rng.standard_normal((4, 6, 31, 2)).astype(np.float32))
+    mask = np.array([True, False, True, False, False, False])
+    _, feats, _ = ref.best_splits(hist, 1.0, 1e-3, feature_mask=mask)
+    assert set(np.unique(feats)) <= {0, 2}
+
+    import jax.numpy as jnp
+    from ddt_tpu.ops import split as S
+
+    _, jfeats, _ = S.best_splits(jnp.asarray(hist), 1.0, 1e-3,
+                                 jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(jfeats), feats)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_sampling_trains_and_is_deterministic(backend):
+    _, Xb, y, _ = _data()
+    cfg = TrainConfig(n_trees=6, max_depth=4, n_bins=63, backend=backend,
+                      subsample=0.7, colsample_bytree=0.6, seed=5)
+    a = api.train(Xb, y, cfg, binned=True, log_every=10 ** 9).ensemble
+    b = api.train(Xb, y, cfg, binned=True, log_every=10 ** 9).ensemble
+    np.testing.assert_array_equal(a.feature, b.feature)
+    np.testing.assert_array_equal(a.leaf_value, b.leaf_value)
+    # And it actually changed the model vs no sampling.
+    full = api.train(Xb, y, cfg.replace(subsample=1.0, colsample_bytree=1.0),
+                     binned=True, log_every=10 ** 9).ensemble
+    assert not np.array_equal(a.feature, full.feature)
+
+
+def test_sampling_backend_parity():
+    """CPU and TPU grow identical ensembles under bagging + colsampling
+    (masks are host-side and backend-independent)."""
+    _, Xb, y, _ = _data(n=2500, f=6)
+    kw = dict(n_trees=5, max_depth=4, n_bins=63,
+              subsample=0.8, colsample_bytree=0.5, seed=11)
+    ec = api.train(Xb, y, TrainConfig(backend="cpu", **kw),
+                   binned=True, log_every=10 ** 9).ensemble
+    et = api.train(Xb, y, TrainConfig(backend="tpu", **kw),
+                   binned=True, log_every=10 ** 9).ensemble
+    np.testing.assert_array_equal(ec.feature, et.feature)
+    np.testing.assert_array_equal(ec.threshold_bin, et.threshold_bin)
+    np.testing.assert_array_equal(ec.is_leaf, et.is_leaf)
+
+
+def test_feature_importances_split_counts():
+    _, Xb, y, _ = _data()
+    ens = api.train(Xb, y, TrainConfig(n_trees=8, max_depth=4, n_bins=63,
+                                       backend="cpu"),
+                    binned=True, log_every=10 ** 9).ensemble
+    imp = ens.feature_importances()
+    assert imp.shape == (Xb.shape[1],)
+    assert imp.min() >= 0 and abs(imp.sum() - 1.0) < 1e-6
+    # Hand-count parity.
+    used = ens.feature[(~ens.is_leaf) & (ens.feature >= 0)]
+    want = np.bincount(used, minlength=Xb.shape[1]) / len(used)
+    np.testing.assert_allclose(imp, want, rtol=1e-6)
+
+
+def test_sklearn_classifier_binary():
+    from ddt_tpu.sklearn import DDTClassifier
+
+    X, _, y, _ = _data()
+    y_lab = np.where(y > 0, "pos", "neg")        # non-integer labels
+    clf = DDTClassifier(n_trees=15, max_depth=4, n_bins=63, backend="cpu")
+    clf.fit(X, y_lab)
+    assert set(clf.classes_) == {"neg", "pos"}
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(X), 2)
+    np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-5)
+    assert clf.score(X, y_lab) > 0.72
+    assert clf.feature_importances_.shape == (X.shape[1],)
+
+
+def test_sklearn_classifier_multiclass_and_regressor():
+    from ddt_tpu.sklearn import DDTClassifier, DDTRegressor
+
+    X, y = synthetic_multiclass(3000, n_features=6, n_classes=3, seed=2)
+    clf = DDTClassifier(n_trees=8, max_depth=4, n_bins=63, backend="cpu")
+    clf.fit(X, y + 10)                            # offset labels map back
+    assert set(clf.classes_) == {10, 11, 12}
+    assert clf.score(X, y + 10) > 0.7
+
+    rng = np.random.default_rng(0)
+    Xr = rng.standard_normal((3000, 5)).astype(np.float32)
+    yr = Xr[:, 0] * 2 - Xr[:, 1] + 0.1 * rng.standard_normal(3000)
+    reg = DDTRegressor(n_trees=30, max_depth=4, n_bins=63, backend="cpu")
+    reg.fit(Xr, yr)
+    assert reg.score(Xr, yr) > 0.8
