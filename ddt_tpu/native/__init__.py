@@ -23,7 +23,10 @@ import subprocess
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "libddthist.so")
+# DDT_NATIVE_LIB selects an alternate build, e.g. libddthist_asan.so from
+# `make -C ddt_tpu/native asan` (run tests under sanitizers; needs the asan
+# runtime preloaded — see the Makefile comment).
+_SO = os.path.join(_DIR, os.environ.get("DDT_NATIVE_LIB", "libddthist.so"))
 
 
 _SYMBOLS = ("ddt_build_histograms", "ddt_traverse", "ddt_split_gain")
