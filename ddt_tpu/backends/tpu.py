@@ -349,8 +349,9 @@ class TPUDevice(DeviceBackend):
                 # Feature-parallel growth replicates every output across the
                 # feature axis BIT-IDENTICALLY by construction (split triples
                 # come out of an all_gather + argmax every shard computes the
-                # same way; node totals/leaf sums are segment_sums of
-                # feature-invariant row vectors; routing values ride a psum).
+                # same way; node totals/leaf aggregates reduce feature-axis-
+                # replicated row vectors with identical programs on every
+                # shard; routing values ride a psum).
                 # The static VMA checker cannot see through the gathered
                 # argmax, so it is disabled for this path only.
                 check_vma=faxis is None,
