@@ -46,6 +46,7 @@ def train(
     eval_set: tuple[np.ndarray, np.ndarray] | None = None,
     eval_metric: str | None = None,
     early_stopping_rounds: int | None = None,
+    profile: bool = False,
     **cfg_overrides,
 ) -> TrainResult:
     """Train a GBDT. `X` is float features (quantized here) unless
@@ -92,6 +93,7 @@ def train(
         log_every=log_every,
         checkpoint_dir=checkpoint_dir,
         checkpoint_every=checkpoint_every,
+        profile=profile,
     )
     ens = driver.fit(
         Xb, np.asarray(y),

@@ -105,6 +105,11 @@ class DeviceBackend(abc.ABC):
     def grad_hess(self, pred: Any, y: Any) -> tuple[Any, Any]:
         """Loss gradients/hessians at `pred`: float32 [R] or [R, C]."""
 
+    def sync(self, x: Any) -> None:
+        """Barrier on x's producer chain, for phase profiling. No-op on
+        host-resident backends (numpy arrays are already materialised);
+        device backends block until x has actually been computed."""
+
     def apply_row_mask(self, g: Any, h: Any, mask: np.ndarray):
         """(g * mask, h * mask) — per-round row bagging (cfg.subsample).
         `mask` is a host bool [R]; device backends upload + fuse the

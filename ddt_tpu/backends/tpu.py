@@ -371,6 +371,11 @@ class TPUDevice(DeviceBackend):
         m[: feature_mask.shape[0]] = feature_mask
         return self._grow_masked_fn(data, g, h, jax.device_put(m))
 
+    def sync(self, x) -> None:
+        from ddt_tpu.utils.device import device_sync
+
+        device_sync(x)
+
     def apply_row_mask(self, g, h, mask):
         # Upload bool (1 byte/row); the cast to f32 is a free fused device op.
         m = self._put_rows(mask.astype(bool))

@@ -102,6 +102,11 @@ def main(argv: list[str] | None = None) -> int:
     tp.add_argument("--feature-partitions", type=int, default=1,
                     help="column partitions (TP-analog mesh axis); uses "
                          "partitions x feature-partitions devices")
+    tp.add_argument("--profile", action="store_true",
+                    help="log a per-phase wallclock breakdown (adds device "
+                         "barriers; rounds run slower than unprofiled)")
+    tp.add_argument("--trace-dir", default=None,
+                    help="capture a jax.profiler trace here (TensorBoard)")
     tp.add_argument("--subsample", type=float, default=1.0,
                     help="row fraction per boosting round (bagging)")
     tp.add_argument("--colsample-bytree", type=float, default=1.0,
@@ -161,12 +166,21 @@ def main(argv: list[str] | None = None) -> int:
             va, tr = idx[:k], idx[k:]
             X, y, eval_set = X[tr], y[tr], (X[va], y[va])
         t0 = time.perf_counter()
-        res = api.train(
-            X, y, cfg, checkpoint_dir=args.checkpoint_dir,
-            checkpoint_every=args.checkpoint_every,
-            eval_set=eval_set, eval_metric=args.metric,
-            early_stopping_rounds=args.early_stop,
-        )
+        import contextlib
+
+        trace_ctx = contextlib.nullcontext()
+        if args.trace_dir:
+            from ddt_tpu.utils.profiling import trace
+
+            trace_ctx = trace(args.trace_dir)
+        with trace_ctx:
+            res = api.train(
+                X, y, cfg, checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every,
+                eval_set=eval_set, eval_metric=args.metric,
+                early_stopping_rounds=args.early_stop,
+                profile=args.profile,
+            )
         dt = time.perf_counter() - t0
         res.ensemble.save(args.out)
         out = {

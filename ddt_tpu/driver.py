@@ -40,10 +40,13 @@ import time
 
 import numpy as np
 
+import contextlib
+
 from ddt_tpu.backends.base import DeviceBackend
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
 from ddt_tpu.reference.numpy_trainer import base_score
+from ddt_tpu.utils.profiling import PhaseTimer
 
 log = logging.getLogger("ddt_tpu.driver")
 
@@ -77,6 +80,7 @@ class Driver:
         log_every: int = 10,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 25,
+        profile: bool = False,
     ):
         self.backend = backend
         self.cfg = cfg
@@ -86,6 +90,16 @@ class Driver:
         self.history: list[dict] = []
         self.best_round: int | None = None
         self.best_score: float | None = None
+        # profile=True records a per-phase wallclock breakdown (SURVEY.md §5
+        # tracing): each phase ends with a device barrier, so rounds get
+        # SLOWER (the fast path pipelines phases without syncs) but the
+        # report shows where device time actually goes.
+        self.timer = PhaseTimer() if profile else None
+
+    def _psync(self, x) -> None:
+        """Backend barrier on x's producer chain (profiling mode only);
+        no-op on host-resident backends."""
+        self.backend.sync(x)
 
     def fit(
         self,
@@ -169,8 +183,14 @@ class Driver:
         # for incremental validation scoring, so the pipeline is bypassed.
         pending: tuple | None = None   # (handle, ensemble slot)
 
+        ph = (
+            self.timer.phase if self.timer is not None
+            else (lambda name: contextlib.nullcontext())
+        )
+
         def _store(handle, slot):
-            tree = self.backend.fetch_tree(handle)
+            with ph("fetch_tree"):
+                tree = self.backend.fetch_tree(handle)
             ens.feature[slot] = tree["feature"]
             ens.threshold_bin[slot] = tree["threshold_bin"]
             ens.is_leaf[slot] = tree["is_leaf"]
@@ -186,7 +206,10 @@ class Driver:
 
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
-            g, h = self.backend.grad_hess(pred, y_dev)
+            with ph("grad"):
+                g, h = self.backend.grad_hess(pred, y_dev)
+                if self.timer is not None:
+                    self._psync(h)
             if bagging:
                 rmask = (
                     np.random.default_rng((cfg.seed, 7919, rnd)).random(R)
@@ -205,9 +228,15 @@ class Driver:
                     )
                     if not fmask.any():     # degenerate draw: keep 1 feature
                         fmask[rnd % F] = True
-                handle, delta = self.backend.grow_tree(
-                    data, gc, hc, feature_mask=fmask)
-                pred = self.backend.apply_delta(pred, delta, c)
+                with ph("grow"):
+                    handle, delta = self.backend.grow_tree(
+                        data, gc, hc, feature_mask=fmask)
+                    if self.timer is not None:
+                        self._psync(delta)
+                with ph("apply_delta"):
+                    pred = self.backend.apply_delta(pred, delta, c)
+                    if self.timer is not None:
+                        self._psync(pred)
                 if val_raw is not None:
                     tree = _store(handle, t_out)
                     leaf = _traverse_one(
@@ -283,4 +312,10 @@ class Driver:
             from ddt_tpu.utils.checkpoint import save_checkpoint
 
             save_checkpoint(self.checkpoint_dir, ens, cfg, completed_rounds)
+        if self.timer is not None:
+            for rec in self.timer.report():
+                log.info("phase %-12s %8.2f ms total  %7.3f ms/call  "
+                         "x%-5d %5.1f%%", rec["phase"], rec["ms_total"],
+                         rec["ms_per_call"], rec["calls"],
+                         100 * rec["share"])
         return ens

@@ -162,3 +162,20 @@ def test_eval_set_binned_path():
     res = api.train(Xt, yt, cfg, binned=True, eval_set=(Xv, yv),
                     log_every=10 ** 9)
     assert res.best_score is not None
+
+
+def test_driver_profile_phase_breakdown():
+    from ddt_tpu.backends.cpu import CPUDevice
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.data.datasets import synthetic_binary
+    from ddt_tpu.data.quantizer import quantize
+    from ddt_tpu.driver import Driver
+
+    X, y = synthetic_binary(2000, n_features=6, seed=0)
+    Xb, _ = quantize(X, n_bins=31)
+    cfg = TrainConfig(n_trees=3, max_depth=3, n_bins=31, backend="cpu")
+    d = Driver(CPUDevice(cfg), cfg, log_every=10 ** 9, profile=True)
+    d.fit(Xb, y)
+    rep = {r["phase"]: r for r in d.timer.report()}
+    assert {"grad", "grow", "apply_delta", "fetch_tree"} <= set(rep)
+    assert all(r["calls"] == 3 for r in rep.values())
