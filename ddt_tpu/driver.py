@@ -97,9 +97,11 @@ class Driver:
         self.timer = PhaseTimer() if profile else None
 
     def _psync(self, x) -> None:
-        """Backend barrier on x's producer chain (profiling mode only);
-        no-op on host-resident backends."""
-        self.backend.sync(x)
+        """Backend barrier on x's producer chain — only when profiling
+        (the fast path must stay sync-free to pipeline rounds); no-op on
+        host-resident backends."""
+        if self.timer is not None:
+            self.backend.sync(x)
 
     def fit(
         self,
@@ -208,8 +210,7 @@ class Driver:
             t0 = time.perf_counter()
             with ph("grad"):
                 g, h = self.backend.grad_hess(pred, y_dev)
-                if self.timer is not None:
-                    self._psync(h)
+                self._psync(h)
             if bagging:
                 rmask = (
                     np.random.default_rng((cfg.seed, 7919, rnd)).random(R)
@@ -231,12 +232,10 @@ class Driver:
                 with ph("grow"):
                     handle, delta = self.backend.grow_tree(
                         data, gc, hc, feature_mask=fmask)
-                    if self.timer is not None:
-                        self._psync(delta)
+                    self._psync(delta)
                 with ph("apply_delta"):
                     pred = self.backend.apply_delta(pred, delta, c)
-                    if self.timer is not None:
-                        self._psync(pred)
+                    self._psync(pred)
                 if val_raw is not None:
                     tree = _store(handle, t_out)
                     leaf = _traverse_one(
