@@ -109,6 +109,7 @@ class CPUDevice(DeviceBackend):
             threshold_bin=tree["threshold_bin"],
             is_leaf=tree["is_leaf"],
             leaf_value=tree["leaf_value"],
+            split_gain=tree["split_gain"],
         )
         return host, delta
 

@@ -327,6 +327,7 @@ class TPUDevice(DeviceBackend):
                 tree.threshold_bin.astype(jnp.float32),
                 tree.is_leaf.astype(jnp.float32),
                 tree.leaf_value,
+                tree.split_gain,
             ])
             return packed, delta
 
@@ -399,6 +400,7 @@ class TPUDevice(DeviceBackend):
             threshold_bin=packed[1].astype(np.int32),
             is_leaf=packed[2].astype(bool),
             leaf_value=packed[3].astype(np.float32),
+            split_gain=packed[4].astype(np.float32),
         )
 
     @functools.cached_property

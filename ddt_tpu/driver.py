@@ -197,6 +197,7 @@ class Driver:
             ens.threshold_bin[slot] = tree["threshold_bin"]
             ens.is_leaf[slot] = tree["is_leaf"]
             ens.leaf_value[slot] = tree["leaf_value"]
+            ens.split_gain[slot] = tree["split_gain"]
             return tree
 
         # Stochastic training (cfg.subsample / cfg.colsample_bytree): masks

@@ -36,6 +36,7 @@ class TreeEnsemble:
     threshold_raw: np.ndarray  # float32 [T, N] raw-value threshold (same rule)
     is_leaf: np.ndarray        # bool   [T, N]
     leaf_value: np.ndarray     # float32 [T, N]
+    split_gain: np.ndarray     # float32 [T, N] gain of the split (0 on leaves)
     max_depth: int
     n_features: int
     learning_rate: float
@@ -140,12 +141,18 @@ class TreeEnsemble:
     def feature_importances(self, kind: str = "split") -> np.ndarray:
         """Normalized per-feature importance, float32 [n_features].
 
-        kind="split": fraction of internal-node splits using the feature
-        (LightGBM's importance_type="split")."""
-        if kind != "split":
+        kind="split": fraction of internal-node splits using the feature;
+        kind="gain": fraction of total split gain attributed to the feature
+        (LightGBM's importance_type="split"/"gain")."""
+        mask = (~self.is_leaf) & (self.feature >= 0)
+        used = self.feature[mask]
+        if kind == "split":
+            w = np.ones(used.shape[0])
+        elif kind == "gain":
+            w = self.split_gain[mask].astype(np.float64)
+        else:
             raise ValueError(f"unknown importance kind {kind!r}")
-        used = self.feature[(~self.is_leaf) & (self.feature >= 0)]
-        counts = np.bincount(used, minlength=self.n_features)
+        counts = np.bincount(used, weights=w, minlength=self.n_features)
         counts = counts[: self.n_features].astype(np.float64)
         tot = counts.sum()
         return (counts / tot if tot > 0 else counts).astype(np.float32)
@@ -157,6 +164,7 @@ class TreeEnsemble:
             "threshold_raw": self.threshold_raw,
             "is_leaf": self.is_leaf,
             "leaf_value": self.leaf_value,
+            "split_gain": self.split_gain,
             "max_depth": np.int64(self.max_depth),
             "n_features": np.int64(self.n_features),
             "learning_rate": np.float64(self.learning_rate),
@@ -174,6 +182,10 @@ class TreeEnsemble:
             threshold_raw=np.asarray(d["threshold_raw"], np.float32),
             is_leaf=np.asarray(d["is_leaf"], bool),
             leaf_value=np.asarray(d["leaf_value"], np.float32),
+            split_gain=np.asarray(
+                d["split_gain"] if "split_gain" in d
+                else np.zeros_like(d["leaf_value"]),
+                np.float32),    # absent in pre-gain saves: zeros
             max_depth=int(d["max_depth"]),
             n_features=int(d["n_features"]),
             learning_rate=float(d["learning_rate"]),
@@ -200,6 +212,7 @@ class TreeEnsemble:
             threshold_raw=self.threshold_raw[:n_trees],
             is_leaf=self.is_leaf[:n_trees],
             leaf_value=self.leaf_value[:n_trees],
+            split_gain=self.split_gain[:n_trees],
         )
 
     @staticmethod
@@ -213,6 +226,7 @@ class TreeEnsemble:
             threshold_raw=np.concatenate([e.threshold_raw for e in ensembles]),
             is_leaf=np.concatenate([e.is_leaf for e in ensembles]),
             leaf_value=np.concatenate([e.leaf_value for e in ensembles]),
+            split_gain=np.concatenate([e.split_gain for e in ensembles]),
         )
 
 
@@ -232,6 +246,7 @@ def empty_ensemble(
         threshold_raw=np.zeros((n_trees, n_nodes), np.float32),
         is_leaf=np.zeros((n_trees, n_nodes), bool),
         leaf_value=np.zeros((n_trees, n_nodes), np.float32),
+        split_gain=np.zeros((n_trees, n_nodes), np.float32),
         max_depth=max_depth,
         n_features=n_features,
         learning_rate=learning_rate,

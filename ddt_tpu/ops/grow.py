@@ -50,6 +50,7 @@ class TreeArrays(NamedTuple):
     threshold_bin: jax.Array  # int32 [n_nodes_total]
     is_leaf: jax.Array        # bool  [n_nodes_total]
     leaf_value: jax.Array     # float32 [n_nodes_total]
+    split_gain: jax.Array     # float32 [n_nodes_total], 0 on leaves
     leaf_of_row: jax.Array    # int32 [R] heap slot where each row landed
 
 
@@ -88,6 +89,7 @@ def grow_tree(
     threshold_bin = jnp.zeros((N,), jnp.int32)
     is_leaf = jnp.zeros((N,), bool)
     leaf_value = jnp.zeros((N,), jnp.float32)
+    split_gain = jnp.zeros((N,), jnp.float32)
 
     node_id = jnp.zeros((R,), jnp.int32)   # heap slot per row
     frozen = jnp.zeros((R,), bool)
@@ -148,6 +150,8 @@ def grow_tree(
         threshold_bin = threshold_bin.at[sl].set(jnp.where(do_split, bins, 0))
         is_leaf = is_leaf.at[sl].set(~do_split)
         leaf_value = leaf_value.at[sl].set(jnp.where(do_split, 0.0, value))
+        split_gain = split_gain.at[sl].set(
+            jnp.where(do_split, gains.astype(jnp.float32), 0.0))
 
         # Route rows through the new splits (dense node-id update). All
         # per-row lookups are one-hot compare+reduce instead of gathers:
@@ -215,7 +219,8 @@ def grow_tree(
     is_leaf = is_leaf.at[sl].set(True)
     leaf_value = leaf_value.at[sl].set(vals.astype(jnp.float32))
 
-    return TreeArrays(feature, threshold_bin, is_leaf, leaf_value, node_id)
+    return TreeArrays(feature, threshold_bin, is_leaf, leaf_value,
+                      split_gain, node_id)
 
 
 def tree_predict_delta(tree: TreeArrays, learning_rate: float) -> jax.Array:

@@ -171,6 +171,7 @@ def grow_tree(
     threshold_bin = np.zeros(N, np.int32)
     is_leaf = np.zeros(N, bool)
     leaf_value = np.zeros(N, np.float32)
+    split_gain = np.zeros(N, np.float32)
 
     node_id = np.zeros(R, np.int64)    # heap index per row
     frozen = np.zeros(R, bool)         # row reached an early leaf
@@ -198,6 +199,7 @@ def grow_tree(
             if do_split[i]:
                 feature[node] = feats[i]
                 threshold_bin[node] = bins[i]
+                split_gain[node] = gains[i]
             else:
                 is_leaf[node] = True
                 leaf_value[node] = value[i]
@@ -244,6 +246,7 @@ def grow_tree(
         "threshold_bin": threshold_bin,
         "is_leaf": is_leaf,
         "leaf_value": leaf_value,
+        "split_gain": split_gain,
         "leaf_of_row": node_id.astype(np.int64),
     }
 
@@ -288,6 +291,7 @@ def fit(
             ens.threshold_bin[t_out] = tree["threshold_bin"]
             ens.is_leaf[t_out] = tree["is_leaf"]
             ens.leaf_value[t_out] = tree["leaf_value"]
+            ens.split_gain[t_out] = tree["split_gain"]
             delta = cfg.learning_rate * tree["leaf_value"][tree["leaf_of_row"]]
             if C > 1:
                 pred[:, c] += delta

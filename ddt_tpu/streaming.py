@@ -128,6 +128,7 @@ def fit_streaming(
         threshold_bin = np.zeros(cfg.n_nodes_total, np.int32)
         is_leaf = np.zeros(cfg.n_nodes_total, bool)
         leaf_value = np.zeros(cfg.n_nodes_total, np.float32)
+        split_gain = np.zeros(cfg.n_nodes_total, np.float32)
 
         def chunk_grads(c: int, Xc, yc):
             pred_c = preds[c] if preds is not None else _rescore(
@@ -169,6 +170,7 @@ def fit_streaming(
                 if do_split[i]:
                     feature[slot] = feats[i]
                     threshold_bin[slot] = bins[i]
+                    split_gain[slot] = gains[i]
                 else:
                     is_leaf[slot] = True
                     leaf_value[slot] = value[i]
@@ -195,6 +197,7 @@ def fit_streaming(
         ens.threshold_bin[t] = threshold_bin
         ens.is_leaf[t] = is_leaf
         ens.leaf_value[t] = leaf_value
+        ens.split_gain[t] = split_gain
 
         if preds is not None:
             # leaf slot per row = heap slot where traversal stopped: either

@@ -114,3 +114,28 @@ def test_sklearn_classifier_multiclass_and_regressor():
     reg = DDTRegressor(n_trees=30, max_depth=4, n_bins=63, backend="cpu")
     reg.fit(Xr, yr)
     assert reg.score(Xr, yr) > 0.8
+
+
+def test_gain_importance_and_backend_gain_parity(tmp_path):
+    _, Xb, y, _ = _data(n=2500, f=6)
+    kw = dict(n_trees=5, max_depth=4, n_bins=63, seed=3)
+    ec = api.train(Xb, y, TrainConfig(backend="cpu", **kw),
+                   binned=True, log_every=10 ** 9).ensemble
+    et = api.train(Xb, y, TrainConfig(backend="tpu", **kw),
+                   binned=True, log_every=10 ** 9).ensemble
+    # Gains are bf16-rounded best gains -> identical across backends.
+    np.testing.assert_array_equal(ec.split_gain, et.split_gain)
+    assert (ec.split_gain[~ec.is_leaf & (ec.feature >= 0)] > 0).all()
+    assert (ec.split_gain[ec.is_leaf] == 0).all()
+    gi = ec.feature_importances(kind="gain")
+    assert gi.shape == (6,) and abs(gi.sum() - 1.0) < 1e-6
+    # save/load round-trips the gains; pre-gain archives load as zeros.
+    path = str(tmp_path / "gain_ens.npz")
+    ec.save(path)
+    from ddt_tpu.models.tree import TreeEnsemble
+    np.testing.assert_array_equal(
+        TreeEnsemble.load(path).split_gain, ec.split_gain)
+    d = ec.to_dict()
+    del d["split_gain"]
+    old = TreeEnsemble.from_dict(d)
+    assert (old.split_gain == 0).all()
