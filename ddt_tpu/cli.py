@@ -140,6 +140,13 @@ def main(argv: list[str] | None = None) -> int:
     bp.add_argument("--partitions", type=int, default=1)
     bp.add_argument("--hist-impl", default="auto")
 
+    ip = sub.add_parser("inspect", help="summarize a saved ensemble")
+    ip.add_argument("--model", required=True)
+    ip.add_argument("--tree", type=int, default=None,
+                    help="also print this tree's structure")
+    ip.add_argument("--importance", choices=["split", "gain"],
+                    default="gain")
+
     args = ap.parse_args(argv)
 
     if args.cmd == "train":
@@ -227,6 +234,36 @@ def main(argv: list[str] | None = None) -> int:
             hist_impl=args.hist_impl, seed=args.seed,
         )
         print(json.dumps(out))
+        return 0
+
+    if args.cmd == "inspect":
+        ens = TreeEnsemble.load(args.model)
+        if args.tree is not None and not (0 <= args.tree < ens.n_trees):
+            ap.error(f"--tree must be in [0, {ens.n_trees}), got {args.tree}")
+        imp = ens.feature_importances(kind=args.importance)
+        if args.importance == "gain" and not imp.any():
+            # Pre-gain archive (split_gain backfilled with zeros): fall back
+            # so legacy models remain inspectable, and say so.
+            print("# no recorded gains (model predates gain recording); "
+                  "showing split-count importance", file=sys.stderr)
+            args.importance = "split"
+            imp = ens.feature_importances(kind="split")
+        top = np.argsort(imp)[::-1][:10]
+        print(json.dumps({
+            "cmd": "inspect", "model": args.model,
+            "n_trees": ens.n_trees, "max_depth": ens.max_depth,
+            "n_features": ens.n_features, "loss": ens.loss,
+            "n_classes": ens.n_classes,
+            "learning_rate": ens.learning_rate,
+            "base_score": ens.base_score,
+            "n_splits": int(((~ens.is_leaf) & (ens.feature >= 0)).sum()),
+            "has_raw_thresholds": bool(ens.has_raw_thresholds),
+            f"top_features_by_{args.importance}": {
+                int(f): round(float(imp[f]), 5) for f in top if imp[f] > 0
+            },
+        }))
+        if args.tree is not None:
+            print(ens.dump_text(args.tree))
         return 0
 
     return 2  # pragma: no cover

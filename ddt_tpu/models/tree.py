@@ -157,6 +157,56 @@ class TreeEnsemble:
         tot = counts.sum()
         return (counts / tot if tot > 0 else counts).astype(np.float32)
 
+    def dump(self, tree: int) -> dict:
+        """One tree as a nested plain-Python dict (debugging / interop).
+
+        Split nodes: {"split": {"feature", "bin", "threshold" (raw value or
+        None), "gain"}, "left", "right"}; leaves: {"leaf": value}. The raw
+        threshold is only present when the ensemble holds BinMapper-filled
+        thresholds."""
+        t = int(tree)
+
+        def node(i: int) -> dict:
+            if self.is_leaf[t, i] or self.feature[t, i] < 0:
+                return {"leaf": float(self.leaf_value[t, i])}
+            return {
+                "split": {
+                    "feature": int(self.feature[t, i]),
+                    "bin": int(self.threshold_bin[t, i]),
+                    "threshold": (
+                        float(self.threshold_raw[t, i])
+                        if self.has_raw_thresholds else None
+                    ),
+                    "gain": float(self.split_gain[t, i]),
+                },
+                "left": node(2 * i + 1),
+                "right": node(2 * i + 2),
+            }
+
+        return node(0)
+
+    def dump_text(self, tree: int) -> str:
+        """Indented text rendering of one tree."""
+        lines: list[str] = []
+
+        def walk(d: dict, depth: int) -> None:
+            pad = "  " * depth
+            if "leaf" in d:
+                lines.append(f"{pad}leaf={d['leaf']:+.6f}")
+                return
+            s = d["split"]
+            thr = (f" (<= {s['threshold']:.6g})"
+                   if s["threshold"] is not None else "")
+            lines.append(
+                f"{pad}f{s['feature']} <= bin {s['bin']}{thr}  "
+                f"gain={s['gain']:.4g}"
+            )
+            walk(d["left"], depth + 1)
+            walk(d["right"], depth + 1)
+
+        walk(self.dump(tree), 0)
+        return "\n".join(lines)
+
     def to_dict(self) -> dict:
         return {
             "feature": self.feature,

@@ -99,3 +99,20 @@ def test_cli_fpga_backend_fails_loudly(tmp_path):
             "--trees=1", "--depth=2", "--bins=15",
             f"--out={tmp_path / 'x.npz'}",
         ])
+
+
+def test_cli_inspect(tmp_path, capsys):
+    model = str(tmp_path / "ens.npz")
+    _run(capsys, [
+        "train", "--backend=cpu", "--dataset=higgs", "--rows=2000",
+        "--trees=4", "--depth=3", "--bins=31", f"--out={model}",
+    ])
+    rc = main(["inspect", f"--model={model}", "--tree=0",
+               "--importance=gain"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[0])
+    assert rec["n_trees"] == 4 and rec["n_splits"] > 0
+    assert rec["top_features_by_gain"]
+    # The tree dump follows: root line mentions a feature split or a leaf.
+    assert out[1].startswith(("f", "leaf="))
