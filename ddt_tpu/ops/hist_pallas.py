@@ -99,6 +99,20 @@ def _hist_kernel(xb_ref, a_ref, out_ref, *, n_feat: int, bins_pad: int,
     )
 
 
+def feature_chunks_for(n_nodes: int, n_features: int, n_bins: int,
+                       tile_r: int = _DEFAULT_TILE_R,
+                       input_bytes: int = 2) -> int | None:
+    """Smallest number of feature chunks whose per-chunk working set fits
+    the kernel's VMEM budget, or None if even one feature does not fit
+    (then the caller must use the matmul path). input_bytes is the one-hot
+    operand's itemsize (2 for bfloat16, 4 for float32)."""
+    for k in range(1, n_features + 1):
+        if pallas_fits(n_nodes, -(-n_features // k), n_bins, tile_r,
+                       input_bytes):
+            return k
+    return None
+
+
 def build_histograms_pallas(
     Xb: jax.Array,
     g: jax.Array,
@@ -117,19 +131,32 @@ def build_histograms_pallas(
     chip). input_dtype is the A/one-hot operand dtype: bfloat16 rides the MXU
     at full rate; float32 buys exact accumulation at reduced rate (same knob
     as the matmul path — cfg.matmul_input_dtype).
+
+    Shapes whose [2N, F*Bp] accumulator overflows the VMEM budget (deep
+    levels: n_nodes >= 64 at 255 bins) are feature-CHUNKED: one pallas_call
+    per column slab, outputs concatenated — exact (columns are independent)
+    and still ~2x the HBM-bound matmul fallback per slab.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    dt = jnp.dtype(input_dtype)
+    F = Xb.shape[1]
+    k = feature_chunks_for(n_nodes, F, n_bins, tile_r, dt.itemsize)
+    if k is None:
+        raise ValueError(
+            f"histogram shape (n_nodes={n_nodes}, n_bins={n_bins}) exceeds "
+            "the Pallas VMEM budget even at one feature per call; use the "
+            "matmul implementation"
+        )
     return _build_histograms_pallas(
-        Xb, g, h, node_index, n_nodes, n_bins, tile_r, interpret,
-        jnp.dtype(input_dtype),
+        Xb, g, h, node_index, n_nodes, n_bins, tile_r, interpret, dt, k,
     )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("n_nodes", "n_bins", "tile_r", "interpret",
-                     "input_dtype"),
+                     "input_dtype", "n_chunks"),
 )
 def _build_histograms_pallas(
     Xb: jax.Array,          # uint8 [R, F]
@@ -141,6 +168,8 @@ def _build_histograms_pallas(
     tile_r: int = _DEFAULT_TILE_R,
     interpret: bool = False,
     input_dtype=jnp.bfloat16,
+    n_chunks: int = 1,      # feature slabs (one pallas_call each); the
+                            # prologue below is shared across slabs
 ) -> jax.Array:
     R, F = Xb.shape
     bins_pad = _bins_pad(n_bins)
@@ -163,34 +192,41 @@ def _build_histograms_pallas(
         Xi = jnp.pad(Xi, ((0, pad), (0, 0)))
         A = jnp.pad(A, ((0, pad), (0, 0)))
 
-    out = pl.pallas_call(
-        functools.partial(_hist_kernel, n_feat=F, bins_pad=bins_pad,
-                          input_dtype=input_dtype),
-        grid=(n_tiles,),
-        in_specs=[
-            pl.BlockSpec(
-                (tile_r, F), lambda i: (i, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (tile_r, 2 * n_nodes), lambda i: (i, 0),
+    def slab(Xs):
+        Fs = Xs.shape[1]
+        out = pl.pallas_call(
+            functools.partial(_hist_kernel, n_feat=Fs, bins_pad=bins_pad,
+                              input_dtype=input_dtype),
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec(
+                    (tile_r, Fs), lambda i: (i, 0), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec(
+                    (tile_r, 2 * n_nodes), lambda i: (i, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (2 * n_nodes, Fs * bins_pad), lambda i: (0, 0),
                 memory_space=pltpu.VMEM,
             ),
-        ],
-        out_specs=pl.BlockSpec(
-            (2 * n_nodes, F * bins_pad), lambda i: (0, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((2 * n_nodes, F * bins_pad),
-                                       jnp.float32),
-        cost_estimate=pl.CostEstimate(
-            flops=2 * 2 * n_nodes * F * bins_pad * n_tiles * tile_r,
-            bytes_accessed=R * F * 4 + R * 4 * n_nodes
-            + 2 * n_nodes * F * bins_pad * 4,
-            transcendentals=0,
-        ),
-        interpret=interpret,
-    )(Xi, A)
+            out_shape=jax.ShapeDtypeStruct((2 * n_nodes, Fs * bins_pad),
+                                           jnp.float32),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * 2 * n_nodes * Fs * bins_pad * n_tiles * tile_r,
+                bytes_accessed=R * Fs * 4 + R * 4 * n_nodes
+                + 2 * n_nodes * Fs * bins_pad * 4,
+                transcendentals=0,
+            ),
+            interpret=interpret,
+        )(Xs, A)
+        # [2N, Fs*Bp] -> [N, Fs, B, 2]
+        out = out.reshape(2, n_nodes, Fs, bins_pad)[..., :n_bins]
+        return out.transpose(1, 2, 3, 0)
 
-    # [2N, F*Bp] -> [N, F, B, 2]
-    out = out.reshape(2, n_nodes, F, bins_pad)[..., :n_bins]
-    return out.transpose(1, 2, 3, 0)
+    if n_chunks == 1:
+        return slab(Xi)
+    fc = -(-F // n_chunks)
+    return jnp.concatenate(
+        [slab(Xi[:, i:i + fc]) for i in range(0, F, fc)], axis=1)

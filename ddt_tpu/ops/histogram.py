@@ -196,9 +196,13 @@ def resolve_hist_impl(
     if platform != "tpu":
         return "matmul"
     if n_nodes is not None and n_features is not None and n_bins is not None:
-        from ddt_tpu.ops.hist_pallas import pallas_fits
+        from ddt_tpu.ops.hist_pallas import feature_chunks_for
 
-        if not pallas_fits(n_nodes, n_features, n_bins):
+        # The kernel feature-chunks itself for deep levels, but every slab
+        # re-streams the [R, 2N] weighted node one-hot from HBM — past a
+        # few slabs that traffic exceeds the matmul path's, so cap k.
+        k = feature_chunks_for(n_nodes, n_features, n_bins)
+        if k is None or k > 4:
             return "matmul"
     return "pallas"
 

@@ -54,3 +54,24 @@ def test_pallas_all_frozen_rows_zero():
         Xb, g, h, ni, 4, 16, tile_r=256, interpret=True
     ))
     assert np.all(got == 0.0)
+
+
+def test_pallas_feature_chunked_deep_level():
+    """n_nodes=128 x 255 bins overflows the one-call VMEM budget; the
+    kernel must feature-chunk and still match the oracle exactly-ish."""
+    import numpy as np
+    from ddt_tpu.ops.hist_pallas import (
+        build_histograms_pallas, feature_chunks_for, pallas_fits)
+    from ddt_tpu.reference import numpy_trainer as ref
+
+    R, F, B, N = 3000, 54, 255, 128
+    assert not pallas_fits(N, F, B)
+    assert (feature_chunks_for(N, F, B) or 0) > 1
+    rng = np.random.default_rng(0)
+    Xb = rng.integers(0, B, size=(R, F), dtype=np.uint8)
+    g = rng.standard_normal(R).astype(np.float32)
+    h = rng.random(R).astype(np.float32)
+    ni = rng.integers(-1, N, size=R).astype(np.int32)
+    got = np.asarray(build_histograms_pallas(Xb, g, h, ni, N, B))
+    want = ref.build_histograms(Xb, g, h, ni, N, B)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
