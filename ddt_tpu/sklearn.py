@@ -46,6 +46,27 @@ class _DDTBase:
         self.n_partitions = n_partitions
         self.seed = seed
 
+    @classmethod
+    def _param_names(cls) -> tuple:
+        """Constructor arg names, derived from the signature (sklearn's own
+        approach) so the list cannot drift from __init__."""
+        import inspect
+
+        return tuple(inspect.signature(cls.__init__).parameters)[1:]
+
+    def get_params(self, deep: bool = True) -> dict:
+        """Constructor params (sklearn clone/GridSearchCV protocol)."""
+        return {k: getattr(self, k) for k in self._param_names()}
+
+    def set_params(self, **params):
+        names = self._param_names()
+        for k, v in params.items():
+            if k not in names:
+                raise ValueError(
+                    f"unknown parameter {k!r}; valid: {names}")
+            setattr(self, k, v)
+        return self
+
     def _cfg(self, **extra) -> TrainConfig:
         extra.setdefault("loss", self._LOSS)
         return TrainConfig(

@@ -139,3 +139,24 @@ def test_gain_importance_and_backend_gain_parity(tmp_path):
     del d["split_gain"]
     old = TreeEnsemble.from_dict(d)
     assert (old.split_gain == 0).all()
+
+
+def test_sklearn_params_protocol():
+    from ddt_tpu.sklearn import DDTClassifier
+
+    clf = DDTClassifier(n_trees=7, max_depth=3, backend="cpu")
+    p = clf.get_params()
+    assert p["n_trees"] == 7 and p["max_depth"] == 3
+    clone = DDTClassifier(**p)              # sklearn.clone() equivalent
+    assert clone.get_params() == p
+    clf.set_params(n_trees=9)
+    assert clf.n_trees == 9
+    with pytest.raises(ValueError):
+        clf.set_params(nope=1)
+    # Real sklearn interop when available.
+    try:
+        from sklearn.base import clone as skclone
+    except ImportError:
+        return
+    c2 = skclone(clf)
+    assert c2.get_params() == clf.get_params()
