@@ -162,3 +162,37 @@ def test_driver_history_logging():
     assert d.history[-1]["round"] == 6
     losses = [r["train_loss"] for r in d.history]
     assert losses == sorted(losses, reverse=True)  # loss decreases
+
+
+@pytest.mark.parametrize("depth,bins,loss", [
+    (1, 2, "logloss"),      # stumps on binary bins
+    (2, 3, "mse"),
+    (8, 17, "logloss"),     # deep + few bins: most nodes become leaves
+    (3, 256, "logloss"),    # full uint8 range
+    (2, 63, "softmax"),
+])
+def test_backend_parity_edge_configs(depth, bins, loss):
+    """CPU and TPU grow identical trees across uncommon shapes."""
+    from ddt_tpu import api
+    from ddt_tpu.data.datasets import synthetic_binary, synthetic_multiclass
+    from ddt_tpu.data.quantizer import quantize
+
+    if loss == "softmax":
+        X, y = synthetic_multiclass(1200, n_features=5, n_classes=3, seed=7)
+        extra = dict(loss="softmax", n_classes=3)
+    else:
+        X, y = synthetic_binary(1200, n_features=5, seed=7)
+        if loss == "mse":
+            y = y + 0.1 * np.random.default_rng(0).standard_normal(len(y))
+        extra = dict(loss=loss)
+    Xb, _ = quantize(X, n_bins=bins, seed=7)
+    kw = dict(n_trees=3, max_depth=depth, n_bins=bins, seed=7, **extra)
+    ec = api.train(Xb, y, TrainConfig(backend="cpu", **kw),
+                   binned=True, log_every=10 ** 9).ensemble
+    et = api.train(Xb, y, TrainConfig(backend="tpu", **kw),
+                   binned=True, log_every=10 ** 9).ensemble
+    np.testing.assert_array_equal(ec.feature, et.feature)
+    np.testing.assert_array_equal(ec.threshold_bin, et.threshold_bin)
+    np.testing.assert_array_equal(ec.is_leaf, et.is_leaf)
+    np.testing.assert_allclose(ec.leaf_value, et.leaf_value,
+                               rtol=2e-4, atol=2e-5)
