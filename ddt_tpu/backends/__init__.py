@@ -47,10 +47,8 @@ _JIT_FIELDS = (
 )
 # LRU-bounded: each cached TPUDevice pins its compiled executables (and any
 # upload-derived device state) for its lifetime, so a hyperparameter sweep
-# over many configs must evict old entries. The cached instance's cfg is
-# NEVER mutated — backends read only _JIT_FIELDS (all part of the key), and
-# non-trace fields (n_trees, seed, checkpoint paths) live on the Driver's
-# own cfg.
+# over many configs must evict old entries. TrainConfig is frozen, so a
+# cached instance's cfg can never drift from the key it was cached under.
 _CACHE_MAX = 8
 _CACHE: "dict" = {}
 

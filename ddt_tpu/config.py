@@ -16,12 +16,16 @@ LOSSES = ("logloss", "mse", "softmax")
 BACKENDS = ("cpu", "tpu", "fpga")  # fpga is a stub: flag parity with reference
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class TrainConfig:
     """Hyper-parameters and system knobs for GBDT training.
 
     Mirrors the reference's flag set (depth, trees, bins, backend, partitions)
     as recovered in SURVEY.md §2 "CLI / config".
+
+    Frozen: backend instances are cached keyed on config fields
+    (backends/__init__.py), so a mutable config could desynchronize a cached
+    backend from its cache key. Use .replace() to derive variants.
     """
 
     # --- model ---

@@ -64,7 +64,18 @@ def _load() -> ctypes.CDLL:
         except Exception as e:  # toolchain missing / build broke
             if not os.path.exists(_SO):
                 raise ImportError(f"native kernel build failed: {e}") from e
-            # No toolchain but an existing .so: use it if it is complete.
+            # No toolchain (or unwritable dir) but an existing .so: use it if
+            # complete — but say so, because a stale library can be
+            # behaviorally outdated in ways the symbol check can't catch,
+            # and a parity failure must be traceable here.
+            import logging
+
+            logging.getLogger("ddt_tpu.native").warning(
+                "native kernel sources are newer than %s but rebuilding "
+                "failed (%s); dlopening the STALE library — kernel-parity "
+                "failures may stem from this. Run `make -C %s` manually.",
+                _SO, e, _DIR,
+            )
     lib = ctypes.CDLL(_SO)
     missing = [s for s in _SYMBOLS if not hasattr(lib, s)]
     if missing:

@@ -82,7 +82,13 @@ def grow_tree(
     # the field bounds at trace time so a future wider-bin or huge-F config
     # fails loudly instead of silently corrupting row routing.
     assert n_bins <= 512, f"routing pack needs n_bins <= 512, got {n_bins}"
-    assert F < 2 ** 20, f"routing pack needs F < 2^20, got {F}"
+    # The packed feats are GLOBAL indices under feature sharding (shard
+    # offset applied below), so the bound must cover shards x local width,
+    # not just the local F. axis_size is static at trace time.
+    F_global = F if feature_axis_name is None else (
+        F * jax.lax.axis_size(feature_axis_name))
+    assert F_global < 2 ** 20, \
+        f"routing pack needs global F < 2^20, got {F_global}"
     N = 2 ** (max_depth + 1) - 1
 
     feature = jnp.full((N,), -1, jnp.int32)

@@ -168,7 +168,7 @@ def predict_raw(
     base: float,
     n_classes: int = 1,        # 1 = scalar output; C = softmax round-major
     tree_chunk: int = 64,
-    row_chunk: int = _DEFAULT_ROW_CHUNK,
+    row_chunk: int | None = None,
 ) -> jax.Array:
     """Raw margin scores: [R] (n_classes==1) or [R, C].
 
@@ -179,12 +179,13 @@ def predict_raw(
     binned = bool(jnp.issubdtype(Xc.dtype, jnp.integer))
     if binned:
         Xc = Xc.astype(jnp.int32)      # uint8 uploads are 4x cheaper; widen
-        if row_chunk == _DEFAULT_ROW_CHUNK:
-            # The comparison-matrix descent materialises [Rc, chunk, Nint]
-            # bits; default to a smaller row chunk to bound that (8k rows
-            # measured fastest on v5e: 4.2 vs 3.9 Mrows/s at 16k for
-            # 1M x 1000 trees). An EXPLICIT row_chunk is always honored.
-            row_chunk = 8_192
+    if row_chunk is None:
+        # The binned comparison-matrix descent materialises
+        # [Rc, chunk, Nint] bits; default to a smaller row chunk there to
+        # bound it (8k rows measured fastest on v5e: 4.2 vs 3.9 Mrows/s at
+        # 16k for 1M x 1000 trees). None is the only "use default" value —
+        # an explicit row_chunk, including 65536, is always honored.
+        row_chunk = 8_192 if binned else _DEFAULT_ROW_CHUNK
     T = feature.shape[0]               # on device where casts are free
     R, F = Xc.shape
     C = n_classes

@@ -128,6 +128,15 @@ class DDTClassifier(_DDTBase):
     def fit(self, X, y, eval_set=None, early_stopping_rounds=None):
         y = np.asarray(y)
         classes = np.unique(y)
+        if len(classes) < 2:
+            # Matches sklearn: fail at fit time, not with an opaque
+            # IndexError at predict time (classes_[argmax over 2 columns]).
+            found = (f"only one class: {classes[0]!r}" if len(classes)
+                     else "no samples")
+            raise ValueError(
+                "This solver needs samples of at least 2 classes in the "
+                f"data, but the data contains {found}"
+            )
         # Map labels to 0..C-1 for training; predictions map back.
         y_enc = np.searchsorted(classes, y)
         if eval_set is not None:

@@ -84,6 +84,8 @@ def try_resume(ckpt_dir: str, ens: TreeEnsemble, cfg: TrainConfig) -> int:
     k = rounds * C
     ens.feature[:k] = saved.feature[:k]
     ens.threshold_bin[:k] = saved.threshold_bin[:k]
+    ens.threshold_raw[:k] = saved.threshold_raw[:k]
     ens.is_leaf[:k] = saved.is_leaf[:k]
     ens.leaf_value[:k] = saved.leaf_value[:k]
+    ens.split_gain[:k] = saved.split_gain[:k]
     return rounds

@@ -139,6 +139,12 @@ def test_checkpoint_resume_matches_uninterrupted(tmp_path):
     np.testing.assert_array_equal(full.threshold_bin, resumed.threshold_bin)
     np.testing.assert_allclose(full.leaf_value, resumed.leaf_value,
                                rtol=2e-4, atol=2e-5)
+    # split_gain must survive resume too (round-1 verdict: trees trained
+    # before the checkpoint silently lost their gains, corrupting
+    # feature_importances("gain") after any resume).
+    np.testing.assert_allclose(full.split_gain, resumed.split_gain,
+                               rtol=2e-4, atol=2e-5)
+    assert np.any(resumed.split_gain[:6] > 0)
 
 
 def test_checkpoint_config_mismatch_refuses(tmp_path):

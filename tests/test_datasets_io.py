@@ -1,0 +1,312 @@
+"""Real-data file ingestion (BASELINE configs 1-3) + model-artifact
+completeness (round-1 verdict items 2 and 4).
+
+The loaders read the on-disk formats the reference datasets actually ship
+in — UCI Higgs CSV.gz (label first), UCI Covertype CSV (label last, classes
+1..7), libsvm sparse text, and our own .npz — so real data can be dropped
+in the moment a file exists. The artifact tests pin the contract that
+predict-time preprocessing comes from the TRAINING-time mapper/encoder
+stored in the model file, never refit on scoring data.
+"""
+
+import gzip
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ddt_tpu import api
+from ddt_tpu.data import datasets
+
+
+# ------------------------------------------------------------------ #
+# load_file formats
+# ------------------------------------------------------------------ #
+
+def test_load_npz_roundtrip(tmp_path):
+    X = np.random.default_rng(0).standard_normal((50, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    p = str(tmp_path / "d.npz")
+    np.savez(p, X=X, y=y)
+    X2, y2 = datasets.load_file(p)
+    np.testing.assert_array_equal(X2, X)
+    np.testing.assert_array_equal(y2, y)
+    assert y2.dtype == np.int32
+
+
+def test_load_npz_missing_keys_raises(tmp_path):
+    p = str(tmp_path / "bad.npz")
+    np.savez(p, a=np.zeros(3))
+    with pytest.raises(ValueError, match="must contain arrays 'X' and 'y'"):
+        datasets.load_file(p)
+
+
+def test_load_csv_higgs_convention(tmp_path):
+    """UCI Higgs: label is the FIRST column, features follow."""
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((30, 5)).astype(np.float32)
+    y = rng.integers(0, 2, 30)
+    p = str(tmp_path / "higgs.csv")
+    M = np.column_stack([y.astype(np.float64), X])
+    np.savetxt(p, M, delimiter=",")
+    X2, y2 = datasets.load_file(p)
+    np.testing.assert_allclose(X2, X, rtol=1e-6)
+    np.testing.assert_array_equal(y2, y)
+
+
+def test_load_csv_covertype_convention(tmp_path):
+    """UCI Covertype: label is the LAST column, classes 1..7 -> 0..6."""
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((40, 6)).astype(np.float32) * 10 + 100
+    y = rng.integers(1, 8, 40)  # 1-based classes
+    p = str(tmp_path / "covtype.csv")
+    np.savetxt(p, np.column_stack([X, y.astype(np.float64)]), delimiter=",")
+    X2, y2 = datasets.load_file(p, label_col="last")
+    np.testing.assert_allclose(X2, X, rtol=1e-6)
+    np.testing.assert_array_equal(y2, y - 1)
+
+
+def test_load_csv_gz_with_header_and_auto_label(tmp_path):
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((25, 3))
+    y = rng.integers(0, 2, 25)
+    p = str(tmp_path / "d.csv.gz")
+    lines = ["label,f0,f1,f2"]
+    for i in range(25):
+        lines.append(",".join([str(y[i])] + [f"{v:.6f}" for v in X[i]]))
+    with gzip.open(p, "wt") as f:
+        f.write("\n".join(lines) + "\n")
+    X2, y2 = datasets.load_file(p)  # auto: header skipped, label=first
+    assert X2.shape == (25, 3)
+    np.testing.assert_array_equal(y2, y)
+
+
+def test_load_libsvm_sparse(tmp_path):
+    p = str(tmp_path / "d.libsvm")
+    with open(p, "w") as f:
+        f.write("1 1:0.5 3:2.0\n")
+        f.write("0 2:-1.0\n")
+        f.write("# comment line\n")
+        f.write("1 1:1.0 4:4.0  # trailing comment\n")
+    X, y = datasets.load_file(p)
+    assert X.shape == (3, 4)
+    np.testing.assert_allclose(
+        X, [[0.5, 0, 2.0, 0], [0, -1.0, 0, 0], [1.0, 0, 0, 4.0]]
+    )
+    np.testing.assert_array_equal(y, [1, 0, 1])
+
+
+def test_load_libsvm_bad_line_raises(tmp_path):
+    p = str(tmp_path / "bad.txt")
+    with open(p, "w") as f:
+        f.write("1 0:0.5\n")  # 0-based index: invalid
+    with pytest.raises(ValueError, match="bad libsvm line"):
+        datasets.load_file(p)
+
+
+def test_load_libsvm_minus_one_plus_one_labels(tmp_path):
+    """The dominant binary-libsvm convention {-1,+1} maps to {0,1}."""
+    p = str(tmp_path / "d.libsvm")
+    with open(p, "w") as f:
+        f.write("-1 1:0.5\n+1 2:1.0\n-1 1:2.0\n")
+    _, y = datasets.load_file(p)
+    np.testing.assert_array_equal(y, [0, 1, 0])
+
+
+def test_load_npz_labels_verbatim(tmp_path):
+    """.npz is our own format: y passes through untouched — integer
+    regression targets 1..k must NOT be shifted."""
+    p = str(tmp_path / "d.npz")
+    yc = np.arange(1, 41)   # counts 1..40
+    np.savez(p, X=np.zeros((40, 2), np.float32), y=yc)
+    _, y = datasets.load_file(p)
+    np.testing.assert_array_equal(y, yc)
+
+
+def test_load_csv_regression_labels_not_normalized(tmp_path):
+    """normalize_labels=False keeps 1-based integer targets for mse."""
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((20, 3))
+    yc = rng.integers(1, 6, 20)
+    p = str(tmp_path / "r.csv")
+    np.savetxt(p, np.column_stack([X, yc.astype(np.float64)]), delimiter=",")
+    _, y = datasets.load_file(p, label_col="last", normalize_labels=False)
+    np.testing.assert_array_equal(y, yc)
+
+
+def test_cli_label_col_last_for_regression(tmp_path, capsys):
+    """--label-col=last trains on the true last-column float target."""
+    from ddt_tpu.cli import main
+
+    X, yt = datasets.synthetic_regression(800, n_features=6, seed=9)
+    p = str(tmp_path / "r.csv")
+    np.savetxt(p, np.column_stack([X, yt.astype(np.float64)]), delimiter=",")
+    model = str(tmp_path / "m.npz")
+    rc = main(["train", "--backend=cpu", f"--data={p}", "--label-col=last",
+               "--loss=mse", "--trees=3", "--depth=3", "--bins=31",
+               f"--out={model}"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # Training on the true target beats the variance of y; a label grabbed
+    # from a feature column would leave loss ~ var(feature col 0).
+    assert rec["final_train_loss"] < np.var(yt) * 0.7
+
+
+def test_cli_criteo_predict_refuses_missing_encoder(tmp_path, capsys):
+    from ddt_tpu.cli import main
+
+    X, y = datasets.synthetic_binary(500, n_features=8, seed=1)
+    res = api.train(X, y, n_trees=2, max_depth=2, n_bins=31,
+                    backend="cpu", log_every=10**9)
+    model = str(tmp_path / "no_enc.npz")
+    res.save(model)  # API save: no encoder stored
+    with pytest.raises(SystemExit, match="categorical encoder"):
+        main(["predict", "--backend=cpu", f"--model={model}",
+              "--dataset=criteo", "--rows=100", "--bins=31"])
+
+
+def test_load_libsvm_n_features_pins_width(tmp_path):
+    """A sparse scoring file must not shrink X below the model's width."""
+    p = str(tmp_path / "d.libsvm")
+    with open(p, "w") as f:
+        f.write("1 1:0.5 2:1.0\n0 1:2.0\n")   # max observed index = 2
+    X, _ = datasets.load_file(p, n_features=5)
+    assert X.shape == (2, 5)
+    with pytest.raises(ValueError, match="exceeds n_features"):
+        datasets.load_file(p, n_features=1)
+
+
+def test_load_libsvm_dense_guardrail(tmp_path, monkeypatch):
+    monkeypatch.setattr(datasets, "_LIBSVM_DENSE_MAX_ELEMS", 10)
+    p = str(tmp_path / "d.libsvm")
+    with open(p, "w") as f:
+        f.write("1 20:0.5\n")   # 1 row x 20 cols > 10 elems
+    with pytest.raises(ValueError, match="dense-only"):
+        datasets.load_file(p)
+
+
+def test_labels_not_shifted_when_class_zero_merely_absent(tmp_path):
+    """An all-positive slice {1} or a non-contiguous set must pass through."""
+    p = str(tmp_path / "d.libsvm")
+    with open(p, "w") as f:
+        f.write("1 1:0.5\n1 1:1.5\n")      # only label 1 present
+    _, y = datasets.load_file(p)
+    np.testing.assert_array_equal(y, [1, 1])
+
+    p2 = str(tmp_path / "d2.libsvm")
+    with open(p2, "w") as f:
+        f.write("1 1:0.5\n3 1:1.5\n")      # {1,3}: not contiguous 1..k
+    _, y2 = datasets.load_file(p2)
+    np.testing.assert_array_equal(y2, [1, 3])
+
+
+def test_cli_predict_rejects_wrong_width_file(tmp_path, capsys):
+    from ddt_tpu.cli import main
+
+    X, y = datasets.synthetic_binary(800, n_features=8, seed=0)
+    ptrain = str(tmp_path / "t.npz")
+    np.savez(ptrain, X=X, y=y)
+    model = str(tmp_path / "m.npz")
+    assert main(["train", "--backend=cpu", f"--data={ptrain}", "--trees=2",
+                 "--depth=2", "--bins=31", f"--out={model}"]) == 0
+    capsys.readouterr()
+    pbad = str(tmp_path / "bad.npz")
+    np.savez(pbad, X=X[:, :5], y=y)        # 5 cols vs model's 8
+    with pytest.raises(ValueError, match="expected 8 feature columns"):
+        main(["predict", "--backend=cpu", f"--model={model}",
+              f"--data={pbad}"])
+
+
+def test_load_file_max_rows(tmp_path):
+    p = str(tmp_path / "d.npz")
+    np.savez(p, X=np.zeros((100, 2), np.float32), y=np.zeros(100))
+    X, y = datasets.load_file(p, max_rows=7)
+    assert len(X) == 7 and len(y) == 7
+
+
+def test_train_from_csv_end_to_end(tmp_path):
+    """--data=file.csv trains end-to-end through the CLI (VERDICT item 4)."""
+    from ddt_tpu.cli import main
+
+    X, y = datasets.synthetic_binary(1500, n_features=8, seed=5)
+    p = str(tmp_path / "higgs.csv")
+    np.savetxt(p, np.column_stack([y.astype(np.float64), X]), delimiter=",")
+    model = str(tmp_path / "m.npz")
+    rc = main(["train", "--backend=cpu", f"--data={p}", "--trees=3",
+               "--depth=3", "--bins=31", f"--out={model}"])
+    assert rc == 0
+    bundle = api.load_model(model)
+    assert bundle.ensemble.n_trees == 3
+    assert bundle.mapper is not None  # full artifact, not just trees
+
+
+# ------------------------------------------------------------------ #
+# Model artifact: mapper/encoder persistence (round-1 Weak #2)
+# ------------------------------------------------------------------ #
+
+def test_save_load_model_bundle_roundtrip(tmp_path):
+    from ddt_tpu.data.categorical import fit_categorical_encoder
+
+    X, y = datasets.synthetic_binary(1000, n_features=6, seed=0)
+    res = api.train(X, y, n_trees=3, max_depth=3, n_bins=31,
+                    backend="cpu", log_every=10**9)
+    Xc = np.random.default_rng(0).integers(0, 50, size=(1000, 2))
+    enc = fit_categorical_encoder(Xc, n_bins=31)
+    p = str(tmp_path / "m.npz")
+    api.save_model(p, res.ensemble, mapper=res.mapper, encoder=enc)
+
+    b = api.load_model(p)
+    np.testing.assert_array_equal(b.ensemble.feature, res.ensemble.feature)
+    np.testing.assert_array_equal(b.mapper.edges, res.mapper.edges)
+    assert b.mapper.n_bins == res.mapper.n_bins
+    assert len(b.encoder.vocab_ids) == 2
+    np.testing.assert_array_equal(b.encoder.transform(Xc), enc.transform(Xc))
+
+    # Bare TreeEnsemble.load still reads the same file (extra keys ignored).
+    from ddt_tpu.models.tree import TreeEnsemble
+
+    ens = TreeEnsemble.load(p)
+    np.testing.assert_array_equal(ens.feature, res.ensemble.feature)
+
+
+def test_cli_predict_uses_training_mapper_on_shifted_data(tmp_path, capsys):
+    """Score data whose distribution differs from training: bins must come
+    from the TRAINING mapper in the artifact. (Round 1 refit the mapper on
+    the scoring set — silently wrong thresholds.)"""
+    from ddt_tpu.cli import main
+
+    X, y = datasets.synthetic_binary(2000, n_features=8, seed=0)
+    ptrain = str(tmp_path / "train.npz")
+    np.savez(ptrain, X=X, y=y)
+    model = str(tmp_path / "m.npz")
+    rc = main(["train", "--backend=cpu", f"--data={ptrain}", "--trees=4",
+               "--depth=3", "--bins=31", f"--out={model}"])
+    assert rc == 0
+    capsys.readouterr()
+
+    # Non-monotone transform: quantile binning is monotone-invariant, so
+    # only a genuinely different distribution SHAPE exposes a refit mapper.
+    Xs = np.square(X[:500]).astype(np.float32)
+    pshift = str(tmp_path / "shift.npz")
+    np.savez(pshift, X=Xs, y=y[:500])
+    sout = str(tmp_path / "scores.npy")
+    rc = main(["predict", "--backend=cpu", f"--model={model}",
+               f"--data={pshift}", f"--out={sout}"])
+    assert rc == 0
+    got = np.load(sout)
+
+    bundle = api.load_model(model)
+    from ddt_tpu.config import TrainConfig
+
+    cfg = TrainConfig(backend="cpu", loss=bundle.ensemble.loss)
+    want = api.predict(bundle.ensemble, Xs, mapper=bundle.mapper, cfg=cfg)
+    np.testing.assert_array_equal(got, want)
+
+    # The round-1 behavior (refit on scoring data) binned this set
+    # differently — prove the test would have caught it.
+    from ddt_tpu.data.quantizer import fit_bin_mapper
+
+    refit = fit_bin_mapper(Xs, n_bins=31, seed=0)
+    assert (refit.transform(Xs) != bundle.mapper.transform(Xs)).any()

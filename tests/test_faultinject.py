@@ -80,3 +80,6 @@ def test_sigkill_mid_training_then_resume_is_exact(tmp_path):
     # Resume rescoring replays fit's own per-round float32 accumulation
     # order (predict_raw_roundwise), so recovery is BIT-exact.
     np.testing.assert_array_equal(ea.leaf_value, eb.leaf_value)
+    # Gains of pre-crash trees must survive the resume (round-1 verdict bug).
+    np.testing.assert_array_equal(ea.split_gain, eb.split_gain)
+    assert np.any(ea.split_gain > 0)

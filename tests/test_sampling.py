@@ -160,3 +160,31 @@ def test_sklearn_params_protocol():
         return
     c2 = skclone(clf)
     assert c2.get_params() == clf.get_params()
+
+
+def test_classifier_single_class_raises_at_fit():
+    """ADVICE r1: single-class y must fail at fit with a clear error, not
+    an IndexError at predict."""
+    import pytest
+
+    from ddt_tpu.sklearn import DDTClassifier
+
+    X = np.random.default_rng(0).standard_normal((50, 4)).astype(np.float32)
+    y = np.ones(50, dtype=np.int64)
+    with pytest.raises(ValueError, match="only one class"):
+        DDTClassifier(n_trees=2, max_depth=2, backend="cpu").fit(X, y)
+
+
+def test_train_config_is_frozen():
+    """ADVICE r1: backend-cache keys assume configs never mutate; the
+    dataclass enforces it."""
+    import dataclasses
+
+    import pytest
+
+    from ddt_tpu.config import TrainConfig
+
+    cfg = TrainConfig(n_bins=31)
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        cfg.n_bins = 63
+    assert cfg.replace(n_bins=63).n_bins == 63  # derivation still works
