@@ -46,12 +46,35 @@ def main() -> None:
 
     value = tpu["mrows_per_sec_per_chip"]
     baseline = cpu["mrows_per_sec_per_chip"]
+    # Honest-baseline context (round-1 verdict, Weak #6): record what the
+    # CPU comparator actually was. This box exposes a single CPU core
+    # (os.cpu_count() below), so the OpenMP-built native kernel runs
+    # effectively single-threaded; on a many-core host the all-core native
+    # number is the comparator to quote.
     print(json.dumps({
         "metric": "higgs1m_histogram_throughput",
         "value": round(value, 2),
         "unit": "Mrows/s/chip",
         "vs_baseline": round(value / baseline, 2),
+        "baseline_mrows_per_sec": round(baseline, 2),
+        "baseline_impl": cpu["impl"],
+        "baseline_cpu_count": os.cpu_count(),
+        "baseline_omp_threads": _omp_threads(),
     }))
+
+
+def _omp_threads() -> int:
+    """Effective OpenMP thread count: first entry of OMP_NUM_THREADS (the
+    spec allows a comma-separated per-nesting-level list, and empty values
+    occur in the wild), falling back to the core count."""
+    raw = os.environ.get("OMP_NUM_THREADS", "").split(",")[0].strip()
+    try:
+        n = int(raw)
+        if n > 0:
+            return n
+    except ValueError:
+        pass
+    return os.cpu_count() or 1
 
 
 if __name__ == "__main__":

@@ -40,7 +40,7 @@ class FPGADevice(DeviceBackend):
 # n_trees or seed never enter a trace, so two train() calls differing only
 # there share one compiled backend.
 _JIT_FIELDS = (
-    "backend", "n_partitions", "feature_partitions",
+    "backend", "n_partitions", "feature_partitions", "host_partitions",
     "max_depth", "n_bins", "learning_rate", "loss", "n_classes",
     "reg_lambda", "min_child_weight", "min_split_gain",
     "hist_impl", "matmul_input_dtype",

@@ -53,6 +53,10 @@ P = jax.sharding.PartitionSpec
 
 AXIS = "rows"    # the data-parallel mesh axis (SURVEY.md §2 "Mesh axes")
 FAXIS = "features"  # optional TP-analog axis: column-sharded histogramming
+HAXIS = "hosts"  # cross-slice DCN axis (SURVEY.md §5 "Distributed comm
+#   backend"): row shards span (hosts, rows); the histogram allreduce
+#   becomes psum over BOTH axes, which XLA phases as an ICI-local reduce
+#   followed by a DCN allreduce. Must match parallel.mesh.HOSTS_AXIS.
 
 
 class LabelHandle(NamedTuple):
@@ -105,32 +109,50 @@ class TPUDevice(DeviceBackend):
             enable_persistent_compile_cache()
         self.n_partitions = max(1, cfg.n_partitions)
         self.feature_partitions = max(1, cfg.feature_partitions)
+        self.host_partitions = max(1, cfg.host_partitions)
         if mesh is not None:
             self.mesh = mesh
-            if FAXIS in mesh.axis_names:
-                self.feature_partitions = mesh.shape[FAXIS]
-            else:
-                self.feature_partitions = 1
-            self.n_partitions = mesh.devices.size // self.feature_partitions
-        elif self.n_partitions > 1 or self.feature_partitions > 1:
-            n_dev = self.n_partitions * self.feature_partitions
+            names = mesh.axis_names
+            self.feature_partitions = (
+                mesh.shape[FAXIS] if FAXIS in names else 1)
+            self.host_partitions = mesh.shape[HAXIS] if HAXIS in names else 1
+            self.n_partitions = mesh.devices.size // (
+                self.feature_partitions * self.host_partitions)
+        elif (self.n_partitions > 1 or self.feature_partitions > 1
+              or self.host_partitions > 1):
+            n_dev = (self.host_partitions * self.n_partitions
+                     * self.feature_partitions)
             devs = devices if devices is not None else jax.devices()
             if len(devs) < n_dev:
                 raise ValueError(
-                    f"n_partitions={self.n_partitions} x feature_partitions="
+                    f"host_partitions={self.host_partitions} x n_partitions="
+                    f"{self.n_partitions} x feature_partitions="
                     f"{self.feature_partitions} needs {n_dev} devices but "
                     f"only {len(devs)} visible"
                 )
-            # rows outermost: row shards land on far mesh dims (DCN-friendly),
-            # the feature axis stays innermost (ICI-adjacent) — the feature
-            # psum/all_gather per level is latency-sensitive.
-            self.mesh = jax.make_mesh(
-                (self.n_partitions, self.feature_partitions), (AXIS, FAXIS),
-                devices=devs[:n_dev],
-            )
+            # hosts outermost (DCN, slowest), rows middle, features innermost
+            # (ICI-adjacent) — the feature psum/all_gather per level is
+            # latency-sensitive; the hosts hop happens once per reduction.
+            if self.host_partitions > 1:
+                self.mesh = jax.make_mesh(
+                    (self.host_partitions, self.n_partitions,
+                     self.feature_partitions),
+                    (HAXIS, AXIS, FAXIS), devices=devs[:n_dev],
+                )
+            else:
+                self.mesh = jax.make_mesh(
+                    (self.n_partitions, self.feature_partitions),
+                    (AXIS, FAXIS), devices=devs[:n_dev],
+                )
         else:
             self.mesh = None
         self.distributed = self.mesh is not None
+        # Row shards span (hosts x rows); every row-dimension sharding spec
+        # and row-axis psum uses this (a tuple axis entry when the pod axis
+        # exists, the plain "rows" name otherwise).
+        self.row_shards = self.host_partitions * self.n_partitions
+        self._row_axes = (
+            (HAXIS, AXIS) if self.host_partitions > 1 else AXIS)
         self._input_dtype = jnp.dtype(cfg.matmul_input_dtype)
 
     # ------------------------------------------------------------------ #
@@ -143,9 +165,9 @@ class TPUDevice(DeviceBackend):
         return jax.sharding.NamedSharding(self.mesh, P(*spec))
 
     def _pad_rows(self, a: np.ndarray) -> np.ndarray:
-        """Pad axis 0 to a multiple of n_partitions (zeros)."""
+        """Pad axis 0 to a multiple of the (hosts x rows) shard count."""
         R = a.shape[0]
-        Rp = -(-R // self.n_partitions) * self.n_partitions
+        Rp = -(-R // self.row_shards) * self.row_shards
         if Rp == R:
             return a
         pad = [(0, Rp - R)] + [(0, 0)] * (a.ndim - 1)
@@ -153,7 +175,7 @@ class TPUDevice(DeviceBackend):
 
     def _put_rows(self, a: np.ndarray, extra_dims: int = 0) -> jax.Array:
         a = self._pad_rows(np.ascontiguousarray(a))
-        sh = self._sharding(AXIS, *([None] * extra_dims))
+        sh = self._sharding(self._row_axes, *([None] * extra_dims))
         return jax.device_put(a, sh) if sh is not None else jax.device_put(a)
 
     # ------------------------------------------------------------------ #
@@ -173,7 +195,7 @@ class TPUDevice(DeviceBackend):
             if Fp != F:
                 Xb = np.pad(Xb, ((0, 0), (0, Fp - F)))
             Xp = self._pad_rows(np.ascontiguousarray(Xb))
-            data = jax.device_put(Xp, self._sharding(AXIS, FAXIS))
+            data = jax.device_put(Xp, self._sharding(self._row_axes, FAXIS))
         else:
             data = self._put_rows(Xb, extra_dims=1)
         return data
@@ -205,6 +227,8 @@ class TPUDevice(DeviceBackend):
                 )
             return unsupported
 
+        rax = self._row_axes
+
         def hist(Xb, g, h, node_index, *, n_nodes):
             # impl resolution happens inside build_histograms with the full
             # shape (pallas only when its VMEM working set fits).
@@ -213,7 +237,9 @@ class TPUDevice(DeviceBackend):
                 impl=cfg.hist_impl, input_dtype=self._input_dtype,
             )
             if self.distributed:
-                out = jax.lax.psum(out, AXIS)  # the fabric-allreduce analog
+                # The fabric-allreduce analog; over (hosts, rows) XLA phases
+                # it ICI-reduce first, then the cross-slice DCN hop.
+                out = jax.lax.psum(out, rax)
             return out
 
         if self.distributed:
@@ -221,7 +247,7 @@ class TPUDevice(DeviceBackend):
                 f = jax.shard_map(
                     functools.partial(hist, n_nodes=n_nodes),
                     mesh=self.mesh,
-                    in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS)),
+                    in_specs=(P(rax, None), P(rax), P(rax), P(rax)),
                     out_specs=P(),
                 )
                 return f(Xb, g, h, node_index)
@@ -240,7 +266,7 @@ class TPUDevice(DeviceBackend):
     def _pad_rows_index(self, idx: np.ndarray) -> np.ndarray:
         """Pad a node-index vector with -1 (frozen) so pad rows are inert."""
         R = idx.shape[0]
-        Rp = -(-R // self.n_partitions) * self.n_partitions
+        Rp = -(-R // self.row_shards) * self.row_shards
         if Rp == R:
             return idx
         return np.concatenate(
@@ -260,10 +286,10 @@ class TPUDevice(DeviceBackend):
         Rp = y.y.shape[0]
         if self.cfg.loss == "softmax":
             z = np.zeros((Rp, self.cfg.n_classes), np.float32)
-            sh = self._sharding(AXIS, None)
+            sh = self._sharding(self._row_axes, None)
         else:
             z = np.full(Rp, base, np.float32)
-            sh = self._sharding(AXIS)
+            sh = self._sharding(self._row_axes)
         return jax.device_put(z, sh) if sh is not None else jax.device_put(z)
 
     def load_pred(self, raw: np.ndarray):
@@ -298,7 +324,7 @@ class TPUDevice(DeviceBackend):
 
     def _build_grow_fn(self, with_mask: bool):
         cfg = self.cfg
-        axis = AXIS if self.distributed else None
+        axis = self._row_axes if self.distributed else None
         faxis = FAXIS if self.feature_partitions > 1 else None
 
         def grow(Xb, g, h, fmask=None):
@@ -338,15 +364,16 @@ class TPUDevice(DeviceBackend):
                 return inner(Xb, g, h, None)
 
         if self.distributed:
-            data_spec = P(AXIS, FAXIS) if faxis else P(AXIS, None)
-            in_specs = (data_spec, P(AXIS), P(AXIS))
+            rax = self._row_axes
+            data_spec = P(rax, FAXIS) if faxis else P(rax, None)
+            in_specs = (data_spec, P(rax), P(rax))
             if with_mask:
                 in_specs = in_specs + (P(),)       # mask replicated
             grow = jax.shard_map(
                 grow,
                 mesh=self.mesh,
                 in_specs=in_specs,
-                out_specs=(P(), P(AXIS)),
+                out_specs=(P(), P(rax)),
                 # Feature-parallel growth replicates every output across the
                 # feature axis BIT-IDENTICALLY by construction (split triples
                 # come out of an all_gather + argmax every shard computes the
@@ -376,6 +403,103 @@ class TPUDevice(DeviceBackend):
         from ddt_tpu.utils.device import device_sync
 
         device_sync(x)
+
+    # ------------------------------------------------------------------ #
+    # fused multi-round training: a whole block of boosting rounds in ONE
+    # device dispatch (lax.scan over rounds). Per-round dispatch economics
+    # dominate wallclock through a remote-attached chip (~10-30 ms of host
+    # overhead per call x 3 calls x 100 rounds); the scan collapses that to
+    # one dispatch + ONE tree fetch per block. Deterministic boosting only
+    # (the Driver falls back to the granular path for bagging/colsample/
+    # eval, whose masks are host-drawn by design).
+    # ------------------------------------------------------------------ #
+
+    def grow_rounds(self, data, pred, y: "LabelHandle", n_rounds: int):
+        """Run `n_rounds` boosting rounds on device. Returns device handles
+        (packed_trees [n_rounds, C, 5, n_nodes] f32, new_pred,
+        losses [n_rounds] f32 — loss AFTER each round, matching
+        loss_value's semantics)."""
+        fn = self._rounds_fns.get(n_rounds)
+        if fn is None:
+            fn = self._build_rounds_fn(n_rounds)
+            self._rounds_fns[n_rounds] = fn
+        return fn(data, pred, y.y, y.valid)
+
+    @functools.cached_property
+    def _rounds_fns(self) -> dict:
+        return {}
+
+    def _build_rounds_fn(self, K: int):
+        cfg = self.cfg
+        C = cfg.n_classes if cfg.loss == "softmax" else 1
+        axis = self._row_axes if self.distributed else None
+        faxis = FAXIS if self.feature_partitions > 1 else None
+        input_dtype = self._input_dtype
+
+        def allreduce(x):
+            return jax.lax.psum(x, axis) if axis is not None else x
+
+        def loss_of(pred, ya, valid):
+            # Shared loss formulas (ops/grad.mean_loss); reductions psum'd
+            # when row shards exist (inside shard_map the plain sums are
+            # shard-local).
+            return grad_ops.mean_loss(pred, ya, valid, cfg.loss,
+                                      allreduce=allreduce)
+
+        def rounds(data_a, pred0, ya, valid):
+            def body(pred, _):
+                g, h = grad_ops.grad_hess(pred, ya, cfg.loss)
+                v = valid[:, None] if g.ndim == 2 else valid
+                g = g * v
+                h = h * v
+                packs = []
+                for c in range(C):
+                    gc = g[:, c] if C > 1 else g
+                    hc = h[:, c] if C > 1 else h
+                    tree = grow_ops.grow_tree(
+                        data_a, gc, hc,
+                        max_depth=cfg.max_depth,
+                        n_bins=cfg.n_bins,
+                        reg_lambda=cfg.reg_lambda,
+                        min_child_weight=cfg.min_child_weight,
+                        min_split_gain=cfg.min_split_gain,
+                        hist_impl=cfg.hist_impl,
+                        input_dtype=input_dtype,
+                        axis_name=axis,
+                        feature_axis_name=faxis,
+                    )
+                    delta = grow_ops.tree_predict_delta(
+                        tree, cfg.learning_rate)
+                    pred = (pred.at[:, c].add(delta) if C > 1
+                            else pred + delta)
+                    packs.append(jnp.stack([
+                        tree.feature.astype(jnp.float32),
+                        tree.threshold_bin.astype(jnp.float32),
+                        tree.is_leaf.astype(jnp.float32),
+                        tree.leaf_value,
+                        tree.split_gain,
+                    ]))
+                return pred, (jnp.stack(packs), loss_of(pred, ya, valid))
+
+            predf, (trees, losses) = jax.lax.scan(body, pred0, None,
+                                                  length=K)
+            return trees, predf, losses
+
+        if self.distributed:
+            rax = self._row_axes
+            pred_spec = P(rax, None) if C > 1 else P(rax)
+            data_spec = P(rax, FAXIS) if faxis else P(rax, None)
+            rounds = jax.shard_map(
+                rounds,
+                mesh=self.mesh,
+                in_specs=(data_spec, pred_spec, P(rax), P(rax)),
+                out_specs=(P(), pred_spec, P()),
+                # Same rationale as _build_grow_fn: tree outputs are
+                # replicated bit-identically by construction; the static
+                # VMA checker cannot see through the gathered argmax.
+                check_vma=faxis is None,
+            )
+        return jax.jit(rounds, donate_argnums=(1,))
 
     def apply_row_mask(self, g, h, mask):
         # Upload bool (1 byte/row); the cast to f32 is a free fused device op.
@@ -422,19 +546,7 @@ class TPUDevice(DeviceBackend):
 
         @jax.jit
         def f(pred, y, valid):
-            n = jnp.maximum(valid.sum(), 1)
-            if loss == "logloss":
-                yf = y.astype(jnp.float32)
-                # Numerically stable logistic loss: log(1+e^-|x|)+max(x,0)-x*y
-                per = jnp.logaddexp(0.0, pred) - pred * yf
-                return jnp.sum(per * valid) / n
-            if loss == "mse":
-                return jnp.sum(jnp.square(pred - y) * valid) / n
-            logp = jax.nn.log_softmax(pred, axis=1)
-            picked = jnp.take_along_axis(
-                logp, y.astype(jnp.int32)[:, None], axis=1
-            )[:, 0]
-            return -jnp.sum(picked * valid) / n
+            return grad_ops.mean_loss(pred, y, valid, loss)
 
         return f
 
@@ -454,7 +566,7 @@ class TPUDevice(DeviceBackend):
 
     def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
         R = Xb.shape[0]
-        chunk = self.PREDICT_ROW_CHUNK * max(1, self.n_partitions)
+        chunk = self.PREDICT_ROW_CHUNK * max(1, self.row_shards)
         fn, ens_dev = self._predict_fn(ens)     # upload the ensemble ONCE
         if R > chunk:
             if self.distributed:
@@ -500,11 +612,12 @@ class TPUDevice(DeviceBackend):
             # (SURVEY.md §3 predict stack). shard_map makes the row-gather
             # sharding explicit — XLA cannot infer it through the
             # take_along_axis traversal.
-            out_spec = P(AXIS) if C == 1 else P(AXIS, None)
+            rax = self._row_axes
+            out_spec = P(rax) if C == 1 else P(rax, None)
             fn = jax.shard_map(
                 fn,
                 mesh=self.mesh,
-                in_specs=(P(), P(), P(), P(), P(AXIS, None)),
+                in_specs=(P(), P(), P(), P(), P(rax, None)),
                 out_specs=out_spec,
                 # predict_raw's scan carry starts replicated (zeros) and
                 # becomes row-varying after the first accumulation; the

@@ -120,6 +120,9 @@ def main(argv: list[str] | None = None) -> int:
     tp.add_argument("--feature-partitions", type=int, default=1,
                     help="column partitions (TP-analog mesh axis); uses "
                          "partitions x feature-partitions devices")
+    tp.add_argument("--host-partitions", type=int, default=1,
+                    help="cross-slice DCN mesh axis for multi-host pods; "
+                         "row shards span host-partitions x partitions")
     tp.add_argument("--profile", action="store_true",
                     help="log a per-phase wallclock breakdown (adds device "
                          "barriers; rounds run slower than unprofiled)")
@@ -179,6 +182,7 @@ def main(argv: list[str] | None = None) -> int:
             n_classes=n_classes if loss == "softmax" else 2,
             backend=args.backend, n_partitions=args.partitions,
             feature_partitions=args.feature_partitions,
+            host_partitions=args.host_partitions,
             subsample=args.subsample,
             colsample_bytree=args.colsample_bytree,
             hist_impl=args.hist_impl, seed=args.seed,

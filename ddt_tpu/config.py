@@ -48,8 +48,11 @@ class TrainConfig:
     # --- system ---
     backend: str = "tpu"        # cpu | tpu | fpga(stub)
     n_partitions: int = 1       # row partitions (data parallel over mesh axis)
-    feature_partitions: int = 1  # column partitions (TP-analog mesh axis);
-    #   total devices used = n_partitions * feature_partitions
+    feature_partitions: int = 1  # column partitions (TP-analog mesh axis)
+    host_partitions: int = 1    # cross-slice "hosts" mesh axis (DCN): row
+    #   shards span hosts x rows; histogram psum phases ICI-first then DCN.
+    #   Total devices used = host_partitions x n_partitions x
+    #   feature_partitions.
     hist_impl: str = "auto"     # auto | matmul | segment | pallas
     seed: int = 0
 
@@ -72,7 +75,8 @@ class TrainConfig:
             raise ValueError("max_depth must be >= 1")
         if self.loss == "softmax" and self.n_classes < 2:
             raise ValueError("softmax needs n_classes >= 2")
-        if self.n_partitions < 1 or self.feature_partitions < 1:
+        if (self.n_partitions < 1 or self.feature_partitions < 1
+                or self.host_partitions < 1):
             raise ValueError("partition counts must be >= 1")
         if not (0.0 < self.subsample <= 1.0):
             raise ValueError("subsample must be in (0, 1]")

@@ -52,12 +52,22 @@ def _split_label(M: np.ndarray, label_col: str) -> tuple[np.ndarray, np.ndarray]
     elif label_col == "last":
         y, X = M[:, -1], M[:, :-1]
     elif label_col == "auto":
-        # Prefer the side that looks like a small-cardinality integer label;
+        # Pick the side that looks like a small-cardinality integer label;
         # ties go to FIRST (the UCI Higgs convention this repo's primary
-        # config uses). Explicit label_col beats auto whenever ambiguous.
+        # config uses). When NEITHER side qualifies (e.g. a float
+        # regression target), auto refuses rather than silently training
+        # on a feature column — the caller must say first/last.
         first_ok = _looks_integer_labels(M[:, 0])
         last_ok = _looks_integer_labels(M[:, -1])
-        if first_ok or not last_ok:
+        if not first_ok and not last_ok:
+            raise ValueError(
+                "label_col='auto' could not identify a label column "
+                "(neither the first nor the last column is a small-"
+                "cardinality integer column — float regression targets "
+                "are indistinguishable from features); pass "
+                "label_col='first' or 'last' (--label-col in the CLI)"
+            )
+        if first_ok:
             y, X = M[:, 0], M[:, 1:]
         else:
             y, X = M[:, -1], M[:, :-1]
@@ -223,13 +233,18 @@ def load_file(
     # Text: find the first line that is DATA (a non-parsing first line is a
     # CSV header — skipped, and never used for format sniffing, so header
     # names containing ':' can't misroute a CSV to the libsvm parser).
+    # `skip` counts PHYSICAL lines consumed before the first data line —
+    # np.loadtxt's skiprows is physical, so blank/comment-only lines ahead
+    # of a header must be counted too, not just the header itself.
     with _open_maybe_gzip(path) as f:
         first = ""
         skip = 0
+        n_headers = 0
         for line in f:
             data = line.split("#", 1)[0]
             if not data.strip():
-                continue               # blank or comment-only line
+                skip += 1              # blank or comment-only line
+                continue
             try:
                 [float(t) for t in data.replace(",", " ").split()]
                 first = data
@@ -238,8 +253,9 @@ def load_file(
                 if _is_libsvm_data_line(data):
                     first = data
                     break
-                skip += 1
-                if skip > 1:
+                skip += 1              # header line
+                n_headers += 1
+                if n_headers > 1:
                     raise ValueError(
                         f"{path}: not a numeric CSV (two non-parsing "
                         "leading lines) and not libsvm format"

@@ -207,6 +207,22 @@ class Driver:
         bagging = cfg.subsample < 1.0
         colsample = cfg.colsample_bytree < 1.0
 
+        # Fused block path: backends exposing grow_rounds run whole blocks
+        # of rounds in one device dispatch + one tree fetch (per-round
+        # dispatch latency dominates on a remote-attached chip). Only for
+        # deterministic boosting — bagging/colsample masks are host-drawn
+        # by design, eval needs each tree immediately, and profiling wants
+        # per-phase barriers.
+        if (
+            getattr(self.backend, "grow_rounds", None) is not None
+            and eval_set is None
+            and self.timer is None
+            and not bagging
+            and not colsample
+        ):
+            return self._fit_fused(
+                data, y_dev, pred, ens, start_round, C)
+
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
             with ph("grad"):
@@ -318,4 +334,60 @@ class Driver:
                          "x%-5d %5.1f%%", rec["phase"], rec["ms_total"],
                          rec["ms_per_call"], rec["calls"],
                          100 * rec["share"])
+        return ens
+
+    def _fit_fused(self, data, y_dev, pred, ens: TreeEnsemble,
+                   start_round: int, C: int) -> TreeEnsemble:
+        """Block loop over backend.grow_rounds: K rounds per dispatch,
+        K x C trees per fetch. Blocks break at checkpoint_every boundaries
+        so the checkpoint cadence (and resume bit-exactness) is identical
+        to the granular path."""
+        cfg = self.cfg
+        rnd = start_round
+        while rnd < cfg.n_trees:
+            K = cfg.n_trees - rnd
+            if self.checkpoint_dir is not None:
+                nxt = (rnd // self.checkpoint_every + 1) * \
+                    self.checkpoint_every
+                K = min(K, nxt - rnd)
+            t0 = time.perf_counter()
+            trees_h, pred, losses_h = self.backend.grow_rounds(
+                data, pred, y_dev, K)
+            trees = np.asarray(trees_h)         # [K, C, 5, N] — ONE fetch
+            losses = np.asarray(losses_h)
+            dt = time.perf_counter() - t0
+            for k in range(K):
+                for c in range(C):
+                    slot = (rnd + k) * C + c
+                    p = trees[k, c]
+                    ens.feature[slot] = p[0].astype(np.int32)
+                    ens.threshold_bin[slot] = p[1].astype(np.int32)
+                    ens.is_leaf[slot] = p[2].astype(bool)
+                    ens.leaf_value[slot] = p[3]
+                    ens.split_gain[slot] = p[4]
+                r = rnd + k
+                if (r + 1) % self.log_every == 0 or r == cfg.n_trees - 1:
+                    rec = {
+                        "round": r + 1,
+                        "train_loss": float(losses[k]),
+                        "ms_per_round": dt * 1e3 / K,
+                    }
+                    self.history.append(rec)
+                    log.info(
+                        "round %4d/%d  loss=%.6f  %.1f ms/round",
+                        r + 1, cfg.n_trees, float(losses[k]), dt * 1e3 / K,
+                    )
+            rnd += K
+            if (
+                self.checkpoint_dir is not None
+                and rnd % self.checkpoint_every == 0
+                and rnd < cfg.n_trees
+            ):
+                from ddt_tpu.utils.checkpoint import save_checkpoint
+
+                save_checkpoint(self.checkpoint_dir, ens, cfg, rnd)
+        if self.checkpoint_dir is not None:
+            from ddt_tpu.utils.checkpoint import save_checkpoint
+
+            save_checkpoint(self.checkpoint_dir, ens, cfg, cfg.n_trees)
         return ens

@@ -27,6 +27,35 @@ def base_score(y: jax.Array, loss: str) -> jax.Array:
     return jnp.float32(0.0)
 
 
+def mean_loss(
+    pred_raw: jax.Array,
+    y: jax.Array,
+    valid: jax.Array,
+    loss: str,
+    allreduce=lambda x: x,
+) -> jax.Array:
+    """Mean training loss over valid rows — the single home of the loss
+    formulas shared by TPUDevice._loss_fn and the fused grow_rounds path
+    (their reported train_loss must stay numerically identical). `allreduce`
+    is identity on one shard, psum over the row axes inside shard_map."""
+    valid = valid.astype(jnp.float32)
+    n = jnp.maximum(allreduce(valid.sum()), 1)
+    if loss == "logloss":
+        yf = y.astype(jnp.float32)
+        # Numerically stable logistic loss: log(1+e^-|x|)+max(x,0)-x*y
+        per = jnp.logaddexp(0.0, pred_raw) - pred_raw * yf
+        return allreduce(jnp.sum(per * valid)) / n
+    if loss == "mse":
+        return allreduce(jnp.sum(jnp.square(pred_raw - y) * valid)) / n
+    if loss == "softmax":
+        logp = jax.nn.log_softmax(pred_raw, axis=1)
+        picked = jnp.take_along_axis(
+            logp, y.astype(jnp.int32)[:, None], axis=1
+        )[:, 0]
+        return -allreduce(jnp.sum(picked * valid)) / n
+    raise ValueError(loss)
+
+
 def grad_hess(
     pred_raw: jax.Array, y: jax.Array, loss: str
 ) -> tuple[jax.Array, jax.Array]:

@@ -67,7 +67,9 @@ def grow_tree(
     hist_impl: str = "auto",
     row_chunk: int = 32_768,
     input_dtype=jnp.bfloat16,
-    axis_name: str | None = None,
+    axis_name: "str | tuple[str, ...] | None" = None,   # row-shard axes;
+    #   a ("hosts", "rows") tuple for pod meshes — psum reduces over all of
+    #   them (XLA phases ICI before DCN for a (hosts, rows, ...) mesh).
     feature_axis_name: str | None = None,
     feature_mask: jax.Array | None = None,   # bool [F global]; colsample
 ) -> TreeArrays:

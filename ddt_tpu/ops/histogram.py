@@ -95,11 +95,21 @@ def _hist_chunk_matmul(
     A = jnp.concatenate(
         [node_oh * gz[:, None], node_oh * hz[:, None]], axis=1
     ).astype(input_dtype)
+    # CPU XLA has no BF16 x BF16 = F32 dot thunk; emulate EXACTLY by
+    # rounding the inputs to bf16 and contracting in f32 — bf16 values are
+    # exact in f32 and their products fit f32, and the MXU accumulates in
+    # f32 anyway, so this reproduces the TPU path's numerics (used by the
+    # bf16-vs-f32 training-quality tests, tests/test_numerics.py).
+    emulate_bf16 = (
+        input_dtype == jnp.bfloat16 and jax.default_backend() == "cpu"
+    )
+    if emulate_bf16:
+        A = A.astype(jnp.float32)
     # TPU default matmul precision is bf16 passes even for f32 operands;
     # when the caller asked for f32 inputs they want exact accumulation.
     prec = (
         jax.lax.Precision.HIGHEST
-        if input_dtype == jnp.float32
+        if input_dtype == jnp.float32 or emulate_bf16
         else jax.lax.Precision.DEFAULT
     )
 
@@ -107,6 +117,8 @@ def _hist_chunk_matmul(
         bins_oh = (
             xcol[:, None] == jnp.arange(n_bins, dtype=jnp.uint8)[None, :]
         ).astype(input_dtype)                                     # [r, B]
+        if emulate_bf16:
+            bins_oh = bins_oh.astype(jnp.float32)
         return jax.lax.dot_general(
             A, bins_oh,
             (((0,), (0,)), ((), ())),                             # contract rows

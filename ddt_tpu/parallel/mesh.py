@@ -43,17 +43,38 @@ def make_row_mesh(
 def make_pod_mesh(
     n_hosts: int | None = None,
     devices_per_host: int | None = None,
+    feature_partitions: int = 1,
+    devices: list | None = None,
 ) -> jax.sharding.Mesh:
-    """2-D (hosts, rows) mesh for multi-slice pods: "rows" is the intra-slice
-    ICI axis, "hosts" the cross-slice DCN axis. Histogram reduction becomes
-    psum over both axes; XLA phases it as ICI-reduce then DCN-allreduce."""
-    devs = jax.devices()
+    """(hosts, rows[, features]) mesh for multi-slice pods: "rows" is the
+    intra-slice ICI axis, "hosts" the cross-slice DCN axis (outermost =
+    slowest varying, so each host's devices stay ICI-contiguous). Histogram
+    reduction becomes psum over (hosts, rows); XLA phases it as ICI-reduce
+    then DCN-allreduce.
+
+    Consumed by TPUDevice: pass the result as `TPUDevice(cfg, mesh=...)`
+    (it reads the hosts/rows/features axis sizes off the mesh), or just set
+    cfg.host_partitions and let TPUDevice build the identical mesh itself."""
+    devs = devices if devices is not None else jax.devices()
     if n_hosts is None:
         n_hosts = max(1, jax.process_count())
     if devices_per_host is None:
-        devices_per_host = len(devs) // n_hosts
+        devices_per_host = len(devs) // (n_hosts * feature_partitions)
+    n_dev = n_hosts * devices_per_host * feature_partitions
+    if len(devs) < n_dev:
+        raise ValueError(
+            f"pod mesh {n_hosts} x {devices_per_host} x "
+            f"{feature_partitions} needs {n_dev} devices, "
+            f"have {len(devs)}"
+        )
+    if feature_partitions > 1:
+        return jax.make_mesh(
+            (n_hosts, devices_per_host, feature_partitions),
+            (HOSTS_AXIS, ROWS_AXIS, "features"), devices=devs[:n_dev],
+        )
     return jax.make_mesh(
         (n_hosts, devices_per_host), (HOSTS_AXIS, ROWS_AXIS),
+        devices=devs[:n_dev],
     )
 
 

@@ -32,7 +32,8 @@ def _cfg_fingerprint(cfg: TrainConfig) -> dict:
     # System knobs may legitimately differ across resume (e.g. resume on a
     # different partition count — distribution never changes results), and
     # n_trees may grow (resuming to train further is the point of resuming).
-    for k in ("n_trees", "n_partitions", "hist_impl", "backend",
+    for k in ("n_trees", "n_partitions", "feature_partitions",
+              "host_partitions", "hist_impl", "backend",
               "matmul_input_dtype"):
         d.pop(k, None)
     return d

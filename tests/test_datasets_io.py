@@ -219,6 +219,33 @@ def test_cli_predict_rejects_wrong_width_file(tmp_path, capsys):
               f"--data={pbad}"])
 
 
+def test_load_csv_comment_before_header(tmp_path):
+    """skiprows must count PHYSICAL lines: a comment/blank line before the
+    header previously desynchronized the header skip and crashed loadtxt."""
+    p = str(tmp_path / "c.csv")
+    with open(p, "w") as f:
+        f.write("# exported 2026-07-30\n")
+        f.write("\n")
+        f.write("label,f0,f1\n")
+        f.write("1,0.5,0.2\n0,1.5,0.8\n")
+    X, y = datasets.load_file(p)
+    assert X.shape == (2, 2)
+    np.testing.assert_array_equal(y, [1, 0])
+
+
+def test_load_csv_auto_refuses_float_targets(tmp_path):
+    """A float regression target defeats auto label detection; refusing
+    beats silently training on feature column 0."""
+    rng = np.random.default_rng(6)
+    M = rng.standard_normal((20, 4))
+    p = str(tmp_path / "r.csv")
+    np.savetxt(p, M, delimiter=",")
+    with pytest.raises(ValueError, match="label_col"):
+        datasets.load_file(p)
+    X, y = datasets.load_file(p, label_col="last")   # explicit works
+    assert X.shape == (20, 3)
+
+
 def test_load_file_max_rows(tmp_path):
     p = str(tmp_path / "d.npz")
     np.savez(p, X=np.zeros((100, 2), np.float32), y=np.zeros(100))

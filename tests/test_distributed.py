@@ -97,6 +97,68 @@ def test_partitioned_softmax():
     np.testing.assert_array_equal(e1.threshold_bin, e4.threshold_bin)
 
 
+@pytest.mark.parametrize("hp,np_,fp", [(2, 4, 1), (2, 2, 2), (4, 2, 1),
+                                       (8, 1, 1)])
+def test_pod_mesh_equals_single(hp, np_, fp):
+    """The DCN story (SURVEY.md §5 'Distributed communication backend',
+    BASELINE config 5): a (hosts, rows[, features]) pod mesh — psum over
+    BOTH row axes — grows bit-identical trees to a single chip."""
+    X, y = datasets.synthetic_binary(4096, n_features=10, seed=31)
+    Xb, _ = quantize(X, n_bins=31, seed=31)
+    e1 = _fit(1, Xb, y)
+    eP = _fit(np_, Xb, y, host_partitions=hp, feature_partitions=fp)
+    np.testing.assert_array_equal(e1.feature, eP.feature)
+    np.testing.assert_array_equal(e1.threshold_bin, eP.threshold_bin)
+    np.testing.assert_array_equal(e1.is_leaf, eP.is_leaf)
+    np.testing.assert_allclose(e1.leaf_value, eP.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_pod_mesh_from_make_pod_mesh():
+    """TPUDevice consumes an externally built parallel.mesh.make_pod_mesh
+    (the multi-host entry path: jax.distributed.initialize + make_pod_mesh
+    + TPUDevice(cfg, mesh=...))."""
+    from ddt_tpu.backends.tpu import TPUDevice
+    from ddt_tpu.parallel.mesh import make_pod_mesh
+
+    mesh = make_pod_mesh(n_hosts=2, devices_per_host=4)
+    assert mesh.axis_names == ("hosts", "rows")
+    cfg = TrainConfig(n_trees=4, max_depth=4, n_bins=31, backend="tpu")
+    be = TPUDevice(cfg, mesh=mesh)
+    assert be.host_partitions == 2 and be.n_partitions == 4
+    assert be.row_shards == 8
+
+    X, y = datasets.synthetic_binary(4096, n_features=10, seed=31)
+    Xb, _ = quantize(X, n_bins=31, seed=31)
+    e1 = _fit(1, Xb, y)
+    eP = Driver(be, cfg, log_every=10**9).fit(Xb, y)
+    np.testing.assert_array_equal(e1.feature, eP.feature)
+    np.testing.assert_array_equal(e1.threshold_bin, eP.threshold_bin)
+
+
+def test_pod_mesh_softmax_and_nondivisible_rows():
+    X, y = datasets.synthetic_multiclass(2003, n_features=12, seed=3)
+    Xb, _ = quantize(X, n_bins=31, seed=3)
+    e1 = _fit(1, Xb, y, loss="softmax", n_classes=7)
+    eP = _fit(2, Xb, y, loss="softmax", n_classes=7, host_partitions=2)
+    np.testing.assert_array_equal(e1.feature, eP.feature)
+    np.testing.assert_array_equal(e1.threshold_bin, eP.threshold_bin)
+
+
+def test_pod_predict_raw():
+    """Row-sharded inference over the (hosts, rows) mesh."""
+    X, y = datasets.synthetic_binary(3000, n_features=10, seed=2)
+    Xb, _ = quantize(X, n_bins=31, seed=2)
+    res = api.train(Xb, y, binned=True, n_trees=6, max_depth=4, n_bins=31,
+                    backend="cpu", log_every=10**9)
+    cfg = TrainConfig(backend="tpu", host_partitions=2, n_partitions=4,
+                      n_bins=31)
+    be = get_backend(cfg)
+    got = be.predict_raw(res.ensemble, Xb)
+    want = res.ensemble.predict_raw(Xb, binned=True)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
 def test_distributed_histogram_is_global():
     """The granular L4 kernel includes the cross-partition allreduce: the
     sharded histogram equals the single-device histogram of all rows."""
