@@ -129,7 +129,13 @@ def train(
     else:
         if mapper is None:
             mapper = fit_bin_mapper(np.asarray(X), n_bins=cfg.n_bins,
-                                    seed=cfg.seed)
+                                    seed=cfg.seed,
+                                    missing_policy=cfg.missing_policy)
+        elif cfg.missing_policy == "learn" and not mapper.missing_bin:
+            raise ValueError(
+                "missing_policy='learn' requires a BinMapper fitted with "
+                "the same policy (its top bin must be the NaN bin)"
+            )
         Xb = mapper.transform(np.asarray(X))
 
     if eval_set is not None:
@@ -186,6 +192,15 @@ def predict(
     X = np.asarray(X)
     if not binned:
         if mapper is not None:
+            if mapper.missing_bin != ens.missing_bin:
+                # A policy mismatch silently misroutes every NaN row (the
+                # reserved bin vs bin 0); same guard as train-time.
+                raise ValueError(
+                    f"mapper.missing_bin={mapper.missing_bin} but the "
+                    f"ensemble was trained with missing_bin="
+                    f"{ens.missing_bin}; use the training-time mapper "
+                    "(api.load_model returns it)"
+                )
             X = mapper.transform(X)
             binned = True
         elif not ens.has_raw_thresholds:

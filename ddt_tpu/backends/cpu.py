@@ -72,13 +72,15 @@ class CPUDevice(DeviceBackend):
         )
 
     def best_splits(self, hist):
+        # Granular L4 surface: 3-tuple contract (missing-direction handling
+        # lives in the grow path, which calls ref.best_splits directly).
         if self._native_split is not None:
             return self._native_split(
                 hist, self.cfg.reg_lambda, self.cfg.min_child_weight
             )
         return ref.best_splits(
             hist, self.cfg.reg_lambda, self.cfg.min_child_weight
-        )
+        )[:3]
 
     # ------------------------------------------------------------------ #
 
@@ -110,6 +112,7 @@ class CPUDevice(DeviceBackend):
             is_leaf=tree["is_leaf"],
             leaf_value=tree["leaf_value"],
             split_gain=tree["split_gain"],
+            default_left=tree["default_left"],
         )
         return host, delta
 
@@ -139,7 +142,10 @@ class CPUDevice(DeviceBackend):
             return ens.predict_raw(Xb, binned=True)
         # C++ batch traversal (the CPU twin of the device gather+compare
         # path); aggregation shared with TreeEnsemble.predict_raw.
+        # Missing-bin models route NaN rows by the learned direction.
         leaf = self._native_traverse(
-            Xb, ens.feature, ens.threshold_bin, ens.is_leaf, ens.max_depth
+            Xb, ens.feature, ens.threshold_bin, ens.is_leaf, ens.max_depth,
+            default_left=ens.default_left,
+            missing_bin_value=ens.n_bins - 1 if ens.missing_bin else -1,
         )                                                       # [T, R]
         return ens.aggregate_leaves(leaf)

@@ -59,6 +59,19 @@ HAXIS = "hosts"  # cross-slice DCN axis (SURVEY.md §5 "Distributed comm
 #   followed by a DCN allreduce. Must match parallel.mesh.HOSTS_AXIS.
 
 
+def _pack_tree(tree) -> "jax.Array":
+    """Stack a grown tree's node arrays into one [6, N] f32 array (single
+    device→host fetch; int32/bool values are exact in f32)."""
+    return jnp.stack([
+        tree.feature.astype(jnp.float32),
+        tree.threshold_bin.astype(jnp.float32),
+        tree.is_leaf.astype(jnp.float32),
+        tree.leaf_value,
+        tree.split_gain,
+        tree.default_left.astype(jnp.float32),
+    ])
+
+
 class LabelHandle(NamedTuple):
     """Labels + pad-row validity mask, row-sharded — the opaque `y` handle
     the Driver threads through grad_hess/loss_value. Per-dataset state lives
@@ -274,9 +287,11 @@ class TPUDevice(DeviceBackend):
         )
 
     def best_splits(self, hist):
+        # The granular L4 surface keeps the 3-tuple contract (no missing
+        # handling — that lives in the fused grow path with the config flag).
         return split_ops.best_splits(
             jnp.asarray(hist), self.cfg.reg_lambda, self.cfg.min_child_weight
-        )
+        )[:3]
 
     # ------------------------------------------------------------------ #
     # fused training ops
@@ -340,21 +355,16 @@ class TPUDevice(DeviceBackend):
                 axis_name=axis,
                 feature_axis_name=faxis,
                 feature_mask=fmask,
+                missing_bin=cfg.missing_policy == "learn",
             )
             delta = grow_ops.tree_predict_delta(tree, cfg.learning_rate)
-            # Pack the four tiny node arrays into ONE f32 array so the host
-            # needs a single device→host fetch per tree (four separate
+            # Pack the tiny node arrays into ONE f32 array so the host
+            # needs a single device→host fetch per tree (separate
             # np.asarray calls each pay the full transfer round-trip —
             # measured ~90 ms apiece through a remote-attached chip, 4x the
             # tree's compute). int32 features/bins and booleans are exact
             # in f32 (values << 2^24).
-            packed = jnp.stack([
-                tree.feature.astype(jnp.float32),
-                tree.threshold_bin.astype(jnp.float32),
-                tree.is_leaf.astype(jnp.float32),
-                tree.leaf_value,
-                tree.split_gain,
-            ])
+            packed = _pack_tree(tree)
             return packed, delta
 
         if not with_mask:
@@ -467,18 +477,13 @@ class TPUDevice(DeviceBackend):
                         input_dtype=input_dtype,
                         axis_name=axis,
                         feature_axis_name=faxis,
+                        missing_bin=cfg.missing_policy == "learn",
                     )
                     delta = grow_ops.tree_predict_delta(
                         tree, cfg.learning_rate)
                     pred = (pred.at[:, c].add(delta) if C > 1
                             else pred + delta)
-                    packs.append(jnp.stack([
-                        tree.feature.astype(jnp.float32),
-                        tree.threshold_bin.astype(jnp.float32),
-                        tree.is_leaf.astype(jnp.float32),
-                        tree.leaf_value,
-                        tree.split_gain,
-                    ]))
+                    packs.append(_pack_tree(tree))
                 return pred, (jnp.stack(packs), loss_of(pred, ya, valid))
 
             predf, (trees, losses) = jax.lax.scan(body, pred0, None,
@@ -525,6 +530,7 @@ class TPUDevice(DeviceBackend):
             is_leaf=packed[2].astype(bool),
             leaf_value=packed[3].astype(np.float32),
             split_gain=packed[4].astype(np.float32),
+            default_left=packed[5].astype(bool),
         )
 
     @functools.cached_property
@@ -599,13 +605,34 @@ class TPUDevice(DeviceBackend):
         thr = jax.device_put(ens.threshold_bin.astype(np.int32), self._sharding())
         leaf = jax.device_put(ens.is_leaf, self._sharding())
         val = jax.device_put(ens.leaf_value, self._sharding())
-        fn = functools.partial(
-            predict_ops.predict_raw,
-            max_depth=ens.max_depth,
-            learning_rate=ens.learning_rate,
-            base=ens.base_score,
-            n_classes=C,
-        )
+        use_missing = ens.missing_bin and ens.default_left is not None
+        if use_missing:
+            dl = jax.device_put(ens.default_left, self._sharding())
+
+            def fn0(feat, thr, leaf, val, dl, Xc):
+                return predict_ops.predict_raw(
+                    feat, thr, leaf, val, Xc,
+                    max_depth=ens.max_depth,
+                    learning_rate=ens.learning_rate,
+                    base=ens.base_score,
+                    n_classes=C,
+                    default_left=dl,
+                    missing_bin_value=ens.n_bins - 1,
+                )
+
+            ens_dev: tuple = (feat, thr, leaf, val, dl)
+            fn = fn0
+            n_rep = 5
+        else:
+            fn = functools.partial(
+                predict_ops.predict_raw,
+                max_depth=ens.max_depth,
+                learning_rate=ens.learning_rate,
+                base=ens.base_score,
+                n_classes=C,
+            )
+            ens_dev = (feat, thr, leaf, val)
+            n_rep = 4
         if self.distributed:
             # Row-sharded scoring is embarrassingly parallel: trees are
             # replicated, each shard traverses its own rows, no collectives
@@ -617,7 +644,7 @@ class TPUDevice(DeviceBackend):
             fn = jax.shard_map(
                 fn,
                 mesh=self.mesh,
-                in_specs=(P(), P(), P(), P(), P(rax, None)),
+                in_specs=(P(),) * n_rep + (P(rax, None),),
                 out_specs=out_spec,
                 # predict_raw's scan carry starts replicated (zeros) and
                 # becomes row-varying after the first accumulation; the
@@ -625,4 +652,4 @@ class TPUDevice(DeviceBackend):
                 # here (no collectives anywhere in the traversal).
                 check_vma=False,
             )
-        return fn, (feat, thr, leaf, val)
+        return fn, ens_dev

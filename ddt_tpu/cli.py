@@ -123,6 +123,9 @@ def main(argv: list[str] | None = None) -> int:
     tp.add_argument("--host-partitions", type=int, default=1,
                     help="cross-slice DCN mesh axis for multi-host pods; "
                          "row shards span host-partitions x partitions")
+    tp.add_argument("--missing", choices=["zero", "learn"], default="zero",
+                    help="NaN policy: zero = bin 0; learn = reserved NaN "
+                         "bin + learned per-split default direction")
     tp.add_argument("--profile", action="store_true",
                     help="log a per-phase wallclock breakdown (adds device "
                          "barriers; rounds run slower than unprofiled)")
@@ -186,6 +189,7 @@ def main(argv: list[str] | None = None) -> int:
             subsample=args.subsample,
             colsample_bytree=args.colsample_bytree,
             hist_impl=args.hist_impl, seed=args.seed,
+            missing_policy=args.missing,
         )
         eval_set = None
         if args.valid_frac > 0:

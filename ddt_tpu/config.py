@@ -45,6 +45,13 @@ class TrainConfig:
     subsample: float = 1.0           # row fraction per boosting round
     colsample_bytree: float = 1.0    # feature fraction per tree
 
+    # --- missing values ---
+    # "zero": NaN maps to bin 0 (v1 policy, no model change).
+    # "learn": the TOP bin (n_bins-1) is reserved for NaN and every split
+    #   learns a default direction for missing rows (left/right by gain),
+    #   the standard histogram-GBDT treatment (LightGBM/XGBoost).
+    missing_policy: str = "zero"
+
     # --- system ---
     backend: str = "tpu"        # cpu | tpu | fpga(stub)
     n_partitions: int = 1       # row partitions (data parallel over mesh axis)
@@ -82,6 +89,15 @@ class TrainConfig:
             raise ValueError("subsample must be in (0, 1]")
         if not (0.0 < self.colsample_bytree <= 1.0):
             raise ValueError("colsample_bytree must be in (0, 1]")
+        if self.missing_policy not in ("zero", "learn"):
+            raise ValueError(
+                f"missing_policy must be zero|learn, got "
+                f"{self.missing_policy!r}"
+            )
+        if self.missing_policy == "learn" and self.n_bins < 3:
+            raise ValueError(
+                "missing_policy='learn' reserves the top bin; n_bins >= 3"
+            )
 
     @property
     def n_nodes_total(self) -> int:

@@ -11,8 +11,11 @@ Bin semantics (shared by every kernel in this repo — oracle, XLA, Pallas, C++)
   i.e. bin = searchsorted(edges, v, side='left') clipped to [0, n_bins-1].
 A split "(feature f, threshold bin t)" routes rows with bin <= t LEFT.
 The raw-value threshold equivalent is edges[t] (go left iff v <= edges[t]).
-NaNs are mapped to bin 0 (documented v1 policy; dedicated missing-bin is a
-later extension).
+
+NaN policy (cfg.missing_policy): "zero" maps NaN to bin 0 (the v1 policy);
+"learn" reserves the TOP bin (n_bins-1) for NaN and every split learns a
+default direction for it (ops/split.py, reference/numpy_trainer.py) — the
+standard histogram-GBDT missing-value treatment.
 """
 
 from __future__ import annotations
@@ -24,14 +27,24 @@ import numpy as np
 
 @dataclasses.dataclass
 class BinMapper:
-    """Per-feature bin edges + the binned-matrix transform."""
+    """Per-feature bin edges + the binned-matrix transform.
+
+    With `missing_bin=True` (cfg.missing_policy="learn") the TOP bin
+    (n_bins-1) is reserved for NaN: real values occupy bins 0..n_bins-2 and
+    every split learns a default direction for bin n_bins-1 downstream."""
 
     edges: np.ndarray       # [n_features, n_bins-1] float32, ascending per row
     n_bins: int
+    missing_bin: bool = False
 
     @property
     def n_features(self) -> int:
         return self.edges.shape[0]
+
+    @property
+    def n_value_bins(self) -> int:
+        """Bins available to real values (excludes the reserved NaN bin)."""
+        return self.n_bins - 1 if self.missing_bin else self.n_bins
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         """Bin a float matrix [rows, n_features] -> uint8 [rows, n_features]."""
@@ -41,29 +54,35 @@ class BinMapper:
                 f"X must be [rows, {self.n_features}], got {X.shape}"
             )
         out = np.empty(X.shape, dtype=np.uint8)
+        nv = self.n_value_bins
         for f in range(self.n_features):
             col = X[:, f]
-            binned = np.searchsorted(self.edges[f], col, side="left")
-            np.clip(binned, 0, self.n_bins - 1, out=binned)
-            binned[np.isnan(col)] = 0  # v1 NaN policy (see module doc);
-            # +/-inf fall naturally into the top/bottom bin via searchsorted.
+            binned = np.searchsorted(self.edges[f, : nv - 1], col,
+                                     side="left")
+            np.clip(binned, 0, nv - 1, out=binned)
+            # NaN policy: reserved top bin under missing_bin, else bin 0
+            # (v1 policy, module doc). +/-inf fall naturally into the
+            # top/bottom VALUE bin via searchsorted.
+            binned[np.isnan(col)] = self.n_bins - 1 if self.missing_bin else 0
             out[:, f] = binned.astype(np.uint8)
         return out
 
     def threshold_value(self, feature: int, threshold_bin: int) -> float:
         """Raw-value threshold for a (feature, bin) split: go left iff v <= it."""
         t = int(threshold_bin)
-        if t >= self.edges.shape[1]:
-            return float("inf")  # rightmost bin: everything goes left
+        if t >= self.n_value_bins - 1:
+            return float("inf")  # rightmost value bin: every value goes left
         return float(self.edges[feature, t])
 
     def save(self) -> dict:
-        return {"edges": self.edges, "n_bins": np.int64(self.n_bins)}
+        return {"edges": self.edges, "n_bins": np.int64(self.n_bins),
+                "missing_bin": np.bool_(self.missing_bin)}
 
     @staticmethod
     def load(d: dict) -> "BinMapper":
         return BinMapper(edges=np.asarray(d["edges"], np.float32),
-                         n_bins=int(d["n_bins"]))
+                         n_bins=int(d["n_bins"]),
+                         missing_bin=bool(d.get("missing_bin", False)))
 
 
 def fit_bin_mapper(
@@ -71,6 +90,7 @@ def fit_bin_mapper(
     n_bins: int = 255,
     max_sample: int = 200_000,
     seed: int = 0,
+    missing_policy: str = "zero",
 ) -> BinMapper:
     """Fit per-feature quantile bin edges on (a sample of) X.
 
@@ -90,26 +110,36 @@ def fit_bin_mapper(
     else:
         Xs = X
 
-    qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]  # n_bins-1 interior quantiles
-    edges = np.empty((n_features, n_bins - 1), dtype=np.float32)
+    missing = missing_policy == "learn"
+    if missing and n_bins < 3:
+        raise ValueError("missing_policy='learn' needs n_bins >= 3")
+    # Under the reserved-NaN-bin policy real values get n_bins-1 bins, so
+    # they need n_bins-2 interior edges; the edges array keeps its
+    # [n_features, n_bins-1] width (trailing column unused = +inf) so the
+    # serialized layout is policy-independent.
+    n_val = n_bins - 1 if missing else n_bins
+    qs = np.linspace(0.0, 1.0, n_val + 1)[1:-1]   # n_val-1 interior quantiles
+    edges = np.full((n_features, n_bins - 1), np.float32(np.inf))
     for f in range(n_features):
         col = Xs[:, f]
         col = col[np.isfinite(col)]
         if col.size == 0:
-            edges[f] = np.arange(n_bins - 1, dtype=np.float32)
+            edges[f, : n_val - 1] = np.arange(n_val - 1, dtype=np.float32)
             continue
         e = np.quantile(col, qs).astype(np.float32)
         # Force strict monotonicity: collapse duplicates upward by epsilon-free
         # padding — duplicates become a run that searchsorted('left') resolves
         # to the first edge, so dup bins are simply never assigned.
         e = np.maximum.accumulate(e)
-        edges[f] = e
-    return BinMapper(edges=edges, n_bins=n_bins)
+        edges[f, : n_val - 1] = e
+    return BinMapper(edges=edges, n_bins=n_bins, missing_bin=missing)
 
 
 def quantize(
-    X: np.ndarray, n_bins: int = 255, max_sample: int = 200_000, seed: int = 0
+    X: np.ndarray, n_bins: int = 255, max_sample: int = 200_000,
+    seed: int = 0, missing_policy: str = "zero",
 ) -> tuple[np.ndarray, BinMapper]:
     """fit + transform convenience: returns (binned uint8 matrix, mapper)."""
-    mapper = fit_bin_mapper(X, n_bins=n_bins, max_sample=max_sample, seed=seed)
+    mapper = fit_bin_mapper(X, n_bins=n_bins, max_sample=max_sample,
+                            seed=seed, missing_policy=missing_policy)
     return mapper.transform(X), mapper

@@ -57,6 +57,8 @@ def _traverse_one(
     is_leaf: np.ndarray,
     Xb: np.ndarray,
     max_depth: int,
+    default_left: np.ndarray | None = None,
+    missing_bin_value: int = -1,
 ) -> np.ndarray:
     """Leaf heap-slot per row for ONE tree (node arrays [n_nodes])."""
     R = Xb.shape[0]
@@ -65,7 +67,11 @@ def _traverse_one(
     for _ in range(max_depth):
         leaf = is_leaf[node]
         fv = Xb[rows, np.maximum(feature[node], 0)]
-        nxt = 2 * node + 1 + (fv > threshold_bin[node])
+        go_right = fv > threshold_bin[node]
+        if missing_bin_value >= 0:
+            go_right = np.where(fv == missing_bin_value,
+                                ~default_left[node], go_right)
+        nxt = 2 * node + 1 + go_right
         node = np.where(leaf, node, nxt)
     return node
 
@@ -126,6 +132,7 @@ class Driver:
         ens = empty_ensemble(
             cfg.n_trees * C, cfg.max_depth, F, cfg.learning_rate, bs,
             cfg.loss, cfg.n_classes,
+            missing_bin=cfg.missing_policy == "learn", n_bins=cfg.n_bins,
         )
 
         start_round = 0
@@ -198,6 +205,7 @@ class Driver:
             ens.is_leaf[slot] = tree["is_leaf"]
             ens.leaf_value[slot] = tree["leaf_value"]
             ens.split_gain[slot] = tree["split_gain"]
+            ens.default_left[slot] = tree["default_left"]
             return tree
 
         # Stochastic training (cfg.subsample / cfg.colsample_bytree): masks
@@ -258,6 +266,10 @@ class Driver:
                     leaf = _traverse_one(
                         tree["feature"], tree["threshold_bin"],
                         tree["is_leaf"], Xb_val, cfg.max_depth,
+                        default_left=tree["default_left"],
+                        missing_bin_value=(
+                            cfg.n_bins - 1
+                            if cfg.missing_policy == "learn" else -1),
                     )
                     dv = cfg.learning_rate * tree["leaf_value"][leaf]
                     if C > 1:
@@ -365,6 +377,7 @@ class Driver:
                     ens.is_leaf[slot] = p[2].astype(bool)
                     ens.leaf_value[slot] = p[3]
                     ens.split_gain[slot] = p[4]
+                    ens.default_left[slot] = p[5].astype(bool)
                 r = rnd + k
                 if (r + 1) % self.log_every == 0 or r == cfg.n_trees - 1:
                     rec = {

@@ -44,6 +44,14 @@ class TreeEnsemble:
     loss: str                  # logloss | mse | softmax
     n_classes: int = 2
     has_raw_thresholds: bool = False  # True once a BinMapper filled threshold_raw
+    # Missing-value support (cfg.missing_policy="learn"): NaN rows occupy
+    # the reserved top bin (n_bins-1) and route by the per-node learned
+    # default direction. default_left is None for models trained without
+    # the policy (and treated as all-False).
+    default_left: np.ndarray | None = None   # bool [T, N]
+    missing_bin: bool = False  # True: bin n_bins-1 is the NaN bin
+    n_bins: int = 0            # binning width the model was trained with
+    #   (0 = unknown/legacy; required when missing_bin is True)
 
     @property
     def n_trees(self) -> int:
@@ -70,6 +78,7 @@ class TreeEnsemble:
         node = np.zeros((T, R), dtype=np.int64)
         thr = self.threshold_bin if binned else self.threshold_raw
         Xc = X.astype(np.int32) if binned else X.astype(np.float32)
+        use_missing = self.missing_bin and self.default_left is not None
         for _ in range(self.max_depth):
             feat = np.take_along_axis(self.feature, node, axis=1)
             t = np.take_along_axis(thr, node, axis=1)
@@ -77,6 +86,13 @@ class TreeEnsemble:
             fv = np.stack([Xc[np.arange(R), np.maximum(feat[k], 0)]
                            for k in range(T)])
             go_right = fv > t
+            if use_missing:
+                # NaN rows: binned = the reserved top bin; raw = NaN itself
+                # (NaN > t is already False, but the learned direction may
+                # be RIGHT). Route by per-node default_left.
+                dl = np.take_along_axis(self.default_left, node, axis=1)
+                miss = (fv == self.n_bins - 1) if binned else np.isnan(fv)
+                go_right = np.where(miss, ~dl, go_right)
             nxt = 2 * node + 1 + go_right
             node = np.where(leaf, node, nxt)
         return node.astype(np.int32)
@@ -215,6 +231,7 @@ class TreeEnsemble:
             "is_leaf": self.is_leaf,
             "leaf_value": self.leaf_value,
             "split_gain": self.split_gain,
+            "default_left": self._dl(),
             "max_depth": np.int64(self.max_depth),
             "n_features": np.int64(self.n_features),
             "learning_rate": np.float64(self.learning_rate),
@@ -222,6 +239,8 @@ class TreeEnsemble:
             "loss": np.bytes_(self.loss.encode()),
             "n_classes": np.int64(self.n_classes),
             "has_raw_thresholds": np.bool_(self.has_raw_thresholds),
+            "missing_bin": np.bool_(self.missing_bin),
+            "n_bins": np.int64(self.n_bins),
         }
 
     @staticmethod
@@ -236,6 +255,11 @@ class TreeEnsemble:
                 d["split_gain"] if "split_gain" in d
                 else np.zeros_like(d["leaf_value"]),
                 np.float32),    # absent in pre-gain saves: zeros
+            default_left=(
+                np.asarray(d["default_left"], bool)
+                if "default_left" in d
+                else np.zeros(np.asarray(d["is_leaf"]).shape, bool)
+            ),
             max_depth=int(d["max_depth"]),
             n_features=int(d["n_features"]),
             learning_rate=float(d["learning_rate"]),
@@ -243,6 +267,8 @@ class TreeEnsemble:
             loss=bytes(d["loss"]).decode(),
             n_classes=int(d["n_classes"]),
             has_raw_thresholds=bool(d.get("has_raw_thresholds", False)),
+            missing_bin=bool(d.get("missing_bin", False)),
+            n_bins=int(d.get("n_bins", 0)),
         )
 
     def save(self, path: str) -> None:
@@ -252,6 +278,10 @@ class TreeEnsemble:
     def load(path: str) -> "TreeEnsemble":
         with np.load(path) as d:
             return TreeEnsemble.from_dict(dict(d))
+
+    def _dl(self) -> np.ndarray:
+        return (self.default_left if self.default_left is not None
+                else np.zeros_like(self.is_leaf))
 
     def truncate(self, n_trees: int) -> "TreeEnsemble":
         """First `n_trees` trees (early stopping keeps the best round)."""
@@ -263,6 +293,7 @@ class TreeEnsemble:
             is_leaf=self.is_leaf[:n_trees],
             leaf_value=self.leaf_value[:n_trees],
             split_gain=self.split_gain[:n_trees],
+            default_left=self._dl()[:n_trees],
         )
 
     @staticmethod
@@ -277,6 +308,7 @@ class TreeEnsemble:
             is_leaf=np.concatenate([e.is_leaf for e in ensembles]),
             leaf_value=np.concatenate([e.leaf_value for e in ensembles]),
             split_gain=np.concatenate([e.split_gain for e in ensembles]),
+            default_left=np.concatenate([e._dl() for e in ensembles]),
         )
 
 
@@ -288,6 +320,8 @@ def empty_ensemble(
     base_score: float,
     loss: str,
     n_classes: int = 2,
+    missing_bin: bool = False,
+    n_bins: int = 0,
 ) -> TreeEnsemble:
     n_nodes = 2 ** (max_depth + 1) - 1
     return TreeEnsemble(
@@ -297,10 +331,13 @@ def empty_ensemble(
         is_leaf=np.zeros((n_trees, n_nodes), bool),
         leaf_value=np.zeros((n_trees, n_nodes), np.float32),
         split_gain=np.zeros((n_trees, n_nodes), np.float32),
+        default_left=np.zeros((n_trees, n_nodes), bool),
         max_depth=max_depth,
         n_features=n_features,
         learning_rate=learning_rate,
         base_score=base_score,
         loss=loss,
         n_classes=n_classes,
+        missing_bin=missing_bin,
+        n_bins=n_bins,
     )

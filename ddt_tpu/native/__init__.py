@@ -29,7 +29,11 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, os.environ.get("DDT_NATIVE_LIB", "libddthist.so"))
 
 
-_SYMBOLS = ("ddt_build_histograms", "ddt_traverse", "ddt_split_gain")
+# ddt_traverse_v2: the traversal ABI gained default_left/missing_bin
+# params; the version suffix makes a stale pre-change .so fail the
+# symbol check below instead of being called with a mismatched ABI
+# (which would reinterpret a pointer as the row count).
+_SYMBOLS = ("ddt_build_histograms", "ddt_traverse_v2", "ddt_split_gain")
 
 
 def _stale() -> bool:
@@ -101,16 +105,18 @@ _lib.ddt_build_histograms.argtypes = [
 ]
 _lib.ddt_build_histograms.restype = None
 
-_lib.ddt_traverse.argtypes = [
-    ctypes.POINTER(ctypes.c_uint8),
-    ctypes.POINTER(ctypes.c_int32),
-    ctypes.POINTER(ctypes.c_int32),
-    ctypes.POINTER(ctypes.c_uint8),
+_lib.ddt_traverse_v2.argtypes = [
+    ctypes.POINTER(ctypes.c_uint8),   # Xb
+    ctypes.POINTER(ctypes.c_int32),   # feature
+    ctypes.POINTER(ctypes.c_int32),   # thr_bin
+    ctypes.POINTER(ctypes.c_uint8),   # is_leaf
+    ctypes.POINTER(ctypes.c_uint8),   # default_left (nullable)
     ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-    ctypes.c_int32,
+    ctypes.c_int32,                   # max_depth
+    ctypes.c_int32,                   # missing_bin_value (-1 = disabled)
     ctypes.POINTER(ctypes.c_int32),
 ]
-_lib.ddt_traverse.restype = None
+_lib.ddt_traverse_v2.restype = None
 
 _lib.ddt_split_gain.argtypes = [
     ctypes.POINTER(ctypes.c_float),   # hist
@@ -180,8 +186,14 @@ def traverse_native(
     thr_bin: np.ndarray,
     is_leaf: np.ndarray,
     max_depth: int,
+    default_left: np.ndarray | None = None,
+    missing_bin_value: int = -1,
 ) -> np.ndarray:
     """C++ batch tree traversal: leaf heap-slot per (tree, row), int32 [T, R].
+
+    `missing_bin_value` >= 0 enables missing-value routing: rows at that bin
+    follow default_left[t, n] instead of the threshold compare (twin of
+    models/tree._traverse_np's binned missing path).
     """
     R, F = Xb.shape
     T, N = feature.shape
@@ -189,10 +201,18 @@ def traverse_native(
     feature = np.ascontiguousarray(feature, np.int32)
     thr_bin = np.ascontiguousarray(thr_bin, np.int32)
     leaf8 = np.ascontiguousarray(is_leaf, np.uint8)
+    if missing_bin_value >= 0 and default_left is None:
+        raise ValueError("missing_bin_value needs default_left")
+    dl_ptr = ctypes.POINTER(ctypes.c_uint8)()   # NULL
+    if default_left is not None:
+        dl8 = np.ascontiguousarray(default_left, np.uint8)
+        dl_ptr = _ptr(dl8, ctypes.c_uint8)
     out = np.empty((T, R), np.int32)
-    _lib.ddt_traverse(
+    _lib.ddt_traverse_v2(
         _ptr(Xb, ctypes.c_uint8), _ptr(feature, ctypes.c_int32),
         _ptr(thr_bin, ctypes.c_int32), _ptr(leaf8, ctypes.c_uint8),
-        R, F, T, N, max_depth, _ptr(out, ctypes.c_int32),
+        dl_ptr,
+        R, F, T, N, max_depth, missing_bin_value,
+        _ptr(out, ctypes.c_int32),
     )
     return out

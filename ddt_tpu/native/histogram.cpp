@@ -102,16 +102,23 @@ void ddt_build_histograms(
 // Batch ensemble traversal (CPU reference of the gather+compare predict
 // path): complete-heap trees, node <- 2*node+1+(x > thr) unless leaf.
 // leaf_out is int32 [T, R] heap slots.
-void ddt_traverse(
+//
+// Missing-value support (twin of models/tree._traverse_np): when
+// missing_bin_value >= 0, rows whose bin equals it are NaN rows and route
+// by default_left[t, n] (1 = left) instead of the threshold compare.
+// default_left may be NULL only when missing_bin_value < 0.
+void ddt_traverse_v2(
     const uint8_t* Xb,          // [R, F] binned rows
     const int32_t* feature,     // [T, N] split feature (-1 on leaves)
     const int32_t* thr_bin,     // [T, N]
     const uint8_t* is_leaf,     // [T, N]
+    const uint8_t* default_left, // [T, N] or NULL (no missing handling)
     int64_t R,
     int64_t F,
     int64_t T,
     int64_t N,
     int32_t max_depth,
+    int32_t missing_bin_value,  // reserved NaN bin id; -1 = disabled
     int32_t* leaf_out           // [T, R]
 ) {
 #ifdef _OPENMP
@@ -121,6 +128,8 @@ void ddt_traverse(
         const int32_t* feat_t = feature + t * N;
         const int32_t* thr_t = thr_bin + t * N;
         const uint8_t* leaf_t = is_leaf + t * N;
+        const uint8_t* dl_t =
+            default_left ? default_left + t * N : nullptr;
         int32_t* out_t = leaf_out + t * R;
         for (int64_t r = 0; r < R; ++r) {
             const uint8_t* row = Xb + r * F;
@@ -128,7 +137,15 @@ void ddt_traverse(
             for (int32_t d = 0; d < max_depth; ++d) {
                 if (leaf_t[node]) break;
                 const int32_t f = feat_t[node];
-                node = 2 * node + 1 + (row[f] > thr_t[node] ? 1 : 0);
+                const uint8_t v = row[f];
+                int right;
+                if (missing_bin_value >= 0 &&
+                    v == (uint8_t)missing_bin_value) {
+                    right = dl_t && dl_t[node] ? 0 : 1;
+                } else {
+                    right = v > thr_t[node] ? 1 : 0;
+                }
+                node = 2 * node + 1 + right;
             }
             out_t[r] = node;
         }

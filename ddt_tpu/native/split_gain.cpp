@@ -55,19 +55,19 @@ void ddt_split_gain(
 #endif
     for (int32_t n = 0; n < n_nodes; ++n) {
         const float* hn = hist + (int64_t)n * nstride;
-        // Node totals from feature 0 (any feature sums the same rows) in
-        // the same sequential order as np.cumsum's last element.
-        float G = 0.0f, H = 0.0f;
-        for (int32_t b = 0; b < B; ++b) {
-            G += hn[b * 2 + 0];
-            H += hn[b * 2 + 1];
-        }
-        const float parent = (G * G) / (H + reg_lambda);
-
         float bg = NEG_INF;
         int64_t bidx = -1;
         for (int64_t f = 0; f < F; ++f) {
             const float* hf = hn + f * fstride;
+            // PER-FEATURE totals in np.cumsum's sequential order (twin
+            // convention with numpy_trainer/ops-split: feature f's own
+            // total makes degenerate complements exactly zero).
+            float G = 0.0f, H = 0.0f;
+            for (int32_t b = 0; b < B; ++b) {
+                G += hf[b * 2 + 0];
+                H += hf[b * 2 + 1];
+            }
+            const float parent = (G * G) / (H + reg_lambda);
             float GL = 0.0f, HL = 0.0f;
             for (int32_t b = 0; b < B - 1; ++b) {  // last bin never valid
                 GL += hf[b * 2 + 0];

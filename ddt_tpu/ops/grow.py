@@ -51,6 +51,7 @@ class TreeArrays(NamedTuple):
     is_leaf: jax.Array        # bool  [n_nodes_total]
     leaf_value: jax.Array     # float32 [n_nodes_total]
     split_gain: jax.Array     # float32 [n_nodes_total], 0 on leaves
+    default_left: jax.Array   # bool  [n_nodes_total] NaN-row direction
     leaf_of_row: jax.Array    # int32 [R] heap slot where each row landed
 
 
@@ -72,6 +73,8 @@ def grow_tree(
     #   them (XLA phases ICI before DCN for a (hosts, rows, ...) mesh).
     feature_axis_name: str | None = None,
     feature_mask: jax.Array | None = None,   # bool [F global]; colsample
+    missing_bin: bool = False,   # cfg.missing_policy="learn": bin n_bins-1
+    #   holds NaN rows; splits learn a default direction for them.
 ) -> TreeArrays:
     """Grow one complete-heap tree. Trace under jit (and shard_map if
     axis_name is set). Matches reference/numpy_trainer.grow_tree decisions.
@@ -80,9 +83,10 @@ def grow_tree(
     returned tree's feature indices are GLOBAL (shard offset applied);
     feature_mask is indexed globally and sliced to the local columns."""
     R, F = Xb.shape
-    # Routing packs (feature << 10 | bin << 1 | split) into int32 — enforce
-    # the field bounds at trace time so a future wider-bin or huge-F config
-    # fails loudly instead of silently corrupting row routing.
+    # Routing packs (feature << 11 | bin << 2 | default_left << 1 | split)
+    # into int32 — enforce the field bounds at trace time so a future
+    # wider-bin or huge-F config fails loudly instead of silently
+    # corrupting row routing.
     assert n_bins <= 512, f"routing pack needs n_bins <= 512, got {n_bins}"
     # The packed feats are GLOBAL indices under feature sharding (shard
     # offset applied below), so the bound must cover shards x local width,
@@ -98,6 +102,7 @@ def grow_tree(
     is_leaf = jnp.zeros((N,), bool)
     leaf_value = jnp.zeros((N,), jnp.float32)
     split_gain = jnp.zeros((N,), jnp.float32)
+    default_left = jnp.zeros((N,), bool)
 
     node_id = jnp.zeros((R,), jnp.int32)   # heap slot per row
     frozen = jnp.zeros((R,), bool)
@@ -134,20 +139,24 @@ def grow_tree(
                 jnp.where(act, g, 0.0), seg, num_segments=n_level))
             Hh = allreduce(jax.ops.segment_sum(
                 jnp.where(act, h, 0.0), seg, num_segments=n_level))
-        gains, feats, bins = S.best_splits(
-            hist, reg_lambda, min_child_weight, feature_mask)
+        gains, feats, bins, dls = S.best_splits(
+            hist, reg_lambda, min_child_weight, feature_mask,
+            missing_bin=missing_bin)
         if feature_axis_name is not None:
-            # Combine per-shard winners: all_gather the (gain, feat, bin)
-            # triples (tiny), argmax over shards — first shard wins ties,
-            # preserving the global first-(feature,bin) tie-break rule.
+            # Combine per-shard winners: all_gather the (gain, feat, bin,
+            # direction) tuples (tiny), argmax over shards — first shard
+            # wins ties, preserving the global first-(feature,bin)
+            # tie-break rule.
             feats = feats + f_lo
             ga = jax.lax.all_gather(gains, feature_axis_name)  # [S, n_level]
             fa = jax.lax.all_gather(feats, feature_axis_name)
             ba = jax.lax.all_gather(bins, feature_axis_name)
+            da = jax.lax.all_gather(dls, feature_axis_name)
             w = jnp.argmax(ga, axis=0)                         # [n_level]
             gains = jnp.take_along_axis(ga, w[None], axis=0)[0]
             feats = jnp.take_along_axis(fa, w[None], axis=0)[0]
             bins = jnp.take_along_axis(ba, w[None], axis=0)[0]
+            dls = jnp.take_along_axis(da, w[None], axis=0)[0]
         value = -G / (Hh + reg_lambda)
 
         do_split = (
@@ -160,22 +169,27 @@ def grow_tree(
         leaf_value = leaf_value.at[sl].set(jnp.where(do_split, 0.0, value))
         split_gain = split_gain.at[sl].set(
             jnp.where(do_split, gains.astype(jnp.float32), 0.0))
+        default_left = default_left.at[sl].set(do_split & dls)
 
         # Route rows through the new splits (dense node-id update). All
         # per-row lookups are one-hot compare+reduce instead of gathers:
         # TPU gathers (even from a 32-entry table) each cost ~10-20 ms at
         # 1M rows, while the [R, n_level] masked reductions are a few ms
         # total — and integer one-hot sums are EXACT, so routing is
-        # bit-identical to the gather formulation. The three per-node
-        # tables (feature, bin, do_split) are packed into ONE int32 so a
-        # single masked reduction covers them: feat<<10 | bin<<1 | split.
+        # bit-identical to the gather formulation. The four per-node
+        # tables (feature, bin, direction, do_split) are packed into ONE
+        # int32 so a single masked reduction covers them:
+        # feat<<11 | bin<<2 | default_left<<1 | split.
         idx_c = jnp.clip(node_id - offset, 0, n_level - 1)
         noh = idx_c[:, None] == jnp.arange(n_level, dtype=jnp.int32)[None, :]
-        table = (feats << 10) | (bins << 1) | do_split.astype(jnp.int32)
+        table = ((feats << 11) | (bins << 2)
+                 | (dls.astype(jnp.int32) << 1)
+                 | do_split.astype(jnp.int32))
         packed_r = jnp.sum(jnp.where(noh, table[None, :], 0), axis=1)
         split_here = (packed_r & 1).astype(bool) & ~frozen
-        feat_r = packed_r >> 10
-        bin_r = (packed_r >> 1) & 0x1FF
+        dl_r = ((packed_r >> 1) & 1).astype(bool)
+        feat_r = packed_r >> 11
+        bin_r = (packed_r >> 2) & 0x1FF
         if feature_axis_name is None:
             foh = (
                 jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
@@ -195,7 +209,12 @@ def grow_tree(
                 jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0), axis=1),
                 feature_axis_name,
             )
-        go_right = (fv > bin_r).astype(jnp.int32)
+        go_right = fv > bin_r
+        if missing_bin:
+            # NaN rows occupy the reserved top bin and follow the node's
+            # learned default direction.
+            go_right = jnp.where(fv == n_bins - 1, ~dl_r, go_right)
+        go_right = go_right.astype(jnp.int32)
         node_id = jnp.where(split_here, 2 * node_id + 1 + go_right, node_id)
         frozen = frozen | ~split_here
 
@@ -228,7 +247,7 @@ def grow_tree(
     leaf_value = leaf_value.at[sl].set(vals.astype(jnp.float32))
 
     return TreeArrays(feature, threshold_bin, is_leaf, leaf_value,
-                      split_gain, node_id)
+                      split_gain, default_left, node_id)
 
 
 def tree_predict_delta(tree: TreeArrays, learning_rate: float) -> jax.Array:

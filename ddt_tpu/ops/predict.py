@@ -80,15 +80,23 @@ def _select_level(k, table):
     return jnp.sum(jnp.where(noh, table[:, None, :], zero), axis=-1)
 
 
-def _descend(eff_feat, eff_thr, Xc, max_depth):
+def _descend(eff_feat, eff_thr, Xc, max_depth, dl=None,
+             missing_bin_value=-1):
     """Relative node index at the bottom level: int32 [T, R].
 
     Per-level formulation: one-hot select of the row's (feature, thr) from
     the level slice, then a feature one-hot select of the bin value. Used
     for float (raw-threshold) data; the binned fast path is _descend_comp.
+
+    `dl` ([T, N] bool) enables missing-value routing: rows whose selected
+    value is missing — bin == missing_bin_value for integer data, NaN for
+    float data — follow the node's learned default direction. Pushed-down
+    leaf nodes select fv = 0 (feature=-1 matches no lane), which is neither
+    the reserved bin nor NaN, so they stay on the always-left path.
     """
     Tc = eff_feat.shape[0]
     R, F = Xc.shape
+    binned = jnp.issubdtype(Xc.dtype, jnp.integer)
     k = jnp.zeros((Tc, R), jnp.int32)
     f_iota = jnp.arange(F, dtype=jnp.int32)[None, None, :]
     for d in range(max_depth):
@@ -99,11 +107,18 @@ def _descend(eff_feat, eff_thr, Xc, max_depth):
         fv = jnp.sum(
             jnp.where(foh, Xc[None, :, :], jnp.zeros((), Xc.dtype)), axis=-1
         )
-        k = 2 * k + (fv > thr_r).astype(jnp.int32)
+        go = fv > thr_r
+        if dl is not None:
+            miss = (fv == missing_bin_value) if binned else jnp.isnan(fv)
+            dl_r = _select_level(
+                k, dl[:, lo:lo + w].astype(jnp.int32)).astype(bool)
+            go = jnp.where(miss, ~dl_r, go)
+        k = 2 * k + go.astype(jnp.int32)
     return k
 
 
-def _descend_comp(eff_feat, eff_thr, Xc, max_depth):
+def _descend_comp(eff_feat, eff_thr, Xc, max_depth, dl=None,
+                  missing_bin_value=-1):
     """Binned fast path: relative node index at the bottom level, [R, T].
 
     Precomputes the comparison bit of EVERY internal node for every row in
@@ -126,6 +141,12 @@ def _descend_comp(eff_feat, eff_thr, Xc, max_depth):
         preferred_element_type=jnp.bfloat16,   # bins <= 255: exact in bf16
     ).reshape(R, Tc, n_int)               # [R, T, Nint] exact bin values
     comp = colval > eff_thr[None, :, :n_int].astype(jnp.bfloat16)
+    if dl is not None:
+        # Missing rows (the reserved bin, exact in bf16) follow the node's
+        # learned direction; pushed-down leaves have colval=0, never the
+        # reserved bin.
+        miss = colval == jnp.bfloat16(missing_bin_value)
+        comp = jnp.where(miss, ~dl[None, :, :n_int], comp)
     k = jnp.zeros((R, Tc), jnp.int32)
     for d in range(max_depth):
         lo, w = (1 << d) - 1, 1 << d
@@ -155,7 +176,8 @@ def traverse(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_depth", "n_classes", "tree_chunk", "row_chunk"),
+    static_argnames=("max_depth", "n_classes", "tree_chunk", "row_chunk",
+                     "missing_bin_value"),
 )
 def predict_raw(
     feature: jax.Array,        # int32 [T, N]
@@ -169,6 +191,10 @@ def predict_raw(
     n_classes: int = 1,        # 1 = scalar output; C = softmax round-major
     tree_chunk: int = 64,
     row_chunk: int | None = None,
+    default_left: jax.Array | None = None,   # bool [T, N]; None = no
+    #   missing-value handling (models trained without the reserved bin)
+    missing_bin_value: int = -1,             # reserved NaN bin id (binned
+    #   data); raw float data detects NaN directly
 ) -> jax.Array:
     """Raw margin scores: [R] (n_classes==1) or [R, C].
 
@@ -205,6 +231,9 @@ def predict_raw(
     )
     featp = ef.reshape(n_tc, tree_chunk, -1)
     thrp = et.reshape(n_tc, tree_chunk, -1)
+    use_missing = default_left is not None
+    if use_missing:
+        dlp = pad_t(default_left).reshape(n_tc, tree_chunk, -1)
     lo = (1 << max_depth) - 1
     valp = ev[:, lo:].reshape(n_tc, tree_chunk, -1)   # bottom level only
     # Class of tree t is t % C (round-major interleave).
@@ -220,10 +249,15 @@ def predict_raw(
 
     def row_body(_, xrc):
         def tree_body(acc, args):
-            f, t, v, coh = args
+            if use_missing:
+                f, t, v, coh, dlc = args
+            else:
+                f, t, v, coh = args
+                dlc = None
             if binned:
-                k = _descend_comp(f, t, xrc, max_depth)      # [Rc, chunk]
-                W = v.shape[1]
+                k = _descend_comp(f, t, xrc, max_depth, dl=dlc,
+                                  missing_bin_value=missing_bin_value)
+                W = v.shape[1]                               # [Rc, chunk]
                 noh = (
                     k[:, :, None]
                     == jnp.arange(W, dtype=jnp.int32)[None, None, :]
@@ -233,7 +267,8 @@ def predict_raw(
                 )                                            # [Rc, chunk]
                 contract = (((1,), (0,)), ((), ()))
             else:
-                k = _descend(f, t, xrc, max_depth)
+                k = _descend(f, t, xrc, max_depth, dl=dlc,
+                             missing_bin_value=missing_bin_value)
                 vals = _select_level(k, v)                   # [chunk, Rc]
                 contract = (((0,), (0,)), ((), ()))
             # Scatter chunk sums into classes: one_hot [chunk, C] matmul.
@@ -247,7 +282,9 @@ def predict_raw(
             return acc, None
 
         acc0 = jnp.zeros((row_chunk, C), jnp.float32)
-        acc, _ = jax.lax.scan(tree_body, acc0, (featp, thrp, valp, cls_oh))
+        xs = ((featp, thrp, valp, cls_oh, dlp) if use_missing
+              else (featp, thrp, valp, cls_oh))
+        acc, _ = jax.lax.scan(tree_body, acc0, xs)
         return None, acc
 
     _, accs = jax.lax.scan(row_body, None, Xp)               # [n_rc, Rc, C]

@@ -26,39 +26,56 @@ def node_totals(hist: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("reg_lambda", "min_child_weight")
+    jax.jit, static_argnames=("reg_lambda", "min_child_weight",
+                              "missing_bin")
 )
 def best_splits(
     hist: jax.Array,            # float32 [n_nodes, F, B, 2]
     reg_lambda: float,
     min_child_weight: float,
     feature_mask: jax.Array | None = None,   # bool [F]; False = excluded
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Per-node best split: (gain [n], feature [n] int32, bin [n] int32).
+    missing_bin: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-node best split: (gain [n], feature [n] i32, bin [n] i32,
+    default_left [n] bool).
 
     gain = 0.5 * (GL^2/(HL+l) + GR^2/(HR+l) - G^2/(H+l)); split at bin b
     sends bins <= b left; last bin invalid (empty right child); children must
     carry >= min_child_weight hessian mass. Invalid positions score -inf.
     feature_mask implements colsample_bytree: masked features never win.
+
+    missing_bin=True (cfg.missing_policy="learn"): bin B-1 holds NaN rows;
+    both default directions are scored per (feature, bin) and the argmax
+    runs over the flattened (direction, feature, bin) axis with the RIGHT
+    block first — zero-missing nodes tie exactly and deterministically pick
+    default_left=False. Semantics identical to the NumPy twin
+    (reference/numpy_trainer.best_splits); keep in sync.
     """
     n_nodes, F, B, _ = hist.shape
     GL = jnp.cumsum(hist[..., 0], axis=2)           # [n, F, B]
     HL = jnp.cumsum(hist[..., 1], axis=2)
-    G = GL[:, 0:1, B - 1:B]                         # [n, 1, 1] totals
-    H = HL[:, 0:1, B - 1:B]
-    GR = G - GL
-    HR = H - HL
-    parent = jnp.square(G) / (H + reg_lambda)
-    gain = 0.5 * (
-        jnp.square(GL) / (HL + reg_lambda)
-        + jnp.square(GR) / (HR + reg_lambda)
-        - parent
-    )
-    valid = (HL >= min_child_weight) & (HR >= min_child_weight)
-    valid = valid & (jnp.arange(B) < B - 1)[None, None, :]
-    valid = valid & ~jnp.isnan(gain)                # 0/0 when reg_lambda == 0
-    if feature_mask is not None:
-        valid = valid & feature_mask[None, :, None]
+    # PER-FEATURE totals: feature f's own cumsum tail, so degenerate
+    # candidates (all mass on one side) get an EXACTLY-zero complement
+    # rather than cross-feature f32 noise near min_child_weight. Keep in
+    # sync with numpy_trainer.best_splits and native/split_gain.cpp.
+    G = GL[:, :, B - 1:B]                           # [n, F, 1]
+    H = HL[:, :, B - 1:B]
+
+    def gain_of(GLd, HLd):
+        GR = G - GLd
+        HR = H - HLd
+        parent = jnp.square(G) / (H + reg_lambda)
+        gain = 0.5 * (
+            jnp.square(GLd) / (HLd + reg_lambda)
+            + jnp.square(GR) / (HR + reg_lambda)
+            - parent
+        )
+        valid = (HLd >= min_child_weight) & (HR >= min_child_weight)
+        valid = valid & ~jnp.isnan(gain)            # 0/0 when reg_lambda == 0
+        if feature_mask is not None:
+            valid = valid & feature_mask[None, :, None]
+        return gain, valid
+
     # Deterministic split selection: round gains to bfloat16 before argmax.
     # Gains within float noise of each other (different cumsum algorithms,
     # psum accumulation order across partitions, NumPy-vs-XLA rounding)
@@ -66,15 +83,42 @@ def best_splits(
     # — so every backend and every partition count picks identical splits.
     # Selecting among candidates within bf16 resolution (~0.4%) of the max is
     # immaterial to model quality; decision stability across devices is not.
-    gain = jnp.where(valid, gain, -jnp.inf).astype(jnp.bfloat16)
+    if not missing_bin:
+        gain, valid = gain_of(GL, HL)
+        valid = valid & (jnp.arange(B) < B - 1)[None, None, :]
+        gain = jnp.where(valid, gain, -jnp.inf).astype(jnp.bfloat16)
+        flat = gain.reshape(n_nodes, F * B)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(
+            flat, best[:, None], axis=1)[:, 0].astype(jnp.float32)
+        return (
+            best_gain,
+            (best // B).astype(jnp.int32),
+            (best % B).astype(jnp.int32),
+            jnp.zeros(n_nodes, bool),
+        )
 
-    flat = gain.reshape(n_nodes, F * B)
+    miss_g = hist[:, :, B - 1:B, 0]                 # [n, F, 1]
+    miss_h = hist[:, :, B - 1:B, 1]
+    gain_r, valid_r = gain_of(GL, HL)               # missing stays RIGHT
+    gain_l, valid_l = gain_of(GL + miss_g, HL + miss_h)   # missing LEFT
+    not_nan_bin = (jnp.arange(B) < B - 1)[None, None, :]
+    valid_r = valid_r & not_nan_bin
+    # t = B-2 under LEFT puts every row left (empty right child): invalid
+    # regardless of the min_child_weight knob.
+    valid_l = valid_l & (jnp.arange(B) < B - 2)[None, None, :]
+    g16 = jnp.concatenate(
+        [jnp.where(valid_r, gain_r, -jnp.inf),
+         jnp.where(valid_l, gain_l, -jnp.inf)], axis=1,
+    ).astype(jnp.bfloat16)                          # [n, 2F, B]: RIGHT first
+    flat = g16.reshape(n_nodes, 2 * F * B)
     best = jnp.argmax(flat, axis=1)
-    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0].astype(
-        jnp.float32
-    )
+    best_gain = jnp.take_along_axis(
+        flat, best[:, None], axis=1)[:, 0].astype(jnp.float32)
+    fb = best % (F * B)
     return (
         best_gain,
-        (best // B).astype(jnp.int32),
-        (best % B).astype(jnp.int32),
+        (fb // B).astype(jnp.int32),
+        (fb % B).astype(jnp.int32),
+        best >= F * B,
     )

@@ -101,42 +101,90 @@ def best_splits(
     reg_lambda: float,
     min_child_weight: float,
     feature_mask: np.ndarray | None = None,   # bool [F]; False = excluded
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Reference SplitGain: per-node best (gain, feature, threshold_bin).
+    missing_bin: bool = False,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Reference SplitGain: per-node best
+    (gain, feature, threshold_bin, default_left).
 
     gain = 0.5*(GL^2/(HL+l) + GR^2/(HR+l) - G^2/(H+l)), maximised over the
-    flattened (feature, bin) axis; first-occurrence argmax (matches jnp.argmax)
-    so all backends agree on tie-breaks. Splitting at bin b sends bins <= b
-    left; the last bin is excluded (empty right child).
+    flattened (direction, feature, bin) axis; first-occurrence argmax
+    (matches jnp.argmax) so all backends agree on tie-breaks. Splitting at
+    bin b sends bins <= b left; the last bin is excluded (empty right
+    child).
+
+    With missing_bin=True the top bin B-1 holds NaN rows and both default
+    directions are scored per (feature, bin): RIGHT keeps the missing mass
+    with the right child (the plain cumsum), LEFT moves it left. Candidate
+    bins are the VALUE bins 0..B-2 (t = B-2 under direction RIGHT is the
+    "missing vs everything" split). Direction RIGHT occupies the first
+    flattened block, so nodes with zero missing mass — where both
+    directions tie exactly — deterministically report default_left=False,
+    matching the missing_bin=False semantics.
     """
     n_nodes, F, B, _ = hist.shape
     GL = np.cumsum(hist[..., 0], axis=2)       # [n, F, B]
     HL = np.cumsum(hist[..., 1], axis=2)
-    G = GL[:, 0, -1][:, None, None]            # totals (feature 0 = any)
-    H = HL[:, 0, -1][:, None, None]
-    GR = G - GL
-    HR = H - HL
-    with np.errstate(divide="ignore", invalid="ignore"):
-        parent = np.square(G) / (H + reg_lambda)
-        gain = 0.5 * (
-            np.square(GL) / (HL + reg_lambda)
-            + np.square(GR) / (HR + reg_lambda)
-            - parent
-        )
-    valid = (HL >= min_child_weight) & (HR >= min_child_weight)
-    valid[:, :, B - 1] = False                 # cannot split on last bin
-    # 0/0 with reg_lambda=0 yields NaN; NaN would win np.argmax — mask it.
-    valid &= ~np.isnan(gain)
-    if feature_mask is not None:
-        valid &= feature_mask[None, :, None]
-    # Deterministic selection (see ops/split.py): bf16-rounded gains turn
-    # float-noise near-ties into exact ties with a shared first-index
-    # tie-break, so CPU/TPU/any-partition-count all pick identical splits.
-    gain = np.where(valid, gain, -np.inf).astype(ml_dtypes.bfloat16)
-    flat = gain.reshape(n_nodes, F * B)
+    # PER-FEATURE totals (every feature sums the same rows, so these agree
+    # up to f32 add order). Using feature f's own total makes the
+    # complement side EXACTLY zero for degenerate candidates (e.g. all of a
+    # node's rows missing on f: the all-left variant gets HR = 0, not
+    # cross-feature float noise that can straddle min_child_weight
+    # differently per partition count). Twins: ops/split.py, C++
+    # split_gain.cpp — keep the same totals convention in all three.
+    G = GL[:, :, -1][:, :, None]               # [n, F, 1]
+    H = HL[:, :, -1][:, :, None]
+
+    def gain_of(GLd, HLd):
+        GR = G - GLd
+        HR = H - HLd
+        with np.errstate(divide="ignore", invalid="ignore"):
+            parent = np.square(G) / (H + reg_lambda)
+            gain = 0.5 * (
+                np.square(GLd) / (HLd + reg_lambda)
+                + np.square(GR) / (HR + reg_lambda)
+                - parent
+            )
+        valid = (HLd >= min_child_weight) & (HR >= min_child_weight)
+        valid &= ~np.isnan(gain)   # 0/0 when reg_lambda == 0
+        if feature_mask is not None:
+            valid = valid & feature_mask[None, :, None]
+        return gain, valid
+
+    if not missing_bin:
+        gain, valid = gain_of(GL, HL)
+        valid[:, :, B - 1] = False             # cannot split on last bin
+        # Deterministic selection (see ops/split.py): bf16-rounded gains
+        # turn float-noise near-ties into exact ties with a shared
+        # first-index tie-break, so CPU/TPU/any-partition-count all pick
+        # identical splits.
+        g16 = np.where(valid, gain, -np.inf).astype(ml_dtypes.bfloat16)
+        flat = g16.reshape(n_nodes, F * B)
+        best = np.argmax(flat, axis=1)
+        best_gain = flat[np.arange(n_nodes), best].astype(np.float32)
+        return (best_gain, (best // B).astype(np.int32),
+                (best % B).astype(np.int32), np.zeros(n_nodes, bool))
+
+    miss_g = hist[:, :, B - 1, 0][..., None]   # [n, F, 1]
+    miss_h = hist[:, :, B - 1, 1][..., None]
+    gain_r, valid_r = gain_of(GL, HL)               # missing stays RIGHT
+    gain_l, valid_l = gain_of(GL + miss_g, HL + miss_h)   # missing LEFT
+    valid_r[:, :, B - 1] = False               # the NaN bin itself: no split
+    valid_l[:, :, B - 1] = False
+    # t = B-2 under LEFT puts every row left -> empty right child; the
+    # HR >= min_child_weight guard already rejects it for mcw > 0, but the
+    # rule must not depend on the knob:
+    valid_l[:, :, B - 2] = False
+    g16 = np.concatenate(
+        [np.where(valid_r, gain_r, -np.inf),
+         np.where(valid_l, gain_l, -np.inf)], axis=1,
+    ).astype(ml_dtypes.bfloat16)               # [n, 2F, B]: RIGHT block first
+    flat = g16.reshape(n_nodes, 2 * F * B)
     best = np.argmax(flat, axis=1)
     best_gain = flat[np.arange(n_nodes), best].astype(np.float32)
-    return best_gain, (best // B).astype(np.int32), (best % B).astype(np.int32)
+    default_left = best >= F * B
+    fb = best % (F * B)
+    return (best_gain, (fb // B).astype(np.int32),
+            (fb % B).astype(np.int32), default_left)
 
 
 def node_totals(hist: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -167,11 +215,13 @@ def grow_tree(
     """
     R, F = Xb.shape
     N = cfg.n_nodes_total
+    missing = cfg.missing_policy == "learn"
     feature = np.full(N, -1, np.int32)
     threshold_bin = np.zeros(N, np.int32)
     is_leaf = np.zeros(N, bool)
     leaf_value = np.zeros(N, np.float32)
     split_gain = np.zeros(N, np.float32)
+    default_left = np.zeros(N, bool)
 
     node_id = np.zeros(R, np.int64)    # heap index per row
     frozen = np.zeros(R, bool)         # row reached an early leaf
@@ -185,11 +235,13 @@ def grow_tree(
         else:
             hist = build_histograms(Xb, g, h, node_index, n_level, cfg.n_bins)
         G, H = node_totals(hist)
-        if split_fn is not None and feature_mask is None:
+        if split_fn is not None and feature_mask is None and not missing:
             gains, feats, bins = split_fn(hist)
+            dls = np.zeros(n_level, bool)
         else:
-            gains, feats, bins = best_splits(
-                hist, cfg.reg_lambda, cfg.min_child_weight, feature_mask
+            gains, feats, bins, dls = best_splits(
+                hist, cfg.reg_lambda, cfg.min_child_weight, feature_mask,
+                missing_bin=missing,
             )
         value = -G / (H + cfg.reg_lambda)
 
@@ -200,6 +252,7 @@ def grow_tree(
                 feature[node] = feats[i]
                 threshold_bin[node] = bins[i]
                 split_gain[node] = gains[i]
+                default_left[node] = dls[i]
             else:
                 is_leaf[node] = True
                 leaf_value[node] = value[i]
@@ -210,9 +263,12 @@ def grow_tree(
         split_here = do_split[idx]
         feat_r = feats[idx]
         bin_r = bins[idx]
-        go_right = (
-            Xb[active, feat_r].astype(np.int32) > bin_r
-        )
+        fv = Xb[active, feat_r].astype(np.int32)
+        go_right = fv > bin_r
+        if missing:
+            # NaN rows (top bin) follow the learned default direction.
+            is_miss = fv == cfg.n_bins - 1
+            go_right = np.where(is_miss, ~dls[idx], go_right)
         new_ids = np.where(
             split_here,
             2 * node_id[active] + 1 + go_right,
@@ -247,6 +303,7 @@ def grow_tree(
         "is_leaf": is_leaf,
         "leaf_value": leaf_value,
         "split_gain": split_gain,
+        "default_left": default_left,
         "leaf_of_row": node_id.astype(np.int64),
     }
 
@@ -273,6 +330,7 @@ def fit(
     ens = empty_ensemble(
         n_trees_total, cfg.max_depth, F, cfg.learning_rate, bs,
         cfg.loss, cfg.n_classes,
+        missing_bin=cfg.missing_policy == "learn", n_bins=cfg.n_bins,
     )
 
     if cfg.loss == "softmax":
@@ -292,6 +350,7 @@ def fit(
             ens.is_leaf[t_out] = tree["is_leaf"]
             ens.leaf_value[t_out] = tree["leaf_value"]
             ens.split_gain[t_out] = tree["split_gain"]
+            ens.default_left[t_out] = tree["default_left"]
             delta = cfg.learning_rate * tree["leaf_value"][tree["leaf_of_row"]]
             if C > 1:
                 pred[:, c] += delta

@@ -32,6 +32,7 @@ class _DDTBase:
         backend: str = "tpu",
         n_partitions: int = 1,
         seed: int = 0,
+        missing_policy: str = "zero",
     ):
         self.n_trees = n_trees
         self.max_depth = max_depth
@@ -45,6 +46,7 @@ class _DDTBase:
         self.backend = backend
         self.n_partitions = n_partitions
         self.seed = seed
+        self.missing_policy = missing_policy
 
     @classmethod
     def _param_names(cls) -> tuple:
@@ -82,6 +84,7 @@ class _DDTBase:
             backend=self.backend,
             n_partitions=self.n_partitions,
             seed=self.seed,
+            missing_policy=self.missing_policy,
             **extra,
         )
 

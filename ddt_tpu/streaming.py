@@ -85,6 +85,12 @@ def fit_streaming(
         raise NotImplementedError(
             "streaming softmax: no BASELINE config requires it yet"
         )
+    if cfg.missing_policy != "zero":
+        raise NotImplementedError(
+            "streaming does not implement missing_policy='learn' yet — "
+            "failing loudly beats silently treating the reserved NaN bin "
+            "as the largest value bin"
+        )
     if backend is None:
         from ddt_tpu.backends import get_backend
 
@@ -156,7 +162,7 @@ def fit_streaming(
             )
 
             G, H = node_totals(hist)
-            gains, feats, bins = best_splits(
+            gains, feats, bins, _ = best_splits(
                 hist, cfg.reg_lambda, cfg.min_child_weight
             )
             value = np.where(

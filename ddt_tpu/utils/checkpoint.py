@@ -89,4 +89,5 @@ def try_resume(ckpt_dir: str, ens: TreeEnsemble, cfg: TrainConfig) -> int:
     ens.is_leaf[:k] = saved.is_leaf[:k]
     ens.leaf_value[:k] = saved.leaf_value[:k]
     ens.split_gain[:k] = saved.split_gain[:k]
+    ens.default_left[:k] = saved.default_left[:k]
     return rounds

@@ -92,8 +92,8 @@ def test_histogram_mass_conservation():
 def test_best_splits_matches_oracle(reg_lambda, mcw):
     Xb, g, h, node_index = _rand_case(B=16, n_nodes=4)
     hist = oracle.build_histograms(Xb, g, h, node_index, 4, 16)
-    want_gain, want_f, want_b = oracle.best_splits(hist, reg_lambda, mcw)
-    got_gain, got_f, got_b = jsplit.best_splits(
+    want_gain, want_f, want_b, _ = oracle.best_splits(hist, reg_lambda, mcw)
+    got_gain, got_f, got_b, _ = jsplit.best_splits(
         jnp.asarray(hist), reg_lambda, mcw
     )
     np.testing.assert_allclose(np.asarray(got_gain), want_gain, rtol=1e-5)

@@ -54,7 +54,7 @@ def test_split_gain_hand_computed():
     hist = np.zeros((1, 1, 3, 2), np.float32)
     hist[0, 0, :, 0] = [-4.0, 1.0, 3.0]   # G per bin
     hist[0, 0, :, 1] = [2.0, 1.0, 2.0]    # H per bin
-    gains, feats, bins = ref.best_splits(hist, lam, min_child_weight=0.0)
+    gains, feats, bins, _ = ref.best_splits(hist, lam, min_child_weight=0.0)
     # Candidate splits: after bin0: GL=-4,HL=2 | GR=4,HR=3
     #                   after bin1: GL=-3,HL=3 | GR=3,HR=2
     parent = 0.0  # G=0 => G^2/(H+l) = 0
@@ -71,13 +71,13 @@ def test_split_gain_respects_min_child_weight():
     hist = np.zeros((1, 1, 3, 2), np.float32)
     hist[0, 0, :, 0] = [-4.0, 1.0, 3.0]
     hist[0, 0, :, 1] = [0.5, 1.0, 2.0]
-    gains, _, bins = ref.best_splits(hist, 1.0, min_child_weight=1.0)
+    gains, _, bins, _ = ref.best_splits(hist, 1.0, min_child_weight=1.0)
     assert bins[0] == 1  # split after bin0 invalid (HL=0.5 < 1.0)
 
 
 def test_last_bin_never_chosen():
     hist = np.ones((1, 2, 4, 2), np.float32)
-    _, _, bins = ref.best_splits(hist, 1.0, 0.0)
+    _, _, bins, _ = ref.best_splits(hist, 1.0, 0.0)
     assert bins[0] < 3
 
 

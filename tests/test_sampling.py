@@ -29,13 +29,13 @@ def test_colsample_masks_features_in_split_selection():
     rng = np.random.default_rng(0)
     hist = np.abs(rng.standard_normal((4, 6, 31, 2)).astype(np.float32))
     mask = np.array([True, False, True, False, False, False])
-    _, feats, _ = ref.best_splits(hist, 1.0, 1e-3, feature_mask=mask)
+    _, feats, _, _ = ref.best_splits(hist, 1.0, 1e-3, feature_mask=mask)
     assert set(np.unique(feats)) <= {0, 2}
 
     import jax.numpy as jnp
     from ddt_tpu.ops import split as S
 
-    _, jfeats, _ = S.best_splits(jnp.asarray(hist), 1.0, 1e-3,
+    _, jfeats, _, _ = S.best_splits(jnp.asarray(hist), 1.0, 1e-3,
                                  jnp.asarray(mask))
     np.testing.assert_array_equal(np.asarray(jfeats), feats)
 
