@@ -560,6 +560,97 @@ class TPUDevice(DeviceBackend):
         return float(self._loss_fn(pred, y.y, y.valid))
 
     # ------------------------------------------------------------------ #
+    # streaming (ops/stream.py): per-(chunk, level) work as one dispatch,
+    # partial-tree traversal + grads + histogram on device; the host only
+    # accumulates the small histograms and decides splits. Used by
+    # streaming.fit_streaming when the backend exposes these.
+    # ------------------------------------------------------------------ #
+
+    @functools.cached_property
+    def _stream_cache(self) -> dict:
+        return {}
+
+    def _stream_fn(self, kind: str, depth: int, class_idx: int):
+        key = (kind, depth, class_idx)
+        fn = self._stream_cache.get(key)
+        if fn is not None:
+            return fn
+        from ddt_tpu.ops import stream as stream_ops
+
+        cfg = self.cfg
+        if self.feature_partitions > 1:
+            raise NotImplementedError(
+                "streaming with feature_partitions > 1 is not wired; "
+                "stream rows (the long axis) instead"
+            )
+        axis = self._row_axes if self.distributed else None
+        softmax = cfg.loss == "softmax"
+
+        if kind == "hist":
+            def f(Xb, pred, y, valid, feat, thr, leaf):
+                return stream_ops.stream_level_hist(
+                    Xb, pred, y, valid, feat, thr, leaf,
+                    depth=depth, n_bins=cfg.n_bins, loss=cfg.loss,
+                    class_idx=class_idx, hist_impl=cfg.hist_impl,
+                    input_dtype=self._input_dtype, axis_name=axis,
+                )
+        elif kind == "leaf":
+            def f(Xb, pred, y, valid, feat, thr, leaf):
+                return stream_ops.stream_leaf_gh(
+                    Xb, pred, y, valid, feat, thr, leaf,
+                    max_depth=depth, loss=cfg.loss, class_idx=class_idx,
+                    axis_name=axis,
+                )
+        elif kind == "update":
+            def f(Xb, pred, feat, thr, leaf, val):
+                return stream_ops.stream_update_pred(
+                    Xb, pred, feat, thr, leaf, val,
+                    max_depth=depth, learning_rate=cfg.learning_rate,
+                    class_idx=class_idx,
+                )
+        else:  # pragma: no cover
+            raise ValueError(kind)
+
+        if self.distributed:
+            rax = self._row_axes
+            pred_spec = P(rax, None) if softmax else P(rax)
+            if kind == "update":
+                in_specs = (P(rax, None), pred_spec, P(), P(), P(), P())
+                out_specs = pred_spec
+            else:
+                in_specs = (P(rax, None), pred_spec, P(rax), P(rax),
+                            P(), P(), P())
+                out_specs = P()
+            f = jax.shard_map(f, mesh=self.mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+        fn = jax.jit(f, donate_argnums=(1,) if kind == "update" else ())
+        self._stream_cache[key] = fn
+        return fn
+
+    def stream_level_hist(self, data, pred, y: "LabelHandle", tree,
+                          depth: int, class_idx: int = 0):
+        """Partial histogram [2^depth, F, B, 2] for one uploaded chunk
+        (device handle; includes the cross-shard psum). `tree` is the
+        partial tree's host arrays (feature, threshold_bin, is_leaf)."""
+        feat, thr, leaf = tree
+        return self._stream_fn("hist", depth, class_idx)(
+            data, pred, y.y, y.valid, feat, thr, leaf)
+
+    def stream_leaf_gh(self, data, pred, y: "LabelHandle", tree,
+                       max_depth: int, class_idx: int = 0):
+        """Final-level (G, H) aggregates [2^max_depth, 2] for one chunk."""
+        feat, thr, leaf = tree
+        return self._stream_fn("leaf", max_depth, class_idx)(
+            data, pred, y.y, y.valid, feat, thr, leaf)
+
+    def stream_update_pred(self, data, pred, tree_full, max_depth: int,
+                           class_idx: int = 0):
+        """pred updated by a finished tree (donated; device-resident)."""
+        feat, thr, leaf, val = tree_full
+        return self._stream_fn("update", max_depth, class_idx)(
+            data, pred, feat, thr, leaf, val)
+
+    # ------------------------------------------------------------------ #
     # inference (TreeEnsemble.predict → gather+compare, row-sharded)
     # ------------------------------------------------------------------ #
 

@@ -1,0 +1,200 @@
+"""Device-side streaming kernels: per-chunk level work as ONE dispatch.
+
+The 10B-row stress config (BASELINE.json config 5) streams the row axis:
+per tree level, every chunk contributes a partial histogram. Round-1's
+trainer recomputed node assignment and gradients on HOST per chunk per
+level and uploaded g/h/ni alongside the data — O(levels x rows) host
+compute plus ~9 extra bytes/row of H2D per pass. These kernels move the
+whole per-(chunk, level) step on device:
+
+    upload Xb chunk (uint8, the unavoidable stream) [+ pred/y if not
+    device-resident] -> ONE dispatch: partial-tree traversal (gather-free
+    one-hot routing, same formulation as ops/grow.py) -> grad/hess ->
+    masked histogram [-> psum over row shards] -> small [n, F, B, 2]
+    output fetched by the host accumulator.
+
+Everything here traces under jit and under shard_map (axis_name set): a
+pod streams chunks with each chunk row-sharded over the mesh, the partial
+histogram psum riding ICI/DCN exactly like the in-memory trainer
+(SURVEY.md §5 "Distributed communication backend", §7 M6).
+
+Bit-compatibility: traversal mirrors streaming._traverse_partial (the
+host twin) and the histogram sum enters the same bf16-rounded split
+selection, so streamed training stays bit-identical to in-memory training
+(tests/test_streaming.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ddt_tpu.ops import grad as grad_ops
+from ddt_tpu.ops import histogram as H
+
+
+def partial_node_index(
+    Xb: jax.Array,            # int32/uint8 [R, F] binned rows
+    feature: jax.Array,       # int32 [n_nodes_total] (-1 on leaves)
+    threshold_bin: jax.Array,  # int32 [n_nodes_total]
+    is_leaf: jax.Array,       # bool  [n_nodes_total]
+    depth: int,
+) -> jax.Array:
+    """Level-local node per row at `depth` (-1 = frozen at an earlier
+    leaf). Gather-free: per unrolled level, the row's node's (feature,
+    threshold, is_leaf) are one-hot selected from the level's heap slice
+    (w = 2^d lanes), the winning column's value from the F lanes — exact
+    integer masked reductions, no scalar-loop gathers (ops/grow.py's
+    routing formulation; twin of streaming._traverse_partial)."""
+    R, F = Xb.shape
+    Xi = Xb.astype(jnp.int32)
+    node = jnp.zeros(R, jnp.int32)
+    frozen = jnp.zeros(R, bool)
+    for d in range(depth):
+        offset = (1 << d) - 1
+        w = 1 << d
+        idx = node - offset
+        noh = idx[:, None] == jnp.arange(w, dtype=jnp.int32)[None, :]
+        sl = slice(offset, offset + w)
+        leaf_r = jnp.any(noh & is_leaf[sl][None, :], axis=1)
+        frozen = frozen | leaf_r
+        # Packed (feat << 10 | thr) select: one masked reduction for both
+        # tables (thr < 1024 by the n_bins <= 512 contract).
+        packed = (feature[sl] << 10) | threshold_bin[sl]
+        pr = jnp.sum(jnp.where(noh, packed[None, :], 0), axis=1)
+        feat_r = pr >> 10                       # -1 stays -1 (arith shift)
+        thr_r = pr & 0x3FF
+        foh = jax.lax.broadcasted_iota(
+            jnp.int32, (1, F), 1) == feat_r[:, None]
+        fv = jnp.sum(jnp.where(foh, Xi, 0), axis=1)
+        node = jnp.where(frozen, node, 2 * node + 1 + (fv > thr_r))
+    offset = (1 << depth) - 1
+    return jnp.where(frozen, -1, node - offset).astype(jnp.int32)
+
+
+def chunk_grads(
+    pred: jax.Array,          # f32 [R] or [R, C]
+    y: jax.Array,
+    valid: jax.Array,         # bool [R] (pad rows False)
+    loss: str,
+    class_idx: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """(g, h) for one class column, pad rows zeroed."""
+    g, h = grad_ops.grad_hess(pred, y, loss)
+    if g.ndim == 2:
+        g = g[:, class_idx]
+        h = h[:, class_idx]
+    v = valid.astype(jnp.float32)
+    return g * v, h * v
+
+
+def stream_level_hist(
+    Xb: jax.Array,            # uint8 [R, F] chunk
+    pred: jax.Array,
+    y: jax.Array,
+    valid: jax.Array,
+    feature: jax.Array,
+    threshold_bin: jax.Array,
+    is_leaf: jax.Array,
+    *,
+    depth: int,
+    n_bins: int,
+    loss: str,
+    class_idx: int = 0,
+    hist_impl: str = "auto",
+    input_dtype=jnp.bfloat16,
+    axis_name=None,
+) -> jax.Array:
+    """One chunk's level-`depth` partial histogram [2^depth, F, B, 2]
+    (psum'd over row shards when axis_name is set)."""
+    ni = partial_node_index(Xb, feature, threshold_bin, is_leaf, depth)
+    g, h = chunk_grads(pred, y, valid, loss, class_idx)
+    out = H.build_histograms(
+        Xb, g, h, ni, 1 << depth, n_bins,
+        impl=hist_impl, input_dtype=input_dtype,
+    )
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out
+
+
+def stream_leaf_gh(
+    Xb: jax.Array,
+    pred: jax.Array,
+    y: jax.Array,
+    valid: jax.Array,
+    feature: jax.Array,
+    threshold_bin: jax.Array,
+    is_leaf: jax.Array,
+    *,
+    max_depth: int,
+    loss: str,
+    class_idx: int = 0,
+    axis_name=None,
+) -> jax.Array:
+    """Final-level (G, H) aggregates for one chunk: f32 [2^max_depth, 2]
+    via the one-hot matmul formulation (ops/grow.py's final level)."""
+    ni = partial_node_index(Xb, feature, threshold_bin, is_leaf, max_depth)
+    g, h = chunk_grads(pred, y, valid, loss, class_idx)
+    n_last = 1 << max_depth
+    act = ni >= 0
+    ga = jnp.where(act, g, 0.0)
+    ha = jnp.where(act, h, 0.0)
+    idx = jnp.clip(ni, 0, n_last - 1)
+    leaf_oh = (
+        idx[:, None] == jnp.arange(n_last, dtype=jnp.int32)[None, :]
+    ).astype(jnp.float32)
+    gh = jnp.stack([ga, ha], axis=1)
+    GH = jax.lax.dot_general(
+        leaf_oh, gh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    if axis_name is not None:
+        GH = jax.lax.psum(GH, axis_name)
+    return GH
+
+
+def stream_update_pred(
+    Xb: jax.Array,
+    pred: jax.Array,
+    feature: jax.Array,
+    threshold_bin: jax.Array,
+    is_leaf: jax.Array,
+    leaf_value: jax.Array,
+    *,
+    max_depth: int,
+    learning_rate: float,
+    class_idx: int = 0,
+) -> jax.Array:
+    """pred += lr * leaf_value[leaf slot] for one finished tree (per-chunk
+    boosting-state update, on device; one-hot select over the heap)."""
+    R, F = Xb.shape
+    Xi = Xb.astype(jnp.int32)
+    node = jnp.zeros(R, jnp.int32)
+    frozen = jnp.zeros(R, bool)
+    for d in range(max_depth):
+        offset = (1 << d) - 1
+        w = 1 << d
+        idx = node - offset
+        noh = idx[:, None] == jnp.arange(w, dtype=jnp.int32)[None, :]
+        sl = slice(offset, offset + w)
+        # STICKY frozen flag (as in partial_node_index): once a row stops
+        # at an early leaf its node index lags the level being matched, so
+        # noh is all-False from then on and a non-sticky "live" test would
+        # wrongly resume descending through a garbage 0/0 split.
+        frozen = frozen | jnp.any(noh & is_leaf[sl][None, :], axis=1)
+        packed = (feature[sl] << 10) | threshold_bin[sl]
+        pr = jnp.sum(jnp.where(noh, packed[None, :], 0), axis=1)
+        feat_r = pr >> 10
+        thr_r = pr & 0x3FF
+        foh = jax.lax.broadcasted_iota(
+            jnp.int32, (1, F), 1) == feat_r[:, None]
+        fv = jnp.sum(jnp.where(foh, Xi, 0), axis=1)
+        node = jnp.where(frozen, node, 2 * node + 1 + (fv > thr_r))
+    N = leaf_value.shape[0]
+    voh = node[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :]
+    dv = jnp.sum(jnp.where(voh, leaf_value[None, :], 0.0), axis=1)
+    if pred.ndim == 2:
+        return pred.at[:, class_idx].add(learning_rate * dv)
+    return pred + learning_rate * dv

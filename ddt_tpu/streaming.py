@@ -67,6 +67,57 @@ def _traverse_partial(
     return out
 
 
+def _apply_level_splits(
+    hist: np.ndarray,
+    cfg: TrainConfig,
+    depth: int,
+    feature: np.ndarray,
+    threshold_bin: np.ndarray,
+    is_leaf: np.ndarray,
+    leaf_value: np.ndarray,
+    split_gain: np.ndarray,
+) -> None:
+    """Level-`depth` split decisions from the accumulated histogram,
+    written into the node arrays in place. The SINGLE home of the
+    streamed split rule — both the host and device loops call this, so
+    host/device bit-identity cannot drift."""
+    from ddt_tpu.reference.numpy_trainer import best_splits, node_totals
+
+    n_level = 1 << depth
+    offset = n_level - 1
+    G, H = node_totals(hist)
+    gains, feats, bins, _ = best_splits(
+        hist, cfg.reg_lambda, cfg.min_child_weight)
+    value = np.where(H > 0, -G / (H + cfg.reg_lambda), 0.0).astype(
+        np.float32)
+    do_split = (gains > cfg.min_split_gain) & np.isfinite(gains) & (H > 0)
+    for i in range(n_level):
+        slot = offset + i
+        if do_split[i]:
+            feature[slot] = feats[i]
+            threshold_bin[slot] = bins[i]
+            split_gain[slot] = gains[i]
+        else:
+            is_leaf[slot] = True
+            leaf_value[slot] = value[i]
+
+
+def _apply_final_leaves(
+    Gl: np.ndarray,
+    Hl: np.ndarray,
+    cfg: TrainConfig,
+    is_leaf: np.ndarray,
+    leaf_value: np.ndarray,
+) -> None:
+    """Final-level leaf values from streamed (G, H) aggregates (shared by
+    the host and device loops)."""
+    n_last = 1 << cfg.max_depth
+    offset = n_last - 1
+    vals = np.where(Hl > 0, -Gl / (Hl + cfg.reg_lambda), 0.0)
+    is_leaf[offset:offset + n_last] = True
+    leaf_value[offset:offset + n_last] = vals.astype(np.float32)
+
+
 def fit_streaming(
     chunk_fn: ChunkFn,
     n_chunks: int,
@@ -74,17 +125,17 @@ def fit_streaming(
     backend=None,
     cache_preds: bool = True,
 ) -> TreeEnsemble:
-    """Train a GBDT over `n_chunks` streamed chunks (binary/mse losses).
+    """Train a GBDT over `n_chunks` streamed chunks.
 
-    backend=None uses the device histogram kernel via a fresh TPUDevice per
-    chunk shape; pass a CPUDevice to stream on host. Softmax streaming is the
-    same loop per class column — wired when a streaming multiclass config
-    exists ([BASELINE] lists only the binary stress config at this scale).
+    Device backends exposing the stream_* surface (TPUDevice) run the
+    whole per-(chunk, level) step on device — traversal, grads, histogram,
+    psum — with the NEXT chunk's upload overlapping the current chunk's
+    compute, and per-chunk boosting state (pred, labels) resident on
+    device for the whole run (ops/stream.py; supports softmax and
+    n_partitions/host_partitions > 1). Host backends stream the original
+    host formulation (binary/mse). Both are bit-identical to the in-memory
+    Driver on the same data (tests/test_streaming.py).
     """
-    if cfg.loss == "softmax":
-        raise NotImplementedError(
-            "streaming softmax: no BASELINE config requires it yet"
-        )
     if cfg.missing_policy != "zero":
         raise NotImplementedError(
             "streaming does not implement missing_policy='learn' yet — "
@@ -96,29 +147,47 @@ def fit_streaming(
 
         backend = get_backend(cfg)
 
+    device = hasattr(backend, "stream_level_hist")
+    if cfg.loss == "softmax" and not device:
+        raise NotImplementedError(
+            "host-path streaming softmax is not wired; use the TPU "
+            "backend (device streaming supports softmax)"
+        )
+
     # Pass 0: base score from running label sums + shape discovery — no
     # O(R) host state anywhere in this trainer except the optional preds
     # cache (see below); at the 10B-row target everything else is O(chunk).
+    # Device backends also ship labels NOW (one read of each chunk, not a
+    # second pass): labels stay device-resident for the whole run.
     y_sum, y_cnt = 0.0, 0
     chunk_lens = []
+    y_dev = []
     for c in range(n_chunks):
         _, yc = chunk_fn(c)
         y_sum += float(np.sum(yc))
         y_cnt += len(yc)
         chunk_lens.append(len(yc))
+        if device:
+            y_dev.append(backend.upload_labels(np.asarray(yc)))
     mean = y_sum / max(1, y_cnt)
     if cfg.loss == "logloss":
         p_ = float(np.clip(mean, 1e-6, 1 - 1e-6))
         bs = float(np.log(p_ / (1 - p_)))
+    elif cfg.loss == "softmax":
+        bs = 0.0
     else:
         bs = float(mean)
     Xb0, _ = chunk_fn(0)
     F = Xb0.shape[1]
 
+    C = cfg.n_classes if cfg.loss == "softmax" else 1
     ens = empty_ensemble(
-        cfg.n_trees, cfg.max_depth, F, cfg.learning_rate, bs,
+        cfg.n_trees * C, cfg.max_depth, F, cfg.learning_rate, bs,
         cfg.loss, cfg.n_classes,
     )
+    if device:
+        return _fit_streaming_device(
+            chunk_fn, n_chunks, cfg, backend, ens, bs, C, y_dev)
 
     # The ONE optional O(R) structure: per-chunk cached raw scores (4 bytes/
     # row). cache_preds=False recomputes scores from the partial ensemble
@@ -157,33 +226,11 @@ def fit_streaming(
                     backend.build_histograms(data, g, h, ni, n_level)
                 )
                 hist = part if hist is None else hist + part
-            from ddt_tpu.reference.numpy_trainer import (
-                best_splits, node_totals,
-            )
-
-            G, H = node_totals(hist)
-            gains, feats, bins, _ = best_splits(
-                hist, cfg.reg_lambda, cfg.min_child_weight
-            )
-            value = np.where(
-                H > 0, -G / (H + cfg.reg_lambda), 0.0
-            ).astype(np.float32)
-            do_split = (
-                (gains > cfg.min_split_gain) & np.isfinite(gains) & (H > 0)
-            )
-            for i in range(n_level):
-                slot = offset + i
-                if do_split[i]:
-                    feature[slot] = feats[i]
-                    threshold_bin[slot] = bins[i]
-                    split_gain[slot] = gains[i]
-                else:
-                    is_leaf[slot] = True
-                    leaf_value[slot] = value[i]
+            _apply_level_splits(hist, cfg, depth, feature, threshold_bin,
+                                is_leaf, leaf_value, split_gain)
 
         # Final level: per-terminal (G, H) aggregates streamed the same way.
         n_last = 1 << cfg.max_depth
-        offset = n_last - 1
         Gl = np.zeros(n_last, np.float32)
         Hl = np.zeros(n_last, np.float32)
         for c in range(n_chunks):
@@ -195,9 +242,7 @@ def fit_streaming(
             act = ni >= 0
             np.add.at(Gl, ni[act], g[act])
             np.add.at(Hl, ni[act], h[act])
-        vals = np.where(Hl > 0, -Gl / (Hl + cfg.reg_lambda), 0.0)
-        is_leaf[offset:offset + n_last] = True
-        leaf_value[offset:offset + n_last] = vals.astype(np.float32)
+        _apply_final_leaves(Gl, Hl, cfg, is_leaf, leaf_value)
 
         ens.feature[t] = feature
         ens.threshold_bin[t] = threshold_bin
@@ -217,6 +262,101 @@ def fit_streaming(
                 preds[c] += cfg.learning_rate * leaf_value[slot]
 
         log.info("streaming: tree %d/%d done", t + 1, cfg.n_trees)
+
+    return ens
+
+
+def _fit_streaming_device(
+    chunk_fn: ChunkFn,
+    n_chunks: int,
+    cfg: TrainConfig,
+    backend,
+    ens: TreeEnsemble,
+    bs: float,
+    C: int,
+    y_dev: list,
+) -> TreeEnsemble:
+    """Device streaming loop: see fit_streaming. Per tree it makes
+    max_depth histogram passes + 1 leaf pass (+ 1 pred-update pass between
+    rounds) over the chunks; each pass re-uploads only Xb (uint8 —
+    pred/labels stay device-resident), and the next chunk's host read +
+    H2D upload is enqueued BEFORE the current chunk's small output is
+    fetched, so the transfer rides under the device compute (double
+    buffering via JAX's async dispatch)."""
+    # Device-resident per-chunk boosting state (labels were shipped during
+    # pass 0): pred for the whole run — 4C bytes/row, row-sharded over the
+    # mesh like the data, per-chip tiny next to the streamed Xb.
+    pred_dev = [backend.init_pred(h, bs) for h in y_dev]
+
+    def passes(tree, depth, kind, class_idx):
+        """One full pass over the chunks; yields per-chunk device outputs
+        with the next upload already in flight."""
+        data = backend.upload(chunk_fn(0)[0])
+        for c in range(n_chunks):
+            if kind == "hist":
+                out = backend.stream_level_hist(
+                    data, pred_dev[c], y_dev[c], tree, depth, class_idx)
+            else:
+                out = backend.stream_leaf_gh(
+                    data, pred_dev[c], y_dev[c], tree, depth, class_idx)
+            if c + 1 < n_chunks:        # prefetch: overlap H2D with compute
+                data = backend.upload(chunk_fn(c + 1)[0])
+            yield np.asarray(out)       # fetch (device likely done by now)
+
+    t_out = 0
+    for rnd in range(cfg.n_trees):
+        # Gradients for EVERY class tree of a round come from the
+        # round-start preds (the Driver computes grad_hess once per round,
+        # then grows C trees from its columns) — so pred updates are
+        # deferred to one pass after all classes (which also costs one
+        # data pass per round instead of C).
+        round_trees = []
+        for cls in range(C):
+            feature = np.full(cfg.n_nodes_total, -1, np.int32)
+            threshold_bin = np.zeros(cfg.n_nodes_total, np.int32)
+            is_leaf = np.zeros(cfg.n_nodes_total, bool)
+            leaf_value = np.zeros(cfg.n_nodes_total, np.float32)
+            split_gain = np.zeros(cfg.n_nodes_total, np.float32)
+            tree = (feature, threshold_bin, is_leaf)
+
+            for depth in range(cfg.max_depth):
+                hist = None
+                for part in passes(tree, depth, "hist", cls):
+                    hist = part if hist is None else hist + part
+                _apply_level_splits(hist, cfg, depth, feature,
+                                    threshold_bin, is_leaf, leaf_value,
+                                    split_gain)
+
+            # Final level: streamed (G, H) aggregates.
+            GH = None
+            for part in passes(tree, cfg.max_depth, "leaf", cls):
+                GH = part if GH is None else GH + part
+            _apply_final_leaves(GH[:, 0], GH[:, 1], cfg, is_leaf,
+                                leaf_value)
+
+            round_trees.append(
+                (feature, threshold_bin, is_leaf, leaf_value))
+            ens.feature[t_out] = feature
+            ens.threshold_bin[t_out] = threshold_bin
+            ens.is_leaf[t_out] = is_leaf
+            ens.leaf_value[t_out] = leaf_value
+            ens.split_gain[t_out] = split_gain
+            t_out += 1
+
+        # One update pass: apply all of the round's class trees to the
+        # device-resident preds (independent columns). Preds are only read
+        # by the NEXT round's gradient passes, so the final round skips
+        # the pass entirely (a whole dataset re-read on the transfer-bound
+        # path).
+        if rnd + 1 < cfg.n_trees:
+            data = backend.upload(chunk_fn(0)[0])
+            for c in range(n_chunks):
+                for cls, tree_full in enumerate(round_trees):
+                    pred_dev[c] = backend.stream_update_pred(
+                        data, pred_dev[c], tree_full, cfg.max_depth, cls)
+                if c + 1 < n_chunks:
+                    data = backend.upload(chunk_fn(c + 1)[0])
+        log.info("streaming: round %d/%d done", rnd + 1, cfg.n_trees)
 
     return ens
 
