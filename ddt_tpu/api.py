@@ -130,7 +130,8 @@ def train(
         if mapper is None:
             mapper = fit_bin_mapper(np.asarray(X), n_bins=cfg.n_bins,
                                     seed=cfg.seed,
-                                    missing_policy=cfg.missing_policy)
+                                    missing_policy=cfg.missing_policy,
+                                    cat_features=cfg.cat_features)
         elif cfg.missing_policy == "learn" and not mapper.missing_bin:
             raise ValueError(
                 "missing_policy='learn' requires a BinMapper fitted with "

@@ -138,7 +138,9 @@ class CPUDevice(DeviceBackend):
     # ------------------------------------------------------------------ #
 
     def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
-        if self._native_traverse is None:
+        if self._native_traverse is None or ens.has_cat_splits:
+            # The C++ traversal has no one-vs-rest routing; the NumPy
+            # scorer is the exact path for categorical models.
             return ens.predict_raw(Xb, binned=True)
         # C++ batch traversal (the CPU twin of the device gather+compare
         # path); aggregation shared with TreeEnsemble.predict_raw.

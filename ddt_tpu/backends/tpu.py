@@ -356,6 +356,7 @@ class TPUDevice(DeviceBackend):
                 feature_axis_name=faxis,
                 feature_mask=fmask,
                 missing_bin=cfg.missing_policy == "learn",
+                cat_features=cfg.cat_features,
             )
             delta = grow_ops.tree_predict_delta(tree, cfg.learning_rate)
             # Pack the tiny node arrays into ONE f32 array so the host
@@ -478,6 +479,7 @@ class TPUDevice(DeviceBackend):
                         axis_name=axis,
                         feature_axis_name=faxis,
                         missing_bin=cfg.missing_policy == "learn",
+                        cat_features=cfg.cat_features,
                     )
                     delta = grow_ops.tree_predict_delta(
                         tree, cfg.learning_rate)
@@ -697,10 +699,21 @@ class TPUDevice(DeviceBackend):
         leaf = jax.device_put(ens.is_leaf, self._sharding())
         val = jax.device_put(ens.leaf_value, self._sharding())
         use_missing = ens.missing_bin and ens.default_left is not None
-        if use_missing:
-            dl = jax.device_put(ens.default_left, self._sharding())
+        use_cat = ens.has_cat_splits
+        if use_missing or use_cat:
+            extras = []
+            if use_missing:
+                extras.append(jax.device_put(ens.default_left,
+                                             self._sharding()))
+            if use_cat:
+                cat_node = np.isin(ens.feature, ens.cat_features)
+                extras.append(jax.device_put(cat_node, self._sharding()))
 
-            def fn0(feat, thr, leaf, val, dl, Xc):
+            def fn0(feat, thr, leaf, val, *rest):
+                *opt, Xc = rest
+                opt = list(opt)
+                dl = opt.pop(0) if use_missing else None
+                cn = opt.pop(0) if use_cat else None
                 return predict_ops.predict_raw(
                     feat, thr, leaf, val, Xc,
                     max_depth=ens.max_depth,
@@ -708,12 +721,14 @@ class TPUDevice(DeviceBackend):
                     base=ens.base_score,
                     n_classes=C,
                     default_left=dl,
-                    missing_bin_value=ens.n_bins - 1,
+                    missing_bin_value=(ens.n_bins - 1 if use_missing
+                                       else -1),
+                    cat_node=cn,
                 )
 
-            ens_dev: tuple = (feat, thr, leaf, val, dl)
+            ens_dev: tuple = (feat, thr, leaf, val, *extras)
             fn = fn0
-            n_rep = 5
+            n_rep = 4 + len(extras)
         else:
             fn = functools.partial(
                 predict_ops.predict_raw,

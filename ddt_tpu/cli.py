@@ -126,6 +126,11 @@ def main(argv: list[str] | None = None) -> int:
     tp.add_argument("--missing", choices=["zero", "learn"], default="zero",
                     help="NaN policy: zero = bin 0; learn = reserved NaN "
                          "bin + learned per-split default direction")
+    tp.add_argument("--cat-splits", choices=["ordinal", "onehot"],
+                    default="ordinal",
+                    help="categorical split type for the criteo config's "
+                         "encoded columns: ordinal (frequency-rank bins, "
+                         "bin<=t) or onehot (one-vs-rest, bin==k)")
     tp.add_argument("--profile", action="store_true",
                     help="log a per-phase wallclock breakdown (adds device "
                          "barriers; rounds run slower than unprofiled)")
@@ -179,6 +184,13 @@ def main(argv: list[str] | None = None) -> int:
             "softmax" if args.dataset == "covertype"
             else "mse" if args.dataset == "regression" else "logloss"
         )
+        cat_features: tuple = ()
+        if (args.dataset == "criteo" and args.cat_splits == "onehot"
+                and not args.data):   # --data overrides --dataset: its
+            # columns are arbitrary, never implicitly categorical
+            # The criteo layout (datasets.synthetic_ctr): 13 numeric
+            # columns first, then the encoder's categorical columns.
+            cat_features = tuple(range(13, X.shape[1]))
         cfg = TrainConfig(
             n_trees=args.trees, max_depth=args.depth, n_bins=args.bins,
             learning_rate=args.lr, loss=loss,
@@ -190,6 +202,7 @@ def main(argv: list[str] | None = None) -> int:
             colsample_bytree=args.colsample_bytree,
             hist_impl=args.hist_impl, seed=args.seed,
             missing_policy=args.missing,
+            cat_features=cat_features,
         )
         eval_set = None
         if args.valid_frac > 0:

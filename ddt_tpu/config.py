@@ -52,6 +52,16 @@ class TrainConfig:
     #   the standard histogram-GBDT treatment (LightGBM/XGBoost).
     missing_policy: str = "zero"
 
+    # --- categorical features ---
+    # Feature indices treated as CATEGORICAL (bin = category id from the
+    # CategoricalEncoder): their split candidates are one-vs-rest
+    # ("bin == k goes left") scored by one-hot gain, instead of ordinal
+    # "bin <= t" — the Criteo-config treatment beyond frequency-ordinal
+    # (SURVEY.md §2 "one-hot-gain variant"). Tuple (hashable: it keys
+    # compiled programs). Categorical columns must be integer-coded
+    # (never NaN).
+    cat_features: tuple = ()
+
     # --- system ---
     backend: str = "tpu"        # cpu | tpu | fpga(stub)
     n_partitions: int = 1       # row partitions (data parallel over mesh axis)
@@ -98,6 +108,22 @@ class TrainConfig:
             raise ValueError(
                 "missing_policy='learn' reserves the top bin; n_bins >= 3"
             )
+        # Normalize unconditionally: a list (even an empty one) must
+        # become a tuple or the backend cache key is unhashable.
+        object.__setattr__(
+            self, "cat_features",
+            tuple(sorted(int(f) for f in self.cat_features)))
+        if self.cat_features:
+            if self.cat_features[0] < 0:
+                raise ValueError("cat_features indices must be >= 0")
+            if self.missing_policy == "learn":
+                raise ValueError(
+                    "cat_features with missing_policy='learn' is not "
+                    "supported: the reserved NaN bin would silently merge "
+                    "the encoder's top category id into its neighbor "
+                    "(categorical columns are integer-coded and never "
+                    "NaN, so use missing_policy='zero')"
+                )
 
     @property
     def n_nodes_total(self) -> int:

@@ -91,6 +91,7 @@ def fit_bin_mapper(
     max_sample: int = 200_000,
     seed: int = 0,
     missing_policy: str = "zero",
+    cat_features: tuple = (),
 ) -> BinMapper:
     """Fit per-feature quantile bin edges on (a sample of) X.
 
@@ -120,7 +121,16 @@ def fit_bin_mapper(
     n_val = n_bins - 1 if missing else n_bins
     qs = np.linspace(0.0, 1.0, n_val + 1)[1:-1]   # n_val-1 interior quantiles
     edges = np.full((n_features, n_bins - 1), np.float32(np.inf))
+    cat = set(int(f) for f in cat_features)
     for f in range(n_features):
+        if f in cat:
+            # Categorical column: values ARE bin ids (CategoricalEncoder
+            # output) — identity edges so quantile re-binning cannot merge
+            # or permute categories. Bin b covers (edges[b-1], edges[b]]
+            # under searchsorted(side='left'), so edges [0, 1, ..] map
+            # integer v to bin v exactly.
+            edges[f, : n_val - 1] = np.arange(n_val - 1, dtype=np.float32)
+            continue
         col = Xs[:, f]
         col = col[np.isfinite(col)]
         if col.size == 0:

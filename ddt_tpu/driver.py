@@ -59,6 +59,7 @@ def _traverse_one(
     max_depth: int,
     default_left: np.ndarray | None = None,
     missing_bin_value: int = -1,
+    cat_features: tuple = (),
 ) -> np.ndarray:
     """Leaf heap-slot per row for ONE tree (node arrays [n_nodes])."""
     R = Xb.shape[0]
@@ -66,8 +67,12 @@ def _traverse_one(
     node = np.zeros(R, np.int64)
     for _ in range(max_depth):
         leaf = is_leaf[node]
-        fv = Xb[rows, np.maximum(feature[node], 0)]
+        feat = feature[node]
+        fv = Xb[rows, np.maximum(feat, 0)]
         go_right = fv > threshold_bin[node]
+        if cat_features is not None and len(cat_features):
+            go_right = np.where(np.isin(feat, cat_features),
+                                fv != threshold_bin[node], go_right)
         if missing_bin_value >= 0:
             go_right = np.where(fv == missing_bin_value,
                                 ~default_left[node], go_right)
@@ -123,6 +128,14 @@ class Driver:
         if Xb.dtype != np.uint8:
             raise TypeError(f"Xb must be uint8 binned data, got {Xb.dtype}")
         C = cfg.n_classes if cfg.loss == "softmax" else 1
+        if cfg.cat_features and cfg.cat_features[-1] >= F:
+            # Validate here, where F is known: the TPU path's scatter
+            # would silently DROP out-of-bounds indices (JAX semantics)
+            # while the NumPy twin raises — a backend-parity trap.
+            raise ValueError(
+                f"cat_features index {cfg.cat_features[-1]} out of range "
+                f"for {F} features"
+            )
         bs = base_score(np.asarray(y), cfg.loss, cfg.n_classes)
 
         data = self.backend.upload(Xb)
@@ -133,6 +146,7 @@ class Driver:
             cfg.n_trees * C, cfg.max_depth, F, cfg.learning_rate, bs,
             cfg.loss, cfg.n_classes,
             missing_bin=cfg.missing_policy == "learn", n_bins=cfg.n_bins,
+            cat_features=cfg.cat_features,
         )
 
         start_round = 0
@@ -270,6 +284,7 @@ class Driver:
                         missing_bin_value=(
                             cfg.n_bins - 1
                             if cfg.missing_policy == "learn" else -1),
+                        cat_features=cfg.cat_features,
                     )
                     dv = cfg.learning_rate * tree["leaf_value"][leaf]
                     if C > 1:

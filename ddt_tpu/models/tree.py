@@ -52,6 +52,11 @@ class TreeEnsemble:
     missing_bin: bool = False  # True: bin n_bins-1 is the NaN bin
     n_bins: int = 0            # binning width the model was trained with
     #   (0 = unknown/legacy; required when missing_bin is True)
+    # Categorical one-vs-rest splits (cfg.cat_features): nodes splitting on
+    # these FEATURE indices route "bin == threshold_bin goes left" instead
+    # of "bin <= threshold_bin" — the split type derives from the feature,
+    # no extra per-node storage. None/empty = all-ordinal model.
+    cat_features: np.ndarray | None = None   # int32, sorted
 
     @property
     def n_trees(self) -> int:
@@ -60,6 +65,12 @@ class TreeEnsemble:
     @property
     def n_nodes_total(self) -> int:
         return int(self.feature.shape[1])
+
+    @property
+    def has_cat_splits(self) -> bool:
+        """Whether any feature uses categorical one-vs-rest routing (the
+        single home of the cat_features presence test)."""
+        return self.cat_features is not None and len(self.cat_features) > 0
 
     # ------------------------------------------------------------------ #
     # NumPy prediction (oracle-grade; the fast path is ops/predict.py)
@@ -79,6 +90,7 @@ class TreeEnsemble:
         thr = self.threshold_bin if binned else self.threshold_raw
         Xc = X.astype(np.int32) if binned else X.astype(np.float32)
         use_missing = self.missing_bin and self.default_left is not None
+        use_cat = self.has_cat_splits
         for _ in range(self.max_depth):
             feat = np.take_along_axis(self.feature, node, axis=1)
             t = np.take_along_axis(thr, node, axis=1)
@@ -86,6 +98,14 @@ class TreeEnsemble:
             fv = np.stack([Xc[np.arange(R), np.maximum(feat[k], 0)]
                            for k in range(T)])
             go_right = fv > t
+            if use_cat:
+                # One-vs-rest: matched category goes left. Categorical
+                # columns hold bin ids in BOTH representations (the
+                # encoder output passes through identity edges), so the
+                # comparison is against threshold_bin either way.
+                tb = np.take_along_axis(self.threshold_bin, node, axis=1)
+                go_right = np.where(np.isin(feat, self.cat_features),
+                                    fv != tb, go_right)
             if use_missing:
                 # NaN rows: binned = the reserved top bin; raw = NaN itself
                 # (NaN > t is already False, but the learned direction may
@@ -241,6 +261,12 @@ class TreeEnsemble:
             "has_raw_thresholds": np.bool_(self.has_raw_thresholds),
             "missing_bin": np.bool_(self.missing_bin),
             "n_bins": np.int64(self.n_bins),
+            # NB: named so it does NOT collide with the model-artifact
+            # encoder keys ("cat_"-prefixed, api.save_model).
+            "categorical_features": (
+                self.cat_features if self.cat_features is not None
+                else np.zeros(0, np.int32)
+            ),
         }
 
     @staticmethod
@@ -269,6 +295,12 @@ class TreeEnsemble:
             has_raw_thresholds=bool(d.get("has_raw_thresholds", False)),
             missing_bin=bool(d.get("missing_bin", False)),
             n_bins=int(d.get("n_bins", 0)),
+            cat_features=(
+                np.asarray(d["categorical_features"], np.int32)
+                if "categorical_features" in d
+                and np.asarray(d["categorical_features"]).size
+                else None
+            ),
         )
 
     def save(self, path: str) -> None:
@@ -322,6 +354,7 @@ def empty_ensemble(
     n_classes: int = 2,
     missing_bin: bool = False,
     n_bins: int = 0,
+    cat_features: tuple = (),
 ) -> TreeEnsemble:
     n_nodes = 2 ** (max_depth + 1) - 1
     return TreeEnsemble(
@@ -340,4 +373,6 @@ def empty_ensemble(
         n_classes=n_classes,
         missing_bin=missing_bin,
         n_bins=n_bins,
+        cat_features=(np.asarray(cat_features, np.int32)
+                      if cat_features else None),
     )

@@ -75,6 +75,8 @@ def grow_tree(
     feature_mask: jax.Array | None = None,   # bool [F global]; colsample
     missing_bin: bool = False,   # cfg.missing_policy="learn": bin n_bins-1
     #   holds NaN rows; splits learn a default direction for them.
+    cat_features: tuple = (),    # GLOBAL feature indices with one-vs-rest
+    #   ("bin == k goes left") categorical splits (cfg.cat_features).
 ) -> TreeArrays:
     """Grow one complete-heap tree. Trace under jit (and shard_map if
     axis_name is set). Matches reference/numpy_trainer.grow_tree decisions.
@@ -83,9 +85,9 @@ def grow_tree(
     returned tree's feature indices are GLOBAL (shard offset applied);
     feature_mask is indexed globally and sliced to the local columns."""
     R, F = Xb.shape
-    # Routing packs (feature << 11 | bin << 2 | default_left << 1 | split)
-    # into int32 — enforce the field bounds at trace time so a future
-    # wider-bin or huge-F config fails loudly instead of silently
+    # Routing packs (feat << 12 | bin << 3 | cat << 2 | default_left << 1
+    # | split) into int32 — enforce the field bounds at trace time so a
+    # future wider-bin or huge-F config fails loudly instead of silently
     # corrupting row routing.
     assert n_bins <= 512, f"routing pack needs n_bins <= 512, got {n_bins}"
     # The packed feats are GLOBAL indices under feature sharding (shard
@@ -93,8 +95,8 @@ def grow_tree(
     # not just the local F. axis_size is static at trace time.
     F_global = F if feature_axis_name is None else (
         F * jax.lax.axis_size(feature_axis_name))
-    assert F_global < 2 ** 20, \
-        f"routing pack needs global F < 2^20, got {F_global}"
+    assert F_global < 2 ** 19, \
+        f"routing pack needs global F < 2^19, got {F_global}"
     N = 2 ** (max_depth + 1) - 1
 
     feature = jnp.full((N,), -1, jnp.int32)
@@ -110,12 +112,20 @@ def grow_tree(
     def allreduce(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
+    cat_vec_g = None                       # bool [F_global]
+    if cat_features:
+        cat_vec_g = jnp.zeros(F_global, bool).at[
+            jnp.asarray(cat_features, jnp.int32)].set(True)
+    cat_vec = cat_vec_g                    # this shard's columns
+
     if feature_axis_name is not None:
         f_shard = jax.lax.axis_index(feature_axis_name)
         f_lo = f_shard * F                 # global index of local column 0
         if feature_mask is not None:
             feature_mask = jax.lax.dynamic_slice_in_dim(
                 feature_mask, f_lo, F)     # this shard's columns
+        if cat_vec_g is not None:
+            cat_vec = jax.lax.dynamic_slice_in_dim(cat_vec_g, f_lo, F)
 
     for depth in range(max_depth):         # unrolled: static 2^d nodes/level
         offset = (1 << depth) - 1
@@ -141,7 +151,7 @@ def grow_tree(
                 jnp.where(act, h, 0.0), seg, num_segments=n_level))
         gains, feats, bins, dls = S.best_splits(
             hist, reg_lambda, min_child_weight, feature_mask,
-            missing_bin=missing_bin)
+            missing_bin=missing_bin, cat_mask=cat_vec)
         if feature_axis_name is not None:
             # Combine per-shard winners: all_gather the (gain, feat, bin,
             # direction) tuples (tiny), argmax over shards — first shard
@@ -176,20 +186,31 @@ def grow_tree(
         # TPU gathers (even from a 32-entry table) each cost ~10-20 ms at
         # 1M rows, while the [R, n_level] masked reductions are a few ms
         # total — and integer one-hot sums are EXACT, so routing is
-        # bit-identical to the gather formulation. The four per-node
-        # tables (feature, bin, direction, do_split) are packed into ONE
-        # int32 so a single masked reduction covers them:
-        # feat<<11 | bin<<2 | default_left<<1 | split.
+        # bit-identical to the gather formulation. The five per-node
+        # tables (feature, bin, cat-ness, direction, do_split) are packed
+        # into ONE int32 so a single masked reduction covers them:
+        # feat<<12 | bin<<3 | cat<<2 | default_left<<1 | split.
         idx_c = jnp.clip(node_id - offset, 0, n_level - 1)
         noh = idx_c[:, None] == jnp.arange(n_level, dtype=jnp.int32)[None, :]
-        table = ((feats << 11) | (bins << 2)
+        if cat_vec_g is not None:
+            # Per-NODE cat-ness of the winning (global) feature: tiny
+            # [n_level, F_global] one-hot select.
+            cat_n = jnp.any(
+                (feats[:, None]
+                 == jnp.arange(F_global, dtype=jnp.int32)[None, :])
+                & cat_vec_g[None, :], axis=1)
+        else:
+            cat_n = jnp.zeros(n_level, bool)
+        table = ((feats << 12) | (bins << 3)
+                 | (cat_n.astype(jnp.int32) << 2)
                  | (dls.astype(jnp.int32) << 1)
                  | do_split.astype(jnp.int32))
         packed_r = jnp.sum(jnp.where(noh, table[None, :], 0), axis=1)
         split_here = (packed_r & 1).astype(bool) & ~frozen
         dl_r = ((packed_r >> 1) & 1).astype(bool)
-        feat_r = packed_r >> 11
-        bin_r = (packed_r >> 2) & 0x1FF
+        cat_r = ((packed_r >> 2) & 1).astype(bool)
+        feat_r = packed_r >> 12
+        bin_r = (packed_r >> 3) & 0x1FF
         if feature_axis_name is None:
             foh = (
                 jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
@@ -210,6 +231,9 @@ def grow_tree(
                 feature_axis_name,
             )
         go_right = fv > bin_r
+        if cat_features:
+            # Categorical one-vs-rest: the matched category goes LEFT.
+            go_right = jnp.where(cat_r, fv != bin_r, go_right)
         if missing_bin:
             # NaN rows occupy the reserved top bin and follow the node's
             # learned default direction.

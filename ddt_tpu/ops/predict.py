@@ -81,7 +81,7 @@ def _select_level(k, table):
 
 
 def _descend(eff_feat, eff_thr, Xc, max_depth, dl=None,
-             missing_bin_value=-1):
+             missing_bin_value=-1, cat_node=None):
     """Relative node index at the bottom level: int32 [T, R].
 
     Per-level formulation: one-hot select of the row's (feature, thr) from
@@ -93,6 +93,10 @@ def _descend(eff_feat, eff_thr, Xc, max_depth, dl=None,
     float data — follow the node's learned default direction. Pushed-down
     leaf nodes select fv = 0 (feature=-1 matches no lane), which is neither
     the reserved bin nor NaN, so they stay on the always-left path.
+
+    `cat_node` ([T, N] bool) marks categorical one-vs-rest nodes: the
+    matched bin goes LEFT (fv != thr goes right). Gated on eff_feat >= 0
+    so pushed-down leaf nodes (thr = +BIG, fv = 0) stay always-left.
     """
     Tc = eff_feat.shape[0]
     R, F = Xc.shape
@@ -108,6 +112,10 @@ def _descend(eff_feat, eff_thr, Xc, max_depth, dl=None,
             jnp.where(foh, Xc[None, :, :], jnp.zeros((), Xc.dtype)), axis=-1
         )
         go = fv > thr_r
+        if cat_node is not None:
+            cat_r = _select_level(
+                k, cat_node[:, lo:lo + w].astype(jnp.int32)).astype(bool)
+            go = jnp.where(cat_r & (feat_r >= 0), fv != thr_r, go)
         if dl is not None:
             miss = (fv == missing_bin_value) if binned else jnp.isnan(fv)
             dl_r = _select_level(
@@ -118,7 +126,7 @@ def _descend(eff_feat, eff_thr, Xc, max_depth, dl=None,
 
 
 def _descend_comp(eff_feat, eff_thr, Xc, max_depth, dl=None,
-                  missing_bin_value=-1):
+                  missing_bin_value=-1, cat_node=None):
     """Binned fast path: relative node index at the bottom level, [R, T].
 
     Precomputes the comparison bit of EVERY internal node for every row in
@@ -141,6 +149,13 @@ def _descend_comp(eff_feat, eff_thr, Xc, max_depth, dl=None,
         preferred_element_type=jnp.bfloat16,   # bins <= 255: exact in bf16
     ).reshape(R, Tc, n_int)               # [R, T, Nint] exact bin values
     comp = colval > eff_thr[None, :, :n_int].astype(jnp.bfloat16)
+    if cat_node is not None:
+        # One-vs-rest nodes: the matched bin (exact in bf16) goes left.
+        # Gate on eff_feat >= 0 so pushed-down leaves stay always-left.
+        cat_eff = cat_node[:, :n_int] & (eff_feat[:, :n_int] >= 0)
+        comp = jnp.where(
+            cat_eff[None, :, :],
+            colval != eff_thr[None, :, :n_int].astype(jnp.bfloat16), comp)
     if dl is not None:
         # Missing rows (the reserved bin, exact in bf16) follow the node's
         # learned direction; pushed-down leaves have colval=0, never the
@@ -195,6 +210,10 @@ def predict_raw(
     #   missing-value handling (models trained without the reserved bin)
     missing_bin_value: int = -1,             # reserved NaN bin id (binned
     #   data); raw float data detects NaN directly
+    cat_node: jax.Array | None = None,       # bool [T, N]; one-vs-rest
+    #   split nodes ("bin == thr goes left", cfg.cat_features). For raw
+    #   float data the caller must put the BIN id in thr for these nodes
+    #   (categorical columns carry bin ids in both representations).
 ) -> jax.Array:
     """Raw margin scores: [R] (n_classes==1) or [R, C].
 
@@ -234,6 +253,9 @@ def predict_raw(
     use_missing = default_left is not None
     if use_missing:
         dlp = pad_t(default_left).reshape(n_tc, tree_chunk, -1)
+    use_cat = cat_node is not None
+    if use_cat:
+        catp = pad_t(cat_node).reshape(n_tc, tree_chunk, -1)
     lo = (1 << max_depth) - 1
     valp = ev[:, lo:].reshape(n_tc, tree_chunk, -1)   # bottom level only
     # Class of tree t is t % C (round-major interleave).
@@ -249,14 +271,14 @@ def predict_raw(
 
     def row_body(_, xrc):
         def tree_body(acc, args):
-            if use_missing:
-                f, t, v, coh, dlc = args
-            else:
-                f, t, v, coh = args
-                dlc = None
+            f, t, v, coh = args[:4]
+            rest = list(args[4:])
+            dlc = rest.pop(0) if use_missing else None
+            catc = rest.pop(0) if use_cat else None
             if binned:
                 k = _descend_comp(f, t, xrc, max_depth, dl=dlc,
-                                  missing_bin_value=missing_bin_value)
+                                  missing_bin_value=missing_bin_value,
+                                  cat_node=catc)
                 W = v.shape[1]                               # [Rc, chunk]
                 noh = (
                     k[:, :, None]
@@ -268,7 +290,8 @@ def predict_raw(
                 contract = (((1,), (0,)), ((), ()))
             else:
                 k = _descend(f, t, xrc, max_depth, dl=dlc,
-                             missing_bin_value=missing_bin_value)
+                             missing_bin_value=missing_bin_value,
+                             cat_node=catc)
                 vals = _select_level(k, v)                   # [chunk, Rc]
                 contract = (((0,), (0,)), ((), ()))
             # Scatter chunk sums into classes: one_hot [chunk, C] matmul.
@@ -282,9 +305,12 @@ def predict_raw(
             return acc, None
 
         acc0 = jnp.zeros((row_chunk, C), jnp.float32)
-        xs = ((featp, thrp, valp, cls_oh, dlp) if use_missing
-              else (featp, thrp, valp, cls_oh))
-        acc, _ = jax.lax.scan(tree_body, acc0, xs)
+        xs = [featp, thrp, valp, cls_oh]
+        if use_missing:
+            xs.append(dlp)
+        if use_cat:
+            xs.append(catp)
+        acc, _ = jax.lax.scan(tree_body, acc0, tuple(xs))
         return None, acc
 
     _, accs = jax.lax.scan(row_body, None, Xp)               # [n_rc, Rc, C]

@@ -35,6 +35,7 @@ def best_splits(
     min_child_weight: float,
     feature_mask: jax.Array | None = None,   # bool [F]; False = excluded
     missing_bin: bool = False,
+    cat_mask: jax.Array | None = None,       # bool [F]; True = categorical
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Per-node best split: (gain [n], feature [n] i32, bin [n] i32,
     default_left [n] bool).
@@ -48,8 +49,13 @@ def best_splits(
     both default directions are scored per (feature, bin) and the argmax
     runs over the flattened (direction, feature, bin) axis with the RIGHT
     block first — zero-missing nodes tie exactly and deterministically pick
-    default_left=False. Semantics identical to the NumPy twin
-    (reference/numpy_trainer.best_splits); keep in sync.
+    default_left=False.
+
+    cat_mask marks categorical features (cfg.cat_features): one-vs-rest
+    candidates ("bin == k goes left", every bin valid, one-hot gain)
+    replace the ordinal cumsum gains on those features; under missing_bin
+    they compete in the RIGHT block only. Semantics identical to the NumPy
+    twin (reference/numpy_trainer.best_splits); keep in sync.
     """
     n_nodes, F, B, _ = hist.shape
     GL = jnp.cumsum(hist[..., 0], axis=2)           # [n, F, B]
@@ -83,9 +89,19 @@ def best_splits(
     # — so every backend and every partition count picks identical splits.
     # Selecting among candidates within bf16 resolution (~0.4%) of the max is
     # immaterial to model quality; decision stability across devices is not.
+    def overlay_cat(gain, valid):
+        """Replace cat features' ordinal gains with one-vs-rest gains
+        (left child = exactly bin k => GL_k is the per-bin sum itself)."""
+        if cat_mask is None:
+            return gain, valid
+        gc, vc = gain_of(hist[..., 0], hist[..., 1])
+        m = cat_mask[None, :, None]
+        return jnp.where(m, gc, gain), jnp.where(m, vc, valid)
+
     if not missing_bin:
         gain, valid = gain_of(GL, HL)
         valid = valid & (jnp.arange(B) < B - 1)[None, None, :]
+        gain, valid = overlay_cat(gain, valid)
         gain = jnp.where(valid, gain, -jnp.inf).astype(jnp.bfloat16)
         flat = gain.reshape(n_nodes, F * B)
         best = jnp.argmax(flat, axis=1)
@@ -107,6 +123,9 @@ def best_splits(
     # t = B-2 under LEFT puts every row left (empty right child): invalid
     # regardless of the min_child_weight knob.
     valid_l = valid_l & (jnp.arange(B) < B - 2)[None, None, :]
+    gain_r, valid_r = overlay_cat(gain_r, valid_r)
+    if cat_mask is not None:
+        valid_l = valid_l & ~cat_mask[None, :, None]   # cat: RIGHT only
     g16 = jnp.concatenate(
         [jnp.where(valid_r, gain_r, -jnp.inf),
          jnp.where(valid_l, gain_l, -jnp.inf)], axis=1,

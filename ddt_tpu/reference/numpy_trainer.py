@@ -102,6 +102,7 @@ def best_splits(
     min_child_weight: float,
     feature_mask: np.ndarray | None = None,   # bool [F]; False = excluded
     missing_bin: bool = False,
+    cat_mask: np.ndarray | None = None,       # bool [F]; True = categorical
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Reference SplitGain: per-node best
     (gain, feature, threshold_bin, default_left).
@@ -120,6 +121,14 @@ def best_splits(
     flattened block, so nodes with zero missing mass — where both
     directions tie exactly — deterministically report default_left=False,
     matching the missing_bin=False semantics.
+
+    `cat_mask` marks CATEGORICAL features: their candidates are
+    one-vs-rest splits ("bin == k goes LEFT", every bin a candidate,
+    one-hot gain Gk^2/(Hk+l) + (G-Gk)^2/(H-Hk+l) - parent) replacing the
+    ordinal cumsum gains in the same (feature, bin) argmax grid — the
+    chosen bin is the matched category k. Under missing_bin they compete
+    only in the direction-RIGHT block (categorical columns are
+    integer-coded and never NaN).
     """
     n_nodes, F, B, _ = hist.shape
     GL = np.cumsum(hist[..., 0], axis=2)       # [n, F, B]
@@ -150,9 +159,20 @@ def best_splits(
             valid = valid & feature_mask[None, :, None]
         return gain, valid
 
+    def overlay_cat(gain, valid):
+        """Replace cat features' ordinal gains with one-vs-rest gains
+        (left child = exactly bin k, so GL_k is the per-bin sum itself;
+        every bin including the last is a candidate)."""
+        if cat_mask is None or not cat_mask.any():
+            return gain, valid
+        gc, vc = gain_of(hist[..., 0], hist[..., 1])
+        m = cat_mask[None, :, None]
+        return np.where(m, gc, gain), np.where(m, vc, valid)
+
     if not missing_bin:
         gain, valid = gain_of(GL, HL)
         valid[:, :, B - 1] = False             # cannot split on last bin
+        gain, valid = overlay_cat(gain, valid)
         # Deterministic selection (see ops/split.py): bf16-rounded gains
         # turn float-noise near-ties into exact ties with a shared
         # first-index tie-break, so CPU/TPU/any-partition-count all pick
@@ -174,6 +194,9 @@ def best_splits(
     # HR >= min_child_weight guard already rejects it for mcw > 0, but the
     # rule must not depend on the knob:
     valid_l[:, :, B - 2] = False
+    gain_r, valid_r = overlay_cat(gain_r, valid_r)
+    if cat_mask is not None:
+        valid_l &= ~cat_mask[None, :, None]    # cat: RIGHT block only
     g16 = np.concatenate(
         [np.where(valid_r, gain_r, -np.inf),
          np.where(valid_l, gain_l, -np.inf)], axis=1,
@@ -216,6 +239,10 @@ def grow_tree(
     R, F = Xb.shape
     N = cfg.n_nodes_total
     missing = cfg.missing_policy == "learn"
+    cat_mask = None
+    if cfg.cat_features:
+        cat_mask = np.zeros(F, bool)
+        cat_mask[list(cfg.cat_features)] = True
     feature = np.full(N, -1, np.int32)
     threshold_bin = np.zeros(N, np.int32)
     is_leaf = np.zeros(N, bool)
@@ -235,13 +262,14 @@ def grow_tree(
         else:
             hist = build_histograms(Xb, g, h, node_index, n_level, cfg.n_bins)
         G, H = node_totals(hist)
-        if split_fn is not None and feature_mask is None and not missing:
+        if (split_fn is not None and feature_mask is None and not missing
+                and cat_mask is None):
             gains, feats, bins = split_fn(hist)
             dls = np.zeros(n_level, bool)
         else:
             gains, feats, bins, dls = best_splits(
                 hist, cfg.reg_lambda, cfg.min_child_weight, feature_mask,
-                missing_bin=missing,
+                missing_bin=missing, cat_mask=cat_mask,
             )
         value = -G / (H + cfg.reg_lambda)
 
@@ -265,6 +293,9 @@ def grow_tree(
         bin_r = bins[idx]
         fv = Xb[active, feat_r].astype(np.int32)
         go_right = fv > bin_r
+        if cat_mask is not None:
+            # Categorical one-vs-rest: the matched category goes LEFT.
+            go_right = np.where(cat_mask[feat_r], fv != bin_r, go_right)
         if missing:
             # NaN rows (top bin) follow the learned default direction.
             is_miss = fv == cfg.n_bins - 1
@@ -324,6 +355,11 @@ def fit(
             "quantize with the same n_bins as the TrainConfig."
         )
     y = np.asarray(y)
+    if cfg.cat_features and cfg.cat_features[-1] >= F:
+        raise ValueError(
+            f"cat_features index {cfg.cat_features[-1]} out of range "
+            f"for {F} features"
+        )
     C = cfg.n_classes if cfg.loss == "softmax" else 1
     bs = base_score(y, cfg.loss, cfg.n_classes)
     n_trees_total = cfg.n_trees * C
@@ -331,6 +367,7 @@ def fit(
         n_trees_total, cfg.max_depth, F, cfg.learning_rate, bs,
         cfg.loss, cfg.n_classes,
         missing_bin=cfg.missing_policy == "learn", n_bins=cfg.n_bins,
+        cat_features=cfg.cat_features,
     )
 
     if cfg.loss == "softmax":

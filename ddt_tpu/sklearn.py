@@ -33,6 +33,7 @@ class _DDTBase:
         n_partitions: int = 1,
         seed: int = 0,
         missing_policy: str = "zero",
+        cat_features: tuple = (),
     ):
         self.n_trees = n_trees
         self.max_depth = max_depth
@@ -47,6 +48,7 @@ class _DDTBase:
         self.n_partitions = n_partitions
         self.seed = seed
         self.missing_policy = missing_policy
+        self.cat_features = cat_features
 
     @classmethod
     def _param_names(cls) -> tuple:
@@ -85,6 +87,7 @@ class _DDTBase:
             n_partitions=self.n_partitions,
             seed=self.seed,
             missing_policy=self.missing_policy,
+            cat_features=tuple(self.cat_features),
             **extra,
         )
 

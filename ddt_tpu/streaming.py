@@ -142,6 +142,11 @@ def fit_streaming(
             "failing loudly beats silently treating the reserved NaN bin "
             "as the largest value bin"
         )
+    if cfg.cat_features:
+        raise NotImplementedError(
+            "streaming does not implement categorical one-vs-rest splits "
+            "yet — failing loudly beats silently training them as ordinal"
+        )
     if backend is None:
         from ddt_tpu.backends import get_backend
 

@@ -36,6 +36,9 @@ def _cfg_fingerprint(cfg: TrainConfig) -> dict:
               "host_partitions", "hist_impl", "backend",
               "matmul_input_dtype"):
         d.pop(k, None)
+    # JSON round-trips tuples as lists; normalize so a saved fingerprint
+    # compares equal to a freshly computed one.
+    d["cat_features"] = list(d.get("cat_features", ()))
     return d
 
 
@@ -68,6 +71,9 @@ def try_resume(ckpt_dir: str, ens: TreeEnsemble, cfg: TrainConfig) -> int:
         return 0
     with open(cursor_path) as f:
         cur = json.load(f)
+    # Fingerprint fields added over time default to their empty value so
+    # checkpoints written before a field existed stay resumable.
+    cur["config"].setdefault("cat_features", [])
     if cur["config"] != _cfg_fingerprint(cfg):
         raise ValueError(
             f"checkpoint at {ckpt_dir} was written by an incompatible config; "
