@@ -1,0 +1,181 @@
+"""Sweep round 8: adaptive hi/lo bin-split histograms for SHALLOW levels.
+
+Motivation (docs/PERF.md cost model): the dense kernel's per-level cost is
+~constant in n_nodes — the VPU one-hot build is 2 ops x F x 256 per ROW
+regardless of how many nodes exist — so levels 0-2 cost as much as level 5.
+Round-1's nibble note ("wins ONLY for n_nodes < 8") dismissed exactly the
+levels that are NOT cheap.
+
+Formulation: split bin index b = n_hi*? no — b = hi * n_lo + lo with
+n_hi * n_lo = 256, both powers of two. Then
+
+    hist[n, f, hi*n_lo+lo] = sum_r a[r,n] * 1[hi_rf==hi] * 1[lo_rf==lo]
+                           = sum_r W_f[r, (n,hi)] * LO_f[r, lo]
+
+W_f = A2 * (hi_col == hi_iota) where A2 is A lane-repeated n_hi times
+(done in the XLA prologue — tiny HBM traffic at small N, avoids in-kernel
+lane relayouts that sank the vG experiment). VPU cost per row per feature:
+2*(2N*n_hi) + 2*n_lo  vs dense 2*256. Optimal n_hi ~ sqrt(128/N):
+
+    N=1: (8,32) -> 96 ops  (5.3x less VPU)    N=8:  (4,64) -> 256 (2x)
+    N=2: (8,32) -> 128 (4x)                   N=16: (4,64) -> 384 (1.3x)
+    N=4: (8,32) -> 192 (2.7x)                 N=32: dense wins (tie at best)
+
+MXU flops are IDENTICAL to dense (2*2N*256*T*F) — only the dot shapes
+change ([2N*n_hi, T]@[T, n_lo] per feature).
+
+Run on the real TPU:  python experiments/hist_sweep8.py
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+from ddt_tpu.ops.hist_pallas import build_histograms_pallas
+from ddt_tpu.utils.device import device_sync
+
+R, F, B = 1_000_000, 28, 255
+ITERS = 10
+REPS = 4
+TILE_R = 512
+
+
+def _kernel_split(xb_ref, a2_ref, out_ref, *, n_feat, n_nodes, n_hi, n_lo):
+    """out[(n,hi), (f,lo)] += W_f^T @ LO_f per feature slab."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = xb_ref[:]                                   # [T, F] int32 bins
+    a2 = a2_ref[:]                                  # [T, 2N*n_hi] bf16
+    t = x.shape[0]
+    shift = {2: 1, 4: 2, 8: 3, 16: 4, 32: 5, 64: 6, 128: 7}[n_lo]
+    hi = x >> shift                                  # [T, F] in [0, n_hi)
+    lo = x & (n_lo - 1)
+
+    w_lanes = 2 * n_nodes * n_hi
+    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (t, w_lanes), 1) & (n_hi - 1)
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (t, n_lo), 1)
+
+    for f in range(n_feat):
+        w = jnp.where(hi[:, f][:, None] == hi_iota, a2, 0.0)   # [T, 2N*n_hi]
+        lo_oh = (lo[:, f][:, None] == lo_iota).astype(jnp.bfloat16)
+        out_ref[:, f * n_lo:(f + 1) * n_lo] += jax.lax.dot_general(
+            w, lo_oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_nodes", "n_hi", "tile_r", "x_dtype")
+)
+def hist_split(Xb, g, h, ni, n_nodes, n_hi, tile_r=TILE_R,
+               x_dtype=jnp.int32):
+    n_lo = 256 // n_hi
+    Rr, Fq = Xb.shape
+    active = ni >= 0
+    idx = jnp.where(active, ni, 0).astype(jnp.int32)
+    gz = jnp.where(active, g, 0.0)
+    hz = jnp.where(active, h, 0.0)
+    noh = jax.nn.one_hot(idx, n_nodes, dtype=jnp.float32)
+    A = jnp.concatenate([noh * gz[:, None], noh * hz[:, None]],
+                        axis=1).astype(jnp.bfloat16)            # [R, 2N]
+    A2 = jnp.repeat(A, n_hi, axis=1)                            # [R, 2N*n_hi]
+    Xi = Xb.astype(x_dtype)
+    n_tiles = -(-Rr // tile_r)
+    pad = n_tiles * tile_r - Rr
+    if pad:
+        Xi = jnp.pad(Xi, ((0, pad), (0, 0)))
+        A2 = jnp.pad(A2, ((0, pad), (0, 0)))
+    w_lanes = 2 * n_nodes * n_hi
+    out = pl.pallas_call(
+        functools.partial(_kernel_split, n_feat=Fq, n_nodes=n_nodes,
+                          n_hi=n_hi, n_lo=n_lo),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile_r, Fq), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, w_lanes), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((w_lanes, Fq * n_lo), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((w_lanes, Fq * n_lo), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * w_lanes * Fq * n_lo * n_tiles * tile_r,
+            bytes_accessed=Rr * Fq * 4 + Rr * w_lanes * 2
+            + w_lanes * Fq * n_lo * 4,
+            transcendentals=0),
+    )(Xi, A2)
+    # [(2,N,hi), (F,lo)] -> [N, F, hi*n_lo+lo=256, 2] -> slice bins
+    out = out.reshape(2, n_nodes, n_hi, Fq, n_lo)
+    out = out.transpose(1, 3, 2, 4, 0).reshape(n_nodes, Fq, 256, 2)
+    return out[:, :, :B, :]
+
+
+def main():
+    rng = np.random.default_rng(0)
+    Xb = jnp.asarray(rng.integers(0, B, size=(R, F), dtype=np.uint8))
+    g = jnp.asarray(rng.standard_normal(R).astype(np.float32))
+    h = jnp.asarray((rng.random(R) + 0.5).astype(np.float32))
+
+    for N in (1, 2, 4, 8, 16, 32):
+        ni_np = rng.integers(0, N, size=R).astype(np.int32)
+        ni_np[:1000] = -1
+        ni = jnp.asarray(ni_np)
+
+        ref = build_histograms_pallas(Xb, g, h, ni, N, B, tile_r=TILE_R)
+        device_sync(ref)
+
+        cands = [(f"N={N:2d} v0 dense", lambda N=N, ni=ni:
+                  build_histograms_pallas(Xb, g, h, ni, N, B,
+                                          tile_r=TILE_R))]
+        for n_hi in (4, 8, 16):
+            if 2 * N * n_hi > 1024:       # accumulator sublane sanity cap
+                continue
+            cands.append((f"N={N:2d} split hi{n_hi:2d}xlo{256 // n_hi:3d}",
+                          lambda N=N, ni=ni, n_hi=n_hi:
+                          hist_split(Xb, g, h, ni, N, n_hi)))
+
+        best, live = {}, []
+        for name, fn in cands:
+            try:
+                out = fn()
+                device_sync(out)
+                if not bool(jnp.allclose(out, ref, rtol=2e-2, atol=2e-2)):
+                    print(f"{name:28s} WRONG RESULT")
+                    continue
+                live.append((name, fn))
+                best[name] = np.inf
+            except Exception as e:  # noqa: BLE001
+                print(f"{name:28s} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:120]}")
+
+        for _ in range(REPS):
+            for name, fn in live:
+                t0 = time.perf_counter()
+                for _ in range(ITERS):
+                    out = fn()
+                device_sync(out)
+                best[name] = min(best[name],
+                                 (time.perf_counter() - t0) / ITERS)
+        for name, _ in live:
+            dt = best[name]
+            print(f"{name:28s} {dt * 1e3:8.2f} ms  {R / dt / 1e6:7.1f} "
+                  f"Mrows/s")
+
+
+if __name__ == "__main__":
+    main()
