@@ -137,6 +137,18 @@ def train(
                 "missing_policy='learn' requires a BinMapper fitted with "
                 "the same policy (its top bin must be the NaN bin)"
             )
+        if cfg.cat_features:
+            # A mapper fitted WITHOUT these columns gave them quantile
+            # edges, which merge/permute category ids before the
+            # one-vs-rest splits see them — silently wrong models.
+            not_identity = mapper.non_identity_columns(cfg.cat_features)
+            if not_identity:
+                raise ValueError(
+                    f"cat_features {not_identity} were not identity-binned "
+                    "by this BinMapper; refit it with "
+                    f"cat_features={tuple(sorted(cfg.cat_features))} so "
+                    "category ids survive binning"
+                )
         Xb = mapper.transform(np.asarray(X))
 
     if eval_set is not None:
@@ -202,6 +214,18 @@ def predict(
                     f"{ens.missing_bin}; use the training-time mapper "
                     "(api.load_model returns it)"
                 )
+            if ens.has_cat_splits:
+                # Same loud-failure contract as missing_bin: the model's
+                # categorical columns must have been identity-binned by
+                # this mapper or every "bin == k" comparison is garbage.
+                not_identity = mapper.non_identity_columns(ens.cat_features)
+                if not_identity:
+                    raise ValueError(
+                        f"the ensemble splits features {not_identity} "
+                        "categorically but this BinMapper did not "
+                        "identity-bin them; use the training-time mapper "
+                        "(api.load_model returns it)"
+                    )
             X = mapper.transform(X)
             binned = True
         elif not ens.has_raw_thresholds:

@@ -36,6 +36,12 @@ class BinMapper:
     edges: np.ndarray       # [n_features, n_bins-1] float32, ascending per row
     n_bins: int
     missing_bin: bool = False
+    # Columns fitted with IDENTITY edges (values are category/bin ids, never
+    # quantile-merged). Recorded so train/predict can verify that a model's
+    # cat_features were identity-binned by THIS mapper — a mapper fitted
+    # without them would silently merge/permute category ids (failing loudly
+    # beats silently, same as the missing_bin guard).
+    cat_features: tuple = ()
 
     @property
     def n_features(self) -> int:
@@ -45,6 +51,20 @@ class BinMapper:
     def n_value_bins(self) -> int:
         """Bins available to real values (excludes the reserved NaN bin)."""
         return self.n_bins - 1 if self.missing_bin else self.n_bins
+
+    def non_identity_columns(self, features) -> list[int]:
+        """Subset of `features` whose edges do NOT identity-map integer bin
+        ids (i.e. were quantile-fitted, so category ids would be merged or
+        permuted by transform). Checks the edges themselves rather than the
+        recorded `cat_features` metadata, so mappers saved before that field
+        existed — or hand-built ones — are judged by the invariant that
+        actually matters."""
+        nv = self.n_value_bins
+        want = np.arange(nv - 1, dtype=np.float32)
+        return sorted(
+            int(f) for f in features
+            if not np.array_equal(self.edges[int(f), : nv - 1], want)
+        )
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         """Bin a float matrix [rows, n_features] -> uint8 [rows, n_features]."""
@@ -76,13 +96,16 @@ class BinMapper:
 
     def save(self) -> dict:
         return {"edges": self.edges, "n_bins": np.int64(self.n_bins),
-                "missing_bin": np.bool_(self.missing_bin)}
+                "missing_bin": np.bool_(self.missing_bin),
+                "cat_features": np.asarray(self.cat_features, np.int32)}
 
     @staticmethod
     def load(d: dict) -> "BinMapper":
         return BinMapper(edges=np.asarray(d["edges"], np.float32),
                          n_bins=int(d["n_bins"]),
-                         missing_bin=bool(d.get("missing_bin", False)))
+                         missing_bin=bool(d.get("missing_bin", False)),
+                         cat_features=tuple(
+                             int(f) for f in d.get("cat_features", ())))
 
 
 def fit_bin_mapper(
@@ -142,7 +165,8 @@ def fit_bin_mapper(
         # to the first edge, so dup bins are simply never assigned.
         e = np.maximum.accumulate(e)
         edges[f, : n_val - 1] = e
-    return BinMapper(edges=edges, n_bins=n_bins, missing_bin=missing)
+    return BinMapper(edges=edges, n_bins=n_bins, missing_bin=missing,
+                     cat_features=tuple(sorted(cat)))
 
 
 def quantize(

@@ -193,12 +193,10 @@ def grow_tree(
         idx_c = jnp.clip(node_id - offset, 0, n_level - 1)
         noh = idx_c[:, None] == jnp.arange(n_level, dtype=jnp.int32)[None, :]
         if cat_vec_g is not None:
-            # Per-NODE cat-ness of the winning (global) feature: tiny
-            # [n_level, F_global] one-hot select.
-            cat_n = jnp.any(
-                (feats[:, None]
-                 == jnp.arange(F_global, dtype=jnp.int32)[None, :])
-                & cat_vec_g[None, :], axis=1)
+            # Per-NODE cat-ness of the winning (global) feature. An
+            # n_level-sized gather from the replicated [F_global] table is
+            # fine — the gathers this file avoids are [R]-sized ones.
+            cat_n = jnp.take(cat_vec_g, feats, axis=0)
         else:
             cat_n = jnp.zeros(n_level, bool)
         table = ((feats << 12) | (bins << 3)
