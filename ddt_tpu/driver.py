@@ -166,9 +166,19 @@ class Driver:
                 )
                 log.info("resumed from checkpoint at round %d", start_round)
 
-        # --- validation-set state (host-side, incremental) ---
+        # --- validation-set state ---
+        # Two realisations of per-round eval scoring:
+        #   device (TPUDevice): validation predictions live ON DEVICE; each
+        #   round's packed tree handles are applied there (eval_round), so
+        #   the host never traverses the val set and the tree-fetch
+        #   pipeline stays on. Only the metric crosses to host — a scalar
+        #   when its f32 device twin exists, the raw-score vector for auc.
+        #   host (CPUDevice): incremental NumPy traversal per tree.
         metric_name = None
         val_raw = None
+        use_dev_eval = False
+        dev_metric = None
+        val_data_dev = val_y_dev = val_pred_dev = None
         if eval_set is not None:
             from ddt_tpu.utils.metrics import (
                 GREATER_IS_BETTER, default_metric, evaluate)
@@ -194,6 +204,17 @@ class Driver:
                 val_raw = ens.truncate(k).predict_raw_roundwise(
                     Xb_val, binned=True).astype(np.float32)
             best = -np.inf
+            if getattr(self.backend, "eval_round", None) is not None:
+                from ddt_tpu.utils.metrics import device_metric
+
+                use_dev_eval = True
+                dev_metric = (
+                    metric_name
+                    if device_metric(metric_name) is not None else None
+                )
+                val_data_dev = self.backend.upload(Xb_val)
+                val_y_dev = self.backend.upload_labels(y_val)
+                val_pred_dev = self.backend.load_pred(val_raw)
         elif early_stopping_rounds is not None:
             raise ValueError("early_stopping_rounds requires an eval_set")
 
@@ -202,8 +223,9 @@ class Driver:
         # One-deep fetch pipeline: a device backend's grow_tree returns an
         # unresolved handle; resolving it costs a device→host round-trip
         # (~tens of ms on a remote-attached chip), so we fetch tree k while
-        # tree k+1 computes. With an eval_set the tree is needed immediately
-        # for incremental validation scoring, so the pipeline is bypassed.
+        # tree k+1 computes. HOST-side eval needs each tree immediately for
+        # incremental scoring (pipeline bypassed); device-side eval applies
+        # the handle on device, so the pipeline stays on.
         pending: tuple | None = None   # (handle, ensemble slot)
 
         ph = (
@@ -247,6 +269,7 @@ class Driver:
 
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
+            round_handles: list = []
             with ph("grad"):
                 g, h = self.backend.grad_hess(pred, y_dev)
                 self._psync(h)
@@ -275,7 +298,12 @@ class Driver:
                 with ph("apply_delta"):
                     pred = self.backend.apply_delta(pred, delta, c)
                     self._psync(pred)
-                if val_raw is not None:
+                if use_dev_eval:
+                    round_handles.append(handle)
+                    if pending is not None:
+                        _store(*pending)
+                    pending = (handle, t_out)
+                elif val_raw is not None:
                     tree = _store(handle, t_out)
                     leaf = _traverse_one(
                         tree["feature"], tree["threshold_bin"],
@@ -296,11 +324,27 @@ class Driver:
                         _store(*pending)
                     pending = (handle, t_out)
                 t_out += 1
-            dt = time.perf_counter() - t0
 
             val_score = None
-            if val_raw is not None:
+            if use_dev_eval:
+                with ph("eval"):
+                    val_pred_dev, sc = self.backend.eval_round(
+                        val_data_dev, val_pred_dev, round_handles,
+                        val_y_dev, dev_metric)
+                if dev_metric is not None:
+                    val_score = float(sc)
+                else:           # metric has no f32 device twin (auc):
+                    # sc is a replicated copy of the predictions (safe to
+                    # resolve even on a multi-host mesh); pad rows dropped.
+                    val_score = evaluate(
+                        metric_name, y_val,
+                        np.asarray(sc)[: Xb_val.shape[0]],
+                    )
+            elif val_raw is not None:
                 val_score = evaluate(metric_name, y_val, val_raw)
+            dt = time.perf_counter() - t0
+
+            if val_score is not None:
                 if sign * val_score > best:
                     best = sign * val_score
                     self.best_round = rnd
@@ -332,6 +376,9 @@ class Driver:
                     rnd + 1, metric_name, self.best_score,
                     self.best_round + 1,
                 )
+                if pending is not None:   # flush BEFORE truncating: the
+                    _store(*pending)      # pending slot indexes the full-
+                    pending = None        # size arrays
                 ens = ens.truncate((self.best_round + 1) * C)
                 completed_rounds = self.best_round + 1
                 break

@@ -95,6 +95,51 @@ def default_metric(loss: str) -> str:
     return {"logloss": "logloss", "softmax": "logloss", "mse": "rmse"}[loss]
 
 
+def device_metric(name: str):
+    """jittable twin of a host metric for on-device eval_set scoring:
+    (y, raw, valid, allreduce) -> f32 scalar, masked by the pad-row
+    validity vector and psum-ready for sharded validation sets. Returns
+    None for metrics that must run on host (auc: rank sums overflow f32
+    well below real validation-set sizes — the Driver fetches the raw
+    scores and uses the f64 host implementation instead)."""
+    if name not in METRICS:
+        raise ValueError(f"unknown metric {name!r}; have {sorted(METRICS)}")
+    if name == "auc":
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    def fn(y, raw, valid, allreduce=lambda x: x):
+        v = valid.astype(jnp.float32)
+        n = allreduce(v.sum())
+        if name == "accuracy":
+            if raw.ndim == 2:
+                ok = raw.argmax(axis=1) == y.astype(jnp.int32)
+            else:
+                ok = (raw > 0) == (y > 0.5)
+            return allreduce((ok.astype(jnp.float32) * v).sum()) / n
+        yf = y.astype(jnp.float32)
+        if name == "rmse":
+            d = raw - yf
+            return jnp.sqrt(allreduce((d * d * v).sum()) / n)
+        # logloss (binary sigmoid / multiclass softmax), host formulas in f32
+        if raw.ndim == 2:
+            z = raw - raw.max(axis=1, keepdims=True)
+            e = jnp.exp(z)
+            p = e / e.sum(axis=1, keepdims=True)
+            # one-hot select of the true-class probability (no row gather)
+            yoh = y.astype(jnp.int32)[:, None] == jnp.arange(
+                raw.shape[1], dtype=jnp.int32)[None, :]
+            py = jnp.sum(jnp.where(yoh, p, 0.0), axis=1)
+            t = -jnp.log(jnp.clip(py, 1e-12, 1.0))
+        else:
+            p = jnp.clip(jax.nn.sigmoid(raw), 1e-12, 1.0 - 1e-12)
+            t = -jnp.where(yf > 0.5, jnp.log(p), jnp.log1p(-p))
+        return allreduce((t * v).sum()) / n
+
+    return fn
+
+
 def evaluate(name: str, y_true: np.ndarray, raw_score: np.ndarray) -> float:
     try:
         fn = METRICS[name]

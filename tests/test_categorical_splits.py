@@ -290,3 +290,26 @@ def test_mapper_without_identity_cat_bins_rejected():
     assert m_legacy.cat_features == ()
     p2 = api.predict(res.ensemble, X, mapper=m_legacy)
     np.testing.assert_allclose(p2, p, rtol=1e-6)
+
+
+def test_cat_eval_set_device_path():
+    """The DEVICE-side eval traversal (TPUDevice.eval_round) honors
+    one-vs-rest routing — twin of test_cat_eval_set_and_early_stopping,
+    which exercises the host path."""
+    X, y, cat = _ctr_matrix(rows=4000)
+    cfg = TrainConfig(n_trees=12, max_depth=4, n_bins=63, backend="tpu",
+                      cat_features=cat)
+    from ddt_tpu.data.quantizer import fit_bin_mapper as _fbm
+
+    m = _fbm(X, n_bins=63, cat_features=cat)
+    Xb = m.transform(X)
+    be = get_backend(cfg)
+    d = Driver(be, cfg, log_every=1)
+    ens = d.fit(Xb[:3000], y[:3000], eval_set=(Xb[3000:], y[3000:]),
+                eval_metric="auc")
+    from ddt_tpu.utils.metrics import evaluate
+
+    last = d.history[-1]
+    part = ens.truncate(last["round"])
+    want = evaluate("auc", y[3000:], part.predict_raw(Xb[3000:], binned=True))
+    np.testing.assert_allclose(last["valid_auc"], want, rtol=1e-6)

@@ -179,3 +179,129 @@ def test_driver_profile_phase_breakdown():
     rep = {r["phase"]: r for r in d.timer.report()}
     assert {"grad", "grow", "apply_delta", "fetch_tree"} <= set(rep)
     assert all(r["calls"] == 3 for r in rep.values())
+
+
+# ---------------------------------------------------------------------- #
+# device-side eval_set scoring (round-1 verdict Weak #5): TPUDevice keeps
+# validation predictions resident on device, applies packed tree handles
+# there, and computes f32 metric twins on device (auc stays on host).
+# ---------------------------------------------------------------------- #
+
+def test_device_metric_twins_match_host():
+    import jax.numpy as jnp
+
+    from ddt_tpu.utils.metrics import device_metric
+
+    rng = np.random.default_rng(0)
+    y = (rng.random(500) < 0.4).astype(np.float32)
+    s = rng.standard_normal(500).astype(np.float32)
+    valid = np.ones(600, bool); valid[500:] = False
+    sp = np.concatenate([s, rng.standard_normal(100).astype(np.float32)])
+    yp = np.concatenate([y, np.ones(100, np.float32)])
+    for name in ("logloss", "rmse", "accuracy"):
+        want = metrics.evaluate(name, y, s)
+        got = float(device_metric(name)(
+            jnp.asarray(yp), jnp.asarray(sp), jnp.asarray(valid)))
+        np.testing.assert_allclose(got, want, rtol=2e-6, err_msg=name)
+    # multiclass twins
+    ym = rng.integers(0, 3, 500).astype(np.int32)
+    sm = rng.standard_normal((500, 3)).astype(np.float32)
+    vm = np.ones(500, bool)
+    for name in ("logloss", "accuracy"):
+        want = metrics.evaluate(name, ym, sm)
+        got = float(device_metric(name)(
+            jnp.asarray(ym), jnp.asarray(sm), jnp.asarray(vm)))
+        np.testing.assert_allclose(got, want, rtol=2e-6, err_msg=name)
+    assert device_metric("auc") is None    # host-only (f32 rank overflow)
+
+
+def test_device_eval_matches_host_eval_history():
+    """TPU (device-resident eval, pipelined tree fetch) and CPU (host
+    incremental traversal) must record the same per-round validation
+    scores and pick the same best round — for the host-metric path (auc)
+    AND a device-metric path (logloss)."""
+    X, y = synthetic_binary(4000, n_features=10, seed=3)
+    Xt, yt, Xv, yv = _split(X, y)
+    for metric in ("auc", "logloss"):
+        kw = dict(n_trees=15, max_depth=4, n_bins=63, log_every=5,
+                  eval_set=(Xv, yv), eval_metric=metric)
+        rc = api.train(Xt, yt, backend="cpu", **kw)
+        rt = api.train(Xt, yt, backend="tpu", **kw)
+        hc = [r[f"valid_{metric}"] for r in rc.history
+              if f"valid_{metric}" in r]
+        ht = [r[f"valid_{metric}"] for r in rt.history
+              if f"valid_{metric}" in r]
+        assert len(ht) >= 3
+        np.testing.assert_allclose(hc, ht, rtol=2e-5)
+        assert rc.best_round == rt.best_round
+
+
+def test_device_eval_sharded_matches_single():
+    """Row-sharded validation scoring (psum'd device metric) equals the
+    single-device path."""
+    X, y = synthetic_binary(4000, n_features=10, seed=3)
+    Xt, yt, Xv, yv = _split(X, y)
+    kw = dict(n_trees=10, max_depth=4, n_bins=63, log_every=2,
+              eval_set=(Xv, yv), eval_metric="logloss")
+    r1 = api.train(Xt, yt, backend="tpu", **kw)
+    r2 = api.train(Xt, yt, backend="tpu", n_partitions=2, **kw)
+    h1 = [r["valid_logloss"] for r in r1.history if "valid_logloss" in r]
+    h2 = [r["valid_logloss"] for r in r2.history if "valid_logloss" in r]
+    np.testing.assert_allclose(h1, h2, rtol=2e-5)
+
+
+def test_device_eval_early_stopping_multiclass():
+    """Early stopping through the device-eval path truncates cleanly with
+    the tree-fetch pipeline active (the pending fetch must flush before
+    truncation)."""
+    X, y = synthetic_multiclass(1500, n_features=8, n_classes=3, seed=7)
+    Xt, yt, Xv, yv = _split(X, y)
+    res = api.train(
+        Xt, yt, backend="tpu", loss="softmax", n_classes=3,
+        n_trees=25, max_depth=3, n_bins=31,
+        eval_set=(Xv, yv), early_stopping_rounds=4, log_every=10 ** 9,
+    )
+    assert res.ensemble.n_trees % 3 == 0
+    assert res.ensemble.n_trees == (res.best_round + 1) * 3
+    # every stored tree is real (the pipeline flushed): no all-zero slots
+    assert (res.ensemble.is_leaf.sum(axis=1) > 0).all()
+
+
+def test_device_eval_missing_values_match_oracle():
+    """NaN rows follow learned default directions inside the device eval
+    traversal: the recorded score equals rescoring the truncated ensemble
+    with the (missing-aware) host oracle."""
+    rng = np.random.default_rng(0)
+    X, y = synthetic_binary(3000, n_features=8, seed=5)
+    X[rng.random(X.shape) < 0.1] = np.nan
+    res = api.train(
+        X[:2400], y[:2400], backend="tpu", missing_policy="learn",
+        n_trees=8, max_depth=4, n_bins=63,
+        eval_set=(X[2400:], y[2400:]), eval_metric="logloss", log_every=1,
+    )
+    last = res.history[-1]
+    part = res.ensemble.truncate(last["round"])
+    want = metrics.evaluate(
+        "logloss", y[2400:],
+        part.predict_raw(res.mapper.transform(X[2400:]), binned=True))
+    np.testing.assert_allclose(last["valid_logloss"], want, rtol=2e-5)
+
+
+def test_device_eval_pod_mesh_matches_single():
+    """Device eval over a (hosts, rows) pod mesh: the host-metric path
+    (auc) resolves a replicated gather — the row-sharded state itself is
+    not addressable-fetchable on real multi-host meshes — and the device
+    metric psums over both axes."""
+    X, y = synthetic_binary(4000, n_features=10, seed=3)
+    Xt, yt, Xv, yv = _split(X, y)
+    for metric in ("auc", "logloss"):
+        kw = dict(n_trees=8, max_depth=4, n_bins=63, log_every=2,
+                  eval_set=(Xv, yv), eval_metric=metric)
+        r1 = api.train(Xt, yt, backend="tpu", **kw)
+        rp = api.train(Xt, yt, backend="tpu", host_partitions=2,
+                       n_partitions=2, **kw)
+        h1 = [r[f"valid_{metric}"] for r in r1.history
+              if f"valid_{metric}" in r]
+        hp = [r[f"valid_{metric}"] for r in rp.history
+              if f"valid_{metric}" in r]
+        np.testing.assert_allclose(h1, hp, rtol=2e-5)
