@@ -91,7 +91,8 @@ class _DDTBase:
             **extra,
         )
 
-    def fit(self, X, y, eval_set=None, early_stopping_rounds=None):
+    def fit(self, X, y, eval_set=None, eval_metric=None,
+            early_stopping_rounds=None):
         from ddt_tpu import api
 
         X = np.asarray(X, np.float32)
@@ -102,12 +103,24 @@ class _DDTBase:
                         np.asarray(eval_set[1]))
         # early_stopping_rounds passes through even without an eval_set so
         # the Driver's "requires an eval_set" error reaches the user.
-        res = api.train(X, y, cfg, log_every=10 ** 9, eval_set=eval_set,
+        res = api.train(X, y, cfg, log_every=1 if eval_set is not None
+                        else 10 ** 9, eval_set=eval_set,
+                        eval_metric=eval_metric,
                         early_stopping_rounds=early_stopping_rounds)
         self.ensemble_ = res.ensemble
         self.mapper_ = res.mapper
         self.n_features_in_ = X.shape[1]
         self.feature_importances_ = self.ensemble_.feature_importances()
+        # sklearn/LightGBM-convention eval attributes (None / {} when no
+        # eval_set was given).
+        self.best_iteration_ = res.best_round
+        self.best_score_ = res.best_score
+        self.evals_result_ = {}
+        for rec in res.history:
+            for k, v in rec.items():
+                if k.startswith("valid_"):
+                    self.evals_result_.setdefault(
+                        k[len("valid_"):], []).append(v)
         return self
 
     def _fit_cfg_extra(self, y) -> dict:
@@ -131,7 +144,8 @@ class DDTClassifier(_DDTBase):
             return {"loss": "softmax", "n_classes": n}
         return {}
 
-    def fit(self, X, y, eval_set=None, early_stopping_rounds=None):
+    def fit(self, X, y, eval_set=None, eval_metric=None,
+            early_stopping_rounds=None):
         y = np.asarray(y)
         classes = np.unique(y)
         if len(classes) < 2:
@@ -154,7 +168,7 @@ class DDTClassifier(_DDTBase):
                     f"{np.unique(yv[unseen])!r}"
                 )
             eval_set = (eval_set[0], np.searchsorted(classes, yv))
-        super().fit(X, y_enc, eval_set=eval_set,
+        super().fit(X, y_enc, eval_set=eval_set, eval_metric=eval_metric,
                     early_stopping_rounds=early_stopping_rounds)
         self.classes_ = classes
         return self

@@ -188,3 +188,25 @@ def test_train_config_is_frozen():
     with pytest.raises(dataclasses.FrozenInstanceError):
         cfg.n_bins = 63
     assert cfg.replace(n_bins=63).n_bins == 63  # derivation still works
+
+
+def test_sklearn_facade_eval_attributes():
+    """LightGBM/sklearn-convention fitted eval attributes: best_iteration_,
+    best_score_, evals_result_ (per-round metric series), populated on both
+    backends (device eval on tpu, host eval on cpu)."""
+    from ddt_tpu.data.datasets import synthetic_binary
+    from ddt_tpu.sklearn import DDTClassifier
+
+    X, y = synthetic_binary(3000, n_features=8, seed=3)
+    for backend in ("cpu", "tpu"):
+        clf = DDTClassifier(n_trees=12, max_depth=4, n_bins=63,
+                            backend=backend)
+        clf.fit(X[:2400], y[:2400], eval_set=(X[2400:], y[2400:]),
+                eval_metric="auc", early_stopping_rounds=8)
+        assert clf.best_iteration_ is not None
+        assert clf.best_score_ == max(clf.evals_result_["auc"])
+        assert len(clf.evals_result_["auc"]) >= clf.best_iteration_ + 1
+    # no eval_set: attributes exist but are empty
+    clf = DDTClassifier(n_trees=3, max_depth=3, n_bins=63, backend="cpu")
+    clf.fit(X[:500], y[:500])
+    assert clf.best_iteration_ is None and clf.evals_result_ == {}
