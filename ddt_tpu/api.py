@@ -27,7 +27,8 @@ log = logging.getLogger("ddt_tpu.api")
 class TrainResult:
     ensemble: TreeEnsemble
     mapper: BinMapper | None      # None when the caller passed binned data
-    history: list[dict]           # per-round {round, train_loss, ms_per_round}
+    history: list[dict]           # {round, ms_per_round, train_loss @ log
+    #   cadence, valid_<metric> every round when an eval_set was given}
     best_round: int | None = None   # 0-based; set when an eval_set was given
     best_score: float | None = None
     # api.train never fits a categorical encoder itself (it sees only the

@@ -551,11 +551,8 @@ class TPUDevice(DeviceBackend):
         rax = self._row_axes
 
         def f(Xb, pred, y, valid, *packs):
-            cat_vec = None
-            if cfg.cat_features:
-                Fg = Xb.shape[1] * self.feature_partitions
-                cat_vec = jnp.zeros(Fg, bool).at[
-                    jnp.asarray(cfg.cat_features, jnp.int32)].set(True)
+            cat_vec = split_ops.cat_feature_vec(
+                cfg.cat_features, Xb.shape[1] * self.feature_partitions)
             for c, pk in enumerate(packs):
                 pred = stream_ops.apply_tree_pred(
                     Xb, pred,
@@ -565,7 +562,7 @@ class TPUDevice(DeviceBackend):
                     max_depth=cfg.max_depth,
                     learning_rate=cfg.learning_rate,
                     class_idx=c,
-                    missing_bin_value=cfg.n_bins - 1 if missing else -1,
+                    missing_bin_value=cfg.missing_bin_value,
                     cat_vec=cat_vec,
                     feature_axis_name=faxis,
                 )
@@ -679,28 +676,35 @@ class TPUDevice(DeviceBackend):
             )
         axis = self._row_axes if self.distributed else None
         softmax = cfg.loss == "softmax"
+        missing_val = cfg.missing_bin_value
+
+        def cat_vec_for(Xb):
+            return split_ops.cat_feature_vec(cfg.cat_features, Xb.shape[1])
 
         if kind == "hist":
-            def f(Xb, pred, y, valid, feat, thr, leaf):
+            def f(Xb, pred, y, valid, feat, thr, leaf, dl):
                 return stream_ops.stream_level_hist(
-                    Xb, pred, y, valid, feat, thr, leaf,
+                    Xb, pred, y, valid, feat, thr, leaf, dl,
                     depth=depth, n_bins=cfg.n_bins, loss=cfg.loss,
                     class_idx=class_idx, hist_impl=cfg.hist_impl,
                     input_dtype=self._input_dtype, axis_name=axis,
+                    missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
                 )
         elif kind == "leaf":
-            def f(Xb, pred, y, valid, feat, thr, leaf):
+            def f(Xb, pred, y, valid, feat, thr, leaf, dl):
                 return stream_ops.stream_leaf_gh(
-                    Xb, pred, y, valid, feat, thr, leaf,
+                    Xb, pred, y, valid, feat, thr, leaf, dl,
                     max_depth=depth, loss=cfg.loss, class_idx=class_idx,
                     axis_name=axis,
+                    missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
                 )
         elif kind == "update":
-            def f(Xb, pred, feat, thr, leaf, val):
+            def f(Xb, pred, feat, thr, leaf, val, dl):
                 return stream_ops.stream_update_pred(
-                    Xb, pred, feat, thr, leaf, val,
+                    Xb, pred, feat, thr, leaf, val, dl,
                     max_depth=depth, learning_rate=cfg.learning_rate,
                     class_idx=class_idx,
+                    missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
                 )
         else:  # pragma: no cover
             raise ValueError(kind)
@@ -709,11 +713,12 @@ class TPUDevice(DeviceBackend):
             rax = self._row_axes
             pred_spec = P(rax, None) if softmax else P(rax)
             if kind == "update":
-                in_specs = (P(rax, None), pred_spec, P(), P(), P(), P())
+                in_specs = (P(rax, None), pred_spec, P(), P(), P(), P(),
+                            P())
                 out_specs = pred_spec
             else:
                 in_specs = (P(rax, None), pred_spec, P(rax), P(rax),
-                            P(), P(), P())
+                            P(), P(), P(), P())
                 out_specs = P()
             f = jax.shard_map(f, mesh=self.mesh, in_specs=in_specs,
                               out_specs=out_specs)
@@ -725,24 +730,27 @@ class TPUDevice(DeviceBackend):
                           depth: int, class_idx: int = 0):
         """Partial histogram [2^depth, F, B, 2] for one uploaded chunk
         (device handle; includes the cross-shard psum). `tree` is the
-        partial tree's host arrays (feature, threshold_bin, is_leaf)."""
-        feat, thr, leaf = tree
+        partial tree's host arrays (feature, threshold_bin, is_leaf,
+        default_left)."""
+        feat, thr, leaf, dl = tree
         return self._stream_fn("hist", depth, class_idx)(
-            data, pred, y.y, y.valid, feat, thr, leaf)
+            data, pred, y.y, y.valid, feat, thr, leaf, dl)
 
     def stream_leaf_gh(self, data, pred, y: "LabelHandle", tree,
                        max_depth: int, class_idx: int = 0):
         """Final-level (G, H) aggregates [2^max_depth, 2] for one chunk."""
-        feat, thr, leaf = tree
+        feat, thr, leaf, dl = tree
         return self._stream_fn("leaf", max_depth, class_idx)(
-            data, pred, y.y, y.valid, feat, thr, leaf)
+            data, pred, y.y, y.valid, feat, thr, leaf, dl)
 
     def stream_update_pred(self, data, pred, tree_full, max_depth: int,
                            class_idx: int = 0):
-        """pred updated by a finished tree (donated; device-resident)."""
-        feat, thr, leaf, val = tree_full
+        """pred updated by a finished tree (donated; device-resident).
+        `tree_full` = (feature, threshold_bin, is_leaf, leaf_value,
+        default_left)."""
+        feat, thr, leaf, val, dl = tree_full
         return self._stream_fn("update", max_depth, class_idx)(
-            data, pred, feat, thr, leaf, val)
+            data, pred, feat, thr, leaf, val, dl)
 
     # ------------------------------------------------------------------ #
     # inference (TreeEnsemble.predict → gather+compare, row-sharded)

@@ -237,8 +237,9 @@ def main(argv: list[str] | None = None) -> int:
             "cmd": "train", "backend": args.backend, "rows": len(y),
             "trees": res.ensemble.n_trees, "depth": cfg.max_depth,
             "wallclock_s": round(dt, 3),
-            "final_train_loss": res.history[-1]["train_loss"]
-            if res.history else None,
+            "final_train_loss": next(
+                (r["train_loss"] for r in reversed(res.history)
+                 if "train_loss" in r), None),
             "model": args.out,
         }
         if res.best_score is not None:

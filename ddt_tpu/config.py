@@ -134,5 +134,12 @@ class TrainConfig:
     def n_leaves_max(self) -> int:
         return 2 ** self.max_depth
 
+    @property
+    def missing_bin_value(self) -> int:
+        """Bin index reserved for NaN rows under missing_policy='learn',
+        -1 otherwise (the single home of the reserved-bin convention —
+        every routing/traversal site reads this)."""
+        return self.n_bins - 1 if self.missing_policy == "learn" else -1
+
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

@@ -309,9 +309,7 @@ class Driver:
                         tree["feature"], tree["threshold_bin"],
                         tree["is_leaf"], Xb_val, cfg.max_depth,
                         default_left=tree["default_left"],
-                        missing_bin_value=(
-                            cfg.n_bins - 1
-                            if cfg.missing_policy == "learn" else -1),
+                        missing_bin_value=cfg.missing_bin_value,
                         cat_features=cfg.cat_features,
                     )
                     dv = cfg.learning_rate * tree["leaf_value"][leaf]
@@ -366,6 +364,16 @@ class Driver:
                     f"  valid_{metric_name}={val_score:.6f}"
                     if val_score is not None else "",
                 )
+            elif val_score is not None:
+                # Eval metrics are recorded EVERY round — the per-round
+                # series (sklearn evals_result_) must not depend on the
+                # logging knob. Train loss stays at log cadence: it costs
+                # a blocking device sync.
+                self.history.append({
+                    "round": rnd + 1,
+                    "ms_per_round": dt * 1e3,
+                    f"valid_{metric_name}": val_score,
+                })
 
             if (
                 early_stopping_rounds is not None

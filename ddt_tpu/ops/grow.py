@@ -112,10 +112,7 @@ def grow_tree(
     def allreduce(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
-    cat_vec_g = None                       # bool [F_global]
-    if cat_features:
-        cat_vec_g = jnp.zeros(F_global, bool).at[
-            jnp.asarray(cat_features, jnp.int32)].set(True)
+    cat_vec_g = S.cat_feature_vec(cat_features, F_global)  # bool [F_global]
     cat_vec = cat_vec_g                    # this shard's columns
 
     if feature_axis_name is not None:

@@ -18,7 +18,17 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=())
+def cat_feature_vec(cat_features, n_features: int) -> "jax.Array | None":
+    """bool [n_features] mask of one-vs-rest (categorical) columns, or
+    None when there are none — the single home of the cat_features →
+    vector convention (grow routing, streamed traversal, device eval all
+    read this)."""
+    if not cat_features:
+        return None
+    return jnp.zeros(n_features, bool).at[
+        jnp.asarray(cat_features, jnp.int32)].set(True)
+
+
 def node_totals(hist: jax.Array) -> tuple[jax.Array, jax.Array]:
     """(G, H) per node: sums over bins of feature 0 (any feature sums the
     same rows). float32 [n_nodes] each."""

@@ -39,13 +39,18 @@ def partial_node_index(
     threshold_bin: jax.Array,  # int32 [n_nodes_total]
     is_leaf: jax.Array,       # bool  [n_nodes_total]
     depth: int,
+    default_left: jax.Array | None = None,   # bool [n_nodes_total]
+    missing_bin_value: int = -1,
+    cat_vec: jax.Array | None = None,        # bool [F]: one-vs-rest cols
 ) -> jax.Array:
     """Level-local node per row at `depth` (-1 = frozen at an earlier
-    leaf). Gather-free: per unrolled level, the row's node's (feature,
-    threshold, is_leaf) are one-hot selected from the level's heap slice
-    (w = 2^d lanes), the winning column's value from the F lanes — exact
-    integer masked reductions, no scalar-loop gathers (ops/grow.py's
-    routing formulation; twin of streaming._traverse_partial)."""
+    leaf). Gather-free: per unrolled level, the row's node's routing
+    fields are one-hot selected from the level's heap slice (w = 2^d
+    lanes) as ONE packed table, the winning column's value from the F
+    lanes — exact integer masked reductions, no scalar-loop gathers
+    (ops/grow.py's routing formulation incl. categorical one-vs-rest and
+    reserved-NaN-bin default directions; twin of
+    streaming._traverse_partial)."""
     R, F = Xb.shape
     Xi = Xb.astype(jnp.int32)
     node = jnp.zeros(R, jnp.int32)
@@ -58,16 +63,37 @@ def partial_node_index(
         sl = slice(offset, offset + w)
         leaf_r = jnp.any(noh & is_leaf[sl][None, :], axis=1)
         frozen = frozen | leaf_r
-        # Packed (feat << 10 | thr) select: one masked reduction for both
-        # tables (thr < 1024 by the n_bins <= 512 contract).
-        packed = (feature[sl] << 10) | threshold_bin[sl]
+        # Packed (feat<<12 | thr<<3 | cat<<2 | dl<<1) select: one masked
+        # reduction for every routing table (thr < 512 by the n_bins
+        # contract; leaves carry feature -1, clamped — frozen rows never
+        # route anyway).
+        f_lvl = jnp.maximum(feature[sl], 0)
+        cat_lvl = (
+            jnp.take(cat_vec, f_lvl, axis=0) if cat_vec is not None
+            else jnp.zeros(w, bool)
+        )
+        dl_lvl = (
+            default_left[sl] if default_left is not None
+            else jnp.zeros(w, bool)
+        )
+        packed = ((f_lvl << 12) | (threshold_bin[sl] << 3)
+                  | (cat_lvl.astype(jnp.int32) << 2)
+                  | (dl_lvl.astype(jnp.int32) << 1))
         pr = jnp.sum(jnp.where(noh, packed[None, :], 0), axis=1)
-        feat_r = pr >> 10                       # -1 stays -1 (arith shift)
-        thr_r = pr & 0x3FF
+        feat_r = pr >> 12
+        thr_r = (pr >> 3) & 0x1FF
+        cat_r = ((pr >> 2) & 1).astype(bool)
+        dl_r = ((pr >> 1) & 1).astype(bool)
         foh = jax.lax.broadcasted_iota(
             jnp.int32, (1, F), 1) == feat_r[:, None]
         fv = jnp.sum(jnp.where(foh, Xi, 0), axis=1)
-        node = jnp.where(frozen, node, 2 * node + 1 + (fv > thr_r))
+        go_right = fv > thr_r
+        if cat_vec is not None:
+            go_right = jnp.where(cat_r, fv != thr_r, go_right)
+        if missing_bin_value >= 0:
+            go_right = jnp.where(fv == missing_bin_value, ~dl_r, go_right)
+        node = jnp.where(
+            frozen, node, 2 * node + 1 + go_right.astype(jnp.int32))
     offset = (1 << depth) - 1
     return jnp.where(frozen, -1, node - offset).astype(jnp.int32)
 
@@ -96,6 +122,7 @@ def stream_level_hist(
     feature: jax.Array,
     threshold_bin: jax.Array,
     is_leaf: jax.Array,
+    default_left: jax.Array | None = None,
     *,
     depth: int,
     n_bins: int,
@@ -104,10 +131,14 @@ def stream_level_hist(
     hist_impl: str = "auto",
     input_dtype=jnp.bfloat16,
     axis_name=None,
+    missing_bin_value: int = -1,
+    cat_vec: jax.Array | None = None,
 ) -> jax.Array:
     """One chunk's level-`depth` partial histogram [2^depth, F, B, 2]
     (psum'd over row shards when axis_name is set)."""
-    ni = partial_node_index(Xb, feature, threshold_bin, is_leaf, depth)
+    ni = partial_node_index(
+        Xb, feature, threshold_bin, is_leaf, depth, default_left,
+        missing_bin_value=missing_bin_value, cat_vec=cat_vec)
     g, h = chunk_grads(pred, y, valid, loss, class_idx)
     out = H.build_histograms(
         Xb, g, h, ni, 1 << depth, n_bins,
@@ -126,15 +157,20 @@ def stream_leaf_gh(
     feature: jax.Array,
     threshold_bin: jax.Array,
     is_leaf: jax.Array,
+    default_left: jax.Array | None = None,
     *,
     max_depth: int,
     loss: str,
     class_idx: int = 0,
     axis_name=None,
+    missing_bin_value: int = -1,
+    cat_vec: jax.Array | None = None,
 ) -> jax.Array:
     """Final-level (G, H) aggregates for one chunk: f32 [2^max_depth, 2]
     via the one-hot matmul formulation (ops/grow.py's final level)."""
-    ni = partial_node_index(Xb, feature, threshold_bin, is_leaf, max_depth)
+    ni = partial_node_index(
+        Xb, feature, threshold_bin, is_leaf, max_depth, default_left,
+        missing_bin_value=missing_bin_value, cat_vec=cat_vec)
     g, h = chunk_grads(pred, y, valid, loss, class_idx)
     n_last = 1 << max_depth
     act = ni >= 0
@@ -249,16 +285,20 @@ def stream_update_pred(
     threshold_bin: jax.Array,
     is_leaf: jax.Array,
     leaf_value: jax.Array,
+    default_left: jax.Array | None = None,
     *,
     max_depth: int,
     learning_rate: float,
     class_idx: int = 0,
+    missing_bin_value: int = -1,
+    cat_vec: jax.Array | None = None,
 ) -> jax.Array:
     """pred += lr * leaf_value[leaf slot] for one finished tree (per-chunk
-    boosting-state update, on device; ordinal splits — streaming rejects
-    cat/missing configs at its entry)."""
+    boosting-state update, on device; full routing semantics)."""
     return apply_tree_pred(
         Xb, pred, feature, threshold_bin, is_leaf, leaf_value,
+        default_left,
         max_depth=max_depth, learning_rate=learning_rate,
-        class_idx=class_idx,
+        class_idx=class_idx, missing_bin_value=missing_bin_value,
+        cat_vec=cat_vec,
     )

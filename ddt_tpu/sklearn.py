@@ -103,8 +103,7 @@ class _DDTBase:
                         np.asarray(eval_set[1]))
         # early_stopping_rounds passes through even without an eval_set so
         # the Driver's "requires an eval_set" error reaches the user.
-        res = api.train(X, y, cfg, log_every=1 if eval_set is not None
-                        else 10 ** 9, eval_set=eval_set,
+        res = api.train(X, y, cfg, log_every=10 ** 9, eval_set=eval_set,
                         eval_metric=eval_metric,
                         early_stopping_rounds=early_stopping_rounds)
         self.ensemble_ = res.ensemble

@@ -42,12 +42,38 @@ log = logging.getLogger("ddt_tpu.streaming")
 ChunkFn = Callable[[int], tuple[np.ndarray, np.ndarray]]
 
 
+def _go_right(
+    fv: np.ndarray,           # winning-column bin values for the live rows
+    nodes: np.ndarray,        # their heap slots
+    feature: np.ndarray,
+    threshold_bin: np.ndarray,
+    default_left: np.ndarray | None,
+    missing_bin_value: int,
+    cat_features: tuple,
+) -> np.ndarray:
+    """Routing decision with the full split semantics (ordinal,
+    categorical one-vs-rest, reserved-NaN-bin default direction) — the
+    single host home of the streamed routing rule."""
+    thr = threshold_bin[nodes]
+    go_right = fv > thr
+    if cat_features:
+        cat = np.isin(feature[nodes], cat_features)
+        go_right = np.where(cat, fv != thr, go_right)
+    if missing_bin_value >= 0:
+        go_right = np.where(fv == missing_bin_value,
+                            ~default_left[nodes], go_right)
+    return go_right
+
+
 def _traverse_partial(
     Xb: np.ndarray,
     feature: np.ndarray,
     threshold_bin: np.ndarray,
     is_leaf: np.ndarray,
     depth: int,
+    default_left: np.ndarray | None = None,
+    missing_bin_value: int = -1,
+    cat_features: tuple = (),
 ) -> np.ndarray:
     """Stateless node assignment at `depth`: heap slot per row, or -1 when the
     row froze at a leaf above this level. Mirrors the in-memory grow loop's
@@ -59,7 +85,9 @@ def _traverse_partial(
         live = ~frozen & ~is_leaf[node]
         frozen |= is_leaf[node]
         f = feature[node[live]]
-        go_right = Xb[live, f].astype(np.int64) > threshold_bin[node[live]]
+        fv = Xb[live, f].astype(np.int64)
+        go_right = _go_right(fv, node[live], feature, threshold_bin,
+                             default_left, missing_bin_value, cat_features)
         node[live] = 2 * node[live] + 1 + go_right
     offset = (1 << depth) - 1
     out = (node - offset).astype(np.int32)
@@ -76,6 +104,7 @@ def _apply_level_splits(
     is_leaf: np.ndarray,
     leaf_value: np.ndarray,
     split_gain: np.ndarray,
+    default_left: np.ndarray | None = None,
 ) -> None:
     """Level-`depth` split decisions from the accumulated histogram,
     written into the node arrays in place. The SINGLE home of the
@@ -86,8 +115,13 @@ def _apply_level_splits(
     n_level = 1 << depth
     offset = n_level - 1
     G, H = node_totals(hist)
-    gains, feats, bins, _ = best_splits(
-        hist, cfg.reg_lambda, cfg.min_child_weight)
+    cat_mask = None
+    if cfg.cat_features:
+        cat_mask = np.zeros(hist.shape[1], bool)
+        cat_mask[list(cfg.cat_features)] = True
+    gains, feats, bins, dls = best_splits(
+        hist, cfg.reg_lambda, cfg.min_child_weight,
+        missing_bin=cfg.missing_policy == "learn", cat_mask=cat_mask)
     value = np.where(H > 0, -G / (H + cfg.reg_lambda), 0.0).astype(
         np.float32)
     do_split = (gains > cfg.min_split_gain) & np.isfinite(gains) & (H > 0)
@@ -97,6 +131,8 @@ def _apply_level_splits(
             feature[slot] = feats[i]
             threshold_bin[slot] = bins[i]
             split_gain[slot] = gains[i]
+            if default_left is not None:
+                default_left[slot] = dls[i]
         else:
             is_leaf[slot] = True
             leaf_value[slot] = value[i]
@@ -134,19 +170,10 @@ def fit_streaming(
     device for the whole run (ops/stream.py; supports softmax and
     n_partitions/host_partitions > 1). Host backends stream the original
     host formulation (binary/mse). Both are bit-identical to the in-memory
-    Driver on the same data (tests/test_streaming.py).
+    Driver on the same data, including missing_policy='learn' (reserved
+    NaN bin + learned default directions) and categorical one-vs-rest
+    splits (tests/test_streaming.py).
     """
-    if cfg.missing_policy != "zero":
-        raise NotImplementedError(
-            "streaming does not implement missing_policy='learn' yet — "
-            "failing loudly beats silently treating the reserved NaN bin "
-            "as the largest value bin"
-        )
-    if cfg.cat_features:
-        raise NotImplementedError(
-            "streaming does not implement categorical one-vs-rest splits "
-            "yet — failing loudly beats silently training them as ordinal"
-        )
     if backend is None:
         from ddt_tpu.backends import get_backend
 
@@ -189,6 +216,8 @@ def fit_streaming(
     ens = empty_ensemble(
         cfg.n_trees * C, cfg.max_depth, F, cfg.learning_rate, bs,
         cfg.loss, cfg.n_classes,
+        missing_bin=cfg.missing_policy == "learn", n_bins=cfg.n_bins,
+        cat_features=cfg.cat_features,
     )
     if device:
         return _fit_streaming_device(
@@ -202,6 +231,7 @@ def fit_streaming(
         if cache_preds else None
     )
 
+    missing_val = cfg.missing_bin_value
     for t in range(cfg.n_trees):
         # Grow one tree level-by-level; histograms accumulate across chunks.
         feature = np.full(cfg.n_nodes_total, -1, np.int32)
@@ -209,6 +239,7 @@ def fit_streaming(
         is_leaf = np.zeros(cfg.n_nodes_total, bool)
         leaf_value = np.zeros(cfg.n_nodes_total, np.float32)
         split_gain = np.zeros(cfg.n_nodes_total, np.float32)
+        default_left = np.zeros(cfg.n_nodes_total, bool)
 
         def chunk_grads(c: int, Xc, yc):
             pred_c = preds[c] if preds is not None else _rescore(
@@ -216,6 +247,9 @@ def fit_streaming(
             )
             return grad_hess(pred_c, np.asarray(yc), cfg.loss)
 
+        route_kw = dict(default_left=default_left,
+                        missing_bin_value=missing_val,
+                        cat_features=cfg.cat_features)
         for depth in range(cfg.max_depth):
             n_level = 1 << depth
             offset = n_level - 1
@@ -223,7 +257,7 @@ def fit_streaming(
             for c in range(n_chunks):
                 Xc, yc = chunk_fn(c)
                 ni = _traverse_partial(
-                    Xc, feature, threshold_bin, is_leaf, depth
+                    Xc, feature, threshold_bin, is_leaf, depth, **route_kw
                 )
                 g, h = chunk_grads(c, Xc, yc)
                 data = backend.upload(Xc)
@@ -232,7 +266,8 @@ def fit_streaming(
                 )
                 hist = part if hist is None else hist + part
             _apply_level_splits(hist, cfg, depth, feature, threshold_bin,
-                                is_leaf, leaf_value, split_gain)
+                                is_leaf, leaf_value, split_gain,
+                                default_left)
 
         # Final level: per-terminal (G, H) aggregates streamed the same way.
         n_last = 1 << cfg.max_depth
@@ -241,7 +276,8 @@ def fit_streaming(
         for c in range(n_chunks):
             Xc, yc = chunk_fn(c)
             ni = _traverse_partial(
-                Xc, feature, threshold_bin, is_leaf, cfg.max_depth
+                Xc, feature, threshold_bin, is_leaf, cfg.max_depth,
+                **route_kw
             )
             g, h = chunk_grads(c, Xc, yc)
             act = ni >= 0
@@ -254,6 +290,8 @@ def fit_streaming(
         ens.is_leaf[t] = is_leaf
         ens.leaf_value[t] = leaf_value
         ens.split_gain[t] = split_gain
+        if ens.default_left is not None:
+            ens.default_left[t] = default_left
 
         if preds is not None:
             # leaf slot per row = heap slot where traversal stopped: either
@@ -262,7 +300,8 @@ def fit_streaming(
             for c in range(n_chunks):
                 Xc, _ = chunk_fn(c)
                 slot = _leaf_slot(
-                    Xc, feature, threshold_bin, is_leaf, cfg.max_depth
+                    Xc, feature, threshold_bin, is_leaf, cfg.max_depth,
+                    **route_kw
                 )
                 preds[c] += cfg.learning_rate * leaf_value[slot]
 
@@ -322,7 +361,8 @@ def _fit_streaming_device(
             is_leaf = np.zeros(cfg.n_nodes_total, bool)
             leaf_value = np.zeros(cfg.n_nodes_total, np.float32)
             split_gain = np.zeros(cfg.n_nodes_total, np.float32)
-            tree = (feature, threshold_bin, is_leaf)
+            default_left = np.zeros(cfg.n_nodes_total, bool)
+            tree = (feature, threshold_bin, is_leaf, default_left)
 
             for depth in range(cfg.max_depth):
                 hist = None
@@ -330,7 +370,7 @@ def _fit_streaming_device(
                     hist = part if hist is None else hist + part
                 _apply_level_splits(hist, cfg, depth, feature,
                                     threshold_bin, is_leaf, leaf_value,
-                                    split_gain)
+                                    split_gain, default_left)
 
             # Final level: streamed (G, H) aggregates.
             GH = None
@@ -340,12 +380,15 @@ def _fit_streaming_device(
                                 leaf_value)
 
             round_trees.append(
-                (feature, threshold_bin, is_leaf, leaf_value))
+                (feature, threshold_bin, is_leaf, leaf_value,
+                 default_left))
             ens.feature[t_out] = feature
             ens.threshold_bin[t_out] = threshold_bin
             ens.is_leaf[t_out] = is_leaf
             ens.leaf_value[t_out] = leaf_value
             ens.split_gain[t_out] = split_gain
+            if ens.default_left is not None:
+                ens.default_left[t_out] = default_left
             t_out += 1
 
         # One update pass: apply all of the round's class trees to the
@@ -366,14 +409,18 @@ def _fit_streaming_device(
     return ens
 
 
-def _leaf_slot(Xb, feature, threshold_bin, is_leaf, max_depth) -> np.ndarray:
+def _leaf_slot(Xb, feature, threshold_bin, is_leaf, max_depth,
+               default_left=None, missing_bin_value=-1,
+               cat_features=()) -> np.ndarray:
     """Heap slot where each row's traversal of one tree terminates."""
     R = Xb.shape[0]
     node = np.zeros(R, np.int64)
     for _ in range(max_depth):
         live = ~is_leaf[node]
         f = feature[node[live]]
-        go_right = Xb[live, f].astype(np.int64) > threshold_bin[node[live]]
+        fv = Xb[live, f].astype(np.int64)
+        go_right = _go_right(fv, node[live], feature, threshold_bin,
+                             default_left, missing_bin_value, cat_features)
         node[live] = 2 * node[live] + 1 + go_right
     return node
 
@@ -382,13 +429,5 @@ def _rescore(ens: TreeEnsemble, n_trees_done: int, Xb, bs) -> np.ndarray:
     """Stateless pred of the first n_trees_done trees (cache_preds=False)."""
     if n_trees_done == 0:
         return np.full(Xb.shape[0], bs, np.float32)
-    import dataclasses
-
-    part = dataclasses.replace(
-        ens,
-        feature=ens.feature[:n_trees_done],
-        threshold_bin=ens.threshold_bin[:n_trees_done],
-        is_leaf=ens.is_leaf[:n_trees_done],
-        leaf_value=ens.leaf_value[:n_trees_done],
-    )
-    return part.predict_raw(Xb, binned=True).astype(np.float32)
+    return ens.truncate(n_trees_done).predict_raw(
+        Xb, binned=True).astype(np.float32)

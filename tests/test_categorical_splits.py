@@ -194,12 +194,33 @@ def test_cli_criteo_onehot(tmp_path, capsys):
     assert b.ensemble.cat_features[0] == 13
 
 
-def test_streaming_refuses_cat():
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_streaming_cat_matches_inmemory(backend):
+    """Streamed training with categorical one-vs-rest splits grows trees
+    bit-identical to the in-memory Driver (host and device stream paths
+    route 'bin == k' semantics per chunk)."""
     from ddt_tpu.streaming import fit_streaming
 
-    cfg = TrainConfig(backend="cpu", cat_features=(1,))
-    with pytest.raises(NotImplementedError, match="categorical"):
-        fit_streaming(lambda c: (None, None), 1, cfg)
+    X, y, cat = _ctr_matrix(rows=2048)
+    m = fit_bin_mapper(X, n_bins=63, cat_features=cat)
+    Xb = m.transform(X)
+    cfg = TrainConfig(n_trees=4, max_depth=4, n_bins=63, backend=backend,
+                      cat_features=cat)
+    full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
+
+    def chunk_fn(c):
+        s = c * 512
+        return Xb[s:s + 512], y[s:s + 512]
+
+    streamed = fit_streaming(chunk_fn, 4, cfg)
+    np.testing.assert_array_equal(full.feature, streamed.feature)
+    np.testing.assert_array_equal(full.threshold_bin,
+                                  streamed.threshold_bin)
+    np.testing.assert_array_equal(full.is_leaf, streamed.is_leaf)
+    np.testing.assert_allclose(full.leaf_value, streamed.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+    used = full.feature[(~full.is_leaf) & (full.feature >= 0)]
+    assert np.isin(used, cat).any()    # a cat split was actually exercised
 
 
 def test_cat_eval_set_and_early_stopping():

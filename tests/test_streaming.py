@@ -133,3 +133,35 @@ def test_streaming_device_softmax_matches_inmemory():
     np.testing.assert_array_equal(full.is_leaf, streamed.is_leaf)
     np.testing.assert_allclose(full.leaf_value, streamed.leaf_value,
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("backend_flag", ["cpu", "tpu"])
+def test_streaming_missing_matches_inmemory(backend_flag):
+    """missing_policy='learn' through the streamed paths: NaN rows occupy
+    the reserved bin and follow learned default directions in the per-chunk
+    traversal — trees bit-identical to the in-memory Driver, and the
+    returned ensemble carries the missing_bin metadata."""
+    rng = np.random.default_rng(3)
+    X, y = datasets.synthetic_binary(4096, n_features=10, seed=21)
+    X[rng.random(X.shape) < 0.15] = np.nan
+    from ddt_tpu.data.quantizer import fit_bin_mapper
+
+    m = fit_bin_mapper(X, n_bins=31, missing_policy="learn")
+    Xb = m.transform(X)
+    cfg = TrainConfig(n_trees=4, max_depth=4, n_bins=31,
+                      backend=backend_flag, missing_policy="learn")
+
+    full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
+
+    chunk_fn, n_chunks = _chunked(Xb, y, 512)
+    streamed = fit_streaming(chunk_fn, n_chunks, cfg)
+
+    np.testing.assert_array_equal(full.feature, streamed.feature)
+    np.testing.assert_array_equal(full.threshold_bin, streamed.threshold_bin)
+    np.testing.assert_array_equal(full.is_leaf, streamed.is_leaf)
+    np.testing.assert_array_equal(full.default_left, streamed.default_left)
+    np.testing.assert_allclose(full.leaf_value, streamed.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+    assert streamed.missing_bin
+    # a learned default direction was actually exercised
+    assert streamed.default_left[~streamed.is_leaf].any()
