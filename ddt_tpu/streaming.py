@@ -42,6 +42,49 @@ log = logging.getLogger("ddt_tpu.streaming")
 ChunkFn = Callable[[int], tuple[np.ndarray, np.ndarray]]
 
 
+def binned_chunks(chunk_fn: ChunkFn, mapper, cfg: TrainConfig) -> ChunkFn:
+    """Adapt a RAW-float chunk source into the binned source
+    fit_streaming consumes, via a fitted BinMapper (see
+    data/quantizer.fit_bin_mapper_streaming for fitting one without
+    materialising the dataset). Purity is preserved: any chunk still
+    regenerates anywhere, bins included — which also means every re-read
+    re-bins; callers whose binned chunks fit somewhere can cache them.
+
+    `cfg` is required so the mapper↔config consistency guards that
+    api.train enforces hold on this path too (a mismatched mapper trains
+    a silently wrong model, not a crashing one)."""
+    if mapper.n_bins != cfg.n_bins:
+        raise ValueError(
+            f"mapper was fitted with n_bins={mapper.n_bins} but "
+            f"cfg.n_bins={cfg.n_bins}"
+        )
+    if (cfg.missing_policy == "learn") != mapper.missing_bin:
+        raise ValueError(
+            f"mapper.missing_bin={mapper.missing_bin} but "
+            f"cfg.missing_policy={cfg.missing_policy!r}; refit the mapper "
+            "with the same policy"
+        )
+    if cfg.cat_features:
+        bad = mapper.non_identity_columns(cfg.cat_features)
+        if bad:
+            raise ValueError(
+                f"cat_features {bad} were not identity-binned by this "
+                "mapper; refit it with "
+                f"cat_features={tuple(sorted(cfg.cat_features))}"
+            )
+
+    def f(c: int):
+        X, y = chunk_fn(c)
+        return mapper.transform(np.asarray(X, np.float32)), y
+
+    # Side-channel accessors so fit_streaming's label-only pass 0 and
+    # shape probe skip the (expensive) binning of chunks they would
+    # otherwise transform and throw away.
+    f.labels = lambda c: chunk_fn(c)[1]
+    f.n_features = mapper.n_features
+    return f
+
+
 def _go_right(
     fv: np.ndarray,           # winning-column bin values for the live rows
     nodes: np.ndarray,        # their heap slots
@@ -194,8 +237,12 @@ def fit_streaming(
     y_sum, y_cnt = 0.0, 0
     chunk_lens = []
     y_dev = []
+    # binned_chunks-style adapters expose a label-only accessor so this
+    # pass doesn't pay for binning feature matrices it never reads.
+    labels_of = getattr(chunk_fn, "labels", None) or (
+        lambda c: chunk_fn(c)[1])
     for c in range(n_chunks):
-        _, yc = chunk_fn(c)
+        yc = labels_of(c)
         y_sum += float(np.sum(yc))
         y_cnt += len(yc)
         chunk_lens.append(len(yc))
@@ -209,8 +256,9 @@ def fit_streaming(
         bs = 0.0
     else:
         bs = float(mean)
-    Xb0, _ = chunk_fn(0)
-    F = Xb0.shape[1]
+    F = getattr(chunk_fn, "n_features", None)
+    if F is None:
+        F = chunk_fn(0)[0].shape[1]
 
     C = cfg.n_classes if cfg.loss == "softmax" else 1
     ens = empty_ensemble(

@@ -65,3 +65,130 @@ def test_save_load_roundtrip():
     assert np.array_equal(m2.edges, mapper.edges)
     assert m2.n_bins == mapper.n_bins
     assert np.array_equal(m2.transform(X), mapper.transform(X))
+
+
+# ---------------------------------------------------------------------- #
+# round-2 L7 additions: streamed quantile fit + device-side transform
+# ---------------------------------------------------------------------- #
+
+def test_streaming_fit_equals_inmemory_with_full_sample():
+    """With max_sample >= total rows the reservoir keeps every row, so the
+    streamed fit's edges must EQUAL the in-memory fit's (np.quantile is
+    order-invariant)."""
+    from ddt_tpu.data.quantizer import (
+        fit_bin_mapper, fit_bin_mapper_streaming)
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((4000, 6)).astype(np.float32)
+
+    def chunk_fn(c):
+        return X[c * 1000:(c + 1) * 1000], None
+
+    m_full = fit_bin_mapper(X, n_bins=31, max_sample=4000)
+    m_str = fit_bin_mapper_streaming(chunk_fn, 4, n_bins=31,
+                                     max_sample=4000)
+    np.testing.assert_array_equal(m_full.edges, m_str.edges)
+
+
+def test_streaming_fit_subsampled_deterministic_and_close():
+    from ddt_tpu.data.quantizer import (
+        fit_bin_mapper, fit_bin_mapper_streaming)
+
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((8000, 4)).astype(np.float32)
+
+    def chunk_fn(c):
+        return X[c * 1000:(c + 1) * 1000], None
+
+    m1 = fit_bin_mapper_streaming(chunk_fn, 8, n_bins=31, max_sample=2000,
+                                  seed=7)
+    m2 = fit_bin_mapper_streaming(chunk_fn, 8, n_bins=31, max_sample=2000,
+                                  seed=7)
+    np.testing.assert_array_equal(m1.edges, m2.edges)   # deterministic
+    m_full = fit_bin_mapper(X, n_bins=31, max_sample=8000)
+    # a 25% uniform sample tracks the true quantiles closely on N(0,1)
+    fin = np.isfinite(m_full.edges)
+    assert np.abs(m1.edges[fin] - m_full.edges[fin]).max() < 0.25
+
+
+def test_streaming_fit_trains_end_to_end():
+    """Raw-float chunks -> streamed mapper fit -> binned_chunks adapter ->
+    fit_streaming: equals in-memory training on the same mapper's bins."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.data.datasets import synthetic_binary
+    from ddt_tpu.data.quantizer import fit_bin_mapper_streaming
+    from ddt_tpu.driver import Driver
+    from ddt_tpu.streaming import binned_chunks, fit_streaming
+
+    X, y = synthetic_binary(4096, n_features=8, seed=3)
+
+    def raw_fn(c):
+        s = c * 1024
+        return X[s:s + 1024], y[s:s + 1024]
+
+    m = fit_bin_mapper_streaming(raw_fn, 4, n_bins=31, max_sample=10_000)
+    cfg = TrainConfig(n_trees=3, max_depth=4, n_bins=31, backend="cpu")
+    streamed = fit_streaming(binned_chunks(raw_fn, m, cfg), 4, cfg)
+    full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(
+        m.transform(X), y)
+    np.testing.assert_array_equal(full.feature, streamed.feature)
+    np.testing.assert_array_equal(full.threshold_bin,
+                                  streamed.threshold_bin)
+
+
+def test_binned_chunks_validates_mapper_against_cfg():
+    """The raw-chunk adapter enforces the same mapper-consistency guards
+    as api.train: n_bins, missing policy, and identity-binned cat columns
+    (a mismatched mapper silently corrupts training otherwise)."""
+    import pytest
+
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.data.quantizer import fit_bin_mapper
+    from ddt_tpu.streaming import binned_chunks
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((500, 5)).astype(np.float32)
+    m = fit_bin_mapper(X, n_bins=31)
+    raw_fn = lambda c: (X, np.zeros(500))  # noqa: E731
+    with pytest.raises(ValueError, match="n_bins"):
+        binned_chunks(raw_fn, m, TrainConfig(n_bins=63))
+    with pytest.raises(ValueError, match="missing"):
+        binned_chunks(raw_fn, m, TrainConfig(n_bins=31,
+                                             missing_policy="learn"))
+    with pytest.raises(ValueError, match="identity-binned"):
+        binned_chunks(raw_fn, m, TrainConfig(n_bins=31, cat_features=(1,)))
+    f = binned_chunks(raw_fn, m, TrainConfig(n_bins=31))
+    assert f.n_features == 5
+    np.testing.assert_array_equal(f.labels(0), np.zeros(500))
+
+
+def test_device_transform_bit_identical():
+    """ops/quantize.transform_binned == BinMapper.transform on every edge
+    case: NaN, +/-inf, exact edge hits, duplicate-edge runs, identity
+    (categorical) columns, reserved NaN bin, and the row-block seam."""
+    from ddt_tpu.data.quantizer import fit_bin_mapper
+
+    rng = np.random.default_rng(2)
+    for policy in ("zero", "learn"):
+        X = rng.standard_normal((3000, 5)).astype(np.float32)
+        X[:, 2] = np.round(np.abs(X[:, 2]) * 3)     # few distinct values
+        X[:, 4] = rng.integers(0, 20, 3000)         # identity column
+        X[rng.random(X.shape) < 0.05] = np.nan
+        X[0, 0] = np.inf
+        X[1, 0] = -np.inf
+        m = fit_bin_mapper(X, n_bins=31, missing_policy=policy,
+                           cat_features=(4,))
+        X[5, 1] = m.edges[1, 3]                     # exact edge hit
+        want = m.transform(X)
+        got = m.transform_device(X)
+        np.testing.assert_array_equal(want, got)
+    # row-block seam: R not a multiple of the block
+    from ddt_tpu.ops.quantize import transform_binned
+    import jax.numpy as jnp
+
+    Xb = rng.standard_normal((700, 3)).astype(np.float32)
+    m = fit_bin_mapper(Xb, n_bins=15)
+    got = np.asarray(transform_binned(
+        jnp.asarray(Xb), jnp.asarray(m.edges), n_bins=15, row_block=256))
+    np.testing.assert_array_equal(m.transform(Xb), got)
