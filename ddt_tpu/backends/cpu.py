@@ -35,15 +35,18 @@ class CPUDevice(DeviceBackend):
     def __init__(self, cfg: TrainConfig, use_native: bool | None = None):
         super().__init__(cfg)
         self._native = None          # histogram kernel
-        self._native_split = None    # split-gain kernel
+        self._native_split = None    # split-gain kernel (plain contract)
+        self._native_split_full = None  # full contract (mask/missing/cat)
         self._native_traverse = None  # batch predict traversal
         if use_native is not False:
             try:
                 from ddt_tpu.native import (
-                    histogram_native, split_gain_native, traverse_native)
+                    histogram_native, split_gain_full_native,
+                    split_gain_native, traverse_native)
 
                 self._native = histogram_native
                 self._native_split = split_gain_native
+                self._native_split_full = split_gain_full_native
                 self._native_traverse = traverse_native
             except Exception:
                 if use_native:  # explicitly requested → surface the failure
@@ -98,10 +101,16 @@ class CPUDevice(DeviceBackend):
 
     def grow_tree(self, data, g, h,
                   feature_mask=None) -> tuple[HostTree, Any]:
+        split_full = None
+        if self._native_split_full is not None:
+            def split_full(hist, fm, missing, cm):
+                return self._native_split_full(
+                    hist, self.cfg.reg_lambda, self.cfg.min_child_weight,
+                    feature_mask=fm, missing_bin=missing, cat_mask=cm)
         tree = ref.grow_tree(
             data, g, h, self.cfg,
-            hist_fn=self.build_histograms, split_fn=self.best_splits,
-            feature_mask=feature_mask,
+            hist_fn=self.build_histograms,
+            feature_mask=feature_mask, split_full_fn=split_full,
         )
         delta = (
             self.cfg.learning_rate * tree["leaf_value"][tree["leaf_of_row"]]
@@ -138,16 +147,20 @@ class CPUDevice(DeviceBackend):
     # ------------------------------------------------------------------ #
 
     def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
-        if self._native_traverse is None or ens.has_cat_splits:
-            # The C++ traversal has no one-vs-rest routing; the NumPy
-            # scorer is the exact path for categorical models.
+        if self._native_traverse is None:
             return ens.predict_raw(Xb, binned=True)
         # C++ batch traversal (the CPU twin of the device gather+compare
         # path); aggregation shared with TreeEnsemble.predict_raw.
-        # Missing-bin models route NaN rows by the learned direction.
+        # Missing-bin models route NaN rows by the learned direction;
+        # categorical one-vs-rest nodes route "bin == thr goes left".
+        cat_node = (
+            np.isin(ens.feature, ens.cat_features)
+            if ens.has_cat_splits else None
+        )
         leaf = self._native_traverse(
             Xb, ens.feature, ens.threshold_bin, ens.is_leaf, ens.max_depth,
             default_left=ens.default_left,
             missing_bin_value=ens.n_bins - 1 if ens.missing_bin else -1,
+            cat_node=cat_node,
         )                                                       # [T, R]
         return ens.aggregate_leaves(leaf)

@@ -12,6 +12,9 @@ Exports:
     traverse_native(Xb, feature, thr_bin, is_leaf, max_depth) -> np.ndarray
     split_gain_native(hist, reg_lambda, min_child_weight)
         -> (gain, feature, bin)
+    split_gain_full_native(hist, reg_lambda, min_child_weight,
+                           feature_mask, missing_bin, cat_mask)
+        -> (gain, feature, bin, default_left)   # full oracle contract
 """
 
 from __future__ import annotations
@@ -29,11 +32,12 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, os.environ.get("DDT_NATIVE_LIB", "libddthist.so"))
 
 
-# ddt_traverse_v2: the traversal ABI gained default_left/missing_bin
-# params; the version suffix makes a stale pre-change .so fail the
-# symbol check below instead of being called with a mismatched ABI
-# (which would reinterpret a pointer as the row count).
-_SYMBOLS = ("ddt_build_histograms", "ddt_traverse_v2", "ddt_split_gain")
+# ddt_traverse_v3: the traversal ABI gained default_left/missing_bin
+# (v2) then cat_node (v3) params; the version suffix makes a stale
+# pre-change .so fail the symbol check below instead of being called with
+# a mismatched ABI (which would reinterpret a pointer as the row count).
+_SYMBOLS = ("ddt_build_histograms", "ddt_traverse_v3", "ddt_split_gain",
+            "ddt_split_gain_full")
 
 
 def _stale() -> bool:
@@ -105,18 +109,36 @@ _lib.ddt_build_histograms.argtypes = [
 ]
 _lib.ddt_build_histograms.restype = None
 
-_lib.ddt_traverse_v2.argtypes = [
+_lib.ddt_traverse_v3.argtypes = [
     ctypes.POINTER(ctypes.c_uint8),   # Xb
     ctypes.POINTER(ctypes.c_int32),   # feature
     ctypes.POINTER(ctypes.c_int32),   # thr_bin
     ctypes.POINTER(ctypes.c_uint8),   # is_leaf
     ctypes.POINTER(ctypes.c_uint8),   # default_left (nullable)
+    ctypes.POINTER(ctypes.c_uint8),   # cat_node (nullable)
     ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
     ctypes.c_int32,                   # max_depth
     ctypes.c_int32,                   # missing_bin_value (-1 = disabled)
     ctypes.POINTER(ctypes.c_int32),
 ]
-_lib.ddt_traverse_v2.restype = None
+_lib.ddt_traverse_v3.restype = None
+
+_lib.ddt_split_gain_full.argtypes = [
+    ctypes.POINTER(ctypes.c_float),   # hist
+    ctypes.c_int32,                   # n_nodes
+    ctypes.c_int64,                   # F
+    ctypes.c_int32,                   # B
+    ctypes.c_float,                   # reg_lambda
+    ctypes.c_float,                   # min_child_weight
+    ctypes.POINTER(ctypes.c_uint8),   # feature_mask (nullable)
+    ctypes.c_int32,                   # missing_bin
+    ctypes.POINTER(ctypes.c_uint8),   # cat_mask (nullable)
+    ctypes.POINTER(ctypes.c_float),   # best_gain
+    ctypes.POINTER(ctypes.c_int32),   # best_feature
+    ctypes.POINTER(ctypes.c_int32),   # best_bin
+    ctypes.POINTER(ctypes.c_uint8),   # default_left out
+]
+_lib.ddt_split_gain_full.restype = None
 
 _lib.ddt_split_gain.argtypes = [
     ctypes.POINTER(ctypes.c_float),   # hist
@@ -180,6 +202,41 @@ def split_gain_native(
     return gain, feat, bin_
 
 
+def split_gain_full_native(
+    hist: np.ndarray,
+    reg_lambda: float,
+    min_child_weight: float,
+    feature_mask: np.ndarray | None = None,
+    missing_bin: bool = False,
+    cat_mask: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """C++ SplitGain, full oracle contract: colsample feature masks, the
+    reserved-NaN-bin direction scoring, and categorical one-vs-rest gains.
+    Bit-parity with numpy_trainer.best_splits (same flattened
+    [direction, feature, bin] bf16 argmax)."""
+    n_nodes, F, B, _ = hist.shape
+    hist = np.ascontiguousarray(hist, np.float32)
+    gain = np.empty(n_nodes, np.float32)
+    feat = np.empty(n_nodes, np.int32)
+    bin_ = np.empty(n_nodes, np.int32)
+    dl = np.empty(n_nodes, np.uint8)
+    null_u8 = ctypes.POINTER(ctypes.c_uint8)()
+    fm = (np.ascontiguousarray(feature_mask, np.uint8)
+          if feature_mask is not None else None)
+    cm = (np.ascontiguousarray(cat_mask, np.uint8)
+          if cat_mask is not None else None)
+    _lib.ddt_split_gain_full(
+        _ptr(hist, ctypes.c_float), n_nodes, F, B,
+        reg_lambda, min_child_weight,
+        _ptr(fm, ctypes.c_uint8) if fm is not None else null_u8,
+        1 if missing_bin else 0,
+        _ptr(cm, ctypes.c_uint8) if cm is not None else null_u8,
+        _ptr(gain, ctypes.c_float), _ptr(feat, ctypes.c_int32),
+        _ptr(bin_, ctypes.c_int32), _ptr(dl, ctypes.c_uint8),
+    )
+    return gain, feat, bin_, dl.astype(bool)
+
+
 def traverse_native(
     Xb: np.ndarray,
     feature: np.ndarray,
@@ -188,12 +245,14 @@ def traverse_native(
     max_depth: int,
     default_left: np.ndarray | None = None,
     missing_bin_value: int = -1,
+    cat_node: np.ndarray | None = None,
 ) -> np.ndarray:
     """C++ batch tree traversal: leaf heap-slot per (tree, row), int32 [T, R].
 
     `missing_bin_value` >= 0 enables missing-value routing: rows at that bin
     follow default_left[t, n] instead of the threshold compare (twin of
-    models/tree._traverse_np's binned missing path).
+    models/tree._traverse_np's binned missing path). `cat_node[t, n]` marks
+    one-vs-rest nodes ("bin == thr goes left").
     """
     R, F = Xb.shape
     T, N = feature.shape
@@ -203,15 +262,20 @@ def traverse_native(
     leaf8 = np.ascontiguousarray(is_leaf, np.uint8)
     if missing_bin_value >= 0 and default_left is None:
         raise ValueError("missing_bin_value needs default_left")
-    dl_ptr = ctypes.POINTER(ctypes.c_uint8)()   # NULL
+    null_u8 = ctypes.POINTER(ctypes.c_uint8)()
+    dl_ptr = null_u8
     if default_left is not None:
         dl8 = np.ascontiguousarray(default_left, np.uint8)
         dl_ptr = _ptr(dl8, ctypes.c_uint8)
+    cat_ptr = null_u8
+    if cat_node is not None:
+        cat8 = np.ascontiguousarray(cat_node, np.uint8)
+        cat_ptr = _ptr(cat8, ctypes.c_uint8)
     out = np.empty((T, R), np.int32)
-    _lib.ddt_traverse_v2(
+    _lib.ddt_traverse_v3(
         _ptr(Xb, ctypes.c_uint8), _ptr(feature, ctypes.c_int32),
         _ptr(thr_bin, ctypes.c_int32), _ptr(leaf8, ctypes.c_uint8),
-        dl_ptr,
+        dl_ptr, cat_ptr,
         R, F, T, N, max_depth, missing_bin_value,
         _ptr(out, ctypes.c_int32),
     )

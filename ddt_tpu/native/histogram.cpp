@@ -107,12 +107,15 @@ void ddt_build_histograms(
 // missing_bin_value >= 0, rows whose bin equals it are NaN rows and route
 // by default_left[t, n] (1 = left) instead of the threshold compare.
 // default_left may be NULL only when missing_bin_value < 0.
-void ddt_traverse_v2(
+// Categorical one-vs-rest (v3): cat_node[t, n] = 1 marks nodes splitting
+// "bin == thr goes LEFT" instead of the ordinal compare; NULL = none.
+void ddt_traverse_v3(
     const uint8_t* Xb,          // [R, F] binned rows
     const int32_t* feature,     // [T, N] split feature (-1 on leaves)
     const int32_t* thr_bin,     // [T, N]
     const uint8_t* is_leaf,     // [T, N]
     const uint8_t* default_left, // [T, N] or NULL (no missing handling)
+    const uint8_t* cat_node,    // [T, N] or NULL (no categorical splits)
     int64_t R,
     int64_t F,
     int64_t T,
@@ -130,6 +133,7 @@ void ddt_traverse_v2(
         const uint8_t* leaf_t = is_leaf + t * N;
         const uint8_t* dl_t =
             default_left ? default_left + t * N : nullptr;
+        const uint8_t* cat_t = cat_node ? cat_node + t * N : nullptr;
         int32_t* out_t = leaf_out + t * R;
         for (int64_t r = 0; r < R; ++r) {
             const uint8_t* row = Xb + r * F;
@@ -142,6 +146,8 @@ void ddt_traverse_v2(
                 if (missing_bin_value >= 0 &&
                     v == (uint8_t)missing_bin_value) {
                     right = dl_t && dl_t[node] ? 0 : 1;
+                } else if (cat_t && cat_t[node]) {
+                    right = v != (uint8_t)thr_t[node] ? 1 : 0;
                 } else {
                     right = v > thr_t[node] ? 1 : 0;
                 }

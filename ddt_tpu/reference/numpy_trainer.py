@@ -225,16 +225,16 @@ def grow_tree(
     h: np.ndarray,
     cfg: TrainConfig,
     hist_fn=None,
-    split_fn=None,
     feature_mask: np.ndarray | None = None,
+    split_full_fn=None,
 ) -> dict:
     """Grow one complete-heap tree. Returns dict of node arrays [n_nodes_total].
 
-    hist_fn/split_fn inject alternate L3 kernels with the same contract
-    (CPUDevice passes the native C++ ones — bit-parity guaranteed); defaults
-    are the NumPy oracle kernels in this module. feature_mask
-    (colsample_bytree) falls back to the NumPy SplitGain — the native kernel
-    has no mask parameter — which is bit-identical anyway.
+    hist_fn/split_full_fn inject alternate L3 kernels with the same
+    contract (CPUDevice passes the native C++ ones — bit-parity
+    guaranteed); defaults are the NumPy oracle kernels in this module.
+    split_full_fn carries the full best_splits contract:
+    (hist, feature_mask, missing_bin, cat_mask) -> 4-tuple.
     """
     R, F = Xb.shape
     N = cfg.n_nodes_total
@@ -262,10 +262,9 @@ def grow_tree(
         else:
             hist = build_histograms(Xb, g, h, node_index, n_level, cfg.n_bins)
         G, H = node_totals(hist)
-        if (split_fn is not None and feature_mask is None and not missing
-                and cat_mask is None):
-            gains, feats, bins = split_fn(hist)
-            dls = np.zeros(n_level, bool)
+        if split_full_fn is not None:
+            gains, feats, bins, dls = split_full_fn(
+                hist, feature_mask, missing, cat_mask)
         else:
             gains, feats, bins, dls = best_splits(
                 hist, cfg.reg_lambda, cfg.min_child_weight, feature_mask,

@@ -11,6 +11,7 @@ native = pytest.importorskip(
     "ddt_tpu.native", reason="native kernels unavailable (no toolchain?)"
 )
 
+from ddt_tpu.config import TrainConfig  # noqa: E402
 from ddt_tpu.reference import numpy_trainer as ref  # noqa: E402
 
 
@@ -138,3 +139,127 @@ def test_cpu_backend_histogram_exact():
     got = be.build_histograms(be.upload(Xb), g, h, ni, 4)
     want = ref.build_histograms(Xb, g, h, ni, 4, 31)
     np.testing.assert_array_equal(want, got)
+
+
+def test_split_gain_full_matches_oracle_fuzz():
+    """ddt_split_gain_full == reference.best_splits EXACTLY across the
+    full contract grid: feature masks, missing_bin direction scoring,
+    categorical one-vs-rest, zero/nonzero reg_lambda and
+    min_child_weight (bf16 argmax tie-breaks included)."""
+    native = pytest.importorskip("ddt_tpu.native")
+
+    rng = np.random.default_rng(0)
+    for trial in range(50):
+        n = int(rng.integers(1, 5))
+        F = int(rng.integers(2, 7))
+        B = int(rng.integers(3, 20))
+        hist = rng.standard_normal((n, F, B, 2)).astype(np.float32)
+        hist[..., 1] = np.abs(hist[..., 1])
+        lam = float(rng.choice([0.0, 0.5, 1.0]))
+        mcw = float(rng.choice([0.0, 1e-3, 0.7]))
+        fm = rng.random(F) < 0.7 if rng.random() < 0.5 else None
+        if fm is not None and not fm.any():
+            fm[0] = True
+        missing = bool(rng.random() < 0.5)
+        cm = (rng.random(F) < 0.4) if rng.random() < 0.5 else None
+        want = ref.best_splits(hist, lam, mcw, fm, missing_bin=missing,
+                               cat_mask=cm)
+        got = native.split_gain_full_native(hist, lam, mcw, fm, missing, cm)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(
+                np.asarray(w, np.float64), np.asarray(g, np.float64),
+                err_msg=f"trial {trial} lam={lam} mcw={mcw} "
+                        f"missing={missing}")
+
+
+def test_native_traverse_cat_routing_matches_numpy():
+    """v3 traversal's one-vs-rest routing == TreeEnsemble's NumPy scorer
+    on a trained categorical model (the native predict path no longer
+    gates cat models off)."""
+    pytest.importorskip("ddt_tpu.native")
+    from ddt_tpu import api
+    from ddt_tpu.backends.cpu import CPUDevice
+    from ddt_tpu.data.categorical import fit_categorical_encoder
+    from ddt_tpu.data.datasets import synthetic_ctr
+    from ddt_tpu.data.quantizer import fit_bin_mapper
+
+    Xn, Xc, y = synthetic_ctr(2000, seed=0)
+    enc = fit_categorical_encoder(Xc, n_bins=63)
+    X = np.concatenate([Xn, enc.transform(Xc).astype(np.float32)], axis=1)
+    cat = tuple(range(Xn.shape[1], X.shape[1]))
+    m = fit_bin_mapper(X, n_bins=63, cat_features=cat)
+    res = api.train(X, y, mapper=m, cat_features=cat, n_trees=5,
+                    max_depth=4, n_bins=63, backend="cpu",
+                    log_every=10**9)
+    Xb = m.transform(X)
+    be = CPUDevice(TrainConfig(backend="cpu", n_bins=63,
+                               cat_features=cat), use_native=True)
+    assert be._native_traverse is not None
+    want = res.ensemble.predict_raw(Xb, binned=True)
+    got = be.predict_raw(res.ensemble, Xb)
+    np.testing.assert_array_equal(want, got)
+    used = res.ensemble.feature[(~res.ensemble.is_leaf)
+                                & (res.ensemble.feature >= 0)]
+    assert np.isin(used, cat).any()
+
+
+def test_cpu_backend_uses_native_full_split_missing_colsample():
+    """The native full-contract SplitGain drives CPU training for
+    missing+colsample configs (no silent NumPy fallback), growing trees
+    identical to a native-disabled run. (Cat composes with
+    missing_policy='zero' only — covered separately below.)"""
+    pytest.importorskip("ddt_tpu.native")
+    from ddt_tpu.backends.cpu import CPUDevice
+    from ddt_tpu.driver import Driver
+
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((3000, 8)).astype(np.float32)
+    X[rng.random(X.shape) < 0.1] = np.nan
+    y = (X[:, 0] > 0.2).astype(np.int64)
+    y[np.isnan(X[:, 0])] = rng.integers(0, 2, np.isnan(X[:, 0]).sum())
+    from ddt_tpu.data.quantizer import fit_bin_mapper
+
+    m = fit_bin_mapper(X, n_bins=31, missing_policy="learn")
+    Xb = m.transform(X)
+    cfg = TrainConfig(n_trees=4, max_depth=4, n_bins=31, backend="cpu",
+                      missing_policy="learn", colsample_bytree=0.75)
+    be_n = CPUDevice(cfg, use_native=True)
+    assert be_n._native_split_full is not None
+    be_0 = CPUDevice(cfg, use_native=False)
+    e_n = Driver(be_n, cfg, log_every=10**9).fit(Xb, y)
+    e_0 = Driver(be_0, cfg, log_every=10**9).fit(Xb, y)
+    np.testing.assert_array_equal(e_n.feature, e_0.feature)
+    np.testing.assert_array_equal(e_n.threshold_bin, e_0.threshold_bin)
+    np.testing.assert_array_equal(e_n.default_left, e_0.default_left)
+    np.testing.assert_allclose(e_n.leaf_value, e_0.leaf_value, rtol=1e-6)
+
+
+def test_cpu_backend_uses_native_full_split_cat_training():
+    """Driver-level categorical training through the native full-contract
+    SplitGain equals a native-disabled run (cat wiring of the
+    split_full path through grow_tree)."""
+    pytest.importorskip("ddt_tpu.native")
+    from ddt_tpu.backends.cpu import CPUDevice
+    from ddt_tpu.data.categorical import fit_categorical_encoder
+    from ddt_tpu.data.datasets import synthetic_ctr
+    from ddt_tpu.data.quantizer import fit_bin_mapper
+    from ddt_tpu.driver import Driver
+
+    Xn, Xc, y = synthetic_ctr(2500, seed=2)
+    enc = fit_categorical_encoder(Xc, n_bins=63)
+    X = np.concatenate([Xn, enc.transform(Xc).astype(np.float32)], axis=1)
+    cat = tuple(range(Xn.shape[1], X.shape[1]))
+    m = fit_bin_mapper(X, n_bins=63, cat_features=cat)
+    Xb = m.transform(X)
+    cfg = TrainConfig(n_trees=4, max_depth=4, n_bins=63, backend="cpu",
+                      cat_features=cat)
+    be_n = CPUDevice(cfg, use_native=True)
+    assert be_n._native_split_full is not None
+    e_n = Driver(be_n, cfg, log_every=10**9).fit(Xb, y)
+    e_0 = Driver(CPUDevice(cfg, use_native=False), cfg,
+                 log_every=10**9).fit(Xb, y)
+    np.testing.assert_array_equal(e_n.feature, e_0.feature)
+    np.testing.assert_array_equal(e_n.threshold_bin, e_0.threshold_bin)
+    np.testing.assert_allclose(e_n.leaf_value, e_0.leaf_value, rtol=1e-6)
+    used = e_n.feature[(~e_n.is_leaf) & (e_n.feature >= 0)]
+    assert np.isin(used, cat).any()
