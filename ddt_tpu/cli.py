@@ -75,6 +75,66 @@ def _load_dataset(args, encoder=None, n_features=None):
     raise SystemExit(f"unknown dataset {args.dataset!r}")
 
 
+def _train_streaming(args, X, y, cfg, encoder) -> int:
+    """`train --stream-chunks=N`: the BASELINE config-5 path from the CLI.
+    The in-memory dataset stands in for a chunk source (the protocol is
+    what's exercised: streamed reservoir quantizer fit, per-chunk
+    histogram accumulation, device-resident boosting state); a file-backed
+    chunk_fn drops into the same two calls."""
+    from ddt_tpu.data.quantizer import fit_bin_mapper_streaming
+    from ddt_tpu.streaming import binned_chunks, fit_streaming
+
+    unsupported = [
+        (args.valid_frac > 0, "--valid-frac"),
+        (args.early_stop is not None, "--early-stop"),
+        (args.checkpoint_dir is not None, "--checkpoint-dir"),
+        (args.subsample < 1.0, "--subsample"),
+        (args.colsample_bytree < 1.0, "--colsample-bytree"),
+        (args.profile, "--profile"),
+        (args.trace_dir is not None, "--trace-dir"),
+    ]
+    bad = [flag for cond, flag in unsupported if cond]
+    if bad:
+        raise SystemExit(
+            f"--stream-chunks does not compose with {', '.join(bad)} "
+            "(streaming trains on the full stream, deterministically)"
+        )
+    n_chunks = args.stream_chunks
+    rows = len(y)
+    if n_chunks > rows:
+        raise SystemExit(
+            f"--stream-chunks={n_chunks} exceeds the row count ({rows}); "
+            "empty chunks are not allowed"
+        )
+    # np.array_split boundaries: sizes differ by at most one, never empty
+    # (ragged chunks are supported — each size compiles its own program).
+    bounds = np.linspace(0, rows, n_chunks + 1).astype(np.int64)
+
+    def raw_fn(c):
+        return X[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
+
+    t0 = time.perf_counter()
+    mapper = fit_bin_mapper_streaming(
+        raw_fn, n_chunks, n_bins=cfg.n_bins, seed=cfg.seed,
+        missing_policy=cfg.missing_policy, cat_features=cfg.cat_features,
+    )
+    ens = fit_streaming(binned_chunks(raw_fn, mapper, cfg), n_chunks, cfg)
+    dt = time.perf_counter() - t0
+    from ddt_tpu.reference.numpy_trainer import _fill_raw_thresholds
+
+    _fill_raw_thresholds(ens, mapper)
+    api.save_model(args.out, ens, mapper=mapper, encoder=encoder)
+    print(json.dumps({
+        "cmd": "train", "backend": args.backend, "rows": rows,
+        "trees": ens.n_trees, "depth": cfg.max_depth,
+        "streamed_chunks": n_chunks,
+        "chunk_rows": int((bounds[1:] - bounds[:-1]).max()),
+        "wallclock_s": round(dt, 3),
+        "model": args.out,
+    }))
+    return 0
+
+
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--backend", choices=BACKENDS, default="tpu",
                    help="device backend (the [BASELINE] flag)")
@@ -142,6 +202,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="feature fraction per tree")
     tp.add_argument("--hist-impl", default="auto",
                     choices=["auto", "matmul", "segment", "pallas"])
+    tp.add_argument("--stream-chunks", type=int, default=0,
+                    help="train via the streaming path (BASELINE config 5) "
+                         "with the dataset split into this many chunks: "
+                         "quantizer fitted by streamed reservoir sample, "
+                         "per-chunk histogram accumulation, boosting state "
+                         "device-resident on device backends")
     tp.add_argument("--out", default="ensemble.npz")
     tp.add_argument("--checkpoint-dir", default=None)
     tp.add_argument("--checkpoint-every", type=_positive_int, default=25,
@@ -204,6 +270,8 @@ def main(argv: list[str] | None = None) -> int:
             missing_policy=args.missing,
             cat_features=cat_features,
         )
+        if args.stream_chunks > 0:
+            return _train_streaming(args, X, y, cfg, encoder)
         eval_set = None
         if args.valid_frac > 0:
             rng = np.random.default_rng(args.seed)

@@ -16,10 +16,12 @@ host when it fits — cache_preds trades O(T²) rescoring for O(R) host RAM).
 The chunk source is a callable (chunk_idx) -> (Xb_chunk, y_chunk): pure, so
 any chunk can be regenerated on any host at any time (the deterministic
 synthetic generator data/datasets.stress_binned_chunk is one; a file-backed
-loader fits the same signature). Every chunk must have the same shape (pad
-the tail chunk). This trainer produces BIT-IDENTICAL trees to the in-memory
-Driver on the same data (tests/test_streaming.py) — the chunk sum enters the
-same bf16-rounded split selection (ops/split.py).
+loader fits the same signature). Chunks may differ in size (each distinct
+size jit-compiles its own per-level program — keep the number of distinct
+sizes small); empty chunks are not allowed. This trainer produces
+BIT-IDENTICAL trees to the in-memory Driver on the same data
+(tests/test_streaming.py) — the chunk sum enters the same bf16-rounded
+split selection (ops/split.py).
 
 Distribution composes: each chunk is row-sharded over the TPUDevice mesh like
 any other upload, so a v5e-64 pod streams 8 host-chunks in parallel while each

@@ -116,3 +116,37 @@ def test_cli_inspect(tmp_path, capsys):
     assert rec["top_features_by_gain"]
     # The tree dump follows: root line mentions a feature split or a leaf.
     assert out[1].startswith(("f", "leaf="))
+
+
+def test_cli_train_streaming(tmp_path, capsys):
+    """--stream-chunks trains via the streaming path (BASELINE config 5
+    from the CLI): streamed quantizer fit + per-chunk accumulation, model
+    artifact complete (mapper included), trees identical to an in-memory
+    run on the same mapper's bins."""
+    from ddt_tpu import api
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.driver import Driver
+
+    model = str(tmp_path / "s.npz")
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--rows=4000", "--trees=4", "--depth=3",
+        "--bins=31", "--stream-chunks=4", f"--out={model}",
+    ])
+    assert rec["streamed_chunks"] == 4 and rec["trees"] == 4
+    b = api.load_model(model)
+    assert b.mapper is not None
+
+    # identical to in-memory training on the streamed mapper's bins
+    from ddt_tpu.data.datasets import synthetic_binary
+
+    X, y = synthetic_binary(4000, seed=0)
+    cfg = TrainConfig(n_trees=4, max_depth=3, n_bins=31, backend="cpu")
+    full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(
+        b.mapper.transform(X), y)
+    np.testing.assert_array_equal(full.feature, b.ensemble.feature)
+
+    # guards: streaming composes with neither eval nor bagging
+    with pytest.raises(SystemExit, match="valid-frac"):
+        main(["train", "--backend=cpu", "--rows=1000", "--trees=2",
+              "--stream-chunks=2", "--valid-frac=0.2"])

@@ -165,3 +165,25 @@ def test_streaming_missing_matches_inmemory(backend_flag):
     assert streamed.missing_bin
     # a learned default direction was actually exercised
     assert streamed.default_left[~streamed.is_leaf].any()
+
+
+@pytest.mark.parametrize("backend_flag", ["cpu", "tpu"])
+def test_streaming_ragged_chunks_match_inmemory(backend_flag):
+    """Unequal chunk sizes (each size compiles its own program) grow trees
+    bit-identical to in-memory training — the CLI's array_split chunking
+    relies on this."""
+    X, y = datasets.synthetic_binary(4000, n_features=8, seed=9)
+    Xb, _ = quantize(X, n_bins=31, seed=9)
+    cfg = TrainConfig(n_trees=3, max_depth=4, n_bins=31,
+                      backend=backend_flag)
+    full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
+
+    bounds = [0, 1337, 2674, 4000]          # 1337/1337/1326 rows
+
+    def chunk_fn(c):
+        return Xb[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
+
+    streamed = fit_streaming(chunk_fn, 3, cfg)
+    np.testing.assert_array_equal(full.feature, streamed.feature)
+    np.testing.assert_array_equal(full.threshold_bin,
+                                  streamed.threshold_bin)
