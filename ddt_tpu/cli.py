@@ -82,7 +82,7 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
     histogram accumulation, device-resident boosting state); a file-backed
     chunk_fn drops into the same two calls."""
     from ddt_tpu.data.quantizer import fit_bin_mapper_streaming
-    from ddt_tpu.streaming import binned_chunks, fit_streaming
+    from ddt_tpu.streaming import fit_streaming, validate_mapper_config
 
     unsupported = [
         (args.valid_frac > 0, "--valid-frac"),
@@ -106,8 +106,10 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
             f"--stream-chunks={n_chunks} exceeds the row count ({rows}); "
             "empty chunks are not allowed"
         )
-    # np.array_split boundaries: sizes differ by at most one, never empty
-    # (ragged chunks are supported — each size compiles its own program).
+    # Truncated-linspace boundaries: sizes differ by at most one, never
+    # empty given the guard above (ragged chunks are supported — each
+    # size compiles its own program). Layout differs from np.array_split
+    # (which fronts the larger chunks); only the two properties matter.
     bounds = np.linspace(0, rows, n_chunks + 1).astype(np.int64)
 
     def raw_fn(c):
@@ -118,7 +120,20 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
         raw_fn, n_chunks, n_bins=cfg.n_bins, seed=cfg.seed,
         missing_policy=cfg.missing_policy, cat_features=cfg.cat_features,
     )
-    ens = fit_streaming(binned_chunks(raw_fn, mapper, cfg), n_chunks, cfg)
+    # Bin ONCE — the dataset is fully resident here, and fit_streaming
+    # re-reads every chunk (max_depth+2) times per tree; streaming the
+    # pre-binned matrix skips ~hundreds of repeat transforms while the
+    # reservoir mapper fit above still exercises the streamed protocol.
+    validate_mapper_config(mapper, cfg)
+    Xb = mapper.transform(X)
+
+    def chunk_fn(c):
+        return Xb[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
+
+    try:
+        ens = fit_streaming(chunk_fn, n_chunks, cfg)
+    except NotImplementedError as e:   # e.g. host-path softmax streaming
+        raise SystemExit(str(e)) from e
     dt = time.perf_counter() - t0
     from ddt_tpu.reference.numpy_trainer import _fill_raw_thresholds
 

@@ -164,7 +164,10 @@ def grow_tree(
             feats = jnp.take_along_axis(fa, w[None], axis=0)[0]
             bins = jnp.take_along_axis(ba, w[None], axis=0)[0]
             dls = jnp.take_along_axis(da, w[None], axis=0)[0]
-        value = -G / (Hh + reg_lambda)
+        # Guarded like the final level and the streamed twin: an EMPTY
+        # node at reg_lambda=0 would otherwise store -0/0 = NaN as its
+        # leaf value, which a predict-time row (different data) can reach.
+        value = jnp.where(Hh > 0, -G / (Hh + reg_lambda), 0.0)
 
         do_split = (
             (gains > min_split_gain) & jnp.isfinite(gains) & (Hh > 0)

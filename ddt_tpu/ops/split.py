@@ -99,6 +99,15 @@ def best_splits(
     # — so every backend and every partition count picks identical splits.
     # Selecting among candidates within bf16 resolution (~0.4%) of the max is
     # immaterial to model quality; decision stability across devices is not.
+    #
+    # Determinism boundary: bf16 rounding absorbs noise RELATIVE to the
+    # gain's magnitude. When the best gains themselves sit at the f32
+    # cancellation noise floor — reg_lambda=0 with min_split_gain=0 on
+    # signal-free nodes — summation-order differences exceed bf16's
+    # ABSOLUTE spacing and backends may legitimately pick different
+    # noise-level splits. Any gain floor above the noise (min_split_gain
+    # >= ~1e-3, or any reg_lambda > 0) restores the invariant
+    # (tests/test_config_fuzz.py).
     def overlay_cat(gain, valid):
         """Replace cat features' ordinal gains with one-vs-rest gains
         (left child = exactly bin k => GL_k is the per-bin sum itself)."""

@@ -270,7 +270,11 @@ def grow_tree(
                 hist, cfg.reg_lambda, cfg.min_child_weight, feature_mask,
                 missing_bin=missing, cat_mask=cat_mask,
             )
-        value = -G / (H + cfg.reg_lambda)
+        # Guarded like the final level and the streamed twin: an EMPTY
+        # node at reg_lambda=0 would otherwise store -0/0 = NaN as its
+        # leaf value, which a predict-time row (different data) can reach.
+        with np.errstate(divide="ignore", invalid="ignore"):
+            value = np.where(H > 0, -G / (H + cfg.reg_lambda), 0.0)
 
         do_split = (gains > cfg.min_split_gain) & np.isfinite(gains) & (H > 0)
         for i in range(n_level):

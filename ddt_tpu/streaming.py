@@ -44,17 +44,10 @@ log = logging.getLogger("ddt_tpu.streaming")
 ChunkFn = Callable[[int], tuple[np.ndarray, np.ndarray]]
 
 
-def binned_chunks(chunk_fn: ChunkFn, mapper, cfg: TrainConfig) -> ChunkFn:
-    """Adapt a RAW-float chunk source into the binned source
-    fit_streaming consumes, via a fitted BinMapper (see
-    data/quantizer.fit_bin_mapper_streaming for fitting one without
-    materialising the dataset). Purity is preserved: any chunk still
-    regenerates anywhere, bins included — which also means every re-read
-    re-bins; callers whose binned chunks fit somewhere can cache them.
-
-    `cfg` is required so the mapper↔config consistency guards that
-    api.train enforces hold on this path too (a mismatched mapper trains
-    a silently wrong model, not a crashing one)."""
+def validate_mapper_config(mapper, cfg: TrainConfig) -> None:
+    """The mapper↔config consistency guards api.train enforces, for the
+    streaming paths (a mismatched mapper trains a silently wrong model,
+    not a crashing one)."""
     if mapper.n_bins != cfg.n_bins:
         raise ValueError(
             f"mapper was fitted with n_bins={mapper.n_bins} but "
@@ -74,6 +67,19 @@ def binned_chunks(chunk_fn: ChunkFn, mapper, cfg: TrainConfig) -> ChunkFn:
                 "mapper; refit it with "
                 f"cat_features={tuple(sorted(cfg.cat_features))}"
             )
+
+
+def binned_chunks(chunk_fn: ChunkFn, mapper, cfg: TrainConfig) -> ChunkFn:
+    """Adapt a RAW-float chunk source into the binned source
+    fit_streaming consumes, via a fitted BinMapper (see
+    data/quantizer.fit_bin_mapper_streaming for fitting one without
+    materialising the dataset). Purity is preserved: any chunk still
+    regenerates anywhere, bins included — which also means every re-read
+    re-bins; callers whose binned chunks fit somewhere can cache them.
+
+    `cfg` is required so the mapper↔config consistency guards that
+    api.train enforces hold on this path too."""
+    validate_mapper_config(mapper, cfg)
 
     def f(c: int):
         X, y = chunk_fn(c)

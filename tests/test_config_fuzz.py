@@ -1,0 +1,136 @@
+"""Randomized cross-backend config fuzz: CPU and TPU training must grow
+IDENTICAL tree structure for any valid config (the repo-wide deterministic
+split rule), and partitioned runs must equal single-device runs. One test,
+wide net — dedicated suites cover each feature in depth; this catches
+interaction regressions between them (loss x missing x cat x sampling x
+partitions x bins x depth).
+"""
+
+import numpy as np
+import pytest
+
+from ddt_tpu.backends import get_backend
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.data.categorical import fit_categorical_encoder
+from ddt_tpu.data.datasets import synthetic_binary, synthetic_multiclass
+from ddt_tpu.data.quantizer import fit_bin_mapper
+from ddt_tpu.driver import Driver
+
+
+def _random_case(rng):
+    rows = int(rng.integers(300, 1500))
+    n_num = int(rng.integers(3, 9))
+    loss = rng.choice(["logloss", "mse", "softmax"])
+    n_classes = int(rng.integers(3, 5)) if loss == "softmax" else 2
+    missing = bool(rng.random() < 0.35)
+    cat = bool(rng.random() < 0.35) and not missing   # config forbids both
+    bins = int(rng.choice([7, 31, 63, 255]))
+    if missing and bins < 3:
+        bins = 31
+
+    X = rng.standard_normal((rows, n_num)).astype(np.float32)
+    if loss == "softmax":
+        _, y = synthetic_multiclass(rows, n_features=4,
+                                    n_classes=n_classes,
+                                    seed=int(rng.integers(99)))
+        y = y[:rows]
+    elif loss == "mse":
+        y = (X[:, 0] * 1.5 + rng.standard_normal(rows) * 0.3).astype(
+            np.float32)
+    else:
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int64)
+    cat_features: tuple = ()
+    if cat:
+        ids = rng.integers(0, 12, size=(rows, 2))
+        enc = fit_categorical_encoder(ids, n_bins=bins)
+        X = np.concatenate([X, enc.transform(ids).astype(np.float32)],
+                           axis=1)
+        cat_features = (n_num, n_num + 1)
+        # categorical signal so cat splits actually get chosen sometimes
+        y = np.asarray(y)
+        if loss == "logloss":
+            y = ((X[:, 0] > 0) | (ids[:, 0] == 3)).astype(np.int64)
+    if missing:
+        X[rng.random(X.shape) < 0.1] = np.nan
+
+    # Cross-backend bit-identity holds when selected gains sit above the
+    # f32 cancellation noise floor (ops/split.py "Determinism boundary"):
+    # reg_lambda=0 WITH min_split_gain=0 admits pure-noise splits whose
+    # f32 summation-order differences exceed bf16's absolute spacing, so
+    # the fuzzer pairs lambda=0 with a noise-floor min_split_gain.
+    lam = float(rng.choice([0.0, 1.0]))
+    cfg = TrainConfig(
+        n_trees=int(rng.integers(2, 5)),
+        max_depth=int(rng.integers(2, 6)),
+        n_bins=bins,
+        loss=str(loss),
+        n_classes=n_classes,
+        learning_rate=float(rng.choice([0.1, 0.3])),
+        reg_lambda=lam,
+        min_split_gain=1e-3 if lam == 0.0 else 0.0,
+        min_child_weight=float(rng.choice([0.0, 1e-3, 0.5])),
+        subsample=float(rng.choice([1.0, 0.8])),
+        colsample_bytree=float(rng.choice([1.0, 0.7])),
+        missing_policy="learn" if missing else "zero",
+        cat_features=cat_features,
+        seed=int(rng.integers(1000)),
+    )
+    m = fit_bin_mapper(X, n_bins=bins,
+                       missing_policy=cfg.missing_policy,
+                       cat_features=cat_features)
+    return m.transform(X), np.asarray(y), cfg
+
+
+@pytest.mark.parametrize("case_seed", range(15))
+def test_random_config_backend_and_partition_identity(case_seed):
+    rng = np.random.default_rng((97, case_seed))
+    Xb, y, cfg = _random_case(rng)
+    ens = {}
+    for backend in ("cpu", "tpu"):
+        c = cfg.replace(backend=backend)
+        ens[backend] = Driver(get_backend(c), c, log_every=10**9).fit(Xb, y)
+    np.testing.assert_array_equal(ens["cpu"].feature, ens["tpu"].feature)
+    np.testing.assert_array_equal(ens["cpu"].threshold_bin,
+                                  ens["tpu"].threshold_bin)
+    np.testing.assert_array_equal(ens["cpu"].is_leaf, ens["tpu"].is_leaf)
+    np.testing.assert_array_equal(ens["cpu"].default_left,
+                                  ens["tpu"].default_left)
+    np.testing.assert_allclose(ens["cpu"].leaf_value,
+                               ens["tpu"].leaf_value,
+                               rtol=2e-4, atol=2e-5)
+    # a partitioned run on the mesh equals the single-device run
+    parts = int(rng.choice([2, 4, 8]))
+    cp = cfg.replace(backend="tpu", n_partitions=parts)
+    ep = Driver(get_backend(cp), cp, log_every=10**9).fit(Xb, y)
+    np.testing.assert_array_equal(ens["tpu"].feature, ep.feature)
+    np.testing.assert_array_equal(ens["tpu"].threshold_bin,
+                                  ep.threshold_bin)
+    # and both backends score the result identically (tolerance)
+    pc = get_backend(cfg.replace(backend="cpu")).predict_raw(
+        ens["cpu"], Xb)
+    pt = get_backend(cfg.replace(backend="tpu")).predict_raw(
+        ens["cpu"], Xb)
+    np.testing.assert_allclose(pc, pt, rtol=5e-4, atol=5e-5)
+
+
+def test_lambda_zero_empty_nodes_have_finite_leaves():
+    """reg_lambda=0 + empty intermediate nodes: the leaf value must be 0,
+    not -0/0 = NaN (a predict-time row from DIFFERENT data can reach a
+    node that was empty at training). Fuzz-discovered; guarded in
+    ops/grow.py, the oracle, and streaming alike."""
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((120, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    m = fit_bin_mapper(X, n_bins=15)
+    Xb = m.transform(X)
+    cfg = TrainConfig(n_trees=2, max_depth=6, n_bins=15, reg_lambda=0.0,
+                      min_child_weight=0.0)
+    for backend in ("cpu", "tpu"):
+        c = cfg.replace(backend=backend)
+        ens = Driver(get_backend(c), c, log_every=10**9).fit(Xb, y)
+        assert np.isfinite(ens.leaf_value).all(), backend
+        # scoring previously-unseen data stays finite even through nodes
+        # empty at training time
+        X2 = rng.standard_normal((500, 4)).astype(np.float32) * 3
+        p = ens.predict_raw(m.transform(X2), binned=True)
+        assert np.isfinite(p).all(), backend
