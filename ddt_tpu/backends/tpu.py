@@ -436,16 +436,44 @@ class TPUDevice(DeviceBackend):
             self._rounds_fns[n_rounds] = fn
         return fn(data, pred, y.y, y.valid)
 
+    def grow_rounds_eval(self, data, pred, y: "LabelHandle", n_rounds: int,
+                         val_data, val_pred, val_y: "LabelHandle",
+                         metric: str):
+        """grow_rounds with validation scoring INSIDE the scan: each
+        round's trees are applied to the resident validation predictions
+        and the metric's f32 device twin evaluates per round — eval runs
+        at fused-dispatch speed (no per-round host round-trips; one [K]
+        scores fetch per block). Metric must have a device twin (the
+        Driver falls back to the granular path for auc / early stopping).
+        Returns (packed_trees, new_pred, losses, new_val_pred,
+        scores [n_rounds] f32)."""
+        key = (n_rounds, metric)
+        fn = self._rounds_eval_fns.get(key)
+        if fn is None:
+            fn = self._build_rounds_fn(n_rounds, eval_metric=metric)
+            self._rounds_eval_fns[key] = fn
+        return fn(data, pred, y.y, y.valid,
+                  val_data, val_pred, val_y.y, val_y.valid)
+
+    @functools.cached_property
+    def _rounds_eval_fns(self) -> dict:
+        return {}
+
     @functools.cached_property
     def _rounds_fns(self) -> dict:
         return {}
 
-    def _build_rounds_fn(self, K: int):
+    def _build_rounds_fn(self, K: int, eval_metric: str | None = None):
+        from ddt_tpu.ops import stream as stream_ops
+        from ddt_tpu.utils.metrics import device_metric
+
         cfg = self.cfg
         C = cfg.n_classes if cfg.loss == "softmax" else 1
         axis = self._row_axes if self.distributed else None
         faxis = FAXIS if self.feature_partitions > 1 else None
         input_dtype = self._input_dtype
+        mfn = device_metric(eval_metric) if eval_metric else None
+        missing = cfg.missing_policy == "learn"
 
         def allreduce(x):
             return jax.lax.psum(x, axis) if axis is not None else x
@@ -457,8 +485,14 @@ class TPUDevice(DeviceBackend):
             return grad_ops.mean_loss(pred, ya, valid, cfg.loss,
                                       allreduce=allreduce)
 
-        def rounds(data_a, pred0, ya, valid):
-            def body(pred, _):
+        def rounds(data_a, pred0, ya, valid, *val_args):
+            if mfn is not None:
+                val_data, vpred0, vy, vvalid = val_args
+                cat_vec = split_ops.cat_feature_vec(
+                    cfg.cat_features,
+                    val_data.shape[1] * self.feature_partitions)
+
+            def one_round(pred, vpred):
                 g, h = grad_ops.grad_hess(pred, ya, cfg.loss)
                 v = valid[:, None] if g.ndim == 2 else valid
                 g = g * v
@@ -478,15 +512,44 @@ class TPUDevice(DeviceBackend):
                         input_dtype=input_dtype,
                         axis_name=axis,
                         feature_axis_name=faxis,
-                        missing_bin=cfg.missing_policy == "learn",
+                        missing_bin=missing,
                         cat_features=cfg.cat_features,
                     )
                     delta = grow_ops.tree_predict_delta(
                         tree, cfg.learning_rate)
                     pred = (pred.at[:, c].add(delta) if C > 1
                             else pred + delta)
+                    if mfn is not None:
+                        vpred = stream_ops.apply_tree_pred(
+                            val_data, vpred,
+                            tree.feature, tree.threshold_bin,
+                            tree.is_leaf, tree.leaf_value,
+                            tree.default_left if missing else None,
+                            max_depth=cfg.max_depth,
+                            learning_rate=cfg.learning_rate,
+                            class_idx=c,
+                            missing_bin_value=cfg.missing_bin_value,
+                            cat_vec=cat_vec,
+                            feature_axis_name=faxis,
+                        )
                     packs.append(_pack_tree(tree))
-                return pred, (jnp.stack(packs), loss_of(pred, ya, valid))
+                return pred, vpred, jnp.stack(packs), loss_of(
+                    pred, ya, valid)
+
+            if mfn is not None:
+                def body(carry, _):
+                    pred, vpred = carry
+                    pred, vpred, packs, loss = one_round(pred, vpred)
+                    return (pred, vpred), (
+                        packs, loss, mfn(vy, vpred, vvalid, allreduce))
+
+                (predf, vpredf), (trees, losses, scores) = jax.lax.scan(
+                    body, (pred0, vpred0), None, length=K)
+                return trees, predf, losses, vpredf, scores
+
+            def body(carry, _):
+                pred, _, packs, loss = one_round(carry, None)
+                return pred, (packs, loss)
 
             predf, (trees, losses) = jax.lax.scan(body, pred0, None,
                                                   length=K)
@@ -496,17 +559,26 @@ class TPUDevice(DeviceBackend):
             rax = self._row_axes
             pred_spec = P(rax, None) if C > 1 else P(rax)
             data_spec = P(rax, FAXIS) if faxis else P(rax, None)
+            in_specs = (data_spec, pred_spec, P(rax), P(rax))
+            out_specs = (P(), pred_spec, P())
+            if mfn is not None:
+                in_specs = in_specs + (data_spec, pred_spec, P(rax),
+                                       P(rax))
+                out_specs = out_specs + (pred_spec, P())
             rounds = jax.shard_map(
                 rounds,
                 mesh=self.mesh,
-                in_specs=(data_spec, pred_spec, P(rax), P(rax)),
-                out_specs=(P(), pred_spec, P()),
+                in_specs=in_specs,
+                out_specs=out_specs,
                 # Same rationale as _build_grow_fn: tree outputs are
                 # replicated bit-identically by construction; the static
                 # VMA checker cannot see through the gathered argmax.
                 check_vma=faxis is None,
             )
-        return jax.jit(rounds, donate_argnums=(1,))
+        # Both block-reassigned prediction buffers are donated (the Driver
+        # rebinds pred AND val_pred from the return every block).
+        donate = (1, 5) if mfn is not None else (1,)
+        return jax.jit(rounds, donate_argnums=donate)
 
     # ------------------------------------------------------------------ #
     # device-side eval_set scoring (round-1 verdict, Weak #5): validation

@@ -114,6 +114,31 @@ class Driver:
         if self.timer is not None:
             self.backend.sync(x)
 
+    def _record_round(self, r: int, ms: float, metric_name,
+                      val_score, loss_fn) -> None:
+        """History/log record for round r, shared by the granular and
+        fused loops: train loss at log cadence only (loss_fn() may cost a
+        device sync), eval metric EVERY round — the per-round series
+        (sklearn evals_result_) must not depend on the logging knob."""
+        if (r + 1) % self.log_every == 0 or r == self.cfg.n_trees - 1:
+            loss = loss_fn()
+            rec = {"round": r + 1, "train_loss": loss,
+                   "ms_per_round": ms}
+            if val_score is not None:
+                rec[f"valid_{metric_name}"] = val_score
+            self.history.append(rec)
+            log.info(
+                "round %4d/%d  loss=%.6f  %.1f ms/round%s",
+                r + 1, self.cfg.n_trees, loss, ms,
+                f"  valid_{metric_name}={val_score:.6f}"
+                if val_score is not None else "",
+            )
+        elif val_score is not None:
+            self.history.append({
+                "round": r + 1, "ms_per_round": ms,
+                f"valid_{metric_name}": val_score,
+            })
+
     def fit(
         self,
         Xb: np.ndarray,
@@ -255,17 +280,31 @@ class Driver:
         # of rounds in one device dispatch + one tree fetch (per-round
         # dispatch latency dominates on a remote-attached chip). Only for
         # deterministic boosting — bagging/colsample masks are host-drawn
-        # by design, eval needs each tree immediately, and profiling wants
-        # per-phase barriers.
+        # by design and profiling wants per-phase barriers. Validation
+        # rides INSIDE the scan (grow_rounds_eval) when its metric has a
+        # device twin and no early stopping is requested (stopping needs
+        # the score back every round).
+        fused_eval = (
+            eval_set is not None
+            and use_dev_eval
+            and dev_metric is not None
+            and early_stopping_rounds is None
+            and getattr(self.backend, "grow_rounds_eval", None) is not None
+        )
         if (
             getattr(self.backend, "grow_rounds", None) is not None
-            and eval_set is None
+            and (eval_set is None or fused_eval)
             and self.timer is None
             and not bagging
             and not colsample
         ):
+            eval_state = None
+            if fused_eval:
+                eval_state = (val_data_dev, val_pred_dev, val_y_dev,
+                              dev_metric, sign)
             return self._fit_fused(
-                data, y_dev, pred, ens, start_round, C)
+                data, y_dev, pred, ens, start_round, C,
+                eval_state=eval_state)
 
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
@@ -348,32 +387,9 @@ class Driver:
                     self.best_round = rnd
                     self.best_score = val_score
 
-            if (rnd + 1) % self.log_every == 0 or rnd == cfg.n_trees - 1:
-                loss = self.backend.loss_value(pred, y_dev)
-                rec = {
-                    "round": rnd + 1,
-                    "train_loss": loss,
-                    "ms_per_round": dt * 1e3,
-                }
-                if val_score is not None:
-                    rec[f"valid_{metric_name}"] = val_score
-                self.history.append(rec)
-                log.info(
-                    "round %4d/%d  loss=%.6f  %.1f ms/round%s",
-                    rnd + 1, cfg.n_trees, loss, dt * 1e3,
-                    f"  valid_{metric_name}={val_score:.6f}"
-                    if val_score is not None else "",
-                )
-            elif val_score is not None:
-                # Eval metrics are recorded EVERY round — the per-round
-                # series (sklearn evals_result_) must not depend on the
-                # logging knob. Train loss stays at log cadence: it costs
-                # a blocking device sync.
-                self.history.append({
-                    "round": rnd + 1,
-                    "ms_per_round": dt * 1e3,
-                    f"valid_{metric_name}": val_score,
-                })
+            self._record_round(
+                rnd, dt * 1e3, metric_name, val_score,
+                lambda: self.backend.loss_value(pred, y_dev))
 
             if (
                 early_stopping_rounds is not None
@@ -419,12 +435,19 @@ class Driver:
         return ens
 
     def _fit_fused(self, data, y_dev, pred, ens: TreeEnsemble,
-                   start_round: int, C: int) -> TreeEnsemble:
+                   start_round: int, C: int,
+                   eval_state: tuple | None = None) -> TreeEnsemble:
         """Block loop over backend.grow_rounds: K rounds per dispatch,
         K x C trees per fetch. Blocks break at checkpoint_every boundaries
         so the checkpoint cadence (and resume bit-exactness) is identical
-        to the granular path."""
+        to the granular path. With eval_state, validation scoring runs
+        inside the scan (grow_rounds_eval) and a [K] scores vector rides
+        the same fetch."""
         cfg = self.cfg
+        metric_name = None
+        if eval_state is not None:
+            val_data, val_pred, val_y, metric_name, sign = eval_state
+            best = -np.inf
         rnd = start_round
         while rnd < cfg.n_trees:
             K = cfg.n_trees - rnd
@@ -433,8 +456,15 @@ class Driver:
                     self.checkpoint_every
                 K = min(K, nxt - rnd)
             t0 = time.perf_counter()
-            trees_h, pred, losses_h = self.backend.grow_rounds(
-                data, pred, y_dev, K)
+            if eval_state is not None:
+                trees_h, pred, losses_h, val_pred, scores_h = \
+                    self.backend.grow_rounds_eval(
+                        data, pred, y_dev, K,
+                        val_data, val_pred, val_y, metric_name)
+                scores = np.asarray(scores_h)   # [K] — same fetch wave
+            else:
+                trees_h, pred, losses_h = self.backend.grow_rounds(
+                    data, pred, y_dev, K)
             trees = np.asarray(trees_h)         # [K, C, 5, N] — ONE fetch
             losses = np.asarray(losses_h)
             dt = time.perf_counter() - t0
@@ -449,17 +479,16 @@ class Driver:
                     ens.split_gain[slot] = p[4]
                     ens.default_left[slot] = p[5].astype(bool)
                 r = rnd + k
-                if (r + 1) % self.log_every == 0 or r == cfg.n_trees - 1:
-                    rec = {
-                        "round": r + 1,
-                        "train_loss": float(losses[k]),
-                        "ms_per_round": dt * 1e3 / K,
-                    }
-                    self.history.append(rec)
-                    log.info(
-                        "round %4d/%d  loss=%.6f  %.1f ms/round",
-                        r + 1, cfg.n_trees, float(losses[k]), dt * 1e3 / K,
-                    )
+                val_score = None
+                if eval_state is not None:
+                    val_score = float(scores[k])
+                    if sign * val_score > best:
+                        best = sign * val_score
+                        self.best_round = r
+                        self.best_score = val_score
+                self._record_round(
+                    r, dt * 1e3 / K, metric_name, val_score,
+                    lambda k=k: float(losses[k]))
             rnd += K
             if (
                 self.checkpoint_dir is not None

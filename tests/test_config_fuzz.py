@@ -25,8 +25,6 @@ def _random_case(rng):
     missing = bool(rng.random() < 0.35)
     cat = bool(rng.random() < 0.35) and not missing   # config forbids both
     bins = int(rng.choice([7, 31, 63, 255]))
-    if missing and bins < 3:
-        bins = 31
 
     X = rng.standard_normal((rows, n_num)).astype(np.float32)
     if loss == "softmax":

@@ -305,3 +305,32 @@ def test_device_eval_pod_mesh_matches_single():
         hp = [r[f"valid_{metric}"] for r in rp.history
               if f"valid_{metric}" in r]
         np.testing.assert_allclose(h1, hp, rtol=2e-5)
+
+
+def test_fused_eval_matches_host_and_granular():
+    """Without early stopping, eval rides INSIDE the fused scan
+    (grow_rounds_eval): histories must equal the host path's, per-round
+    records included, on single and sharded meshes and multiclass."""
+    X, y = synthetic_binary(4000, n_features=10, seed=3)
+    Xt, yt, Xv, yv = _split(X, y)
+    kw = dict(n_trees=12, max_depth=4, n_bins=63, log_every=5,
+              eval_set=(Xv, yv), eval_metric="logloss")
+    rc = api.train(Xt, yt, backend="cpu", **kw)
+    rt = api.train(Xt, yt, backend="tpu", **kw)   # fused in-scan eval
+    hc = [r["valid_logloss"] for r in rc.history if "valid_logloss" in r]
+    ht = [r["valid_logloss"] for r in rt.history if "valid_logloss" in r]
+    assert len(ht) == 12                          # recorded every round
+    np.testing.assert_allclose(hc, ht, rtol=2e-5)
+    assert rc.best_round == rt.best_round
+    r2 = api.train(Xt, yt, backend="tpu", n_partitions=2, **kw)
+    h2 = [r["valid_logloss"] for r in r2.history if "valid_logloss" in r]
+    np.testing.assert_allclose(ht, h2, rtol=2e-5)
+
+    Xm, ym = synthetic_multiclass(1500, n_features=8, n_classes=3, seed=7)
+    km = dict(loss="softmax", n_classes=3, n_trees=8, max_depth=3,
+              n_bins=31, eval_set=(Xm[1200:], ym[1200:]),
+              eval_metric="accuracy", log_every=10**9)
+    rm = api.train(Xm[:1200], ym[:1200], backend="tpu", **km)
+    rh = api.train(Xm[:1200], ym[:1200], backend="cpu", **km)
+    assert rm.best_round == rh.best_round
+    np.testing.assert_allclose(rm.best_score, rh.best_score, rtol=1e-6)
