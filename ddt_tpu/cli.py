@@ -87,7 +87,6 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
     unsupported = [
         (args.valid_frac > 0, "--valid-frac"),
         (args.early_stop is not None, "--early-stop"),
-        (args.checkpoint_dir is not None, "--checkpoint-dir"),
         (args.subsample < 1.0, "--subsample"),
         (args.colsample_bytree < 1.0, "--colsample-bytree"),
         (args.profile, "--profile"),
@@ -131,7 +130,9 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
         return Xb[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
 
     try:
-        ens = fit_streaming(chunk_fn, n_chunks, cfg)
+        ens = fit_streaming(chunk_fn, n_chunks, cfg,
+                            checkpoint_dir=args.checkpoint_dir,
+                            checkpoint_every=args.checkpoint_every)
     except NotImplementedError as e:   # e.g. host-path softmax streaming
         raise SystemExit(str(e)) from e
     dt = time.perf_counter() - t0

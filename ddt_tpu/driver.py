@@ -46,6 +46,7 @@ from ddt_tpu.backends.base import DeviceBackend
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
 from ddt_tpu.reference.numpy_trainer import base_score
+from ddt_tpu.utils import checkpoint
 from ddt_tpu.utils.profiling import PhaseTimer
 
 log = logging.getLogger("ddt_tpu.driver")
@@ -97,6 +98,9 @@ class Driver:
         self.cfg = cfg
         self.log_every = log_every
         self.checkpoint_dir = checkpoint_dir
+        if checkpoint_dir is not None and checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}")
         self.checkpoint_every = checkpoint_every
         self.history: list[dict] = []
         self.best_round: int | None = None
@@ -411,21 +415,18 @@ class Driver:
                 self.checkpoint_dir is not None
                 and (rnd + 1) % self.checkpoint_every == 0
             ):
-                from ddt_tpu.utils.checkpoint import save_checkpoint
-
                 if pending is not None:        # flush the fetch pipeline
                     _store(*pending)
                     pending = None
-                save_checkpoint(self.checkpoint_dir, ens, cfg, rnd + 1)
+                checkpoint.maybe_save(self.checkpoint_dir, ens, cfg,
+                                      rnd + 1)
 
         if pending is not None:                # flush the fetch pipeline
             _store(*pending)
             pending = None
 
-        if self.checkpoint_dir is not None:
-            from ddt_tpu.utils.checkpoint import save_checkpoint
-
-            save_checkpoint(self.checkpoint_dir, ens, cfg, completed_rounds)
+        checkpoint.maybe_save(self.checkpoint_dir, ens, cfg,
+                              completed_rounds)
         if self.timer is not None:
             for rec in self.timer.report():
                 log.info("phase %-12s %8.2f ms total  %7.3f ms/call  "
@@ -490,16 +491,8 @@ class Driver:
                     r, dt * 1e3 / K, metric_name, val_score,
                     lambda k=k: float(losses[k]))
             rnd += K
-            if (
-                self.checkpoint_dir is not None
-                and rnd % self.checkpoint_every == 0
-                and rnd < cfg.n_trees
-            ):
-                from ddt_tpu.utils.checkpoint import save_checkpoint
-
-                save_checkpoint(self.checkpoint_dir, ens, cfg, rnd)
-        if self.checkpoint_dir is not None:
-            from ddt_tpu.utils.checkpoint import save_checkpoint
-
-            save_checkpoint(self.checkpoint_dir, ens, cfg, cfg.n_trees)
+            if rnd < cfg.n_trees:
+                checkpoint.maybe_save(self.checkpoint_dir, ens, cfg, rnd,
+                                      self.checkpoint_every)
+        checkpoint.maybe_save(self.checkpoint_dir, ens, cfg, cfg.n_trees)
         return ens

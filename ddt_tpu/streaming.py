@@ -38,6 +38,7 @@ import numpy as np
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
 from ddt_tpu.reference.numpy_trainer import grad_hess
+from ddt_tpu.utils import checkpoint
 
 log = logging.getLogger("ddt_tpu.streaming")
 
@@ -211,6 +212,8 @@ def fit_streaming(
     cfg: TrainConfig,
     backend=None,
     cache_preds: bool = True,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 25,
 ) -> TreeEnsemble:
     """Train a GBDT over `n_chunks` streamed chunks.
 
@@ -275,9 +278,32 @@ def fit_streaming(
         missing_bin=cfg.missing_policy == "learn", n_bins=cfg.n_bins,
         cat_features=cfg.cat_features,
     )
+    # Checkpoint/resume (SURVEY.md §5) — the streamed runs are the LONGEST
+    # ones, so restartability matters most here. Boosting state is
+    # reconstituted by rescoring the restored partial ensemble per chunk
+    # with the Driver's per-round accumulation order (bit-exact resume).
+    start_round = 0
+    if checkpoint_dir is not None:
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        from ddt_tpu.utils.checkpoint import try_resume
+
+        start_round = try_resume(checkpoint_dir, ens, cfg)
+        if start_round > 0:
+            log.info("streaming: resumed from checkpoint at round %d",
+                     start_round)
+        if start_round >= cfg.n_trees:
+            # Already finished (e.g. a preemptible-restart loop re-runs
+            # the command): return the restored ensemble without the full
+            # boosting-state reconstitution pass over the dataset.
+            return ens
+
     if device:
         return _fit_streaming_device(
-            chunk_fn, n_chunks, cfg, backend, ens, bs, C, y_dev)
+            chunk_fn, n_chunks, cfg, backend, ens, bs, C, y_dev,
+            start_round=start_round, checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every)
 
     # The ONE optional O(R) structure: per-chunk cached raw scores (4 bytes/
     # row). cache_preds=False recomputes scores from the partial ensemble
@@ -286,9 +312,14 @@ def fit_streaming(
         [np.full(chunk_lens[c], bs, np.float32) for c in range(n_chunks)]
         if cache_preds else None
     )
+    if preds is not None and start_round > 0:
+        part = ens.truncate(start_round)
+        for c in range(n_chunks):
+            preds[c] = part.predict_raw_roundwise(
+                chunk_fn(c)[0], binned=True).astype(np.float32)
 
     missing_val = cfg.missing_bin_value
-    for t in range(cfg.n_trees):
+    for t in range(start_round, cfg.n_trees):
         # Grow one tree level-by-level; histograms accumulate across chunks.
         feature = np.full(cfg.n_nodes_total, -1, np.int32)
         threshold_bin = np.zeros(cfg.n_nodes_total, np.int32)
@@ -362,7 +393,10 @@ def fit_streaming(
                 preds[c] += cfg.learning_rate * leaf_value[slot]
 
         log.info("streaming: tree %d/%d done", t + 1, cfg.n_trees)
+        checkpoint.maybe_save(checkpoint_dir, ens, cfg, t + 1,
+                              checkpoint_every)
 
+    checkpoint.maybe_save(checkpoint_dir, ens, cfg, cfg.n_trees)
     return ens
 
 
@@ -375,6 +409,9 @@ def _fit_streaming_device(
     bs: float,
     C: int,
     y_dev: list,
+    start_round: int = 0,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 25,
 ) -> TreeEnsemble:
     """Device streaming loop: see fit_streaming. Per tree it makes
     max_depth histogram passes + 1 leaf pass (+ 1 pred-update pass between
@@ -387,6 +424,26 @@ def _fit_streaming_device(
     # pass 0): pred for the whole run — 4C bytes/row, row-sharded over the
     # mesh like the data, per-chip tiny next to the streamed Xb.
     pred_dev = [backend.init_pred(h, bs) for h in y_dev]
+    if start_round > 0:
+        # Resume: REPLAY the identical device update ops over the restored
+        # trees (rounds ascending, classes ascending — the training
+        # order). Host rescoring would differ by FMA-contraction ULPs
+        # (XLA fuses pred + lr*dv into one rounding); replaying the same
+        # compiled op is bit-exact vs an uninterrupted run by
+        # construction. One upload pass over the chunks, start_round*C
+        # cheap update dispatches each.
+        for c in range(n_chunks):
+            data = backend.upload(chunk_fn(c)[0])
+            for r in range(start_round):
+                for cls in range(C):
+                    slot = r * C + cls
+                    tree_full = (
+                        ens.feature[slot], ens.threshold_bin[slot],
+                        ens.is_leaf[slot], ens.leaf_value[slot],
+                        ens.default_left[slot],
+                    )
+                    pred_dev[c] = backend.stream_update_pred(
+                        data, pred_dev[c], tree_full, cfg.max_depth, cls)
 
     def passes(tree, depth, kind, class_idx):
         """One full pass over the chunks; yields per-chunk device outputs
@@ -403,8 +460,8 @@ def _fit_streaming_device(
                 data = backend.upload(chunk_fn(c + 1)[0])
             yield np.asarray(out)       # fetch (device likely done by now)
 
-    t_out = 0
-    for rnd in range(cfg.n_trees):
+    t_out = start_round * C
+    for rnd in range(start_round, cfg.n_trees):
         # Gradients for EVERY class tree of a round come from the
         # round-start preds (the Driver computes grad_hess once per round,
         # then grows C trees from its columns) — so pred updates are
@@ -461,7 +518,10 @@ def _fit_streaming_device(
                 if c + 1 < n_chunks:
                     data = backend.upload(chunk_fn(c + 1)[0])
         log.info("streaming: round %d/%d done", rnd + 1, cfg.n_trees)
+        checkpoint.maybe_save(checkpoint_dir, ens, cfg, rnd + 1,
+                              checkpoint_every)
 
+    checkpoint.maybe_save(checkpoint_dir, ens, cfg, cfg.n_trees)
     return ens
 
 

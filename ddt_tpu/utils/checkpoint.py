@@ -61,6 +61,24 @@ def save_checkpoint(
     os.replace(tmp_c, os.path.join(ckpt_dir, CURSOR_FILE))
 
 
+def maybe_save(
+    ckpt_dir: str | None,
+    ens: TreeEnsemble,
+    cfg: TrainConfig,
+    completed_rounds: int,
+    every: int | None = None,
+) -> None:
+    """save_checkpoint when a directory is configured and either `every`
+    is None (forced — the end-of-training save) or `completed_rounds`
+    hits the cadence. The single home of the save policy for the Driver
+    and the streaming trainer."""
+    if ckpt_dir is None:
+        return
+    if every is not None and completed_rounds % every != 0:
+        return
+    save_checkpoint(ckpt_dir, ens, cfg, completed_rounds)
+
+
 def try_resume(ckpt_dir: str, ens: TreeEnsemble, cfg: TrainConfig) -> int:
     """Load a checkpoint into `ens` (in place). Returns completed rounds
     (0 = nothing to resume). Raises if the checkpoint's config is

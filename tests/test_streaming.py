@@ -187,3 +187,43 @@ def test_streaming_ragged_chunks_match_inmemory(backend_flag):
     np.testing.assert_array_equal(full.feature, streamed.feature)
     np.testing.assert_array_equal(full.threshold_bin,
                                   streamed.threshold_bin)
+
+
+@pytest.mark.parametrize("backend_flag,loss", [
+    ("cpu", "logloss"),
+    ("tpu", "logloss"),
+    ("tpu", "softmax"),     # C>1: cursor counts rounds, slots rounds*C
+])
+def test_streaming_checkpoint_resume_bit_exact(tmp_path, backend_flag,
+                                               loss):
+    """Streamed training checkpoints per round and resumes BIT-exactly:
+    an interrupted-then-resumed run equals an uninterrupted one (the
+    resident boosting state is reconstituted by per-round rescoring of
+    the restored partial ensemble)."""
+    if loss == "softmax":
+        X, y = datasets.synthetic_multiclass(2048, n_features=8,
+                                             n_classes=3, seed=5)
+        extra = dict(loss="softmax", n_classes=3)
+    else:
+        X, y = datasets.synthetic_binary(2048, n_features=8, seed=5)
+        extra = {}
+    Xb, _ = quantize(X, n_bins=31, seed=5)
+    cfg = TrainConfig(n_trees=5, max_depth=3, n_bins=31,
+                      backend=backend_flag, **extra)
+    chunk_fn, n_chunks = _chunked(Xb, y, 512)
+
+    plain = fit_streaming(chunk_fn, n_chunks, cfg)
+
+    # "Interrupt" after round 2: train a 2-round run into the checkpoint
+    # dir, then resume to 5 from its artifacts.
+    ck = str(tmp_path / "ck")
+    fit_streaming(chunk_fn, n_chunks, cfg.replace(n_trees=2),
+                  checkpoint_dir=ck, checkpoint_every=1)
+    resumed = fit_streaming(chunk_fn, n_chunks, cfg,
+                            checkpoint_dir=ck, checkpoint_every=2)
+
+    np.testing.assert_array_equal(plain.feature, resumed.feature)
+    np.testing.assert_array_equal(plain.threshold_bin,
+                                  resumed.threshold_bin)
+    np.testing.assert_array_equal(plain.is_leaf, resumed.is_leaf)
+    np.testing.assert_array_equal(plain.leaf_value, resumed.leaf_value)
