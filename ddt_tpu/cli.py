@@ -84,11 +84,14 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
     from ddt_tpu.data.quantizer import fit_bin_mapper_streaming
     from ddt_tpu.streaming import fit_streaming, validate_mapper_config
 
+    # cfg (not args) for the TrainConfig-backed fields: a --config file
+    # can set them too, and streaming silently ignoring bagging would be
+    # the exact mismatch this guard exists to prevent.
     unsupported = [
         (args.valid_frac > 0, "--valid-frac"),
         (args.early_stop is not None, "--early-stop"),
-        (args.subsample < 1.0, "--subsample"),
-        (args.colsample_bytree < 1.0, "--colsample-bytree"),
+        (cfg.subsample < 1.0, "subsample"),
+        (cfg.colsample_bytree < 1.0, "colsample_bytree"),
         (args.profile, "--profile"),
         (args.trace_dir is not None, "--trace-dir"),
     ]
@@ -224,6 +227,9 @@ def main(argv: list[str] | None = None) -> int:
                          "quantizer fitted by streamed reservoir sample, "
                          "per-chunk histogram accumulation, boosting state "
                          "device-resident on device backends")
+    tp.add_argument("--config", default=None,
+                    help="YAML/JSON file of TrainConfig fields; values in "
+                         "the file override the corresponding flags")
     tp.add_argument("--out", default="ensemble.npz")
     tp.add_argument("--checkpoint-dir", default=None)
     tp.add_argument("--checkpoint-every", type=_positive_int, default=25,
@@ -261,6 +267,20 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     if args.cmd == "train":
+        file_cfg = None
+        if args.config:
+            from ddt_tpu.config import load_config_file
+
+            file_cfg = load_config_file(args.config)
+            # Fields that feed DATASET loading / inference must apply
+            # BEFORE the load, or the pipeline desynchronizes from the
+            # training config (criteo encoder bins, label normalization
+            # and n_classes inference via loss, generator/split seed,
+            # reported backend).
+            for key, attr in (("n_bins", "bins"), ("seed", "seed"),
+                              ("loss", "loss"), ("backend", "backend")):
+                if key in file_cfg:
+                    setattr(args, attr, file_cfg[key])
         X, y, n_classes, encoder = _load_dataset(args)
         loss = args.loss or (
             "softmax" if args.dataset == "covertype"
@@ -286,6 +306,8 @@ def main(argv: list[str] | None = None) -> int:
             missing_policy=args.missing,
             cat_features=cat_features,
         )
+        if file_cfg is not None:
+            cfg = cfg.replace(**file_cfg)
         if args.stream_chunks > 0:
             return _train_streaming(args, X, y, cfg, encoder)
         eval_set = None

@@ -143,3 +143,38 @@ class TrainConfig:
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def from_file(cls, path: str) -> "TrainConfig":
+        """TrainConfig from a YAML or JSON file (SURVEY.md §5 "Config/flag
+        system": the optional file form of the flag set). Unknown keys
+        fail loudly — a typo'd hyperparameter silently training with its
+        default is worse than an error."""
+        return cls(**load_config_file(path))
+
+
+def load_config_file(path: str) -> dict:
+    """Dict of TrainConfig fields from a .yaml/.yml/.json file, key-
+    validated. The CLI overlays these onto flag-built configs (file wins
+    for the fields it names)."""
+    import json
+
+    with open(path) as f:
+        if path.endswith((".yaml", ".yml")):
+            import yaml
+
+            d = yaml.safe_load(f)
+        else:
+            d = json.load(f)
+    if not isinstance(d, dict):
+        raise ValueError(f"{path} must contain a mapping, got {type(d)}")
+    fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    unknown = sorted(set(d) - fields)
+    if unknown:
+        raise ValueError(
+            f"{path} has unknown TrainConfig keys {unknown}; "
+            f"valid: {sorted(fields)}"
+        )
+    if "cat_features" in d:
+        d["cat_features"] = tuple(d["cat_features"])
+    return d

@@ -150,3 +150,55 @@ def test_cli_train_streaming(tmp_path, capsys):
     with pytest.raises(SystemExit, match="valid-frac"):
         main(["train", "--backend=cpu", "--rows=1000", "--trees=2",
               "--stream-chunks=2", "--valid-frac=0.2"])
+
+
+def test_cli_config_file(tmp_path, capsys):
+    """--config overlays TrainConfig fields from YAML/JSON onto the flag-
+    built config (file wins for fields it names; unknown keys fail)."""
+    from ddt_tpu.config import TrainConfig
+
+    yml = tmp_path / "c.yaml"
+    yml.write_text("n_trees: 5\nmax_depth: 3\nreg_lambda: 2.5\n")
+    model = str(tmp_path / "m.npz")
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--rows=1000", "--trees=99", "--bins=31",
+        f"--config={yml}", f"--out={model}",
+    ])
+    assert rec["trees"] == 5 and rec["depth"] == 3   # file beat --trees=99
+
+    js = tmp_path / "c.json"
+    js.write_text('{"n_trees": 4, "learning_rate": 0.2}')
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--rows=1000", "--bins=31",
+        f"--config={js}", f"--out={model}",
+    ])
+    assert rec["trees"] == 4
+
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"n_treez": 4}')
+    with pytest.raises(ValueError, match="n_treez"):
+        main(["train", "--backend=cpu", "--rows=500", f"--config={bad}"])
+
+    # the library surface
+    c = TrainConfig.from_file(str(yml))
+    assert (c.n_trees, c.max_depth, c.reg_lambda) == (5, 3, 2.5)
+
+
+def test_cli_config_file_syncs_pipeline_fields(tmp_path, capsys):
+    """File-set fields that feed dataset loading / guards apply BEFORE the
+    load: backend is reported truthfully, and file-set bagging is rejected
+    by the streaming guard just like the flag form."""
+    js = tmp_path / "c.json"
+    js.write_text('{"backend": "cpu", "n_trees": 3, "seed": 7}')
+    model = str(tmp_path / "m.npz")
+    rec = _run(capsys, [
+        "train", "--backend=tpu", "--rows=800", "--bins=31",
+        f"--config={js}", f"--out={model}",
+    ])
+    assert rec["backend"] == "cpu"      # the file's backend, not the flag
+
+    bag = tmp_path / "bag.yaml"
+    bag.write_text("subsample: 0.5\n")
+    with pytest.raises(SystemExit, match="subsample"):
+        main(["train", "--backend=cpu", "--rows=800", "--bins=31",
+              "--stream-chunks=2", f"--config={bag}"])
