@@ -192,7 +192,7 @@ def train(
 
 
 def predict(
-    ens: TreeEnsemble,
+    ens: "TreeEnsemble | ModelBundle",
     X: np.ndarray,
     *,
     binned: bool = False,
@@ -202,7 +202,18 @@ def predict(
     cfg: TrainConfig | None = None,
 ) -> np.ndarray:
     """Score a batch. Routes through the device gather+compare path when a
-    backend is given (or cfg selects one); NumPy otherwise."""
+    backend is given (or cfg selects one); NumPy otherwise. A ModelBundle
+    (api.load_model's return) is accepted directly — its training-time
+    mapper is used unless one is passed explicitly. NOTE: the bundle's
+    CategoricalEncoder is NOT applied here (this API never sees which
+    columns are categorical-raw — api.train's contract is that callers
+    encode); X must carry categorical columns already encoded with
+    bundle.encoder.transform, exactly as at training time. The CLI predict
+    path does that re-encoding itself."""
+    if isinstance(ens, ModelBundle):
+        if mapper is None:
+            mapper = ens.mapper
+        ens = ens.ensemble
     X = np.asarray(X)
     if not binned:
         if mapper is not None:

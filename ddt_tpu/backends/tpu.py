@@ -604,10 +604,6 @@ class TPUDevice(DeviceBackend):
             self._eval_fns[(len(handles), metric)] = fn
         return fn(val_data, val_pred, val_y.y, val_y.valid, *handles)
 
-    def fetch_rows(self, x, n_rows: int) -> np.ndarray:
-        """Resolve a row-padded device vector/matrix to host, pad dropped."""
-        return np.asarray(x)[:n_rows]
-
     @functools.cached_property
     def _eval_fns(self) -> dict:
         return {}

@@ -271,12 +271,18 @@ def main(argv: list[str] | None = None) -> int:
         if args.config:
             from ddt_tpu.config import load_config_file
 
-            file_cfg = load_config_file(args.config)
+            try:
+                file_cfg = load_config_file(args.config)
+            except (OSError, ValueError) as e:
+                raise SystemExit(f"--config: {e}") from e
             # Fields that feed DATASET loading / inference must apply
             # BEFORE the load, or the pipeline desynchronizes from the
             # training config (criteo encoder bins, label normalization
             # and n_classes inference via loss, generator/split seed,
-            # reported backend).
+            # reported backend). The full cfg cannot be built first:
+            # cfg.n_classes is DISCOVERED by loading (softmax datasets),
+            # so this list is the sync point — extend it if _load_dataset
+            # ever reads another TrainConfig-backed value.
             for key, attr in (("n_bins", "bins"), ("seed", "seed"),
                               ("loss", "loss"), ("backend", "backend")):
                 if key in file_cfg:

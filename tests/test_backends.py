@@ -202,3 +202,20 @@ def test_backend_parity_edge_configs(depth, bins, loss):
     np.testing.assert_array_equal(ec.is_leaf, et.is_leaf)
     np.testing.assert_allclose(ec.leaf_value, et.leaf_value,
                                rtol=2e-4, atol=2e-5)
+
+
+def test_api_predict_accepts_model_bundle(tmp_path):
+    """api.predict(load_model(path), X) scores with the training-time
+    mapper automatically (the complete-artifact contract end to end)."""
+    from ddt_tpu import api
+    from ddt_tpu.data.datasets import synthetic_binary
+
+    X, y = synthetic_binary(1500, n_features=6, seed=2)
+    res = api.train(X, y, n_trees=4, max_depth=3, n_bins=31,
+                    backend="cpu", log_every=10**9)
+    p = str(tmp_path / "m.npz")
+    res.save(p)
+    bundle = api.load_model(p)
+    got = api.predict(bundle, X)
+    want = api.predict(res.ensemble, X, mapper=res.mapper)
+    np.testing.assert_array_equal(got, want)

@@ -176,8 +176,11 @@ def test_cli_config_file(tmp_path, capsys):
 
     bad = tmp_path / "bad.json"
     bad.write_text('{"n_treez": 4}')
-    with pytest.raises(ValueError, match="n_treez"):
+    with pytest.raises(SystemExit, match="n_treez"):
         main(["train", "--backend=cpu", "--rows=500", f"--config={bad}"])
+    with pytest.raises(SystemExit, match="config"):
+        main(["train", "--backend=cpu", "--rows=500",
+              "--config=/nonexistent.yaml"])
 
     # the library surface
     c = TrainConfig.from_file(str(yml))
