@@ -101,13 +101,19 @@ def best_splits(
     # immaterial to model quality; decision stability across devices is not.
     #
     # Determinism boundary: bf16 rounding absorbs noise RELATIVE to the
-    # gain's magnitude. When the best gains themselves sit at the f32
-    # cancellation noise floor — reg_lambda=0 with min_split_gain=0 on
-    # signal-free nodes — summation-order differences exceed bf16's
-    # ABSOLUTE spacing and backends may legitimately pick different
-    # noise-level splits. Any gain floor above the noise (min_split_gain
-    # >= ~1e-3, or any reg_lambda > 0) restores the invariant
-    # (tests/test_config_fuzz.py).
+    # gain's magnitude — it collapses near-ties AMONG candidates, but it
+    # cannot protect the split/no-split DECISION when a signal-free
+    # node's best gain is itself f32 cancellation noise (~1e-8): with
+    # min_split_gain=0 that noise's sign decides leaf-vs-split and
+    # legitimately differs across summation orders (any reg_lambda).
+    # reg_lambda=0 with min_child_weight=0 additionally lets near-empty
+    # children amplify the noise unboundedly (0/0 vs x/0 can even differ
+    # NaN-vs-inf across backends). Cross-backend bit-identity therefore
+    # holds when decisions sit above the noise floor: min_split_gain >=
+    # ~1e-3 (and min_child_weight >= ~1e-3 when reg_lambda = 0) — the
+    # domain tests/test_config_fuzz.py randomizes over. Well-separated
+    # real-signal configs (the default-parameter test suites) satisfy
+    # this without any explicit floor.
     def overlay_cat(gain, valid):
         """Replace cat features' ordinal gains with one-vs-rest gains
         (left child = exactly bin k => GL_k is the per-bin sum itself)."""

@@ -51,12 +51,17 @@ def _random_case(rng):
     if missing:
         X[rng.random(X.shape) < 0.1] = np.nan
 
-    # Cross-backend bit-identity holds when selected gains sit above the
-    # f32 cancellation noise floor (ops/split.py "Determinism boundary"):
-    # reg_lambda=0 WITH min_split_gain=0 admits pure-noise splits whose
-    # f32 summation-order differences exceed bf16's absolute spacing, so
-    # the fuzzer pairs lambda=0 with a noise-floor min_split_gain.
+    # Cross-backend bit-identity holds when no node's split/no-split
+    # DECISION sits at the f32 cancellation noise floor (ops/split.py
+    # "Determinism boundary"): a signal-free node's best gain is ~1e-8
+    # noise whose sign/magnitude varies with summation order, so
+    # min_split_gain=0 puts the decision on a razor edge regardless of
+    # reg_lambda; and reg_lambda=0 with min_child_weight=0 lets near-
+    # empty children amplify the noise unboundedly. The fuzzer therefore
+    # always carries a noise-floor min_split_gain, plus a hessian floor
+    # when reg_lambda=0.
     lam = float(rng.choice([0.0, 1.0]))
+    mcw = float(rng.choice([0.0, 1e-3, 0.5]))
     cfg = TrainConfig(
         n_trees=int(rng.integers(2, 5)),
         max_depth=int(rng.integers(2, 6)),
@@ -65,8 +70,8 @@ def _random_case(rng):
         n_classes=n_classes,
         learning_rate=float(rng.choice([0.1, 0.3])),
         reg_lambda=lam,
-        min_split_gain=1e-3 if lam == 0.0 else 0.0,
-        min_child_weight=float(rng.choice([0.0, 1e-3, 0.5])),
+        min_split_gain=1e-3,
+        min_child_weight=max(mcw, 1e-3) if lam == 0.0 else mcw,
         subsample=float(rng.choice([1.0, 0.8])),
         colsample_bytree=float(rng.choice([1.0, 0.7])),
         missing_policy="learn" if missing else "zero",
