@@ -174,8 +174,9 @@ def _apply_level_splits(
     gains, feats, bins, dls = best_splits(
         hist, cfg.reg_lambda, cfg.min_child_weight,
         missing_bin=cfg.missing_policy == "learn", cat_mask=cat_mask)
-    value = np.where(H > 0, -G / (H + cfg.reg_lambda), 0.0).astype(
-        np.float32)
+    with np.errstate(divide="ignore", invalid="ignore"):   # empty nodes
+        value = np.where(H > 0, -G / (H + cfg.reg_lambda), 0.0).astype(
+            np.float32)
     do_split = (gains > cfg.min_split_gain) & np.isfinite(gains) & (H > 0)
     for i in range(n_level):
         slot = offset + i
@@ -201,7 +202,8 @@ def _apply_final_leaves(
     the host and device loops)."""
     n_last = 1 << cfg.max_depth
     offset = n_last - 1
-    vals = np.where(Hl > 0, -Gl / (Hl + cfg.reg_lambda), 0.0)
+    with np.errstate(divide="ignore", invalid="ignore"):   # empty nodes
+        vals = np.where(Hl > 0, -Gl / (Hl + cfg.reg_lambda), 0.0)
     is_leaf[offset:offset + n_last] = True
     leaf_value[offset:offset + n_last] = vals.astype(np.float32)
 
