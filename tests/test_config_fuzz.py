@@ -137,3 +137,61 @@ def test_lambda_zero_empty_nodes_have_finite_leaves():
         X2 = rng.standard_normal((500, 4)).astype(np.float32) * 3
         p = ens.predict_raw(m.transform(X2), binned=True)
         assert np.isfinite(p).all(), backend
+
+
+@pytest.mark.parametrize("trial", range(8))
+def test_random_model_predict_paths_agree(trial):
+    """Every scorer path — NumPy oracle, native C++ traversal, device
+    traversal, api raw-with-mapper, float raw-threshold — agrees on a
+    random model (missing/cat included). Exact where the path is exact;
+    tight tolerance for bf16-assisted device descent and re-derived float
+    thresholds."""
+    from ddt_tpu import api
+    from ddt_tpu.backends.cpu import CPUDevice
+
+    rng = np.random.default_rng((31, trial))
+    rows = int(rng.integers(200, 1200))
+    F = int(rng.integers(3, 9))
+    bins = int(rng.choice([7, 31, 63, 255]))
+    loss = str(rng.choice(["logloss", "mse", "softmax"]))
+    nc = int(rng.integers(3, 5)) if loss == "softmax" else 2
+    missing = bool(rng.random() < 0.4)
+    cat = bool(rng.random() < 0.4) and not missing
+    X = rng.standard_normal((rows, F)).astype(np.float32)
+    catf: tuple = ()
+    if cat:
+        ids = rng.integers(0, 10, size=(rows, 1))
+        enc = fit_categorical_encoder(ids, n_bins=bins)
+        X = np.concatenate([X, enc.transform(ids).astype(np.float32)], 1)
+        catf = (F,)
+    if missing:
+        X[rng.random(X.shape) < 0.1] = np.nan
+    y = (rng.integers(0, nc, rows) if loss == "softmax"
+         else (np.nan_to_num(X[:, 0]) > 0).astype(np.int64)
+         if loss == "logloss"
+         else rng.standard_normal(rows).astype(np.float32))
+    res = api.train(X, y, n_trees=int(rng.integers(2, 5)),
+                    max_depth=int(rng.integers(2, 5)), n_bins=bins,
+                    loss=loss, n_classes=nc, backend="cpu",
+                    missing_policy="learn" if missing else "zero",
+                    cat_features=catf, log_every=10**9)
+    ens, m = res.ensemble, res.mapper
+    Xb = m.transform(X)
+    ref = ens.predict_raw(Xb, binned=True)
+    exact = {
+        "native": CPUDevice(TrainConfig(backend="cpu", n_bins=bins,
+                                        cat_features=catf),
+                            use_native=True).predict_raw(ens, Xb),
+        "api_raw_mapper": api.predict(ens, X, mapper=m, raw=True),
+    }
+    for name, got in exact.items():
+        np.testing.assert_allclose(ref, got, rtol=0, atol=0, err_msg=name)
+    approx = {
+        "device": get_backend(TrainConfig(backend="tpu", n_bins=bins,
+                                          cat_features=catf)
+                              ).predict_raw(ens, Xb),
+        "raw_thresholds": ens.predict_raw(X, binned=False),
+    }
+    for name, got in approx.items():
+        np.testing.assert_allclose(ref, got, rtol=3e-4, atol=3e-4,
+                                   err_msg=name)
