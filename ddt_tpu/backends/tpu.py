@@ -177,6 +177,21 @@ class TPUDevice(DeviceBackend):
             return None
         return jax.sharding.NamedSharding(self.mesh, P(*spec))
 
+    @staticmethod
+    def _put(a: np.ndarray, sh) -> jax.Array:
+        """device_put that also works on a MULTI-PROCESS mesh: device_put
+        cannot place shards on devices this process does not own, so when
+        the sharding spans other processes' devices each process
+        materialises its addressable shards from the (identical-everywhere)
+        global host array via the sharding's index map. Single-process
+        meshes keep the plain device_put fast path."""
+        if sh is None:
+            return jax.device_put(a)
+        if not sh.is_fully_addressable:
+            return jax.make_array_from_callback(
+                a.shape, sh, lambda idx: a[idx])
+        return jax.device_put(a, sh)
+
     def _pad_rows(self, a: np.ndarray) -> np.ndarray:
         """Pad axis 0 to a multiple of the (hosts x rows) shard count."""
         R = a.shape[0]
@@ -189,7 +204,7 @@ class TPUDevice(DeviceBackend):
     def _put_rows(self, a: np.ndarray, extra_dims: int = 0) -> jax.Array:
         a = self._pad_rows(np.ascontiguousarray(a))
         sh = self._sharding(self._row_axes, *([None] * extra_dims))
-        return jax.device_put(a, sh) if sh is not None else jax.device_put(a)
+        return self._put(a, sh)
 
     # ------------------------------------------------------------------ #
     # data plane
@@ -208,7 +223,7 @@ class TPUDevice(DeviceBackend):
             if Fp != F:
                 Xb = np.pad(Xb, ((0, 0), (0, Fp - F)))
             Xp = self._pad_rows(np.ascontiguousarray(Xb))
-            data = jax.device_put(Xp, self._sharding(self._row_axes, FAXIS))
+            data = self._put(Xp, self._sharding(self._row_axes, FAXIS))
         else:
             data = self._put_rows(Xb, extra_dims=1)
         return data
@@ -305,7 +320,7 @@ class TPUDevice(DeviceBackend):
         else:
             z = np.full(Rp, base, np.float32)
             sh = self._sharding(self._row_axes)
-        return jax.device_put(z, sh) if sh is not None else jax.device_put(z)
+        return self._put(z, sh)
 
     def load_pred(self, raw: np.ndarray):
         extra = 1 if raw.ndim == 2 else 0
@@ -862,20 +877,19 @@ class TPUDevice(DeviceBackend):
     def _predict_fn(self, ens: TreeEnsemble):
         """(jittable scoring fn, device-resident ensemble arrays)."""
         C = ens.n_classes if ens.loss == "softmax" else 1
-        feat = jax.device_put(ens.feature.astype(np.int32), self._sharding())
-        thr = jax.device_put(ens.threshold_bin.astype(np.int32), self._sharding())
-        leaf = jax.device_put(ens.is_leaf, self._sharding())
-        val = jax.device_put(ens.leaf_value, self._sharding())
+        feat = self._put(ens.feature.astype(np.int32), self._sharding())
+        thr = self._put(ens.threshold_bin.astype(np.int32), self._sharding())
+        leaf = self._put(ens.is_leaf, self._sharding())
+        val = self._put(ens.leaf_value, self._sharding())
         use_missing = ens.missing_bin and ens.default_left is not None
         use_cat = ens.has_cat_splits
         if use_missing or use_cat:
             extras = []
             if use_missing:
-                extras.append(jax.device_put(ens.default_left,
-                                             self._sharding()))
+                extras.append(self._put(ens.default_left, self._sharding()))
             if use_cat:
                 cat_node = np.isin(ens.feature, ens.cat_features)
-                extras.append(jax.device_put(cat_node, self._sharding()))
+                extras.append(self._put(cat_node, self._sharding()))
 
             def fn0(feat, thr, leaf, val, *rest):
                 *opt, Xc = rest

@@ -351,7 +351,7 @@ def main(argv: list[str] | None = None) -> int:
             "wallclock_s": round(dt, 3),
             "final_train_loss": next(
                 (r["train_loss"] for r in reversed(res.history)
-                 if "train_loss" in r), None),
+                 if r.get("train_loss") is not None), None),
             "model": args.out,
         }
         if res.best_score is not None:

@@ -31,6 +31,23 @@ set every round by incremental host-side traversal of each freshly grown tree
 `valid_<metric>` in history, and with `early_stopping_rounds=k` stops when
 the metric hasn't improved in k rounds and truncates the ensemble to the best
 round (utils/metrics.py).
+
+Two documented exceptions to the cross-backend determinism story (the split
+DECISIONS are bit-identical per ops/split.py; these are about reported
+SCORES):
+
+- f32 score boundary: device backends evaluate metrics with their f32 device
+  twins (utils/metrics.device_metric) while host backends use the f64 host
+  implementations, so per-round validation scores — and early-stopping
+  choices on rounds tied within f32 resolution — can differ between TPU and
+  CPU backends for the same data. (auc always scores on host in f64, so
+  auc-driven stopping is backend-invariant.)
+- Resume score seam: on checkpoint resume with a device backend and an
+  eval_set, val predictions are reconstituted by host roundwise rescoring,
+  which differs from the uninterrupted device accumulation by FMA-contraction
+  ULPs; near-tied best_round selection may shift across a resume. (The
+  streaming trainer replays the device ops instead and is bit-exact — its
+  runs are the week-long ones where this matters.)
 """
 
 from __future__ import annotations
@@ -122,8 +139,16 @@ class Driver:
                       val_score, loss_fn) -> None:
         """History/log record for round r, shared by the granular and
         fused loops: train loss at log cadence only (loss_fn() may cost a
-        device sync), eval metric EVERY round — the per-round series
-        (sklearn evals_result_) must not depend on the logging knob."""
+        device sync; off-cadence records carry train_loss=None so the
+        schema stays uniform for external consumers), eval metric EVERY
+        round — the per-round series (sklearn evals_result_) must not
+        depend on the logging knob.
+
+        ms_per_round semantics differ by path, by construction: the
+        granular loop records each round's real wallclock; the fused loop
+        (_fit_fused) dispatches K rounds in one device call, so every
+        round of a block records the BLOCK AVERAGE (per-round wallclock
+        does not exist there — that is the point of fusing)."""
         if (r + 1) % self.log_every == 0 or r == self.cfg.n_trees - 1:
             loss = loss_fn()
             rec = {"round": r + 1, "train_loss": loss,
@@ -139,7 +164,7 @@ class Driver:
             )
         elif val_score is not None:
             self.history.append({
-                "round": r + 1, "ms_per_round": ms,
+                "round": r + 1, "train_loss": None, "ms_per_round": ms,
                 f"valid_{metric_name}": val_score,
             })
 
@@ -395,6 +420,15 @@ class Driver:
                 rnd, dt * 1e3, metric_name, val_score,
                 lambda: self.backend.loss_value(pred, y_dev))
 
+            if early_stopping_rounds is not None and self.best_round is None:
+                # NaN never compares greater, so a NaN-from-round-1 metric
+                # leaves best_round unset; fail with the cause, not a
+                # TypeError from the subtraction below.
+                raise ValueError(
+                    f"validation {metric_name} has been NaN since round 1 "
+                    "(degenerate eval_set — e.g. constant scores or a "
+                    "single-class slice); cannot early-stop on it"
+                )
             if (
                 early_stopping_rounds is not None
                 and rnd - self.best_round >= early_stopping_rounds

@@ -78,6 +78,11 @@ def make_pod_mesh(
     )
 
 
+# Args of the successful initialize_multihost call, for the idempotence
+# guard below (None = never initialised in this process).
+_init_args: dict | None = None
+
+
 def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -85,7 +90,14 @@ def initialize_multihost(
 ) -> None:
     """jax.distributed.initialize with arguments optional (TPU pods
     auto-discover via the metadata service; explicit args for manual
-    bring-up). Safe to call once per process, before first device use."""
+    bring-up). Call once per process, BEFORE first device use.
+
+    Idempotent-or-loud: a repeat call with the SAME arguments is a logged
+    no-op (preemptible-restart loops re-run their whole entry point); a
+    repeat call with DIFFERENT arguments raises — jax.distributed cannot
+    re-wire a live coordinator, and silently keeping the old topology
+    would train on the wrong mesh."""
+    global _init_args
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -93,7 +105,26 @@ def initialize_multihost(
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+    if _init_args is not None:
+        if _init_args == kwargs:
+            log.info("multihost already initialised (process %d/%d); no-op",
+                     jax.process_index(), jax.process_count())
+            return
+        raise RuntimeError(
+            f"initialize_multihost already ran with {_init_args}; cannot "
+            f"re-initialise with {kwargs} — restart the process to change "
+            "the distributed topology"
+        )
+    try:
+        jax.distributed.initialize(**kwargs)
+    except Exception as e:
+        raise RuntimeError(
+            f"jax.distributed.initialize({kwargs}) failed — check that the "
+            "coordinator address is reachable from every process, that "
+            "process_id values are unique in [0, num_processes), and that "
+            "no JAX device was touched before this call"
+        ) from e
+    _init_args = kwargs
     log.info(
         "multihost initialised: process %d/%d, %d global devices",
         jax.process_index(), jax.process_count(), len(jax.devices()),

@@ -256,6 +256,13 @@ def fit_streaming(
         lambda c: chunk_fn(c)[1])
     for c in range(n_chunks):
         yc = labels_of(c)
+        if len(yc) == 0:
+            # Fail HERE, at the cause — a zero-row chunk otherwise dies
+            # far away (device shard padding / NaN base score).
+            raise ValueError(
+                f"chunk {c} is empty; empty chunks are not allowed "
+                "(re-cut the chunk boundaries)"
+            )
         y_sum += float(np.sum(yc))
         y_cnt += len(yc)
         chunk_lens.append(len(yc))

@@ -1,0 +1,104 @@
+"""Worker process for the 2-process jax.distributed bring-up test.
+
+Run as:  python tests/mp_worker.py <coordinator> <num_processes> \
+             <process_id> <devices_per_process> <out.npz>
+
+num_processes == 1 skips initialize_multihost (the single-process
+comparator: same mesh shape, same program, one controller). Each process
+trains the identical small config over a (hosts=nproc*? , rows) pod mesh
+built from the GLOBAL device list and saves its fetched ensemble — the
+parent test asserts all outputs are bit-identical (SURVEY.md §5
+"Distributed communication backend": jax.distributed.initialize is the
+v5e-64 pod bring-up; this exercises the exact entry path with local CPU
+processes, coordinator bootstrap and gloo collectives included).
+
+NOT imported by pytest (no test_ prefix); a standalone entry so the JAX
+platform/device-count environment can be set before first device use.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    coord, nproc, pid, dev_per_proc, out = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+        sys.argv[5],
+    )
+    # sitecustomize may have imported jax already with another platform
+    # bound; the config.update below overrides it. XLA_FLAGS is read when
+    # the CPU client is instantiated, which is AFTER this line.
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={dev_per_proc}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from ddt_tpu.parallel.mesh import initialize_multihost
+
+    if nproc > 1:
+        initialize_multihost(coordinator_address=coord, num_processes=nproc,
+                             process_id=pid)
+        # Idempotence: a repeat call with identical args must be a no-op
+        # (preemptible-restart loops re-run the whole entry point) ...
+        initialize_multihost(coordinator_address=coord, num_processes=nproc,
+                             process_id=pid)
+        # ... and different args must be LOUD, not silently ignored.
+        try:
+            initialize_multihost(coordinator_address=coord,
+                                 num_processes=nproc + 1, process_id=pid)
+        except RuntimeError:
+            pass
+        else:
+            raise AssertionError(
+                "re-init with different args should have raised")
+        assert jax.process_count() == nproc, jax.process_count()
+        assert jax.process_index() == pid, jax.process_index()
+    n_global = nproc * dev_per_proc
+    assert len(jax.devices()) == n_global, jax.devices()
+    assert len(jax.local_devices()) == dev_per_proc
+
+    import numpy as np
+
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.data import datasets
+    from ddt_tpu.data.quantizer import quantize
+    from ddt_tpu.driver import Driver
+
+    # Same deterministic data in every process (the multi-controller SPMD
+    # convention: every host runs the identical program on the identical
+    # host inputs; shards are cut by the sharding's index map).
+    X, y = datasets.synthetic_binary(2048, n_features=10, seed=31)
+    Xb, _ = quantize(X, n_bins=31, seed=31)
+    cfg = TrainConfig(
+        n_trees=3, max_depth=3, n_bins=31, backend="tpu",
+        host_partitions=2, n_partitions=n_global // 2,
+    )
+    be = get_backend(cfg)
+    assert be.mesh.devices.size == n_global
+    ens = Driver(be, cfg, log_every=10**9).fit(Xb, y)
+
+    # Exercise the granular path too (eval_set forces it; device-side eval
+    # keeps val preds resident and fetches a replicated copy for auc —
+    # the multi-host-addressability-sensitive fetch path).
+    k = 512
+    ens2 = Driver(be, cfg, log_every=10**9).fit(
+        Xb[k:], y[k:], eval_set=(Xb[:k], y[:k]), eval_metric="auc")
+
+    np.savez(
+        out,
+        feature=ens.feature, threshold_bin=ens.threshold_bin,
+        is_leaf=ens.is_leaf, leaf_value=ens.leaf_value,
+        g_feature=ens2.feature, g_threshold_bin=ens2.threshold_bin,
+        g_is_leaf=ens2.is_leaf, g_leaf_value=ens2.leaf_value,
+        process_index=np.int64(jax.process_index()),
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""True multi-process distributed execution (round-2 verdict, item 1).
+
+Everything else multi-device in this suite runs in ONE process over
+virtual devices; these tests spawn two real OS processes, wire them with
+jax.distributed.initialize (coordinator bootstrap over localhost, gloo
+CPU collectives), train over a (hosts=2, rows=2) pod mesh built from the
+GLOBAL device list, and assert the fetched ensembles are bit-identical
+across processes AND to a single-process run of the identical mesh shape.
+This is the process-level failure surface a virtual mesh cannot reach:
+per-process device visibility, cross-process psum, non-addressable-shard
+placement (TPUDevice._put), replicated-output fetch (fetch_tree /
+eval_round's all_gather path).
+
+Contract: SURVEY.md §5 "Distributed communication backend"
+("jax.distributed.initialize for the v5e-64 pod config"), BASELINE
+config 5.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(coord, nproc, pid, dev_per_proc, out, tmp_path):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)     # worker pins cpu itself
+    # Isolate XLA compile caches per worker: two processes racing one
+    # cache directory is a real hazard but not what this test is for.
+    env["DDT_COMPILATION_CACHE"] = str(tmp_path / f"cache{pid}")
+    return subprocess.Popen(
+        [sys.executable, _WORKER, coord, str(nproc), str(pid),
+         str(dev_per_proc), out],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def test_two_process_bringup_bit_identical(tmp_path):
+    port = _free_port()
+    coord = f"localhost:{port}"
+    outs = [str(tmp_path / f"p{i}.npz") for i in range(2)]
+    single = str(tmp_path / "single.npz")
+
+    procs = [_spawn(coord, 2, i, 2, outs[i], tmp_path) for i in range(2)]
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(stdout)
+    assert all(p.returncode == 0 for p in procs), (
+        "worker failed:\n" + "\n----\n".join(logs))
+
+    # Single-process comparator: same (hosts=2, rows=2) mesh over 4
+    # virtual devices in one controller — identical program, so identical
+    # trees prove the multi-process run computed the same thing.
+    ps = _spawn("unused", 1, 0, 4, single, tmp_path)
+    stdout, _ = ps.communicate(timeout=900)
+    assert ps.returncode == 0, stdout
+
+    d0 = np.load(outs[0])
+    d1 = np.load(outs[1])
+    ds = np.load(single)
+    assert int(d0["process_index"]) == 0
+    assert int(d1["process_index"]) == 1
+    for prefix in ("", "g_"):
+        for k in ("feature", "threshold_bin", "is_leaf", "leaf_value"):
+            key = prefix + k
+            # The two processes fetch replicas of one global computation:
+            # bitwise equal, leaf values included.
+            np.testing.assert_array_equal(d0[key], d1[key], err_msg=key)
+        for k in ("feature", "threshold_bin", "is_leaf"):
+            key = prefix + k
+            np.testing.assert_array_equal(d0[key], ds[key], err_msg=key)
+        # Cross-process gloo allreduce may sum in a different order than
+        # the single-controller collective: structure is bit-identical
+        # (bf16-rounded split selection absorbs ULPs), leaf values are
+        # float-close.
+        np.testing.assert_allclose(d0[prefix + "leaf_value"],
+                                   ds[prefix + "leaf_value"],
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_initialize_multihost_guard():
+    """The idempotence guard itself, in-process (no coordinator needed:
+    the guard trips before jax.distributed is touched)."""
+    from ddt_tpu.parallel import mesh
+
+    orig = mesh._init_args
+    try:
+        mesh._init_args = {"coordinator_address": "localhost:1",
+                           "num_processes": 2, "process_id": 0}
+        # same args: no-op
+        mesh.initialize_multihost("localhost:1", 2, 0)
+        # different args: loud
+        with pytest.raises(RuntimeError, match="cannot\n?\\s*re-initialise"):
+            mesh.initialize_multihost("localhost:1", 2, 1)
+    finally:
+        mesh._init_args = orig
