@@ -789,6 +789,22 @@ class TPUDevice(DeviceBackend):
                     class_idx=class_idx,
                     missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
                 )
+        elif kind == "roundstart":
+            # `depth` carries the previous round's tree count (= C).
+            n_prev = depth
+
+            def f(Xb, pred, y, valid, *flat):
+                trees = tuple(
+                    tuple(flat[5 * i: 5 * i + 5]) for i in range(n_prev))
+                return stream_ops.stream_round_start(
+                    Xb, pred, y, valid, trees,
+                    max_depth=cfg.max_depth,
+                    learning_rate=cfg.learning_rate,
+                    n_bins=cfg.n_bins, loss=cfg.loss,
+                    hist_impl=cfg.hist_impl,
+                    input_dtype=self._input_dtype, axis_name=axis,
+                    missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
+                )
         else:  # pragma: no cover
             raise ValueError(kind)
 
@@ -799,13 +815,18 @@ class TPUDevice(DeviceBackend):
                 in_specs = (P(rax, None), pred_spec, P(), P(), P(), P(),
                             P())
                 out_specs = pred_spec
+            elif kind == "roundstart":
+                in_specs = (P(rax, None), pred_spec, P(rax), P(rax)) + \
+                    (P(),) * (5 * depth)
+                out_specs = (pred_spec, P())
             else:
                 in_specs = (P(rax, None), pred_spec, P(rax), P(rax),
                             P(), P(), P(), P())
                 out_specs = P()
             f = jax.shard_map(f, mesh=self.mesh, in_specs=in_specs,
                               out_specs=out_specs)
-        fn = jax.jit(f, donate_argnums=(1,) if kind == "update" else ())
+        donate = (1,) if kind in ("update", "roundstart") else ()
+        fn = jax.jit(f, donate_argnums=donate)
         self._stream_cache[key] = fn
         return fn
 
@@ -834,6 +855,16 @@ class TPUDevice(DeviceBackend):
         feat, thr, leaf, val, dl = tree_full
         return self._stream_fn("update", max_depth, class_idx)(
             data, pred, feat, thr, leaf, val, dl)
+
+    def stream_round_start(self, data, pred, y: "LabelHandle",
+                           prev_trees: list):
+        """Fused round-start pass for one chunk: apply the previous
+        round's finished class trees to the resident pred, then return the
+        NEXT round's class-0 depth-0 histogram — one dispatch, one data
+        read (ops/stream.stream_round_start). Returns (new_pred, hist)."""
+        flat = [a for t in prev_trees for a in t]
+        return self._stream_fn("roundstart", len(prev_trees), 0)(
+            data, pred, y.y, y.valid, *flat)
 
     # ------------------------------------------------------------------ #
     # inference (TreeEnsemble.predict → gather+compare, row-sharded)

@@ -17,6 +17,7 @@ import argparse
 import dataclasses
 import json
 import logging
+import os
 import sys
 import time
 
@@ -75,21 +76,33 @@ def _load_dataset(args, encoder=None, n_features=None):
     raise SystemExit(f"unknown dataset {args.dataset!r}")
 
 
+def _seeded_split(X, y, frac: float, seed: int):
+    """The seeded held-out row split — ONE home for both the in-memory and
+    streamed train paths, so their validation semantics cannot drift.
+    Returns (X_train, y_train, X_val, y_val)."""
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(y))
+    k = int(len(y) * frac)
+    if k < 1:
+        raise SystemExit("--valid-frac holds out zero rows")
+    va, tr = idx[:k], idx[k:]
+    return X[tr], y[tr], X[va], y[va]
+
+
 def _train_streaming(args, X, y, cfg, encoder) -> int:
-    """`train --stream-chunks=N`: the BASELINE config-5 path from the CLI.
-    The in-memory dataset stands in for a chunk source (the protocol is
-    what's exercised: streamed reservoir quantizer fit, per-chunk
-    histogram accumulation, device-resident boosting state); a file-backed
-    chunk_fn drops into the same two calls."""
-    from ddt_tpu.data.quantizer import fit_bin_mapper_streaming
-    from ddt_tpu.streaming import fit_streaming, validate_mapper_config
+    """`train --stream-chunks=N | --stream-dir=D`: the BASELINE config-5
+    path from the CLI. With --stream-dir, training streams npz shards
+    from disk in O(chunk) host memory end to end (data.chunks); with
+    --stream-chunks, the loaded dataset is binned chunk-by-chunk into an
+    on-disk uint8 cache and streamed back from it — either way no binned
+    matrix is ever host-resident."""
+    import shutil
+    import tempfile
 
     # cfg (not args) for the TrainConfig-backed fields: a --config file
     # can set them too, and streaming silently ignoring bagging would be
     # the exact mismatch this guard exists to prevent.
     unsupported = [
-        (args.valid_frac > 0, "--valid-frac"),
-        (args.early_stop is not None, "--early-stop"),
         (cfg.subsample < 1.0, "subsample"),
         (cfg.colsample_bytree < 1.0, "colsample_bytree"),
         (args.profile, "--profile"),
@@ -101,57 +114,181 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
             f"--stream-chunks does not compose with {', '.join(bad)} "
             "(streaming trains on the full stream, deterministically)"
         )
-    n_chunks = args.stream_chunks
-    rows = len(y)
-    if n_chunks > rows:
-        raise SystemExit(
-            f"--stream-chunks={n_chunks} exceeds the row count ({rows}); "
-            "empty chunks are not allowed"
-        )
-    # Truncated-linspace boundaries: sizes differ by at most one, never
-    # empty given the guard above (ragged chunks are supported — each
-    # size compiles its own program). Layout differs from np.array_split
-    # (which fronts the larger chunks); only the two properties matter.
-    bounds = np.linspace(0, rows, n_chunks + 1).astype(np.int64)
-
-    def raw_fn(c):
-        return X[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
-
     t0 = time.perf_counter()
-    mapper = fit_bin_mapper_streaming(
-        raw_fn, n_chunks, n_bins=cfg.n_bins, seed=cfg.seed,
-        missing_policy=cfg.missing_policy, cat_features=cfg.cat_features,
-    )
-    # Bin ONCE — the dataset is fully resident here, and fit_streaming
-    # re-reads every chunk (max_depth+2) times per tree; streaming the
-    # pre-binned matrix skips ~hundreds of repeat transforms while the
-    # reservoir mapper fit above still exercises the streamed protocol.
-    validate_mapper_config(mapper, cfg)
-    Xb = mapper.transform(X)
-
-    def chunk_fn(c):
-        return Xb[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
-
+    tmp_cache = None
+    cache_root = args.stream_cache_dir
+    if cache_root is None:
+        tmp_cache = tempfile.mkdtemp(prefix="ddt_binned_")
+        cache_root = tmp_cache
     try:
-        ens = fit_streaming(chunk_fn, n_chunks, cfg,
-                            checkpoint_dir=args.checkpoint_dir,
-                            checkpoint_every=args.checkpoint_every)
-    except NotImplementedError as e:   # e.g. host-path softmax streaming
+        ens, history, mapper, rows, n_chunks, chunk_rows_max = \
+            _stream_fit(args, X, y, cfg, cache_root)
+    except NotImplementedError as e:   # e.g. feature-parallel streaming
         raise SystemExit(str(e)) from e
+    finally:
+        # tmp cache cleanup covers EVERY failure mode, including a death
+        # mid-way through writing the (potentially huge) binned cache.
+        if tmp_cache is not None:
+            shutil.rmtree(tmp_cache, ignore_errors=True)
     dt = time.perf_counter() - t0
-    from ddt_tpu.reference.numpy_trainer import _fill_raw_thresholds
+    if mapper is not None:
+        from ddt_tpu.reference.numpy_trainer import _fill_raw_thresholds
 
-    _fill_raw_thresholds(ens, mapper)
+        _fill_raw_thresholds(ens, mapper)
     api.save_model(args.out, ens, mapper=mapper, encoder=encoder)
-    print(json.dumps({
+    out = {
         "cmd": "train", "backend": args.backend, "rows": rows,
         "trees": ens.n_trees, "depth": cfg.max_depth,
         "streamed_chunks": n_chunks,
-        "chunk_rows": int((bounds[1:] - bounds[:-1]).max()),
+        "chunk_rows": chunk_rows_max,
         "wallclock_s": round(dt, 3),
         "model": args.out,
-    }))
+    }
+    if history:
+        from ddt_tpu.utils.metrics import GREATER_IS_BETTER
+
+        mk = next(k for k in history[0] if k.startswith("valid_"))
+        sign = 1.0 if GREATER_IS_BETTER[mk[len("valid_"):]] else -1.0
+        bi = int(np.argmax([sign * r[mk] for r in history]))
+        out["best_round"] = history[bi]["round"]
+        out["best_score"] = round(history[bi][mk], 6)
+    print(json.dumps(out))
     return 0
+
+
+def _stream_fit(args, X, y, cfg, cache_root):
+    """Chunk-source construction + fit_streaming for _train_streaming
+    (separated so its caller's finally-cleanup wraps the WHOLE cache
+    lifecycle). Returns (ens, history, mapper, rows, n_chunks,
+    chunk_rows_max)."""
+    from ddt_tpu.data import chunks as chunks_mod
+    from ddt_tpu.data.quantizer import fit_bin_mapper_streaming
+    from ddt_tpu.streaming import (binned_chunks, fit_streaming,
+                                   validate_mapper_config)
+
+    def _cached_binned(raw_fn, n, mapper, sub):
+        """Raw chunks -> uint8 cache shards on disk (transform once);
+        falls through to re-binning reads when caching is disabled."""
+        if args.stream_cache_dir == "":
+            return binned_chunks(raw_fn, mapper, cfg)
+        return chunks_mod.write_binned_cache(
+            raw_fn, n, mapper, os.path.join(cache_root, sub))
+
+    if args.stream_dir:
+        # True out-of-core: npz shards streamed from disk, O(chunk) host
+        # memory end to end — nothing was loaded by _load_dataset.
+        if args.stream_chunks:
+            raise SystemExit(
+                "--stream-dir reads its chunk count from the directory; "
+                "drop --stream-chunks")
+        raw = chunks_mod.directory_chunks(args.stream_dir)
+        n_total = raw.n_chunks
+        n_valid = 0
+        if args.valid_frac > 0:
+            # Chunk-granularity holdout: the LAST ceil(frac*n) shards.
+            n_valid = int(np.ceil(n_total * args.valid_frac))
+            if n_valid >= n_total:
+                raise SystemExit(
+                    f"--valid-frac={args.valid_frac} holds out all "
+                    f"{n_total} shards; nothing left to train on")
+        elif args.early_stop is not None:
+            raise SystemExit("--early-stop requires --valid-frac")
+        n_chunks = n_total - n_valid
+
+        def raw_train(c):
+            return raw(c)
+
+        raw_train.labels = raw.labels
+        raw_train.n_features = raw.n_features
+
+        def raw_valid(c):
+            return raw(n_chunks + c)
+
+        raw_valid.labels = lambda c: raw.labels(n_chunks + c)
+
+        lens = [len(raw.labels(c)) for c in range(n_total)]
+        rows = sum(lens[:n_chunks])
+        chunk_rows_max = max(lens[:n_chunks])
+        if cfg.loss == "softmax":
+            ymax = max(int(raw.labels(c).max()) for c in range(n_total))
+            cfg = cfg.replace(n_classes=max(cfg.n_classes, ymax + 1))
+        if raw.binned:
+            # Pre-binned uint8 shards (e.g. a binned cache, or the stress
+            # generator's output): no mapper — the artifact scores binned
+            # input only.
+            mapper = None
+            chunk_fn, valid_chunk_fn = raw_train, (
+                raw_valid if n_valid else None)
+        else:
+            mapper = fit_bin_mapper_streaming(
+                raw_train, n_chunks, n_bins=cfg.n_bins, seed=cfg.seed,
+                missing_policy=cfg.missing_policy,
+                cat_features=cfg.cat_features,
+            )
+            validate_mapper_config(mapper, cfg)
+            chunk_fn = _cached_binned(raw_train, n_chunks, mapper, "train")
+            valid_chunk_fn = (
+                _cached_binned(raw_valid, n_valid, mapper, "valid")
+                if n_valid else None)
+    else:
+        # Loaded dataset (--dataset/--data): held-out validation uses the
+        # same seeded row split as the in-memory path, then BOTH splits
+        # stream through the on-disk uint8 cache — no binned matrix is
+        # ever host-resident (round-2 verdict item 4).
+        Xv = yv = None
+        if args.valid_frac > 0:
+            X, y, Xv, yv = _seeded_split(X, y, args.valid_frac, args.seed)
+        elif args.early_stop is not None:
+            raise SystemExit("--early-stop requires --valid-frac")
+        n_chunks = args.stream_chunks
+        rows = len(y)
+        if n_chunks > rows:
+            raise SystemExit(
+                f"--stream-chunks={n_chunks} exceeds the row count "
+                f"({rows}); empty chunks are not allowed"
+            )
+        # Truncated-linspace boundaries: sizes differ by at most one,
+        # never empty given the guard above (ragged chunks are supported —
+        # each size compiles its own program).
+        bounds = np.linspace(0, rows, n_chunks + 1).astype(np.int64)
+        chunk_rows_max = int((bounds[1:] - bounds[:-1]).max())
+
+        def raw_fn(c):
+            return X[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
+
+        mapper = fit_bin_mapper_streaming(
+            raw_fn, n_chunks, n_bins=cfg.n_bins, seed=cfg.seed,
+            missing_policy=cfg.missing_policy, cat_features=cfg.cat_features,
+        )
+        validate_mapper_config(mapper, cfg)
+        chunk_fn = _cached_binned(raw_fn, n_chunks, mapper, "train")
+
+        valid_chunk_fn = None
+        n_valid = 0
+        if Xv is not None:
+            # Val chunk sizes track the train chunk size (each distinct
+            # size compiles its own device program).
+            n_valid = max(1, int(np.ceil(
+                len(yv) / max(1, -(-rows // n_chunks)))))
+            vbounds = np.linspace(0, len(yv), n_valid + 1).astype(np.int64)
+
+            def raw_vfn(c):
+                return (Xv[vbounds[c]:vbounds[c + 1]],
+                        yv[vbounds[c]:vbounds[c + 1]])
+
+            valid_chunk_fn = _cached_binned(raw_vfn, n_valid, mapper,
+                                            "valid")
+
+    history: list = []
+    ens = fit_streaming(chunk_fn, n_chunks, cfg,
+                        checkpoint_dir=args.checkpoint_dir,
+                        checkpoint_every=args.checkpoint_every,
+                        valid_chunk_fn=valid_chunk_fn,
+                        n_valid_chunks=n_valid,
+                        eval_metric=args.metric,
+                        early_stopping_rounds=args.early_stop,
+                        history=history)
+    return ens, history, mapper, rows, n_chunks, chunk_rows_max
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -227,6 +364,17 @@ def main(argv: list[str] | None = None) -> int:
                          "quantizer fitted by streamed reservoir sample, "
                          "per-chunk histogram accumulation, boosting state "
                          "device-resident on device backends")
+    tp.add_argument("--stream-dir", default=None,
+                    help="train out-of-core from a directory of npz chunk "
+                         "shards (chunk_00000.npz ... with arrays X, y — "
+                         "cut them with data.chunks.shard_file/"
+                         "shard_arrays); O(chunk) host memory end to end. "
+                         "Overrides --dataset/--data")
+    tp.add_argument("--stream-cache-dir", default=None,
+                    help="directory for the streamed paths' on-disk uint8 "
+                         "binned-chunk cache (default: a temp dir deleted "
+                         "after training; pass '' to disable caching and "
+                         "re-bin chunks on every read)")
     tp.add_argument("--config", default=None,
                     help="YAML/JSON file of TrainConfig fields; values in "
                          "the file override the corresponding flags")
@@ -287,14 +435,22 @@ def main(argv: list[str] | None = None) -> int:
                               ("loss", "loss"), ("backend", "backend")):
                 if key in file_cfg:
                     setattr(args, attr, file_cfg[key])
-        X, y, n_classes, encoder = _load_dataset(args)
+        if args.stream_dir:
+            # Out-of-core path: nothing is loaded here — the shards stream
+            # (softmax n_classes is discovered from the shard labels in
+            # _train_streaming).
+            X = y = encoder = None
+            n_classes = 2
+        else:
+            X, y, n_classes, encoder = _load_dataset(args)
         loss = args.loss or (
             "softmax" if args.dataset == "covertype"
             else "mse" if args.dataset == "regression" else "logloss"
         )
         cat_features: tuple = ()
         if (args.dataset == "criteo" and args.cat_splits == "onehot"
-                and not args.data):   # --data overrides --dataset: its
+                and not args.data
+                and not args.stream_dir):   # --data overrides --dataset: its
             # columns are arbitrary, never implicitly categorical
             # The criteo layout (datasets.synthetic_ctr): 13 numeric
             # columns first, then the encoder's categorical columns.
@@ -314,15 +470,12 @@ def main(argv: list[str] | None = None) -> int:
         )
         if file_cfg is not None:
             cfg = cfg.replace(**file_cfg)
-        if args.stream_chunks > 0:
+        if args.stream_chunks > 0 or args.stream_dir:
             return _train_streaming(args, X, y, cfg, encoder)
         eval_set = None
         if args.valid_frac > 0:
-            rng = np.random.default_rng(args.seed)
-            idx = rng.permutation(len(y))
-            k = int(len(y) * args.valid_frac)
-            va, tr = idx[:k], idx[k:]
-            X, y, eval_set = X[tr], y[tr], (X[va], y[va])
+            X, y, Xv, yv = _seeded_split(X, y, args.valid_frac, args.seed)
+            eval_set = (Xv, yv)
         t0 = time.perf_counter()
         import contextlib
 

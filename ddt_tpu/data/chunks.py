@@ -1,0 +1,140 @@
+"""File-backed chunk sources for the streaming trainer (layer L7).
+
+The 10B-row config (BASELINE config 5) cannot hold a dataset in host
+memory; streaming.fit_streaming already trains from any pure
+``chunk_fn(c) -> (X_chunk, y_chunk)``. This module provides the on-disk
+realization: a directory of npz shards, a writer that cuts one, and a
+binned-cache writer so every re-read of a chunk streams uint8 straight
+from disk instead of re-binning floats (fit_streaming re-reads every
+chunk (max_depth+1) times per tree).
+
+Shard layout: ``<dir>/chunk_00000.npz`` ... each holding arrays ``X``
+([rows, F] — float32 raw features, or uint8 when pre-binned) and ``y``
+([rows] labels). Shards stream in filename order; sizes may differ (each
+distinct size jit-compiles its own device program — the writers cut
+near-equal sizes so at most two programs compile).
+
+O(chunk) guarantee: nothing here holds more than one shard in memory at
+a time; the label-only accessor decompresses just the ``y`` member (npz
+members are read lazily), so fit_streaming's pass 0 never touches X.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+CHUNK_PREFIX = "chunk_"
+
+
+def _chunk_path(out_dir: str, c: int) -> str:
+    return os.path.join(out_dir, f"{CHUNK_PREFIX}{c:05d}.npz")
+
+
+def chunk_files(src_dir: str) -> list[str]:
+    files = sorted(glob.glob(os.path.join(src_dir, CHUNK_PREFIX + "*.npz")))
+    if not files:
+        raise ValueError(
+            f"no {CHUNK_PREFIX}*.npz shards in {src_dir!r} — write them "
+            "with data.chunks.shard_arrays / shard_file"
+        )
+    return files
+
+
+def shard_arrays(
+    X: np.ndarray,
+    y: np.ndarray,
+    out_dir: str,
+    n_chunks: int | None = None,
+    chunk_rows: int | None = None,
+) -> list[str]:
+    """Writer utility: cut an in-memory (X, y) into npz shards (linspace
+    bounds — every row covered, sizes differ by at most one). Exactly one
+    of n_chunks / chunk_rows. Returns the written paths."""
+    if (n_chunks is None) == (chunk_rows is None):
+        raise ValueError("pass exactly one of n_chunks / chunk_rows")
+    rows = len(y)
+    if rows == 0:
+        raise ValueError("cannot shard an empty dataset")
+    if n_chunks is None:
+        n_chunks = max(1, -(-rows // chunk_rows))
+    if n_chunks > rows:
+        raise ValueError(
+            f"n_chunks={n_chunks} exceeds the row count ({rows}); empty "
+            "chunks are not allowed"
+        )
+    os.makedirs(out_dir, exist_ok=True)
+    bounds = np.linspace(0, rows, n_chunks + 1).astype(np.int64)
+    paths = []
+    for c in range(n_chunks):
+        p = _chunk_path(out_dir, c)
+        np.savez(p, X=X[bounds[c]:bounds[c + 1]],
+                 y=y[bounds[c]:bounds[c + 1]])
+        paths.append(p)
+    return paths
+
+
+def shard_file(
+    src: str,
+    out_dir: str,
+    chunk_rows: int,
+    label_col: str = "auto",
+    normalize_labels: bool | None = None,
+) -> list[str]:
+    """Shard a dataset file (.npz/.csv[.gz]/libsvm — data.datasets.load_file
+    formats) into npz chunk shards. The source file is materialised once
+    to split it (these formats aren't seekable by row); from then on
+    training streams the shards in O(chunk_rows) memory — run this once on
+    a big-memory box, train anywhere."""
+    from ddt_tpu.data.datasets import load_file
+
+    X, y = load_file(src, label_col=label_col,
+                     normalize_labels=normalize_labels)
+    return shard_arrays(X, y, out_dir, chunk_rows=chunk_rows)
+
+
+def directory_chunks(src_dir: str):
+    """ChunkFn over a shard directory. Exposes the side-channel accessors
+    fit_streaming/binned_chunks use: ``.labels(c)`` (reads only the y
+    member), ``.n_features``, ``.n_chunks``, ``.binned`` (True when the
+    shards hold uint8 pre-binned data)."""
+    files = chunk_files(src_dir)
+
+    def f(c: int):
+        with np.load(files[c]) as d:
+            return d["X"], d["y"]
+
+    def labels(c: int):
+        with np.load(files[c]) as d:
+            return d["y"]
+
+    with np.load(files[0]) as d0:
+        X0 = d0["X"]
+        f.n_features = int(X0.shape[1])
+        f.binned = X0.dtype == np.uint8
+
+    f.labels = labels
+    f.n_chunks = len(files)
+    return f
+
+
+def write_binned_cache(
+    raw_chunk_fn,
+    n_chunks: int,
+    mapper,
+    cache_dir: str,
+):
+    """Transform each raw chunk ONCE through a fitted BinMapper and persist
+    the uint8 result; returns a directory_chunks source over the cache.
+    This is the optional binned-chunk cache: fit_streaming re-reads every
+    chunk (max_depth+1) times per tree, and uint8-from-disk beats
+    re-binning floats on every pass (and is 4x smaller on disk than the
+    float32 it replaces). O(chunk) memory throughout."""
+    os.makedirs(cache_dir, exist_ok=True)
+    for c in range(n_chunks):
+        X, y = raw_chunk_fn(c)
+        np.savez(_chunk_path(cache_dir, c),
+                 X=mapper.transform(np.asarray(X, np.float32)), y=y)
+    return directory_chunks(cache_dir)

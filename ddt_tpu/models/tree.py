@@ -243,6 +243,22 @@ class TreeEnsemble:
         walk(self.dump(tree), 0)
         return "\n".join(lines)
 
+    def to_lightgbm_text(self, feature_names: list[str] | None = None
+                         ) -> str:
+        """LightGBM model.txt rendering (models/lightgbm_io.py): load with
+        lightgbm.Booster(model_str=...) or diff against a LightGBM model
+        tree-by-tree (docs/REAL_DATA.md)."""
+        from ddt_tpu.models.lightgbm_io import to_lightgbm_text
+
+        return to_lightgbm_text(self, feature_names=feature_names)
+
+    @staticmethod
+    def from_lightgbm_text(text: str) -> "TreeEnsemble":
+        """Parse a LightGBM model.txt (models/lightgbm_io.py)."""
+        from ddt_tpu.models.lightgbm_io import from_lightgbm_text
+
+        return from_lightgbm_text(text)
+
     def to_dict(self) -> dict:
         return {
             "feature": self.feature,

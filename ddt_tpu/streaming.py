@@ -208,6 +208,76 @@ def _apply_final_leaves(
     leaf_value[offset:offset + n_last] = vals.astype(np.float32)
 
 
+class _StreamEval:
+    """Held-out-chunk validation for the streaming trainers (round-2
+    verdict item 3): per-round metric over streamed validation chunks,
+    best-round tracking, early stopping. Metrics evaluate on HOST in f64
+    over the concatenated per-chunk raw scores — the Driver's host eval
+    path, so auc works and stopping decisions are backend-invariant (the
+    f32 device-metric boundary documented in driver.py does not apply
+    here). Validation labels are O(val rows) host state — the val set is
+    the small fraction; the 10B-row axis being streamed is the train set.
+    """
+
+    def __init__(self, valid_chunk_fn: ChunkFn, n_valid_chunks: int,
+                 metric_name: str | None, loss: str,
+                 early_stopping_rounds: int | None,
+                 history: list | None):
+        from ddt_tpu.utils.metrics import GREATER_IS_BETTER, default_metric
+
+        if n_valid_chunks < 1:
+            raise ValueError("validation needs n_valid_chunks >= 1")
+        self.fn = valid_chunk_fn
+        self.n = n_valid_chunks
+        self.metric = metric_name or default_metric(loss)
+        if self.metric not in GREATER_IS_BETTER:
+            raise ValueError(
+                f"unknown metric {self.metric!r}; "
+                f"have {sorted(GREATER_IS_BETTER)}"
+            )
+        self.sign = 1.0 if GREATER_IS_BETTER[self.metric] else -1.0
+        self.patience = early_stopping_rounds
+        self.history = history if history is not None else []
+        labels_of = getattr(valid_chunk_fn, "labels", None) or (
+            lambda c: valid_chunk_fn(c)[1])
+        ys = [np.asarray(labels_of(c)) for c in range(self.n)]
+        if any(len(y) == 0 for y in ys):
+            raise ValueError("empty validation chunks are not allowed")
+        self._ys = ys
+        self.y = np.concatenate(ys)
+        self.lens = [len(y) for y in ys]
+        self.best = -np.inf
+        self.best_round: int | None = None
+        self.best_score: float | None = None
+
+    def labels(self, c: int) -> np.ndarray:
+        """Chunk c's labels WITHOUT re-reading (or re-binning) the chunk."""
+        return self._ys[c]
+
+    def record(self, rnd: int, raw_scores: np.ndarray) -> bool:
+        """Score round `rnd` from the concatenated raw validation scores;
+        returns True when early stopping says stop AFTER this round."""
+        from ddt_tpu.utils.metrics import evaluate
+
+        s = evaluate(self.metric, self.y, raw_scores)
+        self.history.append({"round": rnd + 1, f"valid_{self.metric}": s})
+        log.info("streaming: round %d valid_%s=%.6f", rnd + 1, self.metric,
+                 s)
+        if self.sign * s > self.best:
+            self.best = self.sign * s
+            self.best_round = rnd
+            self.best_score = s
+        if self.patience is None:
+            return False
+        if self.best_round is None:
+            # Same guard as Driver.fit: NaN never improves on -inf.
+            raise ValueError(
+                f"validation {self.metric} has been NaN since round 1 "
+                "(degenerate validation chunks); cannot early-stop on it"
+            )
+        return rnd - self.best_round >= self.patience
+
+
 def fit_streaming(
     chunk_fn: ChunkFn,
     n_chunks: int,
@@ -216,19 +286,36 @@ def fit_streaming(
     cache_preds: bool = True,
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 25,
+    valid_chunk_fn: ChunkFn | None = None,
+    n_valid_chunks: int = 0,
+    eval_metric: str | None = None,
+    early_stopping_rounds: int | None = None,
+    history: list | None = None,
 ) -> TreeEnsemble:
     """Train a GBDT over `n_chunks` streamed chunks.
+
+    Validation/early stopping (round-2 verdict item 3): pass held-out
+    chunks via `valid_chunk_fn`/`n_valid_chunks` — each round's freshly
+    grown trees are applied to per-chunk validation predictions (device-
+    resident on device backends, exactly like the training state) and the
+    metric is recorded in `history` ({"round", "valid_<metric>"}, the
+    Driver's record shape). With `early_stopping_rounds=k`, training
+    stops after k rounds without improvement and the returned ensemble is
+    truncated to the best round — identical truncation semantics to
+    Driver.fit. On checkpoint resume, best-round tracking restarts at the
+    resume round (earlier rounds' scores are not re-evaluated).
 
     Device backends exposing the stream_* surface (TPUDevice) run the
     whole per-(chunk, level) step on device — traversal, grads, histogram,
     psum — with the NEXT chunk's upload overlapping the current chunk's
     compute, and per-chunk boosting state (pred, labels) resident on
     device for the whole run (ops/stream.py; supports softmax and
-    n_partitions/host_partitions > 1). Host backends stream the original
-    host formulation (binary/mse). Both are bit-identical to the in-memory
-    Driver on the same data, including missing_policy='learn' (reserved
-    NaN bin + learned default directions) and categorical one-vs-rest
-    splits (tests/test_streaming.py).
+    n_partitions/host_partitions > 1). Host backends stream the host
+    formulation (binary/mse/softmax — one tree per class per round from
+    round-start preds, like the Driver). Both are bit-identical to the
+    in-memory Driver on the same data, including missing_policy='learn'
+    (reserved NaN bin + learned default directions) and categorical
+    one-vs-rest splits (tests/test_streaming.py).
     """
     if backend is None:
         from ddt_tpu.backends import get_backend
@@ -236,11 +323,6 @@ def fit_streaming(
         backend = get_backend(cfg)
 
     device = hasattr(backend, "stream_level_hist")
-    if cfg.loss == "softmax" and not device:
-        raise NotImplementedError(
-            "host-path streaming softmax is not wired; use the TPU "
-            "backend (device streaming supports softmax)"
-        )
 
     # Pass 0: base score from running label sums + shape discovery — no
     # O(R) host state anywhere in this trainer except the optional preds
@@ -308,86 +390,130 @@ def fit_streaming(
             # boosting-state reconstitution pass over the dataset.
             return ens
 
+    if early_stopping_rounds is not None and valid_chunk_fn is None:
+        raise ValueError("early_stopping_rounds requires valid_chunk_fn")
+    ev = None
+    if valid_chunk_fn is not None:
+        ev = _StreamEval(valid_chunk_fn, n_valid_chunks, eval_metric,
+                         cfg.loss, early_stopping_rounds, history)
+
     if device:
         return _fit_streaming_device(
             chunk_fn, n_chunks, cfg, backend, ens, bs, C, y_dev,
             start_round=start_round, checkpoint_dir=checkpoint_dir,
-            checkpoint_every=checkpoint_every)
+            checkpoint_every=checkpoint_every, ev=ev)
 
-    # The ONE optional O(R) structure: per-chunk cached raw scores (4 bytes/
-    # row). cache_preds=False recomputes scores from the partial ensemble
-    # instead (O(T) traversals per row per round) — choose by host RAM.
+    # The ONE optional O(R·C) structure: per-chunk cached raw scores (4C
+    # bytes/row). cache_preds=False recomputes scores from the partial
+    # ensemble instead (O(T) traversals per row per round) — choose by host
+    # RAM.
+    def _fresh_pred(c):
+        if C > 1:
+            return np.zeros((chunk_lens[c], C), np.float32)   # softmax bs=0
+        return np.full(chunk_lens[c], bs, np.float32)
+
     preds = (
-        [np.full(chunk_lens[c], bs, np.float32) for c in range(n_chunks)]
-        if cache_preds else None
+        [_fresh_pred(c) for c in range(n_chunks)] if cache_preds else None
     )
     if preds is not None and start_round > 0:
-        part = ens.truncate(start_round)
+        part = ens.truncate(start_round * C)
         for c in range(n_chunks):
             preds[c] = part.predict_raw_roundwise(
                 chunk_fn(c)[0], binned=True).astype(np.float32)
 
+    # Validation predictions: host-resident per val chunk (always cached —
+    # the val set is the small fraction), updated per round like the
+    # Driver's incremental val_raw.
+    val_preds = None
+    if ev is not None:
+        def _fresh_val(c):
+            if C > 1:
+                return np.zeros((ev.lens[c], C), np.float32)
+            return np.full(ev.lens[c], bs, np.float32)
+
+        val_preds = [_fresh_val(c) for c in range(ev.n)]
+        if start_round > 0:
+            part = ens.truncate(start_round * C)
+            for c in range(ev.n):
+                val_preds[c] = part.predict_raw_roundwise(
+                    ev.fn(c)[0], binned=True).astype(np.float32)
+
     missing_val = cfg.missing_bin_value
-    for t in range(start_round, cfg.n_trees):
-        # Grow one tree level-by-level; histograms accumulate across chunks.
-        feature = np.full(cfg.n_nodes_total, -1, np.int32)
-        threshold_bin = np.zeros(cfg.n_nodes_total, np.int32)
-        is_leaf = np.zeros(cfg.n_nodes_total, bool)
-        leaf_value = np.zeros(cfg.n_nodes_total, np.float32)
-        split_gain = np.zeros(cfg.n_nodes_total, np.float32)
-        default_left = np.zeros(cfg.n_nodes_total, bool)
-
-        def chunk_grads(c: int, Xc, yc):
+    t_out = start_round * C
+    for rnd in range(start_round, cfg.n_trees):
+        # Gradients for every class tree of a round come from the
+        # ROUND-START preds (the Driver computes grad_hess once per round,
+        # then grows C trees from its columns), so pred updates are
+        # deferred until after all classes — mirroring the device loop.
+        def chunk_grads(c: int, Xc, yc, cls: int):
             pred_c = preds[c] if preds is not None else _rescore(
-                ens, t, Xc, bs
+                ens, rnd * C, Xc, bs
             )
-            return grad_hess(pred_c, np.asarray(yc), cfg.loss)
+            g, h = grad_hess(pred_c, np.asarray(yc), cfg.loss)
+            if g.ndim == 2:
+                return g[:, cls], h[:, cls]
+            return g, h
 
-        route_kw = dict(default_left=default_left,
-                        missing_bin_value=missing_val,
-                        cat_features=cfg.cat_features)
-        for depth in range(cfg.max_depth):
-            n_level = 1 << depth
-            offset = n_level - 1
-            hist = None
+        round_trees = []
+        for cls in range(C):
+            # Grow one tree level-by-level; histograms accumulate across
+            # chunks.
+            feature = np.full(cfg.n_nodes_total, -1, np.int32)
+            threshold_bin = np.zeros(cfg.n_nodes_total, np.int32)
+            is_leaf = np.zeros(cfg.n_nodes_total, bool)
+            leaf_value = np.zeros(cfg.n_nodes_total, np.float32)
+            split_gain = np.zeros(cfg.n_nodes_total, np.float32)
+            default_left = np.zeros(cfg.n_nodes_total, bool)
+
+            route_kw = dict(default_left=default_left,
+                            missing_bin_value=missing_val,
+                            cat_features=cfg.cat_features)
+            for depth in range(cfg.max_depth):
+                n_level = 1 << depth
+                hist = None
+                for c in range(n_chunks):
+                    Xc, yc = chunk_fn(c)
+                    ni = _traverse_partial(
+                        Xc, feature, threshold_bin, is_leaf, depth,
+                        **route_kw
+                    )
+                    g, h = chunk_grads(c, Xc, yc, cls)
+                    data = backend.upload(Xc)
+                    part = np.asarray(
+                        backend.build_histograms(data, g, h, ni, n_level)
+                    )
+                    hist = part if hist is None else hist + part
+                _apply_level_splits(hist, cfg, depth, feature,
+                                    threshold_bin, is_leaf, leaf_value,
+                                    split_gain, default_left)
+
+            # Final level: per-terminal (G, H) aggregates streamed the
+            # same way.
+            n_last = 1 << cfg.max_depth
+            Gl = np.zeros(n_last, np.float32)
+            Hl = np.zeros(n_last, np.float32)
             for c in range(n_chunks):
                 Xc, yc = chunk_fn(c)
                 ni = _traverse_partial(
-                    Xc, feature, threshold_bin, is_leaf, depth, **route_kw
+                    Xc, feature, threshold_bin, is_leaf, cfg.max_depth,
+                    **route_kw
                 )
-                g, h = chunk_grads(c, Xc, yc)
-                data = backend.upload(Xc)
-                part = np.asarray(
-                    backend.build_histograms(data, g, h, ni, n_level)
-                )
-                hist = part if hist is None else hist + part
-            _apply_level_splits(hist, cfg, depth, feature, threshold_bin,
-                                is_leaf, leaf_value, split_gain,
-                                default_left)
+                g, h = chunk_grads(c, Xc, yc, cls)
+                act = ni >= 0
+                np.add.at(Gl, ni[act], g[act])
+                np.add.at(Hl, ni[act], h[act])
+            _apply_final_leaves(Gl, Hl, cfg, is_leaf, leaf_value)
 
-        # Final level: per-terminal (G, H) aggregates streamed the same way.
-        n_last = 1 << cfg.max_depth
-        Gl = np.zeros(n_last, np.float32)
-        Hl = np.zeros(n_last, np.float32)
-        for c in range(n_chunks):
-            Xc, yc = chunk_fn(c)
-            ni = _traverse_partial(
-                Xc, feature, threshold_bin, is_leaf, cfg.max_depth,
-                **route_kw
-            )
-            g, h = chunk_grads(c, Xc, yc)
-            act = ni >= 0
-            np.add.at(Gl, ni[act], g[act])
-            np.add.at(Hl, ni[act], h[act])
-        _apply_final_leaves(Gl, Hl, cfg, is_leaf, leaf_value)
-
-        ens.feature[t] = feature
-        ens.threshold_bin[t] = threshold_bin
-        ens.is_leaf[t] = is_leaf
-        ens.leaf_value[t] = leaf_value
-        ens.split_gain[t] = split_gain
-        if ens.default_left is not None:
-            ens.default_left[t] = default_left
+            ens.feature[t_out] = feature
+            ens.threshold_bin[t_out] = threshold_bin
+            ens.is_leaf[t_out] = is_leaf
+            ens.leaf_value[t_out] = leaf_value
+            ens.split_gain[t_out] = split_gain
+            if ens.default_left is not None:
+                ens.default_left[t_out] = default_left
+            t_out += 1
+            round_trees.append((feature, threshold_bin, is_leaf,
+                                leaf_value, default_left))
 
         if preds is not None:
             # leaf slot per row = heap slot where traversal stopped: either
@@ -395,14 +521,48 @@ def fit_streaming(
             # rescore via the tree to keep it simple and exact.
             for c in range(n_chunks):
                 Xc, _ = chunk_fn(c)
-                slot = _leaf_slot(
-                    Xc, feature, threshold_bin, is_leaf, cfg.max_depth,
-                    **route_kw
-                )
-                preds[c] += cfg.learning_rate * leaf_value[slot]
+                for cls, (feature, threshold_bin, is_leaf, leaf_value,
+                          default_left) in enumerate(round_trees):
+                    slot = _leaf_slot(
+                        Xc, feature, threshold_bin, is_leaf, cfg.max_depth,
+                        default_left=default_left,
+                        missing_bin_value=missing_val,
+                        cat_features=cfg.cat_features,
+                    )
+                    dv = cfg.learning_rate * leaf_value[slot]
+                    if C > 1:
+                        preds[c][:, cls] += dv
+                    else:
+                        preds[c] += dv
 
-        log.info("streaming: tree %d/%d done", t + 1, cfg.n_trees)
-        checkpoint.maybe_save(checkpoint_dir, ens, cfg, t + 1,
+        if ev is not None:
+            for c in range(ev.n):
+                Xv, _ = ev.fn(c)
+                for cls, (feature, threshold_bin, is_leaf, leaf_value,
+                          default_left) in enumerate(round_trees):
+                    slot = _leaf_slot(
+                        Xv, feature, threshold_bin, is_leaf, cfg.max_depth,
+                        default_left=default_left,
+                        missing_bin_value=missing_val,
+                        cat_features=cfg.cat_features,
+                    )
+                    dv = cfg.learning_rate * leaf_value[slot]
+                    if C > 1:
+                        val_preds[c][:, cls] += dv
+                    else:
+                        val_preds[c] += dv
+            if ev.record(rnd, np.concatenate(val_preds)):
+                log.info(
+                    "streaming: early stop at round %d (best %s=%.6f at "
+                    "round %d)", rnd + 1, ev.metric, ev.best_score,
+                    ev.best_round + 1)
+                ens = ens.truncate((ev.best_round + 1) * C)
+                checkpoint.maybe_save(checkpoint_dir, ens, cfg,
+                                      ev.best_round + 1)
+                return ens
+
+        log.info("streaming: round %d/%d done", rnd + 1, cfg.n_trees)
+        checkpoint.maybe_save(checkpoint_dir, ens, cfg, rnd + 1,
                               checkpoint_every)
 
     checkpoint.maybe_save(checkpoint_dir, ens, cfg, cfg.n_trees)
@@ -421,6 +581,7 @@ def _fit_streaming_device(
     start_round: int = 0,
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 25,
+    ev: "_StreamEval | None" = None,
 ) -> TreeEnsemble:
     """Device streaming loop: see fit_streaming. Per tree it makes
     max_depth histogram passes + 1 leaf pass (+ 1 pred-update pass between
@@ -433,6 +594,18 @@ def _fit_streaming_device(
     # pass 0): pred for the whole run — 4C bytes/row, row-sharded over the
     # mesh like the data, per-chip tiny next to the streamed Xb.
     pred_dev = [backend.init_pred(h, bs) for h in y_dev]
+    # Validation predictions: device-resident per val chunk, updated per
+    # round by the same stream_update_pred op as the training state; the
+    # raw scores are fetched each round for host-side (f64) metric
+    # evaluation.
+    val_pred = None
+    if ev is not None:
+        # ev.labels avoids re-reading (and, through a binned_chunks
+        # adapter, re-binning) each val chunk just for its labels; the
+        # handles exist for init_pred's padded row shape + validity mask.
+        val_y_dev = [backend.upload_labels(ev.labels(c))
+                     for c in range(ev.n)]
+        val_pred = [backend.init_pred(h, bs) for h in val_y_dev]
     if start_round > 0:
         # Resume: REPLAY the identical device update ops over the restored
         # trees (rounds ascending, classes ascending — the training
@@ -441,18 +614,24 @@ def _fit_streaming_device(
         # compiled op is bit-exact vs an uninterrupted run by
         # construction. One upload pass over the chunks, start_round*C
         # cheap update dispatches each.
-        for c in range(n_chunks):
-            data = backend.upload(chunk_fn(c)[0])
-            for r in range(start_round):
-                for cls in range(C):
-                    slot = r * C + cls
-                    tree_full = (
-                        ens.feature[slot], ens.threshold_bin[slot],
-                        ens.is_leaf[slot], ens.leaf_value[slot],
-                        ens.default_left[slot],
-                    )
-                    pred_dev[c] = backend.stream_update_pred(
-                        data, pred_dev[c], tree_full, cfg.max_depth, cls)
+        def _replay(preds_list, fn_of, n_of):
+            for c in range(n_of):
+                data = backend.upload(fn_of(c)[0])
+                for r in range(start_round):
+                    for cls in range(C):
+                        slot = r * C + cls
+                        tree_full = (
+                            ens.feature[slot], ens.threshold_bin[slot],
+                            ens.is_leaf[slot], ens.leaf_value[slot],
+                            ens.default_left[slot],
+                        )
+                        preds_list[c] = backend.stream_update_pred(
+                            data, preds_list[c], tree_full, cfg.max_depth,
+                            cls)
+
+        _replay(pred_dev, chunk_fn, n_chunks)
+        if ev is not None:
+            _replay(val_pred, ev.fn, ev.n)
 
     def passes(tree, depth, kind, class_idx):
         """One full pass over the chunks; yields per-chunk device outputs
@@ -470,12 +649,18 @@ def _fit_streaming_device(
             yield np.asarray(out)       # fetch (device likely done by now)
 
     t_out = start_round * C
+    # The previous round's finished trees, NOT yet applied to the resident
+    # preds: the application is folded into the NEXT round's first data
+    # pass (stream_round_start) — one pass where round 2 used to spend two
+    # (round-2 verdict item 6). The final round's trees are never applied
+    # (pred is dead after the last gradients — same as the old loop, which
+    # skipped its trailing update pass).
+    prev_trees = None
     for rnd in range(start_round, cfg.n_trees):
         # Gradients for EVERY class tree of a round come from the
         # round-start preds (the Driver computes grad_hess once per round,
         # then grows C trees from its columns) — so pred updates are
-        # deferred to one pass after all classes (which also costs one
-        # data pass per round instead of C).
+        # deferred to the fused round-start pass.
         round_trees = []
         for cls in range(C):
             feature = np.full(cfg.n_nodes_total, -1, np.int32)
@@ -488,8 +673,21 @@ def _fit_streaming_device(
 
             for depth in range(cfg.max_depth):
                 hist = None
-                for part in passes(tree, depth, "hist", cls):
-                    hist = part if hist is None else hist + part
+                if depth == 0 and cls == 0 and prev_trees is not None:
+                    # Fused round-start: apply the previous round's trees
+                    # to the resident preds AND build this tree's depth-0
+                    # histogram in one dispatch per chunk.
+                    data = backend.upload(chunk_fn(0)[0])
+                    for c in range(n_chunks):
+                        pred_dev[c], out = backend.stream_round_start(
+                            data, pred_dev[c], y_dev[c], prev_trees)
+                        if c + 1 < n_chunks:
+                            data = backend.upload(chunk_fn(c + 1)[0])
+                        part = np.asarray(out)
+                        hist = part if hist is None else hist + part
+                else:
+                    for part in passes(tree, depth, "hist", cls):
+                        hist = part if hist is None else hist + part
                 _apply_level_splits(hist, cfg, depth, feature,
                                     threshold_bin, is_leaf, leaf_value,
                                     split_gain, default_left)
@@ -513,19 +711,30 @@ def _fit_streaming_device(
                 ens.default_left[t_out] = default_left
             t_out += 1
 
-        # One update pass: apply all of the round's class trees to the
-        # device-resident preds (independent columns). Preds are only read
-        # by the NEXT round's gradient passes, so the final round skips
-        # the pass entirely (a whole dataset re-read on the transfer-bound
-        # path).
-        if rnd + 1 < cfg.n_trees:
-            data = backend.upload(chunk_fn(0)[0])
-            for c in range(n_chunks):
+        prev_trees = round_trees
+
+        if ev is not None:
+            # Apply the round's trees to the resident val preds, fetch the
+            # raw scores (pad rows sliced off) and score on host.
+            scores = []
+            data = backend.upload(ev.fn(0)[0])
+            for c in range(ev.n):
                 for cls, tree_full in enumerate(round_trees):
-                    pred_dev[c] = backend.stream_update_pred(
-                        data, pred_dev[c], tree_full, cfg.max_depth, cls)
-                if c + 1 < n_chunks:
-                    data = backend.upload(chunk_fn(c + 1)[0])
+                    val_pred[c] = backend.stream_update_pred(
+                        data, val_pred[c], tree_full, cfg.max_depth, cls)
+                if c + 1 < ev.n:
+                    data = backend.upload(ev.fn(c + 1)[0])
+                scores.append(np.asarray(val_pred[c])[: ev.lens[c]])
+            if ev.record(rnd, np.concatenate(scores)):
+                log.info(
+                    "streaming: early stop at round %d (best %s=%.6f at "
+                    "round %d)", rnd + 1, ev.metric, ev.best_score,
+                    ev.best_round + 1)
+                ens = ens.truncate((ev.best_round + 1) * C)
+                checkpoint.maybe_save(checkpoint_dir, ens, cfg,
+                                      ev.best_round + 1)
+                return ens
+
         log.info("streaming: round %d/%d done", rnd + 1, cfg.n_trees)
         checkpoint.maybe_save(checkpoint_dir, ens, cfg, rnd + 1,
                               checkpoint_every)
@@ -551,8 +760,12 @@ def _leaf_slot(Xb, feature, threshold_bin, is_leaf, max_depth,
 
 
 def _rescore(ens: TreeEnsemble, n_trees_done: int, Xb, bs) -> np.ndarray:
-    """Stateless pred of the first n_trees_done trees (cache_preds=False)."""
+    """Stateless pred of the first n_trees_done trees (cache_preds=False).
+    [R] for binary/mse, [R, C] for softmax."""
+    C = ens.n_classes if ens.loss == "softmax" else 1
     if n_trees_done == 0:
+        if C > 1:
+            return np.zeros((Xb.shape[0], C), np.float32)
         return np.full(Xb.shape[0], bs, np.float32)
     return ens.truncate(n_trees_done).predict_raw(
         Xb, binned=True).astype(np.float32)

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Fetch the UCI Covertype dataset (BASELINE config 2: 7-class, depth-8,
+# 500 trees). 581k rows, 54 features, label (1..7) in the LAST column —
+# the csv loader normalizes 1-based classes to 0-based automatically.
+#
+# UNTESTED IN CI: no network in the build environment (docs/REAL_DATA.md).
+set -euo pipefail
+
+OUT_DIR="${1:-data}"
+URL="https://archive.ics.uci.edu/ml/machine-learning-databases/covtype/covtype.data.gz"
+
+mkdir -p "$OUT_DIR"
+if [ -f "$OUT_DIR/covtype.data.gz" ]; then
+    echo "already present: $OUT_DIR/covtype.data.gz"
+    exit 0
+fi
+echo "fetching Covertype (~11 MB) -> $OUT_DIR/covtype.data.gz"
+curl -fL --retry 3 -o "$OUT_DIR/covtype.data.gz.part" "$URL"
+mv "$OUT_DIR/covtype.data.gz.part" "$OUT_DIR/covtype.data.gz"
+echo "done. Covertype config run:"
+echo "  python -m ddt_tpu.cli train --backend=tpu --data=$OUT_DIR/covtype.data.gz \\"
+echo "      --label-col=last --loss=softmax --trees=500 --depth=8 --bins=255"

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Fetch the Criteo Display Advertising (Kaggle DAC) dataset — the
+# tractable stand-in for BASELINE config 3 (Criteo-1TB CTR, sparse
+# categoricals, 4-partition allreduce). ~4.3 GB tarball; train.txt is
+# 45M rows: label, 13 integer features, 26 categorical (hex) features,
+# tab-separated. Prep to npz shards with scripts/prep_criteo.py, then
+# train with --stream-dir.
+#
+# The full Criteo 1TB click logs (config 3 at scale) are served per-day:
+#   https://labs.criteo.com/2013/12/download-terabyte-click-logs/
+# — same prep script, one day file at a time.
+#
+# UNTESTED IN CI: no network in the build environment (docs/REAL_DATA.md).
+set -euo pipefail
+
+OUT_DIR="${1:-data}"
+URL="https://go.criteo.net/criteo-research-kaggle-display-advertising-challenge-dataset.tar.gz"
+
+mkdir -p "$OUT_DIR"
+if [ -f "$OUT_DIR/criteo/train.txt" ]; then
+    echo "already present: $OUT_DIR/criteo/train.txt"
+    exit 0
+fi
+echo "fetching Criteo DAC (~4.3 GB) -> $OUT_DIR/criteo/"
+mkdir -p "$OUT_DIR/criteo"
+curl -fL --retry 3 -o "$OUT_DIR/criteo/dac.tar.gz.part" "$URL"
+mv "$OUT_DIR/criteo/dac.tar.gz.part" "$OUT_DIR/criteo/dac.tar.gz"
+tar -xzf "$OUT_DIR/criteo/dac.tar.gz" -C "$OUT_DIR/criteo"
+echo "done. Prep + streamed training:"
+echo "  python scripts/prep_criteo.py $OUT_DIR/criteo/train.txt $OUT_DIR/criteo_shards"
+echo "  python -m ddt_tpu.cli train --backend=tpu --stream-dir=$OUT_DIR/criteo_shards \\"
+echo "      --trees=100 --depth=6 --bins=255 --partitions=4"

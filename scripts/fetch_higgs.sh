@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Fetch the UCI HIGGS dataset (BASELINE config 1: Higgs-1M binary clf).
+# 11M rows, 28 features, label in the FIRST column (the repo's csv
+# loader's `--label-col auto` convention). ~2.6 GB gzipped.
+#
+# UNTESTED IN CI: the build environment has no network access
+# (docs/REAL_DATA.md) — run on a networked machine, then train with:
+#   python -m ddt_tpu.cli train --data data/HIGGS.csv.gz --rows 1000000 ...
+set -euo pipefail
+
+OUT_DIR="${1:-data}"
+URL="https://archive.ics.uci.edu/ml/machine-learning-databases/00280/HIGGS.csv.gz"
+
+mkdir -p "$OUT_DIR"
+if [ -f "$OUT_DIR/HIGGS.csv.gz" ]; then
+    echo "already present: $OUT_DIR/HIGGS.csv.gz"
+    exit 0
+fi
+echo "fetching HIGGS (~2.6 GB) -> $OUT_DIR/HIGGS.csv.gz"
+curl -fL --retry 3 -o "$OUT_DIR/HIGGS.csv.gz.part" "$URL"
+mv "$OUT_DIR/HIGGS.csv.gz.part" "$OUT_DIR/HIGGS.csv.gz"
+echo "done. First Higgs-1M training run:"
+echo "  python -m ddt_tpu.cli train --backend=tpu --data=$OUT_DIR/HIGGS.csv.gz \\"
+echo "      --trees=100 --depth=6 --bins=255 --valid-frac=0.2 --metric=auc"

@@ -1,0 +1,149 @@
+"""File-backed out-of-core chunk sources (round-2 verdict item 4).
+
+data/chunks.py: npz shard directories behind the streaming ChunkFn
+protocol, the shard writers, the uint8 binned cache — and the CLI's
+--stream-dir path, which must train bit-identically to the in-memory
+--stream-chunks path on the same chunk boundaries.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ddt_tpu.cli import main
+from ddt_tpu.data import chunks as chunks_mod
+from ddt_tpu.data import datasets
+
+
+def _run(capsys, argv):
+    rc = main(argv)
+    assert rc == 0
+    return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+
+def test_shard_roundtrip(tmp_path):
+    X, y = datasets.synthetic_binary(1003, n_features=7, seed=3)
+    d = str(tmp_path / "shards")
+    paths = chunks_mod.shard_arrays(X, y, d, n_chunks=4)
+    assert len(paths) == 4
+    src = chunks_mod.directory_chunks(d)
+    assert src.n_chunks == 4
+    assert src.n_features == 7
+    assert not src.binned
+    Xr = np.concatenate([src(c)[0] for c in range(4)])
+    yr = np.concatenate([src(c)[1] for c in range(4)])
+    np.testing.assert_array_equal(X, Xr)         # every row, in order
+    np.testing.assert_array_equal(y, yr)
+    np.testing.assert_array_equal(src.labels(2), src(2)[1])
+
+
+def test_shard_arrays_validates(tmp_path):
+    X, y = datasets.synthetic_binary(10, n_features=5, seed=1)
+    with pytest.raises(ValueError, match="exactly one"):
+        chunks_mod.shard_arrays(X, y, str(tmp_path), n_chunks=2,
+                                chunk_rows=5)
+    with pytest.raises(ValueError, match="exceeds"):
+        chunks_mod.shard_arrays(X, y, str(tmp_path), n_chunks=11)
+    with pytest.raises(ValueError, match="no chunk_"):
+        chunks_mod.directory_chunks(str(tmp_path / "empty"))
+
+
+def test_shard_file_chunk_rows(tmp_path):
+    X, y = datasets.synthetic_binary(900, n_features=5, seed=4)
+    src_npz = str(tmp_path / "data.npz")
+    np.savez(src_npz, X=X, y=y)
+    d = str(tmp_path / "shards")
+    paths = chunks_mod.shard_file(src_npz, d, chunk_rows=400)
+    assert len(paths) == 3        # ceil(900/400)
+    src = chunks_mod.directory_chunks(d)
+    assert sum(len(src.labels(c)) for c in range(3)) == 900
+
+
+def test_cli_stream_dir_matches_stream_chunks(tmp_path, capsys):
+    """--stream-dir (O(chunk) disk path) == --stream-chunks (loaded
+    dataset) when the shard boundaries match: same reservoir mapper fit,
+    same chunk histograms, bit-identical trees."""
+    from ddt_tpu.models.tree import TreeEnsemble
+
+    X, y = datasets.synthetic_binary(3000, n_features=8, seed=0)
+    d = str(tmp_path / "shards")
+    # linspace bounds — identical to the CLI's in-memory chunk cut
+    chunks_mod.shard_arrays(X, y, d, n_chunks=3)
+    src_npz = str(tmp_path / "data.npz")
+    np.savez(src_npz, X=X, y=y)
+
+    m_mem = str(tmp_path / "mem.npz")
+    rec = _run(capsys, [
+        "train", "--backend=cpu", f"--data={src_npz}", "--trees=3",
+        "--depth=3", "--bins=31", "--stream-chunks=3", f"--out={m_mem}",
+    ])
+    m_dir = str(tmp_path / "dir.npz")
+    rec2 = _run(capsys, [
+        "train", "--backend=cpu", "--trees=3", "--depth=3", "--bins=31",
+        f"--stream-dir={d}", f"--out={m_dir}",
+    ])
+    assert rec2["rows"] == 3000 and rec2["streamed_chunks"] == 3
+    e1 = TreeEnsemble.load(m_mem)
+    e2 = TreeEnsemble.load(m_dir)
+    np.testing.assert_array_equal(e1.feature, e2.feature)
+    np.testing.assert_array_equal(e1.threshold_bin, e2.threshold_bin)
+    np.testing.assert_array_equal(e1.leaf_value, e2.leaf_value)
+    assert rec["rows"] == 3000
+
+
+def test_cli_stream_dir_validation_and_cache_modes(tmp_path, capsys):
+    """--stream-dir + --valid-frac holds out shards; explicit
+    --stream-cache-dir persists the uint8 cache; '' disables caching and
+    trains identically (re-binning reads)."""
+    from ddt_tpu.models.tree import TreeEnsemble
+
+    X, y = datasets.synthetic_binary(3000, n_features=8, seed=2)
+    d = str(tmp_path / "shards")
+    chunks_mod.shard_arrays(X, y, d, n_chunks=4)
+
+    cache = str(tmp_path / "cache")
+    m1 = str(tmp_path / "m1.npz")
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--trees=6", "--depth=3", "--bins=31",
+        f"--stream-dir={d}", "--valid-frac=0.25", "--metric=auc",
+        "--early-stop=4", f"--stream-cache-dir={cache}", f"--out={m1}",
+    ])
+    assert rec["streamed_chunks"] == 3          # 1 of 4 shards held out
+    assert rec["rows"] == 2250
+    assert "best_score" in rec
+    cached = chunks_mod.directory_chunks(str(tmp_path / "cache" / "train"))
+    assert cached.binned and cached.n_chunks == 3
+
+    m2 = str(tmp_path / "m2.npz")
+    _run(capsys, [
+        "train", "--backend=cpu", "--trees=6", "--depth=3", "--bins=31",
+        f"--stream-dir={d}", "--valid-frac=0.25", "--metric=auc",
+        "--early-stop=4", "--stream-cache-dir=", f"--out={m2}",
+    ])
+    e1 = TreeEnsemble.load(m1)
+    e2 = TreeEnsemble.load(m2)
+    np.testing.assert_array_equal(e1.feature, e2.feature)
+    np.testing.assert_array_equal(e1.leaf_value, e2.leaf_value)
+
+
+def test_cli_stream_dir_prebinned(tmp_path, capsys):
+    """uint8 shards are consumed as-is (no mapper in the artifact)."""
+    from ddt_tpu import api
+
+    Xb, y = datasets.stress_binned_chunk(0, 1200, n_features=16, seed=7)
+    d = str(tmp_path / "binned")
+    chunks_mod.shard_arrays(Xb, y, d, n_chunks=3)
+    src = chunks_mod.directory_chunks(d)
+    assert src.binned
+
+    m = str(tmp_path / "m.npz")
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--trees=2", "--depth=3", "--bins=255",
+        f"--stream-dir={d}", f"--out={m}",
+    ])
+    assert rec["trees"] == 2
+    b = api.load_model(m)
+    assert b.mapper is None
+    p = b.ensemble.predict(Xb, binned=True)
+    assert p[y == 1].mean() > p[y == 0].mean()

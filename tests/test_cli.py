@@ -146,10 +146,27 @@ def test_cli_train_streaming(tmp_path, capsys):
         b.mapper.transform(X), y)
     np.testing.assert_array_equal(full.feature, b.ensemble.feature)
 
-    # guards: streaming composes with neither eval nor bagging
+    # guard: early stopping still needs a validation split
     with pytest.raises(SystemExit, match="valid-frac"):
         main(["train", "--backend=cpu", "--rows=1000", "--trees=2",
-              "--stream-chunks=2", "--valid-frac=0.2"])
+              "--stream-chunks=2", "--early-stop=2"])
+
+
+def test_cli_train_streaming_validation(tmp_path, capsys):
+    """--stream-chunks composes with --valid-frac/--early-stop (round-2
+    verdict item 3): held-out rows streamed as validation chunks, metric
+    per round, best_round/best_score in the summary."""
+    model = str(tmp_path / "s.npz")
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--rows=3000", "--trees=25", "--depth=3",
+        "--bins=31", "--stream-chunks=3", "--valid-frac=0.25",
+        "--metric=auc", "--early-stop=3", "--lr=0.9", f"--out={model}",
+    ])
+    assert rec["best_round"] >= 1
+    assert 0.5 < rec["best_score"] <= 1.0
+    # early stop truncated: trees == best_round (binary: 1 tree/round)
+    assert rec["trees"] == rec["best_round"]
+    assert rec["trees"] < 25
 
 
 def test_cli_config_file(tmp_path, capsys):

@@ -1,0 +1,91 @@
+"""LightGBM model.txt interop round-trips (round-2 verdict item 8):
+export -> re-parse with the repo's own loader -> identical predictions.
+LightGBM itself is not installed here; the format is validated
+structurally and semantically via the independent parser."""
+
+import numpy as np
+import pytest
+
+from ddt_tpu import api
+from ddt_tpu.data import datasets
+from ddt_tpu.models.tree import TreeEnsemble
+
+
+def _train(loss="logloss", **kw):
+    if loss == "softmax":
+        X, y = datasets.synthetic_multiclass(1500, n_features=8,
+                                             n_classes=3, seed=11)
+        kw.setdefault("n_classes", 3)
+    elif loss == "mse":
+        X, y = datasets.synthetic_regression(1500, seed=11)
+    else:
+        X, y = datasets.synthetic_binary(1500, n_features=8, seed=11)
+    res = api.train(X, y, n_trees=4, max_depth=3, n_bins=31, loss=loss,
+                    backend="cpu", log_every=10**9, **kw)
+    return res, X
+
+
+@pytest.mark.parametrize("loss", ["logloss", "mse", "softmax"])
+def test_roundtrip_predictions(loss):
+    res, X = _train(loss)
+    txt = res.ensemble.to_lightgbm_text()
+    assert txt.startswith("tree\nversion=v3")
+    assert "end of trees" in txt
+    back = TreeEnsemble.from_lightgbm_text(txt)
+    assert back.loss == loss
+    assert back.n_features == res.ensemble.n_features
+    want = res.ensemble.predict_raw(X, binned=False)
+    got = back.predict_raw(X, binned=False)
+    # base-score fold + shrinkage pre-multiplication reorder float adds:
+    # ULP-level, not structural.
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_roundtrip_missing_default_directions():
+    """NaN routing survives: decision_type carries the NaN missing type
+    and the learned default-left bit."""
+    X, y = datasets.synthetic_binary(2000, n_features=6, seed=3)
+    X = X.copy()
+    X[::7, 2] = np.nan
+    res = api.train(X, y, n_trees=4, max_depth=3, n_bins=31,
+                    backend="cpu", missing_policy="learn",
+                    log_every=10**9)
+    txt = res.ensemble.to_lightgbm_text()
+    back = TreeEnsemble.from_lightgbm_text(txt)
+    want = res.ensemble.predict_raw(X, binned=False)
+    got = back.predict_raw(X, binned=False)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert back.default_left is not None
+
+
+def test_export_validates():
+    from ddt_tpu.models.tree import empty_ensemble
+
+    bare = empty_ensemble(2, 3, 5, 0.1, 0.0, "logloss")
+    with pytest.raises(ValueError, match="raw-value thresholds"):
+        bare.to_lightgbm_text()
+
+    res, _ = _train()
+    ens = res.ensemble
+    ens.cat_features = np.array([1], np.int32)
+    with pytest.raises(ValueError, match="categorical"):
+        ens.to_lightgbm_text()
+
+
+def test_header_fields_and_leaf_encoding():
+    res, _ = _train()
+    txt = res.ensemble.to_lightgbm_text(
+        feature_names=[f"f{i}" for i in range(8)])
+    lines = dict(
+        ln.partition("=")[::2] for ln in txt.splitlines() if "=" in ln)
+    assert lines["num_class"] == "1"
+    assert lines["objective"] == "binary sigmoid:1"
+    assert lines["max_feature_idx"] == "7"
+    assert "feature_names=f0 f1 f2 f3 f4 f5 f6 f7" in txt
+    # leaf references are negative (~leaf_idx), internals non-negative
+    lc = [int(v) for v in lines["left_child"].split()]
+    rc = [int(v) for v in lines["right_child"].split()]
+    n_leaves = int(lines["num_leaves"])
+    refs = lc + rc
+    assert sum(1 for r in refs if r < 0) == n_leaves
+    assert all(-n_leaves <= r < n_leaves - 1 for r in refs)

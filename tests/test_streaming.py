@@ -66,10 +66,46 @@ def test_streaming_stress_generator_runs():
     assert p[y == 1].mean() > p[y == 0].mean()
 
 
-def test_streaming_softmax_host_not_implemented():
-    cfg = TrainConfig(loss="softmax", n_classes=3, backend="cpu")
-    with pytest.raises(NotImplementedError):
-        fit_streaming(lambda c: (None, None), 1, cfg)
+@pytest.mark.parametrize("cache", [True, False])
+def test_streaming_softmax_host_matches_inmemory(cache):
+    """Round-2 verdict item 7b: the host path streams softmax too (one
+    tree per class per round from round-start preds), closing the
+    backend-parity hole that used to raise NotImplementedError."""
+    X, y = datasets.synthetic_multiclass(2048, n_features=8, n_classes=3,
+                                         seed=5)
+    Xb, _ = quantize(X, n_bins=31, seed=5)
+    cfg = TrainConfig(n_trees=3, max_depth=3, n_bins=31, backend="cpu",
+                      loss="softmax", n_classes=3)
+    full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
+    chunk_fn, n_chunks = _chunked(Xb, y, 512)
+    streamed = fit_streaming(chunk_fn, n_chunks, cfg, cache_preds=cache)
+    assert streamed.n_trees == 9          # rounds x classes
+    np.testing.assert_array_equal(full.feature, streamed.feature)
+    np.testing.assert_array_equal(full.threshold_bin, streamed.threshold_bin)
+    np.testing.assert_array_equal(full.is_leaf, streamed.is_leaf)
+    np.testing.assert_allclose(full.leaf_value, streamed.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_streaming_empty_chunk_rejected():
+    cfg = TrainConfig(n_trees=2, max_depth=2, backend="cpu")
+    with pytest.raises(ValueError, match="empty"):
+        fit_streaming(
+            lambda c: (np.zeros((0, 3), np.uint8), np.zeros(0)), 2, cfg)
+
+
+def test_early_stop_nan_metric_raises():
+    """Round-2 verdict weak #3: a NaN metric from round 1 must fail with
+    the cause, not a TypeError from best_round arithmetic."""
+    X, y = datasets.synthetic_binary(512, n_features=6, seed=3)
+    Xb, _ = quantize(X, n_bins=15, seed=3)
+    yv = np.full(128, np.nan)     # NaN labels => NaN rmse every round
+    cfg = TrainConfig(n_trees=5, max_depth=2, n_bins=15, backend="cpu",
+                      loss="mse")
+    drv = Driver(get_backend(cfg), cfg, log_every=10**9)
+    with pytest.raises(ValueError, match="NaN since round 1"):
+        drv.fit(Xb, y.astype(np.float32), eval_set=(Xb[:128], yv),
+                eval_metric="rmse", early_stopping_rounds=2)
 
 
 def test_streaming_device_partitioned_matches_inmemory():
@@ -227,3 +263,142 @@ def test_streaming_checkpoint_resume_bit_exact(tmp_path, backend_flag,
                                   resumed.threshold_bin)
     np.testing.assert_array_equal(plain.is_leaf, resumed.is_leaf)
     np.testing.assert_array_equal(plain.leaf_value, resumed.leaf_value)
+
+
+# --------------------------------------------------------------------- #
+# Streaming validation + early stopping (round-2 verdict item 3)
+# --------------------------------------------------------------------- #
+
+def _chunked_all(Xb, y, n_chunks):
+    """Chunking that covers EVERY row (linspace bounds, ragged tail ok) —
+    _chunked drops the tail when len isn't a multiple of the chunk size."""
+    bounds = np.linspace(0, len(y), n_chunks + 1).astype(np.int64)
+
+    def chunk_fn(c):
+        return Xb[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
+    return chunk_fn, n_chunks
+
+
+def _val_split(Xb, y, frac=0.25, seed=7):
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(y))
+    k = int(len(y) * frac)
+    va, tr = idx[:k], idx[k:]
+    return Xb[tr], y[tr], Xb[va], y[va]
+
+
+@pytest.mark.parametrize("backend_flag", ["cpu", "tpu"])
+def test_streaming_validation_history_matches_driver(backend_flag):
+    """Per-round streamed validation scores equal the in-memory Driver's
+    valid_<metric> series on the same split (host-f64 metric both sides;
+    the cpu pair is bit-identical, the device pair FMA-close)."""
+    X, y = datasets.synthetic_binary(4096, n_features=10, seed=13)
+    Xb, _ = quantize(X, n_bins=31, seed=13)
+    Xt, yt, Xv, yv = _val_split(Xb, y)
+    cfg = TrainConfig(n_trees=5, max_depth=3, n_bins=31,
+                      backend=backend_flag)
+
+    drv = Driver(get_backend(TrainConfig(n_trees=5, max_depth=3, n_bins=31,
+                                         backend="cpu")),
+                 cfg, log_every=10**9)
+    drv.fit(Xt, yt, eval_set=(Xv, yv), eval_metric="auc")
+    want = [r["valid_auc"] for r in drv.history]
+
+    chunk_fn, n_chunks = _chunked_all(Xt, yt, 6)
+    vfn, n_valid = _chunked_all(Xv, yv, 2)
+    history = []
+    streamed = fit_streaming(chunk_fn, n_chunks, cfg,
+                             valid_chunk_fn=vfn, n_valid_chunks=n_valid,
+                             eval_metric="auc", history=history)
+    got = [r["valid_auc"] for r in history]
+    assert len(got) == 5
+    assert streamed.n_trees == 5            # no early stop requested
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_streaming_early_stop_truncates_like_driver():
+    """Early stopping under streaming stops at the same round and returns
+    the same truncated ensemble as Driver.fit on the same data."""
+    X, y = datasets.synthetic_binary(3072, n_features=8, seed=17)
+    Xb, _ = quantize(X, n_bins=31, seed=17)
+    Xt, yt, Xv, yv = _val_split(Xb, y)
+    # Aggressive lr so validation logloss degrades within a few rounds.
+    # min_split_gain floors the decisions above the f32 noise floor (the
+    # determinism domain documented in ops/split.py) — lr=0.9 pushes late
+    # trees into signal-free territory where noise-sign splits otherwise
+    # legitimately differ between chunk-summed and whole-data histograms.
+    cfg = TrainConfig(n_trees=30, max_depth=4, n_bins=31, backend="cpu",
+                      learning_rate=0.9, min_split_gain=1e-3)
+
+    drv = Driver(get_backend(cfg), cfg, log_every=10**9)
+    full = drv.fit(Xt, yt, eval_set=(Xv, yv), eval_metric="logloss",
+                   early_stopping_rounds=3)
+    assert full.n_trees < 30                # it actually stopped
+
+    chunk_fn, n_chunks = _chunked_all(Xt, yt, 4)
+    vfn, n_valid = _chunked_all(Xv, yv, 2)
+    history = []
+    streamed = fit_streaming(chunk_fn, n_chunks, cfg,
+                             valid_chunk_fn=vfn, n_valid_chunks=n_valid,
+                             eval_metric="logloss",
+                             early_stopping_rounds=3, history=history)
+    assert streamed.n_trees == full.n_trees
+    np.testing.assert_array_equal(full.feature, streamed.feature)
+    np.testing.assert_array_equal(full.threshold_bin,
+                                  streamed.threshold_bin)
+    np.testing.assert_allclose(full.leaf_value, streamed.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_streaming_device_early_stop_matches_host_streaming():
+    """Device-resident val-pred early stopping (tpu) picks the same round
+    as the host streaming path."""
+    X, y = datasets.synthetic_binary(3072, n_features=8, seed=17)
+    Xb, _ = quantize(X, n_bins=31, seed=17)
+    Xt, yt, Xv, yv = _val_split(Xb, y)
+    cfg_h = TrainConfig(n_trees=30, max_depth=4, n_bins=31, backend="cpu",
+                        learning_rate=0.9, min_split_gain=1e-3)
+    chunk_fn, n_chunks = _chunked_all(Xt, yt, 4)
+    vfn, n_valid = _chunked_all(Xv, yv, 2)
+    host = fit_streaming(chunk_fn, n_chunks, cfg_h,
+                         valid_chunk_fn=vfn, n_valid_chunks=n_valid,
+                         eval_metric="logloss", early_stopping_rounds=3)
+    dev = fit_streaming(chunk_fn, n_chunks, cfg_h.replace(backend="tpu"),
+                        valid_chunk_fn=vfn, n_valid_chunks=n_valid,
+                        eval_metric="logloss", early_stopping_rounds=3)
+    assert host.n_trees == dev.n_trees
+    np.testing.assert_array_equal(host.feature, dev.feature)
+
+
+def test_streaming_early_stop_requires_validation():
+    cfg = TrainConfig(n_trees=2, max_depth=2, backend="cpu")
+    with pytest.raises(ValueError, match="valid_chunk_fn"):
+        fit_streaming(lambda c: (np.zeros((4, 3), np.uint8), np.zeros(4)),
+                      1, cfg, early_stopping_rounds=2)
+
+
+def test_streaming_device_folded_pass_count():
+    """Round-2 verdict item 6: the pred-update pass is folded into the
+    next round's depth-0 pass (stream_round_start) — a T-round depth-D
+    binary run reads each chunk exactly T*(D+1) times (D hist passes + 1
+    leaf pass), with NO separate update passes; and the folded run stays
+    bit-identical to in-memory training."""
+    X, y = datasets.synthetic_binary(2048, n_features=8, seed=3)
+    Xb, _ = quantize(X, n_bins=31, seed=3)
+    calls = {"n": 0}
+
+    def chunk_fn(c):
+        calls["n"] += 1
+        return Xb[c * 512:(c + 1) * 512], y[c * 512:(c + 1) * 512]
+
+    chunk_fn.labels = lambda c: y[c * 512:(c + 1) * 512]   # pass 0 reads
+    chunk_fn.n_features = 8                                # shape probe
+    cfg = TrainConfig(n_trees=3, max_depth=4, n_bins=31, backend="tpu")
+    streamed = fit_streaming(chunk_fn, 4, cfg)
+    assert calls["n"] == 4 * 3 * (4 + 1)      # chunks * rounds * (D+1)
+
+    full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
+    np.testing.assert_array_equal(full.feature, streamed.feature)
+    np.testing.assert_array_equal(full.threshold_bin, streamed.threshold_bin)
+    np.testing.assert_allclose(full.leaf_value, streamed.leaf_value,
+                               rtol=2e-4, atol=2e-5)
