@@ -23,6 +23,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# Perf-regression floor (SURVEY.md §4, round-2 verdict weak #2): the
+# shipped Pallas kernel measures 45-64 Mrows/s/chip on the v5e across
+# tunnel noise bands; a silent regression (e.g. a Mosaic toolchain change
+# re-breaking the int32 compare domain, or a dispatch falling back to the
+# ~26 Mrows/s matmul path) must FAIL the bench, not quietly ship a number.
+# 40 sits below every observed noise band but above every known-bad mode.
+TPU_FLOOR_MROWS = 40.0
+
 
 def main() -> None:
     from ddt_tpu.backends.tpu import enable_persistent_compile_cache
@@ -51,6 +59,9 @@ def main() -> None:
     # (os.cpu_count() below), so the OpenMP-built native kernel runs
     # effectively single-threaded; on a many-core host the all-core native
     # number is the comparator to quote.
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
     print(json.dumps({
         "metric": "higgs1m_histogram_throughput",
         "value": round(value, 2),
@@ -60,7 +71,15 @@ def main() -> None:
         "baseline_impl": cpu["impl"],
         "baseline_cpu_count": os.cpu_count(),
         "baseline_omp_threads": _omp_threads(),
+        "floor_mrows_per_sec": TPU_FLOOR_MROWS if on_tpu else None,
     }))
+    if on_tpu and value < TPU_FLOOR_MROWS:
+        raise SystemExit(
+            f"PERF REGRESSION: {value:.1f} Mrows/s/chip is below the "
+            f"{TPU_FLOOR_MROWS} floor (docs/PERF.md; previously measured "
+            "45-64 across tunnel noise). A wrong-path dispatch or kernel "
+            "regression shipped — investigate before trusting this build."
+        )
 
 
 def _omp_threads() -> int:

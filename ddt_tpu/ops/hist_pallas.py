@@ -45,9 +45,27 @@ LANE = 128
 # double-buffered input windows.
 _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 _DEFAULT_TILE_R = 512
+# The transposed kernel's default row tile: tiles 1024-2048 measure
+# identically (~73 Mrows/s at 64 bins, min-of-8; sweep 10 A/B) and 512
+# was never faster — 1024 keeps the VMEM working set modest.
+_DEFAULT_TILE_R_T = 1024
+
+
+def _default_tile_r(n_bins: int) -> int:
+    """The row tile the dispatcher will actually run with: the transposed
+    kernel (n_bins <= 128) uses the larger tile (sweep-10 A/B). The ONE
+    home of this rule — pallas_fits/feature_chunks_for must size VMEM for
+    the same tile the kernel allocates."""
+    return _DEFAULT_TILE_R_T if _bins_pad(n_bins) <= LANE \
+        else _DEFAULT_TILE_R
 
 
 def _bins_pad(n_bins: int) -> int:
+    """Padded one-hot lanes per feature. n_bins <= 128 pads to ONE lane
+    tile and routes to the TRANSPOSED kernel (see _hist_kernel_t);
+    wider bin counts pad to 256 for the row-major kernel."""
+    if n_bins <= LANE:
+        return LANE
     return max(2 * LANE, ((n_bins + LANE - 1) // LANE) * LANE)
 
 
@@ -55,11 +73,14 @@ def pallas_fits(
     n_nodes: int,
     n_features: int,
     n_bins: int,
-    tile_r: int = _DEFAULT_TILE_R,
+    tile_r: int | None = None,
     input_bytes: int = 2,
 ) -> bool:
     """Whether the kernel's VMEM working set fits at this shape (the shape
-    guard behind hist_impl='auto' — ops/histogram.resolve_hist_impl)."""
+    guard behind hist_impl='auto' — ops/histogram.resolve_hist_impl).
+    tile_r=None sizes for the tile the dispatcher will actually run."""
+    if tile_r is None:
+        tile_r = _default_tile_r(n_bins)
     fbp = n_features * _bins_pad(n_bins)
     oh_bytes = tile_r * fbp * input_bytes
     acc_bytes = 2 * n_nodes * fbp * 4
@@ -99,13 +120,50 @@ def _hist_kernel(xb_ref, a_ref, out_ref, *, n_feat: int, bins_pad: int,
     )
 
 
+def _hist_kernel_t(xt_ref, a_ref, out_ref, *, n_feat: int, bins_pad: int,
+                   input_dtype):
+    """TRANSPOSED row tile (used when bins_pad == 128, i.e. n_bins <= 128):
+    out[F*Bp, 2N] += OH[F*Bp, T] @ A[T, 2N].
+
+    Why a second form exists (experiments/hist_sweep9/10, measured v5e):
+    the row-major kernel is bound by per-feature [T, 1] -> [T, Bp] LANE
+    broadcasts (cost flat in Bp — shrinking bins bought nothing), while
+    this form broadcasts x rows along SUBLANES ((bin_iota[Bp, 1] ==
+    x[1, T])), which Mosaic executes as cheap row replication. At 64 bins
+    it measures ~72 Mrows/s vs ~48 row-major. At Bp = 256 the transposed
+    form loses its edge (more sublane tiles per slab), so the row-major
+    kernel keeps the 255-bin contract.
+    """
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    xt = xt_ref[:]                                        # [F, T]
+    tile_r = xt.shape[1]
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (bins_pad, tile_r), 0)
+    slabs = [
+        (xt[f, :][None, :] == bin_iota).astype(input_dtype)   # [Bp, T]
+        for f in range(n_feat)
+    ]
+    oh = jnp.concatenate(slabs, axis=0)                   # [F*Bp, T]
+    out_ref[:] += jax.lax.dot_general(
+        oh, a_ref[:],
+        (((1,), (0,)), ((), ())),                         # contract rows
+        preferred_element_type=jnp.float32,
+    )
+
+
 def feature_chunks_for(n_nodes: int, n_features: int, n_bins: int,
-                       tile_r: int = _DEFAULT_TILE_R,
+                       tile_r: int | None = None,
                        input_bytes: int = 2) -> int | None:
     """Smallest number of feature chunks whose per-chunk working set fits
     the kernel's VMEM budget, or None if even one feature does not fit
     (then the caller must use the matmul path). input_bytes is the one-hot
     operand's itemsize (2 for bfloat16, 4 for float32)."""
+    if tile_r is None:
+        tile_r = _default_tile_r(n_bins)
     for k in range(1, n_features + 1):
         if pallas_fits(n_nodes, -(-n_features // k), n_bins, tile_r,
                        input_bytes):
@@ -120,7 +178,7 @@ def build_histograms_pallas(
     node_index: jax.Array,
     n_nodes: int,
     n_bins: int,
-    tile_r: int = _DEFAULT_TILE_R,
+    tile_r: int | None = None,
     interpret: bool | None = None,
     input_dtype=jnp.bfloat16,
 ) -> jax.Array:
@@ -139,6 +197,8 @@ def build_histograms_pallas(
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if tile_r is None:
+        tile_r = _default_tile_r(n_bins)
     dt = jnp.dtype(input_dtype)
     F = Xb.shape[1]
     k = feature_chunks_for(n_nodes, F, n_bins, tile_r, dt.itemsize)
@@ -194,6 +254,38 @@ def _build_histograms_pallas(
 
     def slab(Xs):
         Fs = Xs.shape[1]
+        cost = pl.CostEstimate(
+            flops=2 * 2 * n_nodes * Fs * bins_pad * n_tiles * tile_r,
+            bytes_accessed=R * Fs * 4 + R * 4 * n_nodes
+            + 2 * n_nodes * Fs * bins_pad * 4,
+            transcendentals=0,
+        )
+        if bins_pad <= LANE:
+            # Transposed kernel (n_bins <= 128): sublane-broadcast one-hot
+            # build — ~1.5x the row-major form at 64 bins (sweep 10).
+            out = pl.pallas_call(
+                functools.partial(_hist_kernel_t, n_feat=Fs,
+                                  bins_pad=bins_pad,
+                                  input_dtype=input_dtype),
+                grid=(n_tiles,),
+                in_specs=[
+                    pl.BlockSpec((Fs, tile_r), lambda i: (0, i),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((tile_r, 2 * n_nodes), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec(
+                    (Fs * bins_pad, 2 * n_nodes), lambda i: (0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                out_shape=jax.ShapeDtypeStruct(
+                    (Fs * bins_pad, 2 * n_nodes), jnp.float32),
+                cost_estimate=cost,
+                interpret=interpret,
+            )(Xs.T, A)
+            # [Fs*Bp, 2N] -> [N, Fs, B, 2]
+            out = out.reshape(Fs, bins_pad, 2, n_nodes)[:, :n_bins]
+            return out.transpose(3, 0, 1, 2)
         out = pl.pallas_call(
             functools.partial(_hist_kernel, n_feat=Fs, bins_pad=bins_pad,
                               input_dtype=input_dtype),
@@ -213,12 +305,7 @@ def _build_histograms_pallas(
             ),
             out_shape=jax.ShapeDtypeStruct((2 * n_nodes, Fs * bins_pad),
                                            jnp.float32),
-            cost_estimate=pl.CostEstimate(
-                flops=2 * 2 * n_nodes * Fs * bins_pad * n_tiles * tile_r,
-                bytes_accessed=R * Fs * 4 + R * 4 * n_nodes
-                + 2 * n_nodes * Fs * bins_pad * 4,
-                transcendentals=0,
-            ),
+            cost_estimate=cost,
             interpret=interpret,
         )(Xs, A)
         # [2N, Fs*Bp] -> [N, Fs, B, 2]

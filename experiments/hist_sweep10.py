@@ -1,0 +1,188 @@
+"""Sweep round 10: TRANSPOSED one-hot layout for the histogram kernel.
+
+Sweep 9's finding: throughput is FLAT (~48-52 Mrows/s) across bin count
+(255 vs 64 vs 32), one-hot lane width (Bp 256 vs 128) and operand dtype
+(bf16 vs int8) — so the kernel is NOT bound by one-hot element count or
+MXU rate. The invariant cost is per-(feature, tile) column handling: the
+current form broadcasts x[:, f] as [T, 1] -> [T, Bp] across LANES, a VPU
+relayout Mosaic executes per feature (28x per tile) — the same relayout
+class that sank the in-kernel A-build (docs/PERF.md round 1) and the
+hi/lo split (round 2).
+
+Hypothesis: transpose the tile. With Xt [F, T] each feature is a
+contiguous sublane ROW; the one-hot build becomes
+(bin_iota[Bp, 1] == x_row[1, T]) -> [Bp, T], broadcasting along
+SUBLANES (cheap row replication) instead of lanes. The dot contracts T:
+[F*Bp, T] @ [T, 2N] -> [F*Bp, 2N]; same MXU flops, same VMEM budget.
+
+Run on the real TPU:  python -u experiments/hist_sweep10.py
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ddt_tpu.utils.device import device_sync  # noqa: E402
+
+R, F, N = 1_000_000, 28, 32
+
+
+def _kernel_t(xt_ref, a_ref, out_ref, *, n_feat, bins_pad, oh_dtype):
+    """Transposed form: xt [F, T] int32, a [T, 2N], out [F*bins_pad, 2N]."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    xt = xt_ref[:]                                     # [F, T]
+    tile_r = xt.shape[1]
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (bins_pad, tile_r), 0)
+    slabs = [
+        (xt[f, :][None, :] == bin_iota).astype(oh_dtype)   # [Bp, T]
+        for f in range(n_feat)
+    ]
+    oh = jnp.concatenate(slabs, axis=0)                # [F*Bp, T]
+    out_ref[:] += jax.lax.dot_general(
+        oh, a_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins", "bins_pad",
+                                             "tile_r", "oh_dtype"))
+def variant_t(Xt, g, h, ni, n_bins, bins_pad, tile_r, oh_dtype):
+    active = ni >= 0
+    idx = jnp.where(active, ni, 0).astype(jnp.int32)
+    gz = jnp.where(active, g, 0.0)
+    hz = jnp.where(active, h, 0.0)
+    noh = jax.nn.one_hot(idx, N, dtype=jnp.float32)
+    A = jnp.concatenate(
+        [noh * gz[:, None], noh * hz[:, None]], axis=1
+    ).astype(oh_dtype)                                 # [R, 2N]
+    n_tiles = R // tile_r
+    out = pl.pallas_call(
+        functools.partial(_kernel_t, n_feat=F, bins_pad=bins_pad,
+                          oh_dtype=oh_dtype),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((F, tile_r), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 2 * N), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((F * bins_pad, 2 * N), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((F * bins_pad, 2 * N), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(Xt, A)
+    return out
+
+
+def run(name, fn, args, iters=10, reps=5):
+    try:
+        out = fn(*args)
+        device_sync(out)
+        dt = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            device_sync(out)
+            dt = min(dt, (time.perf_counter() - t0) / iters)
+        print(f"{name:44s} {R / dt / 1e6:8.1f} Mrows/s   "
+              f"{dt * 1e3:7.2f} ms")
+    except Exception as e:
+        print(f"{name:44s} FAILED: {type(e).__name__}: {str(e)[:140]}")
+
+
+if __name__ == "__main__":
+    print(f"platform={jax.default_backend()}  shape {R}x{F}, N={N}")
+    rng = np.random.default_rng(0)
+    Xb = rng.integers(0, 255, (R, F), dtype=np.uint8)
+    Xt = jax.device_put(np.ascontiguousarray(Xb.T).astype(np.int32))
+    Xt64 = jax.device_put(
+        np.ascontiguousarray((Xb % 64).T).astype(np.int32))
+    g = jax.device_put(rng.standard_normal(R).astype(np.float32))
+    h = jax.device_put(rng.random(R).astype(np.float32))
+    ni = jax.device_put(rng.integers(0, N, R).astype(np.int32))
+
+    for tile_r in (256, 512, 1024):
+        run(f"T-form 255b Bp=256 bf16 tile={tile_r}", variant_t,
+            (Xt, g, h, ni, 255, 256, tile_r, jnp.bfloat16))
+    run("T-form 64b Bp=128 bf16 tile=512", variant_t,
+        (Xt64, g, h, ni, 64, 128, 512, jnp.bfloat16))
+    run("T-form 64b Bp=128 bf16 tile=1024", variant_t,
+        (Xt64, g, h, ni, 64, 128, 1024, jnp.bfloat16))
+
+
+# ---- integration questions: prologue transpose, shallow levels, tile 2048
+@functools.partial(jax.jit, static_argnames=("n_bins", "bins_pad",
+                                             "tile_r", "oh_dtype", "n"))
+def variant_t_rowmajor(Xb, g, h, ni, n_bins, bins_pad, tile_r, oh_dtype,
+                       n=N):
+    """Production-shaped entry: row-major uint8 Xb, transpose in the XLA
+    prologue (what the real kernel would do)."""
+    active = ni >= 0
+    idx = jnp.where(active, ni, 0).astype(jnp.int32)
+    gz = jnp.where(active, g, 0.0)
+    hz = jnp.where(active, h, 0.0)
+    noh = jax.nn.one_hot(idx, n, dtype=jnp.float32)
+    A = jnp.concatenate(
+        [noh * gz[:, None], noh * hz[:, None]], axis=1
+    ).astype(oh_dtype)
+    Xt = Xb.astype(jnp.int32).T                        # prologue transpose
+    n_tiles = R // tile_r
+    out = pl.pallas_call(
+        functools.partial(_kernel_t, n_feat=F, bins_pad=bins_pad,
+                          oh_dtype=oh_dtype),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((F, tile_r), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 2 * n), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((F * bins_pad, 2 * n), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((F * bins_pad, 2 * n), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(Xt, A)
+    return out
+
+
+if __name__ == "__main__":
+    import os
+    if os.environ.get("SWEEP10B"):
+        Xb64 = jax.device_put((Xb % 64))
+        run("T-form 64b tile=2048 (pre-transposed)", variant_t,
+            (Xt64, g, h, ni, 64, 128, 2048, jnp.bfloat16))
+        run("T-form 64b tile=1024 ROW-MAJOR prologue", variant_t_rowmajor,
+            (Xb64, g, h, ni, 64, 128, 1024, jnp.bfloat16))
+        run("T-form 64b tile=2048 ROW-MAJOR prologue", variant_t_rowmajor,
+            (Xb64, g, h, ni, 64, 128, 2048, jnp.bfloat16))
+        ni1 = jax.device_put(np.zeros(R, np.int32))
+        run("T-form 64b tile=1024 N=1 (shallow level)",
+            lambda *a: variant_t_rowmajor(*a, n=1),
+            (Xb64, g, h, ni1, 64, 128, 1024, jnp.bfloat16))
+        run("T-form 255b tile=1024 ROW-MAJOR prologue", variant_t_rowmajor,
+            (jax.device_put(Xb), g, h, ni, 255, 256, 1024, jnp.bfloat16))
+
+
+if __name__ == "__main__":
+    if os.environ.get("SWEEP10C"):
+        Xb64 = jax.device_put((Xb % 64))
+        for t in (1024, 1536, 2048):
+            run(f"AB row-major 64b tile={t}", variant_t_rowmajor,
+                (Xb64, g, h, ni, 64, 128, t, jnp.bfloat16), iters=15,
+                reps=8)

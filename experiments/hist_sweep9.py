@@ -101,10 +101,12 @@ def variant(Xb, g, h, ni, n_bins, bins_pad, oh_dtype, a_dtype):
 
 def run(name, n_bins, bins_pad, oh_dtype, a_dtype, iters=10, reps=5):
     rng = np.random.default_rng(0)
-    Xb = rng.integers(0, n_bins, (R, F), dtype=np.uint8)
-    g = rng.standard_normal(R).astype(np.float32)
-    h = rng.random(R).astype(np.float32)
-    ni = rng.integers(0, N, R).astype(np.int32)
+    # device_put ONCE — numpy inputs would re-upload ~40 MB through the
+    # tunnel per call and time the H2D link instead of the kernel.
+    Xb = jax.device_put(rng.integers(0, n_bins, (R, F), dtype=np.uint8))
+    g = jax.device_put(rng.standard_normal(R).astype(np.float32))
+    h = jax.device_put(rng.random(R).astype(np.float32))
+    ni = jax.device_put(rng.integers(0, N, R).astype(np.int32))
     try:
         out = variant(Xb, g, h, ni, n_bins, bins_pad, oh_dtype, a_dtype)
         device_sync(out)

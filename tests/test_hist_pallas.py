@@ -25,8 +25,8 @@ def _case(R, F, B, N, seed=0, frozen_frac=0.2):
 
 @pytest.mark.parametrize("R,F,B,N", [
     (700, 4, 31, 1),       # unaligned rows, single node (root level)
-    (1024, 3, 255, 8),     # full 255-bin width
-    (2000, 5, 16, 32),     # deep level, small bins
+    (1024, 3, 255, 8),     # full 255-bin width (row-major kernel)
+    (2000, 5, 16, 32),     # deep level, small bins (transposed kernel)
 ])
 def test_pallas_matches_oracle(R, F, B, N):
     Xb, g, h, ni = _case(R, F, B, N)
@@ -75,3 +75,24 @@ def test_pallas_feature_chunked_deep_level():
     got = np.asarray(build_histograms_pallas(Xb, g, h, ni, N, B))
     want = ref.build_histograms(Xb, g, h, ni, N, B)
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("R,F,B,N", [
+    (1600, 6, 64, 8),      # the 64-bin opt-in contract (transposed)
+    (900, 6, 64, 32),      # transposed at the widest depth-6 level
+    (800, 3, 128, 4),      # transposed/row-major boundary: Bp exactly 128
+    (800, 3, 129, 4),      # first width ABOVE the boundary (row-major)
+])
+def test_transposed_kernel_exact_f32(R, F, B, N):
+    """The round-3 transposed kernel (n_bins <= 128 -> one lane tile,
+    sublane-broadcast one-hot) vs the oracle with float32 inputs — exact
+    accumulation isolates kernel STRUCTURE from bf16 input rounding."""
+    import jax.numpy as jnp
+
+    Xb, g, h, ni = _case(R, F, B, N)
+    want = ref.build_histograms(Xb, g, h, ni, N, B)
+    got = np.asarray(build_histograms_pallas(
+        Xb, g, h, ni, N, B, tile_r=256, interpret=True,
+        input_dtype=jnp.float32,
+    ))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
