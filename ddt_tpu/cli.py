@@ -76,6 +76,66 @@ def _load_dataset(args, encoder=None, n_features=None):
     raise SystemExit(f"unknown dataset {args.dataset!r}")
 
 
+def _predict_streaming(args, bundle) -> int:
+    """`predict --stream-dir=D`: score npz shards chunk-by-chunk in
+    O(chunk) host memory (the 10M-row x 1000-tree config at beyond-RAM
+    scale). Scores land as per-shard .npy files under --out (a directory
+    here) — a 10B-row score vector has no business being concatenated in
+    host memory either."""
+    from ddt_tpu.data import chunks as chunks_mod
+
+    ens = bundle.ensemble
+    src = chunks_mod.directory_chunks(args.stream_dir)
+    if bundle.encoder is not None and not src.binned:
+        # Shards are arbitrary files — nothing says which columns are
+        # raw categorical ids, so re-encoding here is impossible and
+        # quantile-binning raw ids would silently garbage every
+        # categorical split. Same refuse-loudly contract as the
+        # in-memory path's encoder checks.
+        raise SystemExit(
+            f"{args.model} carries a categorical encoder but the shards "
+            "hold raw floats; score via the in-memory predict path, or "
+            "shard data whose categorical columns are already "
+            "encoder.transform'ed AND pre-binned (uint8)."
+        )
+    if not src.binned and bundle.mapper is None \
+            and not ens.has_raw_thresholds:
+        raise SystemExit(
+            f"{args.model} carries neither a bin mapper nor raw "
+            "thresholds; retrain with the current CLI (which saves the "
+            "full artifact) or shard pre-binned uint8 data."
+        )
+    if src.binned and src.n_features != ens.n_features:
+        raise SystemExit(
+            f"shards have {src.n_features} features but the model was "
+            f"trained with {ens.n_features}")
+    cfg = TrainConfig(backend=args.backend, loss=ens.loss,
+                      n_classes=max(ens.n_classes, 2))
+    out_dir = args.out or "scores"
+    os.makedirs(out_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    rows = 0
+    for c in range(src.n_chunks):
+        X, _ = src(c)
+        if src.binned:
+            scores = api.predict(ens, X, binned=True, cfg=cfg)
+        elif bundle.mapper is not None:
+            scores = api.predict(ens, X, mapper=bundle.mapper, cfg=cfg)
+        else:   # raw-value thresholds traversal (mapper-less artifact)
+            scores = api.predict(ens, X, cfg=cfg)
+        np.save(os.path.join(out_dir, f"scores_{c:05d}.npy"), scores)
+        rows += len(scores)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "cmd": "predict", "backend": args.backend, "rows": rows,
+        "trees": ens.n_trees, "streamed_chunks": src.n_chunks,
+        "wallclock_s": round(dt, 3),
+        "rows_per_sec": round(rows / dt, 1),
+        "out_dir": out_dir,
+    }))
+    return 0
+
+
 def _seeded_split(X, y, frac: float, seed: int):
     """The seeded held-out row split — ONE home for both the in-memory and
     streamed train paths, so their validation semantics cannot drift.
@@ -392,7 +452,14 @@ def main(argv: list[str] | None = None) -> int:
     pp = sub.add_parser("predict", help="score a batch with a saved ensemble")
     _add_common(pp)
     pp.add_argument("--model", required=True)
-    pp.add_argument("--out", default=None, help="write scores to this .npy")
+    pp.add_argument("--out", default=None, help="write scores to this .npy "
+                    "(with --stream-dir: a DIRECTORY of per-shard "
+                    "scores_NNNNN.npy files)")
+    pp.add_argument("--stream-dir", default=None,
+                    help="score a directory of npz chunk shards "
+                         "out-of-core, O(chunk) host memory (BASELINE "
+                         "config 4 at beyond-RAM scale); overrides "
+                         "--dataset/--data")
 
     bp = sub.add_parser("bench", help="kernel/e2e benchmarks (JSON lines)")
     _add_common(bp)
@@ -516,6 +583,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "predict":
         bundle = api.load_model(args.model)
         ens = bundle.ensemble
+        if args.stream_dir:
+            return _predict_streaming(args, bundle)
         if args.dataset == "criteo" and not args.data \
                 and bundle.encoder is None:
             # Same contract as the missing-mapper case below: refitting the

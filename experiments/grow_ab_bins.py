@@ -1,0 +1,38 @@
+"""A/B: fused grow_tree dispatch time at 255 vs 64 bins (interleaved,
+min-of-reps) — does the transposed kernel's standalone win survive the
+full grow composition? Run on the real TPU."""
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ddt_tpu.backends.tpu import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache()
+
+import numpy as np  # noqa: E402
+
+from ddt_tpu.backends import get_backend  # noqa: E402
+from ddt_tpu.config import TrainConfig  # noqa: E402
+from ddt_tpu.utils.device import device_sync  # noqa: E402
+
+rng = np.random.default_rng(0)
+R = 1_000_000
+g = rng.standard_normal(R).astype(np.float32)
+h = rng.random(R).astype(np.float32)
+for bins in (255, 64, 255, 64):
+    cfg = TrainConfig(n_trees=1, max_depth=6, n_bins=bins, backend="tpu")
+    be = get_backend(cfg)
+    Xb = rng.integers(0, bins, (R, 28), dtype=np.uint8)
+    data = be.upload(Xb)
+    gd, hd = be._put_rows(g), be._put_rows(h)
+    handle, delta = be.grow_tree(data, gd, hd)
+    device_sync(delta)
+    dt = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(5):
+            handle, delta = be.grow_tree(data, gd, hd)
+        device_sync(delta)
+        dt = min(dt, (time.perf_counter() - t0) / 5)
+    print(f"grow_tree bins={bins}: {dt * 1e3:.1f} ms/tree", flush=True)

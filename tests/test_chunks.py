@@ -147,3 +147,76 @@ def test_cli_stream_dir_prebinned(tmp_path, capsys):
     assert b.mapper is None
     p = b.ensemble.predict(Xb, binned=True)
     assert p[y == 1].mean() > p[y == 0].mean()
+
+
+def test_cli_predict_stream_dir(tmp_path, capsys):
+    """Out-of-core batch scoring (BASELINE config 4 beyond RAM): per-shard
+    score files, equal to in-memory prediction on the concatenation."""
+    from ddt_tpu import api
+
+    X, y = datasets.synthetic_binary(2500, n_features=8, seed=6)
+    d = str(tmp_path / "shards")
+    chunks_mod.shard_arrays(X, y, d, n_chunks=3)
+
+    m = str(tmp_path / "m.npz")
+    _run(capsys, [
+        "train", "--backend=cpu", "--trees=4", "--depth=3", "--bins=31",
+        f"--stream-dir={d}", f"--out={m}",
+    ])
+    sdir = str(tmp_path / "scores")
+    rec = _run(capsys, [
+        "predict", "--backend=cpu", f"--model={m}",
+        f"--stream-dir={d}", f"--out={sdir}",
+    ])
+    assert rec["rows"] == 2500 and rec["streamed_chunks"] == 3
+    got = np.concatenate(
+        [np.load(f"{sdir}/scores_{c:05d}.npy") for c in range(3)])
+    b = api.load_model(m)
+    want = api.predict(b.ensemble, X, mapper=b.mapper)
+    # CLI routes through the CPU backend (native traversal); the oracle
+    # comparison is ULP-level, not bitwise.
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # pre-binned shards score too (binned model path)
+    Xb, yb = datasets.stress_binned_chunk(0, 900, n_features=16, seed=8)
+    db = str(tmp_path / "binned")
+    chunks_mod.shard_arrays(Xb, yb, db, n_chunks=2)
+    mb = str(tmp_path / "mb.npz")
+    _run(capsys, ["train", "--backend=cpu", "--trees=2", "--depth=3",
+                  "--bins=255", f"--stream-dir={db}", f"--out={mb}"])
+    rec = _run(capsys, ["predict", "--backend=cpu", f"--model={mb}",
+                        f"--stream-dir={db}",
+                        f"--out={tmp_path / 'sb'}"])
+    assert rec["rows"] == 900
+
+
+def test_cli_predict_stream_dir_guards(tmp_path, capsys):
+    """Encoder-carrying models refuse raw shards (silent garbage
+    otherwise); width mismatches on binned shards fail loudly."""
+    from ddt_tpu.cli import main as cli_main
+
+    # criteo-style in-memory model carries an encoder
+    m = str(tmp_path / "cm.npz")
+    _run(capsys, ["train", "--backend=cpu", "--dataset=criteo",
+                  "--rows=1200", "--trees=2", "--depth=3", "--bins=31",
+                  f"--out={m}"])
+    X, y = datasets.synthetic_binary(600, n_features=8, seed=1)
+    d = str(tmp_path / "raw")
+    chunks_mod.shard_arrays(X, y, d, n_chunks=2)
+    with pytest.raises(SystemExit, match="categorical encoder"):
+        cli_main(["predict", "--backend=cpu", f"--model={m}",
+                  f"--stream-dir={d}", f"--out={tmp_path / 's'}"])
+
+    # binned shards with the wrong width vs a binned-trained model
+    Xb, yb = datasets.stress_binned_chunk(0, 800, n_features=16, seed=2)
+    db = str(tmp_path / "b16")
+    chunks_mod.shard_arrays(Xb, yb, db, n_chunks=2)
+    mb = str(tmp_path / "mb.npz")
+    _run(capsys, ["train", "--backend=cpu", "--trees=2", "--depth=2",
+                  "--bins=255", f"--stream-dir={db}", f"--out={mb}"])
+    Xw, yw = datasets.stress_binned_chunk(0, 800, n_features=24, seed=2)
+    dw = str(tmp_path / "b24")
+    chunks_mod.shard_arrays(Xw, yw, dw, n_chunks=2)
+    with pytest.raises(SystemExit, match="24 features"):
+        cli_main(["predict", "--backend=cpu", f"--model={mb}",
+                  f"--stream-dir={dw}", f"--out={tmp_path / 's2'}"])

@@ -71,3 +71,26 @@ def test_f32_matmul_inputs_match_segment_exactly():
     np.testing.assert_array_equal(e_mm.threshold_bin, e_seg.threshold_bin)
     np.testing.assert_allclose(e_mm.leaf_value, e_seg.leaf_value,
                                rtol=2e-4, atol=2e-5)
+
+
+def test_64bin_contract_quality():
+    """The 64-bin opt-in speed contract (transposed kernel, docs/PERF.md
+    round-3): coarser quantiles must cost little accuracy — held-out AUC
+    within 0.01 of the 255-bin model on the synthetic Higgs config."""
+    from ddt_tpu import api
+    from ddt_tpu.data import datasets
+    from ddt_tpu.utils.metrics import auc
+
+    X, y = datasets.synthetic_binary(12000, seed=4)
+    Xt, yt, Xv, yv = X[:9000], y[:9000], X[9000:], y[9000:]
+
+    def fit_auc(bins):
+        res = api.train(Xt, yt, n_trees=20, max_depth=5, n_bins=bins,
+                        backend="cpu", log_every=10**9)
+        return auc(yv, api.predict(res.ensemble, Xv, mapper=res.mapper,
+                                   raw=True))
+
+    a255 = fit_auc(255)
+    a64 = fit_auc(64)
+    assert a255 > 0.75                      # the config separates at all
+    assert a64 > a255 - 0.01, (a64, a255)   # knob costs < 1 AUC point here
