@@ -399,6 +399,19 @@ def main(argv: list[str] | None = None) -> int:
     tp.add_argument("--host-partitions", type=int, default=1,
                     help="cross-slice DCN mesh axis for multi-host pods; "
                          "row shards span host-partitions x partitions")
+    tp.add_argument("--multihost-coordinator", default=None,
+                    help="host:port of process 0 — runs jax.distributed."
+                         "initialize before any device use, making "
+                         "jax.devices() the GLOBAL pod device list (run "
+                         "the SAME command on every host). On TPU pods "
+                         "with auto-discovery, pass --multihost-processes "
+                         "alone. Every process writes --out (the fetched "
+                         "ensembles are replicas; use per-process paths "
+                         "on a shared FS if you prefer)")
+    tp.add_argument("--multihost-processes", type=int, default=None,
+                    help="total process count for --multihost-coordinator")
+    tp.add_argument("--multihost-id", type=int, default=None,
+                    help="this process's id in [0, multihost-processes)")
     tp.add_argument("--missing", choices=["zero", "learn"], default="zero",
                     help="NaN policy: zero = bin 0; learn = reserved NaN "
                          "bin + learned per-split default direction")
@@ -480,6 +493,16 @@ def main(argv: list[str] | None = None) -> int:
                     default="gain")
 
     args = ap.parse_args(argv)
+
+    if args.cmd == "train" and (
+            args.multihost_coordinator is not None
+            or args.multihost_processes is not None):
+        # Must run before ANY device use (SURVEY.md §5 "Distributed
+        # communication backend": the v5e-64 pod bring-up).
+        from ddt_tpu.parallel.mesh import initialize_multihost
+
+        initialize_multihost(args.multihost_coordinator,
+                             args.multihost_processes, args.multihost_id)
 
     if args.cmd == "train":
         file_cfg = None

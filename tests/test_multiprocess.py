@@ -113,3 +113,50 @@ def test_initialize_multihost_guard():
             mesh.initialize_multihost("localhost:1", 2, 1)
     finally:
         mesh._init_args = orig
+
+
+def test_cli_multihost_train(tmp_path):
+    """The CLI's own multihost bring-up (--multihost-*): two OS processes
+    run the SAME train command, each fetches a replicated ensemble,
+    bit-identical across processes. (sitecustomize pins the axon
+    platform even in fresh subprocesses, so the wrapper flips the jax
+    config to cpu before invoking the CLI — exactly what a multihost
+    launcher script does on a non-TPU host.)"""
+    port = _free_port()
+    outs = [str(tmp_path / f"cli{i}.npz") for i in range(2)]
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["DDT_COMPILATION_CACHE"] = str(tmp_path / f"cc{i}")
+        wrapper = ("import jax, sys; "
+                   "jax.config.update('jax_platforms', 'cpu'); "
+                   "from ddt_tpu.cli import main; "
+                   "sys.exit(main(sys.argv[1:]))")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", wrapper, "train",
+             "--backend=tpu", "--rows=2048", "--trees=3", "--depth=3",
+             "--bins=31", "--host-partitions=2", "--partitions=2",
+             f"--multihost-coordinator=localhost:{port}",
+             "--multihost-processes=2", f"--multihost-id={i}",
+             f"--out={outs[i]}"],
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(stdout)
+    assert all(p.returncode == 0 for p in procs), (
+        "cli multihost worker failed:\n" + "\n----\n".join(logs))
+    d0 = np.load(outs[0])
+    d1 = np.load(outs[1])
+    for k in ("feature", "threshold_bin", "is_leaf", "leaf_value"):
+        np.testing.assert_array_equal(d0[k], d1[k], err_msg=k)
