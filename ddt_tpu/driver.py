@@ -311,13 +311,15 @@ class Driver:
         # deterministic boosting — bagging/colsample masks are host-drawn
         # by design and profiling wants per-phase barriers. Validation
         # rides INSIDE the scan (grow_rounds_eval) when its metric has a
-        # device twin and no early stopping is requested (stopping needs
-        # the score back every round).
+        # device twin; EARLY STOPPING rides too — the stopping rule is
+        # replayed post-hoc over the block's per-round scores vector
+        # (training past the stop point cannot change earlier trees, so
+        # truncation gives the EXACT granular-path model; blocks are
+        # capped at the patience so overrun work is bounded).
         fused_eval = (
             eval_set is not None
             and use_dev_eval
             and dev_metric is not None
-            and early_stopping_rounds is None
             and getattr(self.backend, "grow_rounds_eval", None) is not None
         )
         if (
@@ -333,7 +335,8 @@ class Driver:
                               dev_metric, sign)
             return self._fit_fused(
                 data, y_dev, pred, ens, start_round, C,
-                eval_state=eval_state)
+                eval_state=eval_state,
+                early_stopping_rounds=early_stopping_rounds)
 
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
@@ -471,13 +474,19 @@ class Driver:
 
     def _fit_fused(self, data, y_dev, pred, ens: TreeEnsemble,
                    start_round: int, C: int,
-                   eval_state: tuple | None = None) -> TreeEnsemble:
+                   eval_state: tuple | None = None,
+                   early_stopping_rounds: int | None = None
+                   ) -> TreeEnsemble:
         """Block loop over backend.grow_rounds: K rounds per dispatch,
         K x C trees per fetch. Blocks break at checkpoint_every boundaries
         so the checkpoint cadence (and resume bit-exactness) is identical
         to the granular path. With eval_state, validation scoring runs
         inside the scan (grow_rounds_eval) and a [K] scores vector rides
-        the same fetch."""
+        the same fetch; early stopping replays the stopping rule over
+        that vector after the fetch — identical models to the granular
+        path (trees past the stop point are simply discarded), with
+        blocks capped at the patience so at most one patience-worth of
+        rounds is grown beyond the stop."""
         cfg = self.cfg
         metric_name = None
         if eval_state is not None:
@@ -490,6 +499,8 @@ class Driver:
                 nxt = (rnd // self.checkpoint_every + 1) * \
                     self.checkpoint_every
                 K = min(K, nxt - rnd)
+            if early_stopping_rounds is not None:
+                K = min(K, max(early_stopping_rounds, 1))
             t0 = time.perf_counter()
             if eval_state is not None:
                 trees_h, pred, losses_h, val_pred, scores_h = \
@@ -524,6 +535,24 @@ class Driver:
                 self._record_round(
                     r, dt * 1e3 / K, metric_name, val_score,
                     lambda k=k: float(losses[k]))
+                if early_stopping_rounds is not None:
+                    if self.best_round is None:
+                        raise ValueError(
+                            f"validation {metric_name} has been NaN since "
+                            "round 1 (degenerate eval_set — e.g. constant "
+                            "scores or a single-class slice); cannot "
+                            "early-stop on it"
+                        )
+                    if r - self.best_round >= early_stopping_rounds:
+                        log.info(
+                            "early stop at round %d (best %s=%.6f at "
+                            "round %d)", r + 1, metric_name,
+                            self.best_score, self.best_round + 1,
+                        )
+                        ens = ens.truncate((self.best_round + 1) * C)
+                        checkpoint.maybe_save(self.checkpoint_dir, ens,
+                                              cfg, self.best_round + 1)
+                        return ens
             rnd += K
             if rnd < cfg.n_trees:
                 checkpoint.maybe_save(self.checkpoint_dir, ens, cfg, rnd,

@@ -334,3 +334,53 @@ def test_fused_eval_matches_host_and_granular():
     rh = api.train(Xm[:1200], ym[:1200], backend="cpu", **km)
     assert rm.best_round == rh.best_round
     np.testing.assert_allclose(rm.best_score, rh.best_score, rtol=1e-6)
+
+
+def test_fused_early_stopping_matches_granular():
+    """Early stopping now rides the fused block path (round-3): the
+    stopping rule replays over the in-scan scores vector, so the model,
+    best round, and truncation are identical to the granular path — at
+    one dispatch per block instead of per round."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.data import datasets
+    from ddt_tpu.data.quantizer import quantize
+    from ddt_tpu.driver import Driver
+
+    X, y = datasets.synthetic_binary(3072, n_features=8, seed=17)
+    Xb, _ = quantize(X, n_bins=31, seed=17)
+    Xt, yt, Xv, yv = Xb[:2304], y[:2304], Xb[2304:], y[2304:]
+    cfg = TrainConfig(n_trees=30, max_depth=4, n_bins=31, backend="tpu",
+                      learning_rate=0.9, min_split_gain=1e-3)
+
+    be = get_backend(cfg)
+    calls = {"fused": 0}
+    orig = be.grow_rounds_eval
+
+    def spy(*a, **k):
+        calls["fused"] += 1
+        return orig(*a, **k)
+
+    be.grow_rounds_eval = spy
+    try:
+        drv = Driver(be, cfg, log_every=10**9)
+        fused = drv.fit(Xt, yt, eval_set=(Xv, yv), eval_metric="logloss",
+                        early_stopping_rounds=3)
+    finally:
+        be.grow_rounds_eval = orig
+    assert calls["fused"] >= 1              # the fused path actually ran
+    assert fused.n_trees < 30               # and it stopped early
+    fused_best = drv.best_round
+
+    # Granular comparator: CPUDevice has no grow_rounds — same rule,
+    # per-round host scoring.
+    cfg_c = cfg.replace(backend="cpu")
+    drv_c = Driver(get_backend(cfg_c), cfg_c, log_every=10**9)
+    gran = drv_c.fit(Xt, yt, eval_set=(Xv, yv), eval_metric="logloss",
+                     early_stopping_rounds=3)
+    assert gran.n_trees == fused.n_trees
+    assert drv_c.best_round == fused_best
+    np.testing.assert_array_equal(gran.feature, fused.feature)
+    np.testing.assert_array_equal(gran.threshold_bin, fused.threshold_bin)
+    np.testing.assert_allclose(gran.leaf_value, fused.leaf_value,
+                               rtol=2e-4, atol=2e-5)
