@@ -263,3 +263,65 @@ def test_cpu_backend_uses_native_full_split_cat_training():
     np.testing.assert_allclose(e_n.leaf_value, e_0.leaf_value, rtol=1e-6)
     used = e_n.feature[(~e_n.is_leaf) & (e_n.feature >= 0)]
     assert np.isin(used, cat).any()
+
+
+def test_csv_parse_native_matches_loadtxt(tmp_path):
+    """The native CSV parser (csv_loader.cpp) vs np.loadtxt on the exact
+    subset load_file uses: comments, blank lines, headers skipped by
+    physical count, \\r\\n endings, exponents, max_rows."""
+    from ddt_tpu.native import csv_parse_native
+
+    text = (
+        "colA,colB,colC\n"            # header (skip_rows=1)
+        "1.5,2,-3e2\r\n"
+        "# a full-line comment\n"
+        "\n"
+        "4,5.25,6 # trailing comment\n"
+        "-0.125,1e-3,+7\n"
+    )
+    p = tmp_path / "t.csv"
+    p.write_text(text)
+    want = np.loadtxt(str(p), delimiter=",", skiprows=1)
+    got = csv_parse_native(text.encode(), skip_rows=1)
+    np.testing.assert_array_equal(got, want)
+
+    got2 = csv_parse_native(text.encode(), skip_rows=1, max_rows=2)
+    np.testing.assert_array_equal(got2, want[:2])
+
+
+def test_csv_parse_native_rejects_malformed():
+    from ddt_tpu.native import csv_parse_native
+
+    with pytest.raises(ValueError, match="line 2.*expected"):
+        csv_parse_native(b"1,2,3\n4,5\n")
+    with pytest.raises(ValueError, match="unparseable"):
+        csv_parse_native(b"1,2\n3,x\n")
+    with pytest.raises(ValueError, match="empty"):
+        csv_parse_native(b"1,,3\n")
+    assert csv_parse_native(b"").shape == (0, 0)
+
+
+def test_load_file_csv_native_equals_fallback(tmp_path, monkeypatch):
+    """load_file's CSV branch: native parse == np.loadtxt fallback."""
+    from ddt_tpu.data import datasets as ds
+
+    rng = np.random.default_rng(3)
+    M = rng.standard_normal((200, 5)).round(4)
+    M[:, 0] = rng.integers(0, 2, 200)
+    p = tmp_path / "d.csv"
+    np.savetxt(str(p), M, delimiter=",", fmt="%.6g")
+
+    Xn, yn = ds.load_file(str(p))
+    # Force the fallback by making the native import fail.
+    import builtins
+    real_import = builtins.__import__
+
+    def block(name, *a, **k):
+        if name == "ddt_tpu.native":
+            raise ImportError("blocked for fallback test")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", block)
+    Xf, yf = ds.load_file(str(p))
+    np.testing.assert_array_equal(Xn, Xf)
+    np.testing.assert_array_equal(yn, yf)
