@@ -44,6 +44,12 @@ def main() -> None:
         backend="tpu", rows=rows, features=features, bins=bins,
         n_nodes=n_nodes, iters=15, reps=8,
     )
+    # The 64-bin opt-in contract (transposed kernel, docs/PERF.md round-3
+    # addendum) — secondary evidence field, not the headline metric.
+    tpu64 = bench_histogram(
+        backend="tpu", rows=rows, features=features, bins=64,
+        n_nodes=n_nodes, iters=10, reps=4,
+    )
 
     # CPU reference baseline: fewer rows (np.add.at is slow; throughput is
     # row-linear at this shape), normalised to M-rows/sec.
@@ -72,6 +78,7 @@ def main() -> None:
         "baseline_cpu_count": os.cpu_count(),
         "baseline_omp_threads": _omp_threads(),
         "floor_mrows_per_sec": TPU_FLOOR_MROWS if on_tpu else None,
+        "value_64bin_optin": round(tpu64["mrows_per_sec_per_chip"], 2),
     }))
     if on_tpu and value < TPU_FLOOR_MROWS:
         raise SystemExit(
