@@ -451,6 +451,28 @@ class TPUDevice(DeviceBackend):
             self._rounds_fns[n_rounds] = fn
         return fn(data, pred, y.y, y.valid)
 
+    def grow_rounds_masked(self, data, pred, y: "LabelHandle",
+                           n_rounds: int, fmasks: np.ndarray):
+        """grow_rounds with per-round/per-class colsample feature masks
+        riding the scan as xs: `fmasks` is host bool [n_rounds, C, F]
+        (KBs — unlike bagging's [K, R] row masks, small enough to ship
+        per block, which is why colsample fuses and subsample does not).
+        Masks are padded to the global column count here; padded columns
+        stay masked out."""
+        K, C, F = fmasks.shape
+        Fg = data.shape[1]          # jax.Array shape is GLOBAL (padded)
+        m = np.zeros((K, C, Fg), bool)
+        m[..., :F] = fmasks
+        fn = self._rounds_masked_fns.get(n_rounds)
+        if fn is None:
+            fn = self._build_rounds_fn(n_rounds, masked=True)
+            self._rounds_masked_fns[n_rounds] = fn
+        return fn(data, pred, y.y, y.valid, m)
+
+    @functools.cached_property
+    def _rounds_masked_fns(self) -> dict:
+        return {}
+
     def grow_rounds_eval(self, data, pred, y: "LabelHandle", n_rounds: int,
                          val_data, val_pred, val_y: "LabelHandle",
                          metric: str):
@@ -478,7 +500,13 @@ class TPUDevice(DeviceBackend):
     def _rounds_fns(self) -> dict:
         return {}
 
-    def _build_rounds_fn(self, K: int, eval_metric: str | None = None):
+    def _build_rounds_fn(self, K: int, eval_metric: str | None = None,
+                         masked: bool = False):
+        # The mfn scan branch does not thread feature masks; combining
+        # them must fail loudly here, not silently grow unmasked trees
+        # (the Driver routes colsample+eval_set to the granular path).
+        assert not (masked and eval_metric is not None), \
+            "masked fused blocks do not compose with in-scan eval"
         from ddt_tpu.ops import stream as stream_ops
         from ddt_tpu.utils.metrics import device_metric
 
@@ -500,14 +528,16 @@ class TPUDevice(DeviceBackend):
             return grad_ops.mean_loss(pred, ya, valid, cfg.loss,
                                       allreduce=allreduce)
 
-        def rounds(data_a, pred0, ya, valid, *val_args):
+        def rounds(data_a, pred0, ya, valid, *rest):
+            if masked:
+                *rest, fmasks = rest          # [K, C, Fg] bool, scan xs
             if mfn is not None:
-                val_data, vpred0, vy, vvalid = val_args
+                val_data, vpred0, vy, vvalid = rest
                 cat_vec = split_ops.cat_feature_vec(
                     cfg.cat_features,
                     val_data.shape[1] * self.feature_partitions)
 
-            def one_round(pred, vpred):
+            def one_round(pred, vpred, fmask_r=None):
                 g, h = grad_ops.grad_hess(pred, ya, cfg.loss)
                 v = valid[:, None] if g.ndim == 2 else valid
                 g = g * v
@@ -527,6 +557,8 @@ class TPUDevice(DeviceBackend):
                         input_dtype=input_dtype,
                         axis_name=axis,
                         feature_axis_name=faxis,
+                        feature_mask=(
+                            fmask_r[c] if fmask_r is not None else None),
                         missing_bin=missing,
                         cat_features=cfg.cat_features,
                     )
@@ -562,6 +594,14 @@ class TPUDevice(DeviceBackend):
                     body, (pred0, vpred0), None, length=K)
                 return trees, predf, losses, vpredf, scores
 
+            if masked:
+                def body(carry, fm):          # fm [C, Fg]: this round's
+                    pred, _, packs, loss = one_round(carry, None, fm)
+                    return pred, (packs, loss)
+
+                predf, (trees, losses) = jax.lax.scan(body, pred0, fmasks)
+                return trees, predf, losses
+
             def body(carry, _):
                 pred, _, packs, loss = one_round(carry, None)
                 return pred, (packs, loss)
@@ -580,6 +620,8 @@ class TPUDevice(DeviceBackend):
                 in_specs = in_specs + (data_spec, pred_spec, P(rax),
                                        P(rax))
                 out_specs = out_specs + (pred_spec, P())
+            if masked:
+                in_specs = in_specs + (P(),)   # fmasks replicated
             rounds = jax.shard_map(
                 rounds,
                 mesh=self.mesh,

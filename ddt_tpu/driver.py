@@ -128,6 +128,18 @@ class Driver:
         # report shows where device time actually goes.
         self.timer = PhaseTimer() if profile else None
 
+    def _draw_colsample_mask(self, rnd: int, c: int, F: int) -> np.ndarray:
+        """The per-(seed, round, class) colsample feature mask — ONE home
+        for the rng tuple and the degenerate-draw rescue, because the
+        fused==granular ensemble-parity guarantee depends on both paths
+        drawing bit-identical masks."""
+        m = (np.random.default_rng(
+            (self.cfg.seed, 104729, rnd, c)).random(F)
+            < self.cfg.colsample_bytree)
+        if not m.any():                 # degenerate draw: keep 1 feature
+            m[rnd % F] = True
+        return m
+
     def _psync(self, x) -> None:
         """Backend barrier on x's producer chain — only when profiling
         (the fast path must stay sync-free to pipeline rounds); no-op on
@@ -322,12 +334,22 @@ class Driver:
             and dev_metric is not None
             and getattr(self.backend, "grow_rounds_eval", None) is not None
         )
+        # colsample fuses too (round 3): its [K, C, F] feature masks are
+        # KBs and ride the scan as xs, drawn by the SAME host rngs as the
+        # granular path so fused == granular == cross-backend. Bagging's
+        # [K, R] row masks stay granular (too big to ship per block).
+        fused_masked = (
+            colsample
+            and eval_set is None
+            and getattr(self.backend, "grow_rounds_masked", None)
+            is not None
+        )
         if (
             getattr(self.backend, "grow_rounds", None) is not None
             and (eval_set is None or fused_eval)
             and self.timer is None
             and not bagging
-            and not colsample
+            and (not colsample or fused_masked)
         ):
             eval_state = None
             if fused_eval:
@@ -336,7 +358,8 @@ class Driver:
             return self._fit_fused(
                 data, y_dev, pred, ens, start_round, C,
                 eval_state=eval_state,
-                early_stopping_rounds=early_stopping_rounds)
+                early_stopping_rounds=early_stopping_rounds,
+                colsample_features=F if fused_masked else None)
 
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
@@ -353,15 +376,10 @@ class Driver:
             for c in range(C):
                 gc = g[:, c] if C > 1 else g
                 hc = h[:, c] if C > 1 else h
-                fmask = None
-                if colsample:
-                    fmask = (
-                        np.random.default_rng(
-                            (cfg.seed, 104729, rnd, c)).random(F)
-                        < cfg.colsample_bytree
-                    )
-                    if not fmask.any():     # degenerate draw: keep 1 feature
-                        fmask[rnd % F] = True
+                fmask = (
+                    self._draw_colsample_mask(rnd, c, F) if colsample
+                    else None
+                )
                 with ph("grow"):
                     handle, delta = self.backend.grow_tree(
                         data, gc, hc, feature_mask=fmask)
@@ -475,7 +493,8 @@ class Driver:
     def _fit_fused(self, data, y_dev, pred, ens: TreeEnsemble,
                    start_round: int, C: int,
                    eval_state: tuple | None = None,
-                   early_stopping_rounds: int | None = None
+                   early_stopping_rounds: int | None = None,
+                   colsample_features: int | None = None
                    ) -> TreeEnsemble:
         """Block loop over backend.grow_rounds: K rounds per dispatch,
         K x C trees per fetch. Blocks break at checkpoint_every boundaries
@@ -508,6 +527,15 @@ class Driver:
                         data, pred, y_dev, K,
                         val_data, val_pred, val_y, metric_name)
                 scores = np.asarray(scores_h)   # [K] — same fetch wave
+            elif colsample_features is not None:
+                F = colsample_features
+                fmasks = np.zeros((K, C, F), bool)
+                for k in range(K):
+                    for c in range(C):
+                        fmasks[k, c] = self._draw_colsample_mask(
+                            rnd + k, c, F)
+                trees_h, pred, losses_h = self.backend.grow_rounds_masked(
+                    data, pred, y_dev, K, fmasks)
             else:
                 trees_h, pred, losses_h = self.backend.grow_rounds(
                     data, pred, y_dev, K)

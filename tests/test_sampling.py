@@ -210,3 +210,70 @@ def test_sklearn_facade_eval_attributes():
     clf = DDTClassifier(n_trees=3, max_depth=3, n_bins=63, backend="cpu")
     clf.fit(X[:500], y[:500])
     assert clf.best_iteration_ is None and clf.evals_result_ == {}
+
+
+def test_colsample_rides_fused_path():
+    """Round-3: colsample's [K, C, F] masks ride the fused scan as xs —
+    grow_rounds_masked must engage and grow the same ensemble as the
+    granular CPU path (same host-drawn masks)."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.driver import Driver
+
+    X, y = synthetic_binary(2048, n_features=10, seed=3)
+    Xb, _ = quantize(X, n_bins=31, seed=3)
+    cfg = TrainConfig(n_trees=5, max_depth=3, n_bins=31, backend="tpu",
+                      colsample_bytree=0.5, seed=7)
+    be = get_backend(cfg)
+    calls = {"masked": 0}
+    orig = be.grow_rounds_masked
+
+    def spy(*a, **k):
+        calls["masked"] += 1
+        return orig(*a, **k)
+
+    be.grow_rounds_masked = spy
+    try:
+        fused = Driver(be, cfg, log_every=10**9).fit(Xb, y)
+    finally:
+        be.grow_rounds_masked = orig
+    assert calls["masked"] >= 1
+
+    cfg_c = cfg.replace(backend="cpu")
+    gran = Driver(get_backend(cfg_c), cfg_c, log_every=10**9).fit(Xb, y)
+    np.testing.assert_array_equal(gran.feature, fused.feature)
+    np.testing.assert_array_equal(gran.threshold_bin, fused.threshold_bin)
+    np.testing.assert_allclose(gran.leaf_value, fused.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_colsample_fused_softmax_and_partitions():
+    """Masked fused blocks compose with softmax (per-class masks) and the
+    row mesh."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.driver import Driver
+
+    X, y = synthetic_multiclass(1500, n_features=12, seed=5)
+    Xb, _ = quantize(X, n_bins=31, seed=5)
+    cfg = TrainConfig(n_trees=3, max_depth=3, n_bins=31, backend="cpu",
+                      loss="softmax", n_classes=7, colsample_bytree=0.6,
+                      seed=9)
+    gran = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
+    cfg_t = cfg.replace(backend="tpu", n_partitions=2)
+    be = get_backend(cfg_t)
+    calls = {"masked": 0}
+    orig = be.grow_rounds_masked
+
+    def spy(*a, **k):
+        calls["masked"] += 1
+        return orig(*a, **k)
+
+    be.grow_rounds_masked = spy
+    try:
+        fused = Driver(be, cfg_t, log_every=10**9).fit(Xb, y)
+    finally:
+        be.grow_rounds_masked = orig
+    assert calls["masked"] >= 1        # the masked fused path engaged
+    np.testing.assert_array_equal(gran.feature, fused.feature)
+    np.testing.assert_array_equal(gran.threshold_bin, fused.threshold_bin)
+    np.testing.assert_allclose(gran.leaf_value, fused.leaf_value,
+                               rtol=2e-4, atol=2e-5)
