@@ -114,6 +114,17 @@ def best_splits(
     # domain tests/test_config_fuzz.py randomizes over. Well-separated
     # real-signal configs (the default-parameter test suites) satisfy
     # this without any explicit floor.
+    #
+    # Cross-PLATFORM boundary (round 3, measured — experiments/
+    # chip_parity.py): all of the above holds WITHIN a platform. Real-v5e
+    # vs CPU training additionally differs by f32 summation ORDER (MXU
+    # systolic accumulation vs sequential loops), which flips decisions
+    # on EXACT near-ties that straddle a bf16 quantization boundary —
+    # ~2-4 nodes per 155 at depth 4, unaffected by min_split_gain or
+    # f32 matmul inputs (ordering is not a dtype). Model quality is
+    # equivalent (held-out AUC within 0.004 both directions over 20
+    # trees); reproducibility ACROSS platforms is per-platform, not
+    # bitwise.
     def overlay_cat(gain, valid):
         """Replace cat features' ordinal gains with one-vs-rest gains
         (left child = exactly bin k => GL_k is the per-bin sum itself)."""
