@@ -107,6 +107,7 @@ def train(
     eval_set: tuple[np.ndarray, np.ndarray] | None = None,
     eval_metric: str | None = None,
     early_stopping_rounds: int | None = None,
+    sample_weight: np.ndarray | None = None,
     profile: bool = False,
     **cfg_overrides,
 ) -> TrainResult:
@@ -180,6 +181,7 @@ def train(
         eval_set=eval_set,
         eval_metric=eval_metric,
         early_stopping_rounds=early_stopping_rounds,
+        sample_weight=sample_weight,
     )
     if mapper is not None:
         from ddt_tpu.reference.numpy_trainer import _fill_raw_thresholds

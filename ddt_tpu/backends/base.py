@@ -59,8 +59,12 @@ class DeviceBackend(abc.ABC):
         distributed). Returns an opaque handle accepted by the kernels."""
 
     @abc.abstractmethod
-    def upload_labels(self, y: np.ndarray) -> Any:
-        """Ship labels [R] (row-sharded alongside the data when distributed)."""
+    def upload_labels(self, y: np.ndarray,
+                      sample_weight: np.ndarray | None = None) -> Any:
+        """Ship labels [R] (row-sharded alongside the data when
+        distributed), with optional per-row instance weights — they scale
+        gradients, hessians, and the training loss's numerator AND
+        denominator (weighted means)."""
 
     # ------------------------------------------------------------------ #
     # L3 kernels (granular contract: parity tests + bench drive these)

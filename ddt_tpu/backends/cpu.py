@@ -17,7 +17,7 @@ otherwise the NumPy np.add.at path runs. Both match the oracle bit-for-bit.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, NamedTuple
 
 import numpy as np
 
@@ -25,6 +25,15 @@ from ddt_tpu.backends.base import DeviceBackend, HostTree
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble
 from ddt_tpu.reference import numpy_trainer as ref
+
+
+class CPULabels(NamedTuple):
+    """Labels + optional instance weights — the opaque `y` handle (per-
+    dataset state lives in handles, not on the cached backend instance;
+    mirrors TPUDevice.LabelHandle)."""
+
+    y: np.ndarray
+    w: np.ndarray | None
 
 
 class CPUDevice(DeviceBackend):
@@ -60,8 +69,14 @@ class CPUDevice(DeviceBackend):
             raise TypeError(f"binned data must be uint8, got {Xb.dtype}")
         return Xb
 
-    def upload_labels(self, y: np.ndarray) -> np.ndarray:
-        return np.asarray(y)
+    def upload_labels(self, y: np.ndarray,
+                      sample_weight: np.ndarray | None = None
+                      ) -> "CPULabels":
+        return CPULabels(
+            np.asarray(y),
+            None if sample_weight is None
+            else np.asarray(sample_weight, np.float32),
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -88,7 +103,7 @@ class CPUDevice(DeviceBackend):
     # ------------------------------------------------------------------ #
 
     def init_pred(self, y, base: float):
-        R = y.shape[0]
+        R = y.y.shape[0]
         if self.cfg.loss == "softmax":
             return np.zeros((R, self.cfg.n_classes), np.float32)
         return np.full(R, base, np.float32)
@@ -97,7 +112,12 @@ class CPUDevice(DeviceBackend):
         return np.array(raw, np.float32)
 
     def grad_hess(self, pred, y):
-        return ref.grad_hess(pred, y, self.cfg.loss)
+        g, h = ref.grad_hess(pred, y.y, self.cfg.loss)
+        if y.w is not None:
+            w = y.w[:, None] if g.ndim == 2 else y.w
+            g = g * w
+            h = h * w
+        return g, h
 
     def grow_tree(self, data, g, h,
                   feature_mask=None) -> tuple[HostTree, Any]:
@@ -132,17 +152,25 @@ class CPUDevice(DeviceBackend):
             pred += delta
         return pred
 
-    def loss_value(self, pred, y) -> float:
+    def loss_value(self, pred, yh) -> float:
         loss = self.cfg.loss
+        y = yh.y
+        w = yh.w
+
+        def wmean(per_row):
+            if w is None:
+                return float(np.mean(per_row))
+            return float(np.average(per_row, weights=w))
+
         if loss == "logloss":
             p = 1.0 / (1.0 + np.exp(-pred.astype(np.float64)))
             p = np.clip(p, 1e-12, 1 - 1e-12)
-            return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+            return wmean(-(y * np.log(p) + (1 - y) * np.log(1 - p)))
         if loss == "mse":
-            return float(np.mean((pred - y) ** 2))
+            return wmean((pred - y) ** 2)
         z = pred - pred.max(axis=1, keepdims=True)
         logp = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
-        return float(-np.mean(logp[np.arange(y.shape[0]), y.astype(np.int64)]))
+        return wmean(-logp[np.arange(y.shape[0]), y.astype(np.int64)])
 
     # ------------------------------------------------------------------ #
 

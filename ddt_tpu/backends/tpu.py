@@ -73,9 +73,13 @@ def _pack_tree(tree) -> "jax.Array":
 
 
 class LabelHandle(NamedTuple):
-    """Labels + pad-row validity mask, row-sharded — the opaque `y` handle
-    the Driver threads through grad_hess/loss_value. Per-dataset state lives
-    here, NOT on the backend instance (instances are cached and shared)."""
+    """Labels + per-row WEIGHT mask, row-sharded — the opaque `y` handle
+    the Driver threads through grad_hess/loss_value. `valid` is float32:
+    0 on pad rows, the instance weight elsewhere (1.0 without
+    sample_weight) — one mask multiplication weights gradients, hessians,
+    loss numerators AND the loss denominator (weighted means) everywhere,
+    granular and fused paths alike. Per-dataset state lives here, NOT on
+    the backend instance (instances are cached and shared)."""
 
     y: jax.Array
     valid: jax.Array
@@ -228,14 +232,18 @@ class TPUDevice(DeviceBackend):
             data = self._put_rows(Xb, extra_dims=1)
         return data
 
-    def upload_labels(self, y: np.ndarray) -> "LabelHandle":
-        # The pad-row validity mask travels WITH the labels (not on the
+    def upload_labels(self, y: np.ndarray,
+                      sample_weight: np.ndarray | None = None
+                      ) -> "LabelHandle":
+        # The pad-row weight mask travels WITH the labels (not on the
         # backend instance): backend instances are cached and shared across
         # fits, so per-dataset state must live in the opaque handles the
         # Driver threads through grad_hess/loss_value.
         y = np.asarray(y)
-        valid = np.zeros(self._pad_rows(y).shape[0], bool)
-        valid[: y.shape[0]] = True
+        valid = np.zeros(self._pad_rows(y).shape[0], np.float32)
+        valid[: y.shape[0]] = (
+            1.0 if sample_weight is None
+            else np.asarray(sample_weight, np.float32))
         return LabelHandle(self._put_rows(y), self._put_rows(valid))
 
     # ------------------------------------------------------------------ #

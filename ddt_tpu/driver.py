@@ -187,8 +187,16 @@ class Driver:
         eval_set: tuple[np.ndarray, np.ndarray] | None = None,
         eval_metric: str | None = None,
         early_stopping_rounds: int | None = None,
+        sample_weight: np.ndarray | None = None,
     ) -> TreeEnsemble:
-        """Train on binned uint8 data. Returns the grown ensemble."""
+        """Train on binned uint8 data. Returns the grown ensemble.
+
+        `sample_weight` (float [R], >= 0, not all zero): per-row instance
+        weights scaling each row's gradient/hessian contribution and the
+        weighted-mean training loss; the base score becomes the weighted
+        mean. Integer weights are exactly equivalent to duplicating rows
+        (tested). Validation metrics stay unweighted; the streaming
+        trainer does not take weights."""
         cfg = self.cfg
         R, F = Xb.shape
         if Xb.dtype != np.uint8:
@@ -202,10 +210,23 @@ class Driver:
                 f"cat_features index {cfg.cat_features[-1]} out of range "
                 f"for {F} features"
             )
-        bs = base_score(np.asarray(y), cfg.loss, cfg.n_classes)
+        if sample_weight is not None:
+            sample_weight = np.asarray(sample_weight, np.float32)
+            if sample_weight.shape != (R,):
+                raise ValueError(
+                    f"sample_weight must be [R]={R}, got "
+                    f"{sample_weight.shape}")
+            if not np.all(np.isfinite(sample_weight)) \
+                    or (sample_weight < 0).any():
+                raise ValueError("sample_weight must be finite and >= 0")
+            if not (sample_weight > 0).any():
+                raise ValueError("sample_weight is all zero")
+        bs = base_score(np.asarray(y), cfg.loss, cfg.n_classes,
+                        sample_weight=sample_weight)
 
         data = self.backend.upload(Xb)
-        y_dev = self.backend.upload_labels(np.asarray(y))
+        y_dev = self.backend.upload_labels(np.asarray(y),
+                                           sample_weight=sample_weight)
         pred = self.backend.init_pred(y_dev, bs)
 
         ens = empty_ensemble(

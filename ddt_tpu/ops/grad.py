@@ -39,7 +39,11 @@ def mean_loss(
     (their reported train_loss must stay numerically identical). `allreduce`
     is identity on one shard, psum over the row axes inside shard_map."""
     valid = valid.astype(jnp.float32)
-    n = jnp.maximum(allreduce(valid.sum()), 1)
+    # `valid` carries instance WEIGHTS (1/0 without sample_weight), so the
+    # denominator is a weight sum in (0, inf) — clamp only the exact-zero
+    # case, not sums below 1 (a >=1 clamp silently halves the reported
+    # loss for fractional-weight datasets).
+    n = jnp.maximum(allreduce(valid.sum()), 1e-12)
     if loss == "logloss":
         yf = y.astype(jnp.float32)
         # Numerically stable logistic loss: log(1+e^-|x|)+max(x,0)-x*y

@@ -101,11 +101,12 @@ def partial_node_index(
 def chunk_grads(
     pred: jax.Array,          # f32 [R] or [R, C]
     y: jax.Array,
-    valid: jax.Array,         # bool [R] (pad rows False)
+    valid: jax.Array,         # float32 [R] weights (0 on pad rows)
     loss: str,
     class_idx: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
-    """(g, h) for one class column, pad rows zeroed."""
+    """(g, h) for one class column, scaled by the per-row weight mask
+    (pad rows carry 0; instance weights when the caller set them)."""
     g, h = grad_ops.grad_hess(pred, y, loss)
     if g.ndim == 2:
         g = g[:, class_idx]

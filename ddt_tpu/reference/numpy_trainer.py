@@ -33,12 +33,20 @@ from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
 # Losses (NumPy twins of ops/grad.py — keep formulas in sync)
 # --------------------------------------------------------------------------- #
 
-def base_score(y: np.ndarray, loss: str, n_classes: int = 2) -> float:
+def base_score(y: np.ndarray, loss: str, n_classes: int = 2,
+               sample_weight: np.ndarray | None = None) -> float:
+    """Raw-score init; the weighted mean when sample_weight is given
+    (weights scale each row's contribution to the loss, so the optimal
+    constant shifts with them)."""
+    mean = (
+        float(np.mean(y)) if sample_weight is None
+        else float(np.average(y, weights=sample_weight))
+    )
     if loss == "logloss":
-        p = float(np.clip(np.mean(y), 1e-6, 1 - 1e-6))
+        p = float(np.clip(mean, 1e-6, 1 - 1e-6))
         return float(np.log(p / (1 - p)))
     if loss == "mse":
-        return float(np.mean(y))
+        return mean
     return 0.0  # softmax: symmetric zero init per class
 
 

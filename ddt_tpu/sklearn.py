@@ -91,8 +91,8 @@ class _DDTBase:
             **extra,
         )
 
-    def fit(self, X, y, eval_set=None, eval_metric=None,
-            early_stopping_rounds=None):
+    def fit(self, X, y, sample_weight=None, *, eval_set=None,
+            eval_metric=None, early_stopping_rounds=None):
         from ddt_tpu import api
 
         X = np.asarray(X, np.float32)
@@ -105,7 +105,8 @@ class _DDTBase:
         # the Driver's "requires an eval_set" error reaches the user.
         res = api.train(X, y, cfg, log_every=10 ** 9, eval_set=eval_set,
                         eval_metric=eval_metric,
-                        early_stopping_rounds=early_stopping_rounds)
+                        early_stopping_rounds=early_stopping_rounds,
+                        sample_weight=sample_weight)
         self.ensemble_ = res.ensemble
         self.mapper_ = res.mapper
         self.n_features_in_ = X.shape[1]
@@ -143,8 +144,8 @@ class DDTClassifier(_DDTBase):
             return {"loss": "softmax", "n_classes": n}
         return {}
 
-    def fit(self, X, y, eval_set=None, eval_metric=None,
-            early_stopping_rounds=None):
+    def fit(self, X, y, sample_weight=None, *, eval_set=None,
+            eval_metric=None, early_stopping_rounds=None):
         y = np.asarray(y)
         classes = np.unique(y)
         if len(classes) < 2:
@@ -168,7 +169,8 @@ class DDTClassifier(_DDTBase):
                 )
             eval_set = (eval_set[0], np.searchsorted(classes, yv))
         super().fit(X, y_enc, eval_set=eval_set, eval_metric=eval_metric,
-                    early_stopping_rounds=early_stopping_rounds)
+                    early_stopping_rounds=early_stopping_rounds,
+                    sample_weight=sample_weight)
         self.classes_ = classes
         return self
 
