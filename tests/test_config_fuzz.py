@@ -88,10 +88,16 @@ def _random_case(rng):
 def test_random_config_backend_and_partition_identity(case_seed):
     rng = np.random.default_rng((97, case_seed))
     Xb, y, cfg = _random_case(rng)
+    # ~1/3 of cases train weighted (round 3: weights ride the valid mask
+    # through every path, so the whole identity matrix must hold with
+    # them too).
+    w = (rng.integers(1, 4, len(y)).astype(np.float64)
+         if rng.random() < 0.35 else None)
     ens = {}
     for backend in ("cpu", "tpu"):
         c = cfg.replace(backend=backend)
-        ens[backend] = Driver(get_backend(c), c, log_every=10**9).fit(Xb, y)
+        ens[backend] = Driver(get_backend(c), c, log_every=10**9).fit(
+            Xb, y, sample_weight=w)
     np.testing.assert_array_equal(ens["cpu"].feature, ens["tpu"].feature)
     np.testing.assert_array_equal(ens["cpu"].threshold_bin,
                                   ens["tpu"].threshold_bin)
@@ -104,7 +110,8 @@ def test_random_config_backend_and_partition_identity(case_seed):
     # a partitioned run on the mesh equals the single-device run
     parts = int(rng.choice([2, 4, 8]))
     cp = cfg.replace(backend="tpu", n_partitions=parts)
-    ep = Driver(get_backend(cp), cp, log_every=10**9).fit(Xb, y)
+    ep = Driver(get_backend(cp), cp, log_every=10**9).fit(
+        Xb, y, sample_weight=w)
     np.testing.assert_array_equal(ens["tpu"].feature, ep.feature)
     np.testing.assert_array_equal(ens["tpu"].threshold_bin,
                                   ep.threshold_bin)
