@@ -23,18 +23,35 @@ from __future__ import annotations
 
 import glob
 import os
+import re
 
 import numpy as np
 
 CHUNK_PREFIX = "chunk_"
+_CHUNK_RE = re.compile(re.escape(CHUNK_PREFIX) + r"(\d+)\.npz$")
 
 
 def _chunk_path(out_dir: str, c: int) -> str:
     return os.path.join(out_dir, f"{CHUNK_PREFIX}{c:05d}.npz")
 
 
+def _purge_stale(out_dir: str, n_chunks: int) -> None:
+    """Drop shards with index >= n_chunks that a prior (larger) run left
+    behind — otherwise directory_chunks would report the stale count and
+    serve the old run's data. Called AFTER writing so re-sharding in
+    place never deletes data before reading it; non-canonical filenames
+    that merely match the glob (chunk_backup.npz) are left alone."""
+    for path in glob.glob(os.path.join(out_dir, CHUNK_PREFIX + "*.npz")):
+        m = _CHUNK_RE.search(os.path.basename(path))
+        if m and int(m.group(1)) >= n_chunks:
+            os.remove(path)
+
+
 def chunk_files(src_dir: str) -> list[str]:
-    files = sorted(glob.glob(os.path.join(src_dir, CHUNK_PREFIX + "*.npz")))
+    files = sorted(
+        f for f in glob.glob(os.path.join(src_dir, CHUNK_PREFIX + "*.npz"))
+        if _CHUNK_RE.search(os.path.basename(f))
+    )
     if not files:
         raise ValueError(
             f"no {CHUNK_PREFIX}*.npz shards in {src_dir!r} — write them "
@@ -73,6 +90,7 @@ def shard_arrays(
         np.savez(p, X=X[bounds[c]:bounds[c + 1]],
                  y=y[bounds[c]:bounds[c + 1]])
         paths.append(p)
+    _purge_stale(out_dir, n_chunks)
     return paths
 
 
@@ -137,4 +155,5 @@ def write_binned_cache(
         X, y = raw_chunk_fn(c)
         np.savez(_chunk_path(cache_dir, c),
                  X=mapper.transform(np.asarray(X, np.float32)), y=y)
+    _purge_stale(cache_dir, n_chunks)
     return directory_chunks(cache_dir)

@@ -312,11 +312,20 @@ def csv_parse_native(
     # First pass allocation needs n_cols; probe the first data row in
     # Python (cheap) so the buffer can be allocated exactly once.
     n_cols = 0
-    for ln in data.split(b"\n")[skip_rows:]:
-        payload = ln.split(b"#", 1)[0].strip()
+    pos = 0
+    skipped = 0
+    while skipped < skip_rows and pos < len(data):
+        nl = data.find(b"\n", pos)
+        pos = len(data) if nl < 0 else nl + 1
+        skipped += 1
+    while pos < len(data):
+        nl = data.find(b"\n", pos)
+        end = len(data) if nl < 0 else nl
+        payload = data[pos:end].split(b"#", 1)[0].strip()
         if payload:
             n_cols = payload.count(b",") + 1
             break
+        pos = end + 1
     if n_cols == 0:
         return np.empty((0, 0), np.float64)
     out = np.empty((cap, n_cols), np.float64)

@@ -159,12 +159,16 @@ long ddt_csv_parse(const char* buf, long len, long skip_rows,
     local_err[0] = '\0';
 #pragma omp parallel for schedule(static) shared(first_bad)
     for (long r = 0; r < rows; ++r) {
-        if (r > first_bad) continue;
+        long bad_snapshot;
+#pragma omp atomic read
+        bad_snapshot = first_bad;
+        if (r > bad_snapshot) continue;
         char e[256];
         if (parse_line(lines[static_cast<size_t>(r)], n_cols,
                        out + r * n_cols, e, sizeof(e)) < 0) {
 #pragma omp critical
             if (r < first_bad) {
+#pragma omp atomic write
                 first_bad = r;
                 memcpy(local_err, e, sizeof(e));
             }

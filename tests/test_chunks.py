@@ -49,6 +49,51 @@ def test_shard_arrays_validates(tmp_path):
         chunks_mod.directory_chunks(str(tmp_path / "empty"))
 
 
+def test_binned_cache_clears_stale_shards(tmp_path):
+    """Re-using a cache dir for a run with fewer chunks must not leave
+    the prior run's extra shards behind (the returned source would
+    report the stale count and serve the old run's data)."""
+    from ddt_tpu.data.quantizer import fit_bin_mapper
+
+    X, y = datasets.synthetic_binary(400, n_features=6, seed=7)
+    mapper = fit_bin_mapper(X, n_bins=15)
+    cache = str(tmp_path / "cache")
+
+    def raw4(c):
+        return X[c * 100:(c + 1) * 100], y[c * 100:(c + 1) * 100]
+
+    src = chunks_mod.write_binned_cache(raw4, 4, mapper, cache)
+    assert src.n_chunks == 4
+
+    def raw2(c):
+        return X[c * 200:(c + 1) * 200], y[c * 200:(c + 1) * 200]
+
+    src = chunks_mod.write_binned_cache(raw2, 2, mapper, cache)
+    assert src.n_chunks == 2
+    assert sum(len(src.labels(c)) for c in range(2)) == 400
+
+    # In-place re-bin: the raw source reads from cache_dir itself; the
+    # purge must not delete shards before they are read.
+    raw_dir = str(tmp_path / "raw")
+    chunks_mod.shard_arrays(X, y, raw_dir, n_chunks=3)
+    raw_src = chunks_mod.directory_chunks(raw_dir)
+    src = chunks_mod.write_binned_cache(raw_src, 3, mapper, raw_dir)
+    assert src.n_chunks == 3
+    assert src.binned
+    assert sum(len(src.labels(c)) for c in range(3)) == 400
+
+    # shard_arrays over a reused out_dir purges stale indices too, but
+    # leaves non-canonical names that merely match the glob alone.
+    foreign = tmp_path / "raw" / "chunk_backup.npz"
+    np.savez(foreign, junk=np.zeros(1))
+    chunks_mod.shard_arrays(X, y, raw_dir, n_chunks=2)
+    assert foreign.exists()
+    assert not (tmp_path / "raw" / "chunk_00002.npz").exists()
+    # ...and the reader shares the purge's definition of a chunk: the
+    # foreign file is not served as a shard.
+    assert chunks_mod.directory_chunks(raw_dir).n_chunks == 2
+
+
 def test_shard_file_chunk_rows(tmp_path):
     X, y = datasets.synthetic_binary(900, n_features=5, seed=4)
     src_npz = str(tmp_path / "data.npz")
