@@ -20,9 +20,18 @@ Format notes (LightGBM's text serialization, stable since v2):
   applied); the ensemble's base score is folded into tree 0's leaves
   (LightGBM's boost_from_average does the same)
 
-Exportable models: ordinal splits with raw thresholds (train through a
-BinMapper). Categorical one-vs-rest splits would need LightGBM's
-cat_boundaries/cat_threshold bitsets — unsupported here, exporters raise.
+Exportable models: ordinal splits need raw thresholds (train through a
+BinMapper). Categorical one-vs-rest splits export as LightGBM categorical
+nodes (decision_type bit 0): the node's `threshold` is an index into
+`cat_boundaries`, which offsets into the `cat_threshold` uint32 bitset
+array; a value v routes LEFT when bit v is set. One-vs-rest means every
+exported bitset has exactly ONE bit set (the matched category). The
+re-parser accepts only such single-bit bitsets — a real LightGBM model
+with multi-category bitsets has no TreeEnsemble representation (split
+type here derives from the feature, with one matched category per node)
+and raises. NaN handling on cat nodes mirrors ordinal nodes (missing
+type NaN + per-node default direction) — that matches this repo's
+traversal, not LightGBM's own NaN-in-categorical convention.
 """
 
 from __future__ import annotations
@@ -33,6 +42,7 @@ from ddt_tpu.models.tree import TreeEnsemble
 
 _MISSING_NAN = 2 << 2        # decision_type missing-type field: NaN
 _DEFAULT_LEFT = 2            # decision_type default-left bit
+_CATEGORICAL = 1             # decision_type categorical-split bit
 
 
 def _objective(ens: TreeEnsemble) -> str:
@@ -60,12 +70,8 @@ def to_lightgbm_text(ens: TreeEnsemble,
             "BinMapper (api.train) or fill them with "
             "reference.numpy_trainer._fill_raw_thresholds first"
         )
-    if ens.has_cat_splits:
-        raise ValueError(
-            "LightGBM export of categorical one-vs-rest splits "
-            "(cat_boundaries bitsets) is not supported; export the "
-            "ordinal-split model or drop cat_features"
-        )
+    cat_set = (set(int(f) for f in ens.cat_features)
+               if ens.has_cat_splits else set())
     if feature_names is None:
         feature_names = [f"Column_{i}" for i in range(ens.n_features)]
     C = ens.n_classes if ens.loss == "softmax" else 1
@@ -92,6 +98,8 @@ def to_lightgbm_text(ens: TreeEnsemble,
         left_child: list[int] = []
         right_child: list[int] = []
         leaf_value: list[float] = []
+        cat_boundaries: list[int] = [0]    # prefix offsets into cat words
+        cat_threshold: list[int] = []      # uint32 bitset words
 
         def walk(slot: int) -> int:
             """Returns the LightGBM child reference for heap `slot`:
@@ -103,10 +111,22 @@ def to_lightgbm_text(ens: TreeEnsemble,
                 leaf_value.append(v)
                 return -len(leaf_value)        # ~(leaf_idx) == -(idx+1)
             i = len(split_feature)
-            split_feature.append(int(ens.feature[t, slot]))
+            feat = int(ens.feature[t, slot])
+            split_feature.append(feat)
             split_gain.append(float(ens.split_gain[t, slot]))
-            threshold.append(float(ens.threshold_raw[t, slot]))
             dt = 0
+            if feat in cat_set:
+                # One-vs-rest: a single-bit bitset (matched category goes
+                # LEFT); threshold holds the index into cat_boundaries.
+                k = int(ens.threshold_bin[t, slot])
+                words = [0] * (k // 32 + 1)
+                words[k // 32] = 1 << (k % 32)
+                threshold.append(float(len(cat_boundaries) - 1))
+                cat_threshold.extend(words)
+                cat_boundaries.append(len(cat_threshold))
+                dt |= _CATEGORICAL
+            else:
+                threshold.append(float(ens.threshold_raw[t, slot]))
             if use_missing:
                 dt |= _MISSING_NAN
                 if ens.default_left[t, slot]:
@@ -120,12 +140,13 @@ def to_lightgbm_text(ens: TreeEnsemble,
 
         walk(0)
         n_leaves = len(leaf_value)
+        n_cat = len(cat_boundaries) - 1
         zeros = [0.0] * n_leaves
         izeros = [0] * max(1, n_leaves - 1)
         lines += [
             f"Tree={t}",
             f"num_leaves={n_leaves}",
-            "num_cat=0",
+            f"num_cat={n_cat}",
             "split_feature=" + _fmt_int(split_feature),
             "split_gain=" + _fmt(split_gain),
             "threshold=" + _fmt(threshold),
@@ -138,6 +159,13 @@ def to_lightgbm_text(ens: TreeEnsemble,
             "internal_value=" + _fmt([0.0] * max(1, n_leaves - 1)),
             "internal_weight=" + _fmt([0.0] * max(1, n_leaves - 1)),
             "internal_count=" + _fmt_int(izeros),
+        ]
+        if n_cat:
+            lines += [
+                "cat_boundaries=" + _fmt_int(cat_boundaries),
+                "cat_threshold=" + _fmt_int(cat_threshold),
+            ]
+        lines += [
             "is_linear=0",
             f"shrinkage={ens.learning_rate:.17g}",
             "",
@@ -196,16 +224,21 @@ def from_lightgbm_text(text: str) -> TreeEnsemble:
     n_nodes = 2 ** (max_depth + 1) - 1
     T = len(trees)
     feature = np.full((T, n_nodes), -1, np.int32)
+    threshold_bin = np.zeros((T, n_nodes), np.int32)
     threshold_raw = np.zeros((T, n_nodes), np.float32)
     is_leaf = np.zeros((T, n_nodes), bool)
     leaf_value = np.zeros((T, n_nodes), np.float32)
     split_gain = np.zeros((T, n_nodes), np.float32)
     default_left = np.zeros((T, n_nodes), bool)
     any_missing = False
+    cat_feats: set[int] = set()    # features with categorical nodes
+    ord_feats: set[int] = set()    # features with numerical nodes
 
     for t, blk in enumerate(trees):
+        cb = ct = None
         if int(blk.get("num_cat", "0")) != 0:
-            raise ValueError("categorical LightGBM trees are not supported")
+            cb = [int(v) for v in blk["cat_boundaries"].split()]
+            ct = [int(v) for v in blk["cat_threshold"].split()]
         lv = [float(v) for v in blk["leaf_value"].split()]
         if int(blk["num_leaves"]) == 1:
             is_leaf[t, 0] = True
@@ -225,8 +258,27 @@ def from_lightgbm_text(text: str) -> TreeEnsemble:
                 leaf_value[t, slot] = lv[~ref]
                 return
             feature[t, slot] = sf[ref]
-            threshold_raw[t, slot] = th[ref]
             split_gain[t, slot] = sg[ref]
+            if dt[ref] & _CATEGORICAL:
+                # Bitset -> the single matched category (one-vs-rest).
+                cat_idx = int(th[ref])
+                words = ct[cb[cat_idx]:cb[cat_idx + 1]]
+                bits = [w * 32 + b for w, word in enumerate(words)
+                        for b in range(32) if word >> b & 1]
+                if len(bits) != 1:
+                    raise ValueError(
+                        f"categorical node with {len(bits)} set bits: only "
+                        "one-vs-rest (single-category) bitsets have a "
+                        "TreeEnsemble representation"
+                    )
+                cat_feats.add(sf[ref])
+                # Cat columns hold category ids in BOTH representations,
+                # so bin and raw thresholds coincide.
+                threshold_bin[t, slot] = bits[0]
+                threshold_raw[t, slot] = float(bits[0])
+            else:
+                ord_feats.add(sf[ref])
+                threshold_raw[t, slot] = th[ref]
             if (dt[ref] >> 2) == 2:            # NaN missing type
                 any_missing = True
                 default_left[t, slot] = bool(dt[ref] & _DEFAULT_LEFT)
@@ -235,9 +287,17 @@ def from_lightgbm_text(text: str) -> TreeEnsemble:
 
         place(0, 0)
 
+    both = cat_feats & ord_feats
+    if both:
+        raise ValueError(
+            f"features {sorted(both)} appear in both categorical and "
+            "numerical nodes; TreeEnsemble derives split type from the "
+            "feature, so mixed use is unrepresentable"
+        )
+
     return TreeEnsemble(
         feature=feature,
-        threshold_bin=np.zeros((T, n_nodes), np.int32),
+        threshold_bin=threshold_bin,
         threshold_raw=threshold_raw,
         is_leaf=is_leaf,
         leaf_value=leaf_value,
@@ -249,6 +309,8 @@ def from_lightgbm_text(text: str) -> TreeEnsemble:
         loss=loss,
         n_classes=max(C, 2),
         has_raw_thresholds=True,
+        cat_features=(np.asarray(sorted(cat_feats), np.int32)
+                      if cat_feats else None),
         default_left=default_left if any_missing else None,
         # Raw-value traversal tests np.isnan directly; missing_bin=True
         # just switches the learned default_left directions on.

@@ -65,11 +65,81 @@ def test_export_validates():
     with pytest.raises(ValueError, match="raw-value thresholds"):
         bare.to_lightgbm_text()
 
-    res, _ = _train()
+
+def _train_categorical(seed=0, **kw):
+    """A model with real one-vs-rest cat splits (criteo-shaped data)."""
+    from ddt_tpu.data.categorical import fit_categorical_encoder
+    from ddt_tpu.data.datasets import synthetic_ctr
+
+    Xn, Xc, y = synthetic_ctr(4000, seed=seed)
+    enc = fit_categorical_encoder(Xc, n_bins=63)
+    X = np.concatenate([Xn, enc.transform(Xc).astype(np.float32)], axis=1)
+    cat = tuple(range(Xn.shape[1], X.shape[1]))
+    res = api.train(X, y, n_trees=5, max_depth=4, n_bins=63,
+                    backend="cpu", cat_features=cat, log_every=10**9, **kw)
+    return res, X, cat
+
+
+def test_roundtrip_categorical():
+    """Cat one-vs-rest splits export as LightGBM categorical nodes
+    (single-bit cat_threshold bitsets) and parse back to identical
+    predictions — the Criteo-config model family is no longer excluded
+    from the tree-diff validation path (round-3 verdict item 6)."""
+    res, X, cat = _train_categorical()
     ens = res.ensemble
-    ens.cat_features = np.array([1], np.int32)
-    with pytest.raises(ValueError, match="categorical"):
-        ens.to_lightgbm_text()
+    assert ens.has_cat_splits
+    txt = ens.to_lightgbm_text()
+    blocks = [b for b in txt.split("Tree=") if "num_cat" in b]
+    n_cat_total = sum(
+        int(b.split("num_cat=")[1].splitlines()[0]) for b in blocks)
+    assert n_cat_total > 0, "model grew no cat splits; test data too easy"
+    assert "cat_boundaries=" in txt and "cat_threshold=" in txt
+
+    back = TreeEnsemble.from_lightgbm_text(txt)
+    assert back.cat_features is not None
+    assert set(back.cat_features) <= set(cat)
+    want = ens.predict_raw(X, binned=False)
+    got = back.predict_raw(X, binned=False)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_categorical_bitset_validation():
+    """Multi-bit bitsets (real LightGBM cat splits) and mixed cat/ordinal
+    feature use are unrepresentable and must fail loudly, not silently
+    misroute."""
+    res, X, cat = _train_categorical()
+    txt = res.ensemble.to_lightgbm_text()
+
+    # Doctor one bitset to carry two categories.
+    lines = txt.splitlines()
+    for i, ln in enumerate(lines):
+        if ln.startswith("cat_threshold="):
+            words = ln.split("=")[1].split()
+            words[0] = str(int(words[0]) | (1 << 31) | 1)
+            lines[i] = "cat_threshold=" + " ".join(words)
+            break
+    with pytest.raises(ValueError, match="set bits"):
+        TreeEnsemble.from_lightgbm_text("\n".join(lines))
+
+    # Doctor a cat node's feature to collide with an ordinal feature.
+    lines = txt.splitlines()
+    for i, ln in enumerate(lines):
+        if ln.startswith("decision_type="):
+            dts = [int(v) for v in ln.split("=")[1].split()]
+            if not any(d & 1 for d in dts):
+                continue
+            cat_pos = next(j for j, d in enumerate(dts) if d & 1)
+            ord_pos = next((j for j, d in enumerate(dts) if not d & 1), None)
+            if ord_pos is None:
+                continue
+            sf_line = i - 3          # split_feature precedes decision_type
+            assert lines[sf_line].startswith("split_feature=")
+            sfs = lines[sf_line].split("=")[1].split()
+            sfs[cat_pos] = sfs[ord_pos]
+            lines[sf_line] = "split_feature=" + " ".join(sfs)
+            break
+    with pytest.raises(ValueError, match="both categorical and numerical"):
+        TreeEnsemble.from_lightgbm_text("\n".join(lines))
 
 
 def test_header_fields_and_leaf_encoding():
