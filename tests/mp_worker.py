@@ -1,7 +1,7 @@
 """Worker process for the 2-process jax.distributed bring-up test.
 
 Run as:  python tests/mp_worker.py <coordinator> <num_processes> \
-             <process_id> <devices_per_process> <out.npz>
+             <process_id> <devices_per_process> <out.npz> <stream_dir>
 
 num_processes == 1 skips initialize_multihost (the single-process
 comparator: same mesh shape, same program, one controller). Each process
@@ -21,9 +21,9 @@ import sys
 
 
 def main() -> int:
-    coord, nproc, pid, dev_per_proc, out = (
+    coord, nproc, pid, dev_per_proc, out, stream_dir = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
-        sys.argv[5],
+        sys.argv[5], sys.argv[6],
     )
     # sitecustomize may have imported jax already with another platform
     # bound; the config.update below overrides it. XLA_FLAGS is read when
@@ -89,12 +89,28 @@ def main() -> int:
     ens2 = Driver(be, cfg, log_every=10**9).fit(
         Xb[k:], y[k:], eval_set=(Xb[:k], y[:k]), eval_metric="auc")
 
+    # Streamed training over on-disk shards on the SAME multi-process
+    # mesh (round-3 verdict item 4): fit_streaming's device path does
+    # per-chunk jax.device_put placement every (chunk, level) step —
+    # exactly where process-local addressability bugs live. Every process
+    # writes identical shards to its own dir (multi-controller SPMD: same
+    # host inputs everywhere), then streams them.
+    from ddt_tpu.data import chunks as chunks_mod
+    from ddt_tpu.streaming import fit_streaming
+
+    chunks_mod.shard_arrays(Xb, y, stream_dir, n_chunks=4)
+    src = chunks_mod.directory_chunks(stream_dir)
+    assert src.binned
+    ens3 = fit_streaming(src, src.n_chunks, cfg, backend=be)
+
     np.savez(
         out,
         feature=ens.feature, threshold_bin=ens.threshold_bin,
         is_leaf=ens.is_leaf, leaf_value=ens.leaf_value,
         g_feature=ens2.feature, g_threshold_bin=ens2.threshold_bin,
         g_is_leaf=ens2.is_leaf, g_leaf_value=ens2.leaf_value,
+        s_feature=ens3.feature, s_threshold_bin=ens3.threshold_bin,
+        s_is_leaf=ens3.is_leaf, s_leaf_value=ens3.leaf_value,
         process_index=np.int64(jax.process_index()),
     )
     return 0

@@ -9,7 +9,8 @@ across processes AND to a single-process run of the identical mesh shape.
 This is the process-level failure surface a virtual mesh cannot reach:
 per-process device visibility, cross-process psum, non-addressable-shard
 placement (TPUDevice._put), replicated-output fetch (fetch_tree /
-eval_round's all_gather path).
+eval_round's all_gather path), and fit_streaming's per-(chunk, level)
+device placement over on-disk shards (round-3 verdict item 4).
 
 Contract: SURVEY.md §5 "Distributed communication backend"
 ("jax.distributed.initialize for the v5e-64 pod config"), BASELINE
@@ -42,7 +43,8 @@ def _spawn(coord, nproc, pid, dev_per_proc, out, tmp_path):
     env["DDT_COMPILATION_CACHE"] = str(tmp_path / f"cache{pid}")
     return subprocess.Popen(
         [sys.executable, _WORKER, coord, str(nproc), str(pid),
-         str(dev_per_proc), out],
+         str(dev_per_proc), out,
+         str(tmp_path / f"shards_{nproc}_{pid}")],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True,
     )
@@ -79,7 +81,7 @@ def test_two_process_bringup_bit_identical(tmp_path):
     ds = np.load(single)
     assert int(d0["process_index"]) == 0
     assert int(d1["process_index"]) == 1
-    for prefix in ("", "g_"):
+    for prefix in ("", "g_", "s_"):
         for k in ("feature", "threshold_bin", "is_leaf", "leaf_value"):
             key = prefix + k
             # The two processes fetch replicas of one global computation:
