@@ -1,0 +1,84 @@
+"""Worker for the config-5 O(chunk) memory witness (round-3 verdict
+item 3). NOT a pytest module (no test_ prefix): RSS high-water marks are
+process-wide, so the measurement needs a process that has never touched
+the dataset — the parent test spawns this and asserts on the JSON it
+prints.
+
+Run: python tests/stream_rss_worker.py <rows> <features> <n_chunks> \
+         <bins> <work_dir>
+
+Phases, each RSS-stamped (ru_maxrss):
+  1. import + jax init            (baseline)
+  2. shard writing, chunk by chunk (never materialises the dataset)
+  3. streamed training over the shards through the CLI --stream-dir path
+The printed deltas let the parent assert the whole pipeline stayed
+O(chunk): peak_after_train - baseline must be far below the binned
+dataset size (let alone the float32 in-memory size)."""
+
+import json
+import os
+import resource
+import sys
+
+
+def _rss_mb() -> float:
+    # linux ru_maxrss is KiB.
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main() -> int:
+    rows, features, n_chunks, bins, work_dir = (
+        int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+        int(sys.argv[4]), sys.argv[5],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+
+    from ddt_tpu.data import chunks as chunks_mod
+    from ddt_tpu.data import datasets
+
+    jax.devices()                       # force platform init into baseline
+    rss_baseline = _rss_mb()
+
+    # Cut shards one chunk at a time — the writer itself must be O(chunk).
+    chunk_rows = rows // n_chunks
+    shard_dir = os.path.join(work_dir, "shards")
+    os.makedirs(shard_dir, exist_ok=True)
+    for c in range(n_chunks):
+        Xc, yc = datasets.stress_binned_chunk(
+            c, chunk_rows, n_features=features, seed=5, n_bins=bins)
+        np.savez(os.path.join(shard_dir, f"chunk_{c:05d}.npz"),
+                 X=Xc, y=yc)
+        del Xc, yc
+    rss_sharded = _rss_mb()
+
+    from ddt_tpu.cli import main as cli_main
+
+    rc = cli_main([
+        "train", "--backend=tpu", f"--stream-dir={shard_dir}",
+        f"--bins={bins}", "--trees=1", "--depth=2",
+        f"--out={os.path.join(work_dir, 'm.npz')}",
+    ])
+    rss_trained = _rss_mb()
+
+    src = chunks_mod.directory_chunks(shard_dir)
+    print(json.dumps({
+        "rc": rc,
+        "rows": rows,
+        "chunk_mb": chunk_rows * features / 1e6,
+        "dataset_binned_mb": rows * features / 1e6,
+        "dataset_float_mb": rows * features * 4 / 1e6,
+        "n_chunks": src.n_chunks,
+        "rss_baseline_mb": round(rss_baseline, 1),
+        "rss_sharded_mb": round(rss_sharded, 1),
+        "rss_trained_mb": round(rss_trained, 1),
+    }))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
