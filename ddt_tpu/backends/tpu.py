@@ -931,6 +931,12 @@ class TPUDevice(DeviceBackend):
         R = Xb.shape[0]
         chunk = self.PREDICT_ROW_CHUNK * max(1, self.row_shards)
         fn, ens_dev = self._predict_fn(ens)     # upload the ensemble ONCE
+        if isinstance(Xb, jax.Array) and (R <= chunk or self.distributed):
+            # Device-resident input is only special-cased on the
+            # single-chip big-batch loop below (where it skips the bulk
+            # upload, isolating device compute for benchmarking); the
+            # other paths pad/shard on host.
+            Xb = np.asarray(Xb)
         if R > chunk:
             if self.distributed:
                 # Per-chunk host→device upload (each chunk must be laid out
@@ -946,7 +952,8 @@ class TPUDevice(DeviceBackend):
                 # host→device traffic than int32, which dominates wallclock
                 # on a remote-attached chip), slice chunks on device, fetch
                 # all outputs in one device→host transfer at the end.
-                Xd = jax.device_put(np.ascontiguousarray(Xb))
+                Xd = (Xb if isinstance(Xb, jax.Array)
+                      else jax.device_put(np.ascontiguousarray(Xb)))
                 outs = [
                     fn(*ens_dev, Xd[i:i + chunk]) for i in range(0, R, chunk)
                 ]
