@@ -1,0 +1,75 @@
+"""Paired-ratio A/B for the 255-bin kernel forms (sweep 11 epilogue).
+
+Three interleaved min-of-reps runs of sweep 11 gave CONTRADICTORY
+winners (row-major 42.6/42.6/52.4 vs transposed-Bp256 49.6/50.0/39.8
+Mrows/s): each arm sticks to a ~40 or ~50 Mrows/s band for a whole
+~30 s timing window, so even interleaved minimums compare across bands,
+not kernels. This harness measures the PER-REP PAIRED RATIO instead —
+arm order alternates every rep (A,B / B,A), reps spread over ~4-6
+minutes sample many band states, and the median of per-rep ratios is
+robust to any band structure that affects both arms of a pair.
+
+Run: python -u experiments/hist_ab_paired.py
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from experiments.hist_sweep11 import build  # noqa: E402
+from ddt_tpu.utils.device import device_sync  # noqa: E402
+
+R, F, N = 1_024_000, 28, 32
+REPS, ITERS = 40, 8
+
+
+def main() -> None:
+    print(f"platform={jax.default_backend()}  {R}x{F}, N={N}, 255 bins",
+          flush=True)
+    rng = np.random.default_rng(0)
+    Xb = rng.integers(0, 255, (R, F), dtype=np.uint8)
+    Xi = jax.device_put(Xb.astype(np.int32))
+    Xt = jax.device_put(np.ascontiguousarray(Xb.T).astype(np.int32))
+    g = jax.device_put(rng.standard_normal(R).astype(np.float32))
+    h = jax.device_put(rng.random(R).astype(np.float32))
+    ni = jax.device_put(rng.integers(0, N, R).astype(np.int32))
+
+    arm_a = ("control", 512)
+    arm_b = ("prologue_t", 2048)
+    for form, tile in (arm_a, arm_b):
+        device_sync(build(Xi, Xt, g, h, ni, form, tile))   # compile
+
+    def bout(form, tile):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = build(Xi, Xt, g, h, ni, form, tile)
+        device_sync(out)
+        return (time.perf_counter() - t0) / ITERS
+
+    ratios = []
+    for rep in range(REPS):
+        order = (arm_a, arm_b) if rep % 2 == 0 else (arm_b, arm_a)
+        ts = {}
+        for form, tile in order:
+            ts[form] = bout(form, tile)
+        ratios.append(ts["control"] / ts["prologue_t"])
+        print(f"rep {rep:02d}  control {R / ts['control'] / 1e6:6.1f}  "
+              f"T-form {R / ts['prologue_t'] / 1e6:6.1f}  "
+              f"ratio(ctl/T) {ratios[-1]:.3f}", flush=True)
+        time.sleep(4)          # let the band state evolve between pairs
+    med = float(np.median(ratios))
+    q1, q3 = np.percentile(ratios, [25, 75])
+    print(f"\nmedian ratio control/T-form = {med:.3f}  "
+          f"IQR [{q1:.3f}, {q3:.3f}]  "
+          f"({'T-form faster' if med > 1.02 else 'control faster' if med < 0.98 else 'parity'})",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

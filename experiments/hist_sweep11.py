@@ -1,0 +1,269 @@
+"""Sweep round 11: MXU-broadcast one-hot — attack the 255-bin relayout
+bound (round-3 verdict item 7, docs/PERF.md round-3 addendum).
+
+The documented bound: the row-major kernel's cost is the per-(feature,
+tile) [T, 1] -> [T, Bp] LANE broadcast — a Mosaic relayout executed
+F=28x per tile, flat in bin count/dtype. Every round-1-3 variant that
+still needed per-feature broadcasts (in-kernel A-build, hi/lo split,
+int8) died on the same class.
+
+This sweep's idea: do the broadcast ON THE MXU instead of the VPU.
+  XB[T, F*Bp] = x[T, F] @ E[F, F*Bp],  E[f, l] = 1 iff l // Bp == f
+replicates x[t, f] across the f-th Bp-lane block as a single bf16
+matmul (exact: bin ids <= 255 are integers <= 2^8, bf16 represents
+integers to 2^8; products are x*1; each output sums ONE product). Then
+the one-hot is ONE relayout-free elementwise compare against the lane
+iota's low bits:
+  OH = (XB == iota_lane & (Bp - 1))
+MXU cost added: [T, F] @ [F, F*Bp] = F x F*Bp x T MACs ~ 44% of the main
+dot's 2N x T x F*Bp — affordable because the kernel was measured NOT
+MXU-bound (sweep 9: int8 pure-counts bound only +7%).
+
+Arms (all 255-bin contract shape, interleaved per rep, min-of-reps):
+  control     shipped row-major kernel (per-feature lane broadcast)
+  mxu-bcast   row-major, one-hot via x @ E + single compare
+  mxu-bcast-T transposed: (E_t @ Xt) with sublane iota, dot contracts T
+  resident-T  sweep-10 transposed form at Bp=256 fed an ALREADY
+              feature-major Xt (no prologue transpose) — is the
+              documented break-even the prologue's fault?
+
+Correctness: every arm's output is checked against the control before
+timing (exact f32 equality is not expected across forms — allclose).
+
+Run on the real TPU:  python -u experiments/hist_sweep11.py
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ddt_tpu.utils.device import device_sync  # noqa: E402
+
+R, F, N, BINS, BP = 1_024_000, 28, 32, 255, 256
+
+
+def _prologue(g, h, ni, oh_dtype):
+    active = ni >= 0
+    idx = jnp.where(active, ni, 0).astype(jnp.int32)
+    gz = jnp.where(active, g, 0.0)
+    hz = jnp.where(active, h, 0.0)
+    noh = jax.nn.one_hot(idx, N, dtype=jnp.float32)
+    return jnp.concatenate(
+        [noh * gz[:, None], noh * hz[:, None]], axis=1
+    ).astype(oh_dtype)                                   # [R, 2N]
+
+
+# ---------------------------------------------------------------- control
+def _kernel_rm(xb_ref, a_ref, out_ref, *, oh_dtype):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = xb_ref[:]                                        # [T, F] int32
+    tile_r = x.shape[0]
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (tile_r, BP), 1)
+    slabs = [
+        (x[:, f][:, None] == bin_iota).astype(oh_dtype) for f in range(F)
+    ]
+    oh = jnp.concatenate(slabs, axis=1)                  # [T, F*Bp]
+    out_ref[:] += jax.lax.dot_general(
+        a_ref[:], oh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+# ------------------------------------------------------- mxu-bcast (row)
+def _kernel_mxu(xb_ref, a_ref, out_ref, *, oh_dtype):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = xb_ref[:].astype(oh_dtype)                       # [T, F] exact <=255
+    tile_r = x.shape[0]
+    # E[f, l] = (l // Bp == f): built from two iotas, [F, F*Bp] — small.
+    lane_f = jax.lax.broadcasted_iota(jnp.int32, (F, F * BP), 1) // BP
+    feat = jax.lax.broadcasted_iota(jnp.int32, (F, F * BP), 0)
+    e = (lane_f == feat).astype(oh_dtype)
+    xb = jax.lax.dot_general(                            # [T, F*Bp] f32
+        x, e, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    mod = (jax.lax.broadcasted_iota(jnp.int32, (tile_r, F * BP), 1)
+           & (BP - 1)).astype(jnp.float32)
+    oh = (xb == mod).astype(oh_dtype)
+    out_ref[:] += jax.lax.dot_general(
+        a_ref[:], oh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+# ------------------------------------------------ mxu-bcast (transposed)
+def _kernel_mxu_t(xt_ref, a_ref, out_ref, *, oh_dtype):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    xt = xt_ref[:].astype(oh_dtype)                      # [F, T]
+    tile_r = xt.shape[1]
+    # E_t[l, f] = (l // Bp == f): [F*Bp, F].
+    lane_f = jax.lax.broadcasted_iota(jnp.int32, (F * BP, F), 0) // BP
+    feat = jax.lax.broadcasted_iota(jnp.int32, (F * BP, F), 1)
+    e = (lane_f == feat).astype(oh_dtype)
+    xbt = jax.lax.dot_general(                           # [F*Bp, T] f32
+        e, xt, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    mod = (jax.lax.broadcasted_iota(jnp.int32, (F * BP, tile_r), 0)
+           & (BP - 1)).astype(jnp.float32)
+    oh = (xbt == mod).astype(oh_dtype)                   # [F*Bp, T]
+    out_ref[:] += jax.lax.dot_general(
+        oh, a_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+# ------------------------------------------------- resident transposed
+def _kernel_t(xt_ref, a_ref, out_ref, *, oh_dtype):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    xt = xt_ref[:]                                       # [F, T] int32
+    tile_r = xt.shape[1]
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (BP, tile_r), 0)
+    slabs = [
+        (xt[f, :][None, :] == bin_iota).astype(oh_dtype) for f in range(F)
+    ]
+    oh = jnp.concatenate(slabs, axis=0)                  # [F*Bp, T]
+    out_ref[:] += jax.lax.dot_general(
+        oh, a_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _call_rowmajor(kernel, Xi, A, tile_r, oh_dtype):
+    n_tiles = R // tile_r
+    return pl.pallas_call(
+        functools.partial(kernel, oh_dtype=oh_dtype),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile_r, F), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 2 * N), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((2 * N, F * BP), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((2 * N, F * BP), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(Xi, A)
+
+
+def _call_transposed(kernel, Xt, A, tile_r, oh_dtype):
+    n_tiles = R // tile_r
+    return pl.pallas_call(
+        functools.partial(kernel, oh_dtype=oh_dtype),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((F, tile_r), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, 2 * N), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((F * BP, 2 * N), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((F * BP, 2 * N), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(Xt, A)
+
+
+@functools.partial(jax.jit, static_argnames=("form", "tile_r"))
+def build(Xi, Xt, g, h, ni, form, tile_r):
+    A = _prologue(g, h, ni, jnp.bfloat16)
+    if form == "control":
+        out = _call_rowmajor(_kernel_rm, Xi, A, tile_r, jnp.bfloat16)
+    elif form == "prologue_t":
+        out = _call_transposed(_kernel_t, Xi.T, A, tile_r, jnp.bfloat16)
+    elif form == "mxu":
+        out = _call_rowmajor(_kernel_mxu, Xi, A, tile_r, jnp.bfloat16)
+    elif form == "mxu_t":
+        out = _call_transposed(_kernel_mxu_t, Xt, A, tile_r, jnp.bfloat16)
+    elif form == "resident_t":
+        out = _call_transposed(_kernel_t, Xt, A, tile_r, jnp.bfloat16)
+    else:
+        raise ValueError(form)
+    if form in ("mxu_t", "resident_t", "prologue_t"):
+        # [F*Bp, 2N] -> [2N, F*Bp] for comparison parity with control.
+        out = out.T
+    return out
+
+
+def main():
+    print(f"platform={jax.default_backend()}  {R}x{F}, N={N}, "
+          f"bins={BINS} (Bp={BP})", flush=True)
+    rng = np.random.default_rng(0)
+    Xb = rng.integers(0, BINS, (R, F), dtype=np.uint8)
+    Xi = jax.device_put(Xb.astype(np.int32))
+    Xt = jax.device_put(np.ascontiguousarray(Xb.T).astype(np.int32))
+    g = jax.device_put(rng.standard_normal(R).astype(np.float32))
+    h = jax.device_put(rng.random(R).astype(np.float32))
+    ni = jax.device_put(rng.integers(0, N, R).astype(np.int32))
+
+    arms = [
+        ("control  tile=512", "control", 512),
+        ("prologueT tile=1024", "prologue_t", 1024),
+        ("prologueT tile=2048", "prologue_t", 2048),
+    ]
+    # Correctness vs control, then warm-up.
+    want = None
+    live = []
+    for name, form, tile_r in arms:
+        try:
+            out = build(Xi, Xt, g, h, ni, form, tile_r)
+            device_sync(out)
+            got = np.asarray(out)
+            if want is None:
+                want = got
+            else:
+                np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+            live.append({"name": name, "form": form, "tile_r": tile_r,
+                         "dt": float("inf")})
+        except Exception as e:
+            print(f"{name:22s} FAILED: {type(e).__name__}: "
+                  f"{str(e)[:160]}", flush=True)
+    # Interleaved timing: every arm samples every rep's noise band.
+    iters, reps = 8, 10
+    for rep in range(reps):
+        for arm in live:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = build(Xi, Xt, g, h, ni, arm["form"], arm["tile_r"])
+            device_sync(out)
+            arm["dt"] = min(arm["dt"],
+                            (time.perf_counter() - t0) / iters)
+    print(f"\ninterleaved min-of-{reps} (x{iters} iters):")
+    for arm in live:
+        print(f"{arm['name']:22s} {R / arm['dt'] / 1e6:8.1f} Mrows/s   "
+              f"{arm['dt'] * 1e3:7.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
