@@ -339,6 +339,19 @@ def _stream_fit(args, X, y, cfg, cache_root):
             valid_chunk_fn = _cached_binned(raw_vfn, n_valid, mapper,
                                             "valid")
 
+    raw_cache = getattr(args, "stream_device_cache", "auto")
+    if raw_cache == "auto":
+        dev_cache: "bool | int" = True
+    elif raw_cache == "off":
+        dev_cache = False
+    else:
+        try:
+            dev_cache = int(raw_cache)
+        except ValueError:
+            raise SystemExit(
+                f"--stream-device-cache must be 'auto', 'off', or a byte "
+                f"count, got {raw_cache!r}")
+
     history: list = []
     ens = fit_streaming(chunk_fn, n_chunks, cfg,
                         checkpoint_dir=args.checkpoint_dir,
@@ -347,7 +360,8 @@ def _stream_fit(args, X, y, cfg, cache_root):
                         n_valid_chunks=n_valid,
                         eval_metric=args.metric,
                         early_stopping_rounds=args.early_stop,
-                        history=history)
+                        history=history,
+                        device_chunk_cache=dev_cache)
     return ens, history, mapper, rows, n_chunks, chunk_rows_max
 
 
@@ -448,6 +462,12 @@ def main(argv: list[str] | None = None) -> int:
                          "binned-chunk cache (default: a temp dir deleted "
                          "after training; pass '' to disable caching and "
                          "re-bin chunks on every read)")
+    tp.add_argument("--stream-device-cache", default="auto",
+                    help="device-resident chunk cache for the streamed "
+                         "paths: 'auto' (cache binned chunks in device "
+                         "memory up to a ~6 GiB budget — every pass after "
+                         "the first reads HBM instead of re-paying the "
+                         "host->device link), 'off', or a byte budget")
     tp.add_argument("--config", default=None,
                     help="YAML/JSON file of TrainConfig fields; values in "
                          "the file override the corresponding flags")

@@ -278,6 +278,43 @@ class _StreamEval:
         return rnd - self.best_round >= self.patience
 
 
+# Default HBM budget for the device-resident chunk cache: big enough to
+# hold mid-size out-of-core datasets entirely (a v5e core has 16 GB),
+# small enough to leave the working set (histograms, preds, pipeline
+# buffers) ample headroom.
+DEVICE_CHUNK_CACHE_BYTES = 6 << 30
+
+
+class _DeviceChunkCache:
+    """Memoises `backend.upload(chunk)` per chunk index up to a shared
+    byte budget. Streamed training re-reads every chunk (max_depth + 1)
+    times per tree; when the binned chunks fit in device memory, paying
+    the host→device transfer once and serving every later pass from HBM
+    removes the pipeline's transfer bound entirely (measured: the
+    remote-tunnel 20M x 64 run drops from transfer-bound to compute-
+    bound — docs/PERF.md round-4). Chunks past the budget simply upload
+    per use, preserving O(working-set) device memory for datasets that
+    do not fit. Safe because no stream op donates its data operand
+    (backends/tpu.py _stream_fn: only pred is donated)."""
+
+    def __init__(self, backend, chunk_fn, budget: list):
+        self._backend = backend
+        self._chunk_fn = chunk_fn
+        self._budget = budget          # [remaining_bytes], shared train/val
+        self._cached: dict = {}
+
+    def get(self, c: int):
+        h = self._cached.get(c)
+        if h is not None:
+            return h
+        Xc = np.asarray(self._chunk_fn(c)[0])
+        h = self._backend.upload(Xc)
+        if Xc.nbytes <= self._budget[0]:
+            self._budget[0] -= Xc.nbytes
+            self._cached[c] = h
+        return h
+
+
 def fit_streaming(
     chunk_fn: ChunkFn,
     n_chunks: int,
@@ -291,6 +328,7 @@ def fit_streaming(
     eval_metric: str | None = None,
     early_stopping_rounds: int | None = None,
     history: list | None = None,
+    device_chunk_cache: "bool | int" = True,
 ) -> TreeEnsemble:
     """Train a GBDT over `n_chunks` streamed chunks.
 
@@ -316,6 +354,19 @@ def fit_streaming(
     in-memory Driver on the same data, including missing_policy='learn'
     (reserved NaN bin + learned default directions) and categorical
     one-vs-rest splits (tests/test_streaming.py).
+
+    `device_chunk_cache` (device backends only): True caches uploaded
+    binned chunks in device memory up to DEVICE_CHUNK_CACHE_BYTES —
+    but only when the device has memory of its own (on a CPU-platform
+    run the "device" IS host RAM, so True degrades to no caching there:
+    pinning min(dataset, 6 GiB) of host memory would break the O(chunk)
+    host contract this trainer exists for). An int budget is always
+    honored verbatim (that is how the CPU-platform tests force the
+    cache on); False re-uploads every pass (the pre-round-4 behavior).
+    Caching changes no results — the same buffers feed the same ops —
+    only how often the H2D link is paid: once per chunk instead of
+    (max_depth + 1) times per tree. Host memory stays O(chunk); device
+    memory grows to min(dataset, budget).
     """
     if backend is None:
         from ddt_tpu.backends import get_backend
@@ -401,7 +452,8 @@ def fit_streaming(
         return _fit_streaming_device(
             chunk_fn, n_chunks, cfg, backend, ens, bs, C, y_dev,
             start_round=start_round, checkpoint_dir=checkpoint_dir,
-            checkpoint_every=checkpoint_every, ev=ev)
+            checkpoint_every=checkpoint_every, ev=ev,
+            device_chunk_cache=device_chunk_cache)
 
     # The ONE optional O(R·C) structure: per-chunk cached raw scores (4C
     # bytes/row). cache_preds=False recomputes scores from the partial
@@ -582,14 +634,31 @@ def _fit_streaming_device(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 25,
     ev: "_StreamEval | None" = None,
+    device_chunk_cache: "bool | int" = True,
 ) -> TreeEnsemble:
     """Device streaming loop: see fit_streaming. Per tree it makes
     max_depth histogram passes + 1 leaf pass (+ 1 pred-update pass between
-    rounds) over the chunks; each pass re-uploads only Xb (uint8 —
-    pred/labels stay device-resident), and the next chunk's host read +
-    H2D upload is enqueued BEFORE the current chunk's small output is
+    rounds) over the chunks; each pass re-reads only Xb (uint8 —
+    pred/labels stay device-resident) — from the device chunk cache when
+    it fits the budget, else re-uploaded with the next chunk's host read
+    + H2D upload enqueued BEFORE the current chunk's small output is
     fetched, so the transfer rides under the device compute (double
     buffering via JAX's async dispatch)."""
+    if device_chunk_cache is True:
+        # Platform guard (see fit_streaming's docstring): on the CPU
+        # platform the device buffers ARE host RAM — a default-on cache
+        # would pin the dataset in host memory. Real accelerators cache.
+        import jax
+
+        on_host = jax.default_backend() == "cpu"
+        cache_budget = [0 if on_host else DEVICE_CHUNK_CACHE_BYTES]
+    elif device_chunk_cache is False:
+        cache_budget = [0]
+    else:
+        cache_budget = [int(device_chunk_cache)]
+    chunks = _DeviceChunkCache(backend, chunk_fn, cache_budget)
+    val_chunks = (_DeviceChunkCache(backend, ev.fn, cache_budget)
+                  if ev is not None else None)
     # Device-resident per-chunk boosting state (labels were shipped during
     # pass 0): pred for the whole run — 4C bytes/row, row-sharded over the
     # mesh like the data, per-chip tiny next to the streamed Xb.
@@ -614,9 +683,9 @@ def _fit_streaming_device(
         # compiled op is bit-exact vs an uninterrupted run by
         # construction. One upload pass over the chunks, start_round*C
         # cheap update dispatches each.
-        def _replay(preds_list, fn_of, n_of):
+        def _replay(preds_list, src_of, n_of):
             for c in range(n_of):
-                data = backend.upload(fn_of(c)[0])
+                data = src_of.get(c)
                 for r in range(start_round):
                     for cls in range(C):
                         slot = r * C + cls
@@ -629,14 +698,14 @@ def _fit_streaming_device(
                             data, preds_list[c], tree_full, cfg.max_depth,
                             cls)
 
-        _replay(pred_dev, chunk_fn, n_chunks)
+        _replay(pred_dev, chunks, n_chunks)
         if ev is not None:
-            _replay(val_pred, ev.fn, ev.n)
+            _replay(val_pred, val_chunks, ev.n)
 
     def passes(tree, depth, kind, class_idx):
         """One full pass over the chunks; yields per-chunk device outputs
-        with the next upload already in flight."""
-        data = backend.upload(chunk_fn(0)[0])
+        with the next read/upload already in flight."""
+        data = chunks.get(0)
         for c in range(n_chunks):
             if kind == "hist":
                 out = backend.stream_level_hist(
@@ -645,7 +714,7 @@ def _fit_streaming_device(
                 out = backend.stream_leaf_gh(
                     data, pred_dev[c], y_dev[c], tree, depth, class_idx)
             if c + 1 < n_chunks:        # prefetch: overlap H2D with compute
-                data = backend.upload(chunk_fn(c + 1)[0])
+                data = chunks.get(c + 1)
             yield np.asarray(out)       # fetch (device likely done by now)
 
     t_out = start_round * C
@@ -677,12 +746,12 @@ def _fit_streaming_device(
                     # Fused round-start: apply the previous round's trees
                     # to the resident preds AND build this tree's depth-0
                     # histogram in one dispatch per chunk.
-                    data = backend.upload(chunk_fn(0)[0])
+                    data = chunks.get(0)
                     for c in range(n_chunks):
                         pred_dev[c], out = backend.stream_round_start(
                             data, pred_dev[c], y_dev[c], prev_trees)
                         if c + 1 < n_chunks:
-                            data = backend.upload(chunk_fn(c + 1)[0])
+                            data = chunks.get(c + 1)
                         part = np.asarray(out)
                         hist = part if hist is None else hist + part
                 else:
@@ -717,13 +786,13 @@ def _fit_streaming_device(
             # Apply the round's trees to the resident val preds, fetch the
             # raw scores (pad rows sliced off) and score on host.
             scores = []
-            data = backend.upload(ev.fn(0)[0])
+            data = val_chunks.get(0)
             for c in range(ev.n):
                 for cls, tree_full in enumerate(round_trees):
                     val_pred[c] = backend.stream_update_pred(
                         data, val_pred[c], tree_full, cfg.max_depth, cls)
                 if c + 1 < ev.n:
-                    data = backend.upload(ev.fn(c + 1)[0])
+                    data = val_chunks.get(c + 1)
                 scores.append(np.asarray(val_pred[c])[: ev.lens[c]])
             if ev.record(rnd, np.concatenate(scores)):
                 log.info(

@@ -15,7 +15,8 @@ rate measures the LINK, not the kernels — the number that matters for
 the pod config is that rate x chips on a PCIe/DMA host, where the same
 code is compute-bound at the histogram kernel's rate.
 
-Run: python -u experiments/stream_scale.py [rows] [features]
+Run: python -u experiments/stream_scale.py [rows] [features] [off]
+(third arg "off" disables the device chunk cache — the round-4 A/B).
 """
 
 import json
@@ -71,12 +72,14 @@ def main() -> None:
     print(f"sharded {ROWS * FEATURES / 1e9:.2f} GB in {t_shard:.0f}s "
           f"(rss {rss_mb():.0f} MB)", flush=True)
 
+    cache = (sys.argv[3] if len(sys.argv) > 3 else "on") != "off"
     cfg = TrainConfig(n_trees=TREES, max_depth=DEPTH, n_bins=BINS,
                       backend="tpu")
     be = get_backend(cfg)
     src = chunks_mod.directory_chunks(shard_dir)
     t0 = time.perf_counter()
-    ens = fit_streaming(src, src.n_chunks, cfg, backend=be)
+    ens = fit_streaming(src, src.n_chunks, cfg, backend=be,
+                        device_chunk_cache=cache)
     t_train = time.perf_counter() - t0
 
     # Data visits per tree: one histogram pass per level + the leaf pass
@@ -86,6 +89,7 @@ def main() -> None:
     rec = {
         "rows": ROWS, "features": FEATURES, "n_chunks": N_CHUNKS,
         "bins": BINS, "trees": TREES, "depth": DEPTH,
+        "device_chunk_cache": cache,
         "shard_s": round(t_shard, 1),
         "train_s": round(t_train, 1),
         "s_per_tree": round(t_train / TREES, 1),

@@ -58,9 +58,13 @@ def main() -> int:
 
     from ddt_tpu.cli import main as cli_main
 
+    # Device cache OFF: on this CPU platform the "device" is host RAM, so
+    # a cached run would legitimately hold the dataset and mask exactly
+    # the O(chunk) property this worker exists to witness.
     rc = cli_main([
         "train", "--backend=tpu", f"--stream-dir={shard_dir}",
         f"--bins={bins}", "--trees=1", "--depth=2",
+        "--stream-device-cache=off",
         f"--out={os.path.join(work_dir, 'm.npz')}",
     ])
     rss_trained = _rss_mb()

@@ -2,13 +2,14 @@
 item 3): "O(chunk) by construction" meets multi-GB-class data. The
 defining property of O(chunk) is that peak memory tracks the CHUNK
 size, not the dataset size — so the test trains TWICE at the same
-500k-row chunk size, with the dataset doubled (2.5M -> 5M rows; 80 ->
-160 MB binned, 320 -> 640 MB as the float32 matrix the in-memory path
-would hold), each in a FRESH subprocess (RSS high-water marks are
-process-wide), and asserts the peak-RSS growth is flat. On this CPU
-platform the "device" is host RAM, so a path that held the dataset
-device-side would show up too (it would add ~+80 MB binned / +320 MB
-float between the runs).
+500k-row chunk size, with the dataset quadrupled (2.5M -> 10M rows; 80
+-> 320 MB binned, 320 MB -> 1.28 GB as the float32 matrix the
+in-memory path would hold), each in a FRESH subprocess (RSS high-water
+marks are process-wide), and asserts the peak-RSS growth is flat. On
+this CPU platform the "device" is host RAM, so a path that held the
+dataset device-side would show up too (it would add ~+240 MB binned /
++960 MB float between the runs); the device chunk cache is explicitly
+OFF in the worker for the same reason.
 
 The full-size measured run (20M x 64 on the real chip, throughput +
 peak RSS) lives in experiments/stream_scale.py with results in
@@ -42,7 +43,7 @@ def _measure(rows, n_chunks, work_dir):
 
 def test_stream_dir_memory_is_o_chunk(tmp_path):
     small = _measure(5 * CHUNK_ROWS, 5, tmp_path / "small")
-    big = _measure(10 * CHUNK_ROWS, 10, tmp_path / "big")
+    big = _measure(20 * CHUNK_ROWS, 20, tmp_path / "big")
 
     # The shard writer holds one generated chunk + npz buffers — flat in
     # dataset size by construction, bounded in chunk size.
@@ -51,11 +52,13 @@ def test_stream_dir_memory_is_o_chunk(tmp_path):
         assert shard_delta < 8 * rec["chunk_mb"], rec
 
     # Training: peak RSS grows with the chunk (per-chunk buffers, XLA
-    # intermediates sized [chunk_rows, ...]) plus small per-dataset state
-    # (the cached per-chunk preds: rows x 4 B = 10 -> 20 MB, labels).
-    # Doubling the dataset at fixed chunk size must NOT move the peak by
-    # anywhere near the dataset growth (+80 MB binned / +320 MB float if
-    # a path held it).
+    # intermediates sized [chunk_rows, ...], async-dispatch queue depth)
+    # plus small per-dataset state (the cached per-chunk preds: rows x
+    # 4 B = 10 -> 40 MB, labels). Quadrupling the dataset at fixed chunk
+    # size must NOT move the peak by anywhere near the dataset growth
+    # (+240 MB binned / +960 MB float if a path held it); 120 MB of
+    # headroom absorbs queue-depth jitter under CPU contention while
+    # staying half the smallest held-data signature.
     d_small = small["rss_trained_mb"] - small["rss_baseline_mb"]
     d_big = big["rss_trained_mb"] - big["rss_baseline_mb"]
-    assert d_big - d_small < 60, (small, big)
+    assert d_big - d_small < 120, (small, big)
