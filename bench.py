@@ -40,17 +40,22 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # Perf-regression floors (SURVEY.md §4). Histogram: the shipped Pallas
-# kernel measures 45-64 Mrows/s/chip across tunnel bands; 40 sits below
-# every observed band but above every known-bad mode (matmul fallback
-# ~26, broken compare domain ~int path). E2E: the fused dispatch builds
-# the 100-tree config in 11-15 s; 30 s is beyond any noise band but well
-# under the granular-dispatch regression (~3x). Predict: the resident
-# 10M x 1000-tree scoring sustains ~4 Mrows/s of device compute; 2.0
-# catches a descent-path regression without tripping on tunnel jitter
-# of the output fetch.
-TPU_FLOOR_MROWS = 40.0
-E2E_CEILING_S = 30.0
-PREDICT_FLOOR_MROWS = 2.0
+# kernel measures 40-64 Mrows/s/chip across tunnel bands (individual
+# low-band bout samples as low as 39.8 — experiments/hist_ab_paired.py);
+# 35 sits below every observed sample but above every known-bad mode
+# (matmul fallback ~26, broken compare domain below that). E2E: the
+# fused dispatch builds the 100-tree config in 11-23 s across bands;
+# 32 s clears the slow band with margin. A ~3x granular-dispatch
+# regression lands at 33-69 s and is caught from any band; note a
+# smaller regression inside a fast band can hide under a fixed ceiling —
+# the histogram floor covers the kernel side of that risk. Predict: the
+# resident arm still fetches the [10M] f32 scores through the tunnel,
+# so slow D2H bands drag it from ~2.9 to ~1.0 Mrows/s (measured back to
+# back); 0.8 sits below that while still catching the catastrophic
+# scalar-gather descent regression (~0.3-0.4 in any band).
+TPU_FLOOR_MROWS = 35.0
+E2E_CEILING_S = 32.0
+PREDICT_FLOOR_MROWS = 0.8
 # Cross-platform training parity (experiments/chip_parity.py): 2-4/155
 # split flips from MXU f32 summation order straddling bf16 gain-rounding
 # ties; quality-equivalent. Wider divergence means a real kernel bug.
@@ -157,7 +162,7 @@ def main() -> None:
     if tr["wallclock_s"] > E2E_CEILING_S:
         fails.append(
             f"e2e train {tr['wallclock_s']:.1f}s > {E2E_CEILING_S}s ceiling "
-            "(fused-dispatch regression; 11-15s expected)")
+            "(fused-dispatch regression; 11-23s expected across bands)")
     if pr["mrows_per_sec"] < PREDICT_FLOOR_MROWS:
         fails.append(
             f"resident predict {pr['mrows_per_sec']:.2f} Mrows/s < "

@@ -125,6 +125,16 @@ def best_splits(
     # equivalent (held-out AUC within 0.004 both directions over 20
     # trees); reproducibility ACROSS platforms is per-platform, not
     # bitwise.
+    #
+    # Cross-PROCESS boundary (round 3, tests/test_multiprocess.py): a
+    # multi-process mesh (gloo/real-pod collectives) may sum the
+    # histogram allreduce in a different order than the single-
+    # controller compilation of the same mesh shape. Measured effect:
+    # tree STRUCTURE stays bit-identical (bf16 gain rounding absorbs
+    # the ULPs), leaf VALUES agree to float tolerance (rtol ~2e-4)
+    # rather than bitwise. The bit-identity contract is therefore:
+    # bitwise within one controller at any partition count; structure-
+    # identical + leaf-tolerant across controllers/processes.
     def overlay_cat(gain, valid):
         """Replace cat features' ordinal gains with one-vs-rest gains
         (left child = exactly bin k => GL_k is the per-bin sum itself)."""

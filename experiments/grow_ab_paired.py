@@ -1,0 +1,70 @@
+"""Paired-ratio A/B: fused grow_tree dispatch at 255 vs 64 bins.
+
+Round 3's interleaved grow A/B (grow_ab_bins.py) measured ~1.3x for the
+64-bin opt-in at the whole-tree dispatch level; round 4's sweep-11
+epilogue showed that protocol can still compare arms across the
+tunnel's persistent wallclock bands. This re-measures the claim with
+the amended protocol (docs/PERF.md round-4 addendum): per-rep PAIRED
+ratios, arm order alternating every rep, pairs spread over minutes,
+median reported.
+
+Run: python -u experiments/grow_ab_paired.py
+"""
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ddt_tpu.backends.tpu import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache()
+
+import numpy as np  # noqa: E402
+
+from ddt_tpu.backends import get_backend  # noqa: E402
+from ddt_tpu.config import TrainConfig  # noqa: E402
+from ddt_tpu.utils.device import device_sync  # noqa: E402
+
+R, REPS, ITERS = 1_000_000, 24, 4
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal(R).astype(np.float32)
+    h = rng.random(R).astype(np.float32)
+    arms = {}
+    for bins in (255, 64):
+        cfg = TrainConfig(n_trees=1, max_depth=6, n_bins=bins,
+                          backend="tpu")
+        be = get_backend(cfg)
+        Xb = rng.integers(0, bins, (R, 28), dtype=np.uint8)
+        args = (be.upload(Xb), be._put_rows(g), be._put_rows(h))
+        _, delta = be.grow_tree(*args)
+        device_sync(delta)                       # compile + first run
+        arms[bins] = (be, args)
+
+    def bout(bins):
+        be, args = arms[bins]
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            _, delta = be.grow_tree(*args)
+        device_sync(delta)
+        return (time.perf_counter() - t0) / ITERS
+
+    ratios = []
+    for rep in range(REPS):
+        order = (255, 64) if rep % 2 == 0 else (64, 255)
+        ts = {b: bout(b) for b in order}
+        ratios.append(ts[255] / ts[64])
+        print(f"rep {rep:02d}  255b {ts[255] * 1e3:6.1f} ms  "
+              f"64b {ts[64] * 1e3:6.1f} ms  ratio {ratios[-1]:.3f}",
+              flush=True)
+        time.sleep(4)
+    med = float(np.median(ratios))
+    q1, q3 = np.percentile(ratios, [25, 75])
+    print(f"\nmedian paired ratio 255b/64b = {med:.3f}  "
+          f"IQR [{q1:.3f}, {q3:.3f}]", flush=True)
+
+
+if __name__ == "__main__":
+    main()

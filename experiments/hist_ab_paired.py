@@ -22,10 +22,9 @@ import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from experiments.hist_sweep11 import build  # noqa: E402
+from experiments.hist_sweep11 import F, N, R, build  # noqa: E402
 from ddt_tpu.utils.device import device_sync  # noqa: E402
 
-R, F, N = 1_024_000, 28, 32
 REPS, ITERS = 40, 8
 
 
