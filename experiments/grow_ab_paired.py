@@ -6,10 +6,14 @@ epilogue showed that protocol can still compare arms across the
 tunnel's persistent wallclock bands. This re-measures the claim with
 the amended protocol (docs/PERF.md round-4 addendum): per-rep PAIRED
 ratios, arm order alternating every rep, pairs spread over minutes,
-median reported.
+median reported (scaffolding: experiments/paired_protocol.py).
+
+Measured 2026-07-30 (24 pairs): median 255b/64b = 1.281,
+IQR [1.150, 1.407] — round-3's ~1.3x fused-dispatch claim CONFIRMED.
 
 Run: python -u experiments/grow_ab_paired.py
 """
+import functools
 import sys
 import time
 
@@ -21,6 +25,7 @@ enable_persistent_compile_cache()
 
 import numpy as np  # noqa: E402
 
+from experiments.paired_protocol import paired_ab  # noqa: E402
 from ddt_tpu.backends import get_backend  # noqa: E402
 from ddt_tpu.config import TrainConfig  # noqa: E402
 from ddt_tpu.utils.device import device_sync  # noqa: E402
@@ -51,19 +56,10 @@ def main() -> None:
         device_sync(delta)
         return (time.perf_counter() - t0) / ITERS
 
-    ratios = []
-    for rep in range(REPS):
-        order = (255, 64) if rep % 2 == 0 else (64, 255)
-        ts = {b: bout(b) for b in order}
-        ratios.append(ts[255] / ts[64])
-        print(f"rep {rep:02d}  255b {ts[255] * 1e3:6.1f} ms  "
-              f"64b {ts[64] * 1e3:6.1f} ms  ratio {ratios[-1]:.3f}",
-              flush=True)
-        time.sleep(4)
-    med = float(np.median(ratios))
-    q1, q3 = np.percentile(ratios, [25, 75])
-    print(f"\nmedian paired ratio 255b/64b = {med:.3f}  "
-          f"IQR [{q1:.3f}, {q3:.3f}]", flush=True)
+    paired_ab(
+        functools.partial(bout, 255), functools.partial(bout, 64),
+        name_a="255b", name_b="64b", reps=REPS,
+    )
 
 
 if __name__ == "__main__":

@@ -7,13 +7,15 @@ Mrows/s): each arm sticks to a ~40 or ~50 Mrows/s band for a whole
 not kernels. This harness measures the PER-REP PAIRED RATIO instead —
 arm order alternates every rep (A,B / B,A), reps spread over ~4-6
 minutes sample many band states, and the median of per-rep ratios is
-robust to any band structure that affects both arms of a pair.
+robust to any band structure that affects both arms of a pair. The
+protocol scaffolding lives in experiments/paired_protocol.py.
 
 Run: python -u experiments/hist_ab_paired.py
 """
 
 from __future__ import annotations
 
+import functools
 import sys
 import time
 
@@ -23,6 +25,7 @@ import numpy as np
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from experiments.hist_sweep11 import F, N, R, build  # noqa: E402
+from experiments.paired_protocol import paired_ab  # noqa: E402
 from ddt_tpu.utils.device import device_sync  # noqa: E402
 
 REPS, ITERS = 40, 8
@@ -51,23 +54,11 @@ def main() -> None:
         device_sync(out)
         return (time.perf_counter() - t0) / ITERS
 
-    ratios = []
-    for rep in range(REPS):
-        order = (arm_a, arm_b) if rep % 2 == 0 else (arm_b, arm_a)
-        ts = {}
-        for form, tile in order:
-            ts[form] = bout(form, tile)
-        ratios.append(ts["control"] / ts["prologue_t"])
-        print(f"rep {rep:02d}  control {R / ts['control'] / 1e6:6.1f}  "
-              f"T-form {R / ts['prologue_t'] / 1e6:6.1f}  "
-              f"ratio(ctl/T) {ratios[-1]:.3f}", flush=True)
-        time.sleep(4)          # let the band state evolve between pairs
-    med = float(np.median(ratios))
-    q1, q3 = np.percentile(ratios, [25, 75])
-    print(f"\nmedian ratio control/T-form = {med:.3f}  "
-          f"IQR [{q1:.3f}, {q3:.3f}]  "
-          f"({'T-form faster' if med > 1.02 else 'control faster' if med < 0.98 else 'parity'})",
-          flush=True)
+    paired_ab(
+        functools.partial(bout, *arm_a), functools.partial(bout, *arm_b),
+        name_a="control", name_b="T-form", reps=REPS,
+        scale=R / 1e6, unit="Mrows/s",
+    )
 
 
 if __name__ == "__main__":
