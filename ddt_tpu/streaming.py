@@ -368,6 +368,19 @@ def fit_streaming(
     (max_depth + 1) times per tree. Host memory stays O(chunk); device
     memory grows to min(dataset, budget).
     """
+    if cfg.subsample < 1.0 or cfg.colsample_bytree < 1.0:
+        # Sampling masks are host-drawn per round over the FULL row/
+        # column index space (driver.py) — incompatible with O(chunk)
+        # streaming by design. Silently training unsampled would diverge
+        # from Driver.fit on the same config; fail at the cause (the CLI
+        # has always rejected this combination, the library path must
+        # too — round-4 streaming fuzz caught the gap).
+        raise ValueError(
+            f"fit_streaming does not support row/column sampling "
+            f"(subsample={cfg.subsample}, colsample_bytree="
+            f"{cfg.colsample_bytree}); use the in-memory Driver for "
+            "bagging configs"
+        )
     if backend is None:
         from ddt_tpu.backends import get_backend
 

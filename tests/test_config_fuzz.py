@@ -202,3 +202,43 @@ def test_random_model_predict_paths_agree(trial):
     for name, got in approx.items():
         np.testing.assert_allclose(ref, got, rtol=3e-4, atol=3e-4,
                                    err_msg=name)
+
+
+@pytest.mark.parametrize("case_seed", range(5))
+def test_random_config_streaming_identity(case_seed):
+    """Round-4 fuzz dimension: fit_streaming over RANDOM chunk boundaries
+    and a RANDOM device-chunk-cache budget (0 .. whole dataset) must grow
+    the in-memory Driver's exact trees for any valid config — the cache
+    changes only when the H2D link is paid, never the math."""
+    from ddt_tpu.streaming import fit_streaming
+
+    rng = np.random.default_rng((113, case_seed))
+    Xb, y, cfg = _random_case(rng)
+    # Sampling is the one dimension streaming rejects by contract
+    # (host-drawn full-index masks don't stream; fit_streaming raises).
+    cfg = cfg.replace(backend="tpu", subsample=1.0, colsample_bytree=1.0)
+    full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
+
+    rows = len(y)
+    n_chunks = int(rng.integers(2, 6))
+    bounds = np.linspace(0, rows, n_chunks + 1).astype(int)
+
+    def chunk_fn(c):
+        return Xb[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
+
+    chunk_fn.labels = lambda c: y[bounds[c]:bounds[c + 1]]
+    chunk_fn.n_features = Xb.shape[1]
+    budget = int(rng.integers(0, Xb.nbytes + 1))   # 0 = no caching
+    streamed = fit_streaming(chunk_fn, n_chunks, cfg,
+                             device_chunk_cache=budget)
+    np.testing.assert_array_equal(full.feature, streamed.feature)
+    np.testing.assert_array_equal(full.threshold_bin,
+                                  streamed.threshold_bin)
+    np.testing.assert_array_equal(full.is_leaf, streamed.is_leaf)
+    np.testing.assert_allclose(full.leaf_value, streamed.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+
+    # The guard the round-4 fuzz caught missing: the library path must
+    # reject sampling configs loudly, like the CLI always has.
+    with pytest.raises(ValueError, match="sampling"):
+        fit_streaming(chunk_fn, n_chunks, cfg.replace(subsample=0.8))

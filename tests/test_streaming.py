@@ -132,6 +132,19 @@ def test_streaming_device_partitioned_matches_inmemory():
     np.testing.assert_array_equal(full.threshold_bin,
                                   streamed_pod.threshold_bin)
 
+    # ... and the device chunk cache composes with the pod mesh: cached
+    # handles are MESH-SHARDED arrays held across passes (forced on via
+    # an explicit budget — the CPU-platform default is off), still
+    # bit-identical.
+    streamed_pod_cached = fit_streaming(chunk_fn, n_chunks, cfg3,
+                                        device_chunk_cache=1 << 30)
+    np.testing.assert_array_equal(streamed_pod.feature,
+                                  streamed_pod_cached.feature)
+    np.testing.assert_array_equal(streamed_pod.threshold_bin,
+                                  streamed_pod_cached.threshold_bin)
+    np.testing.assert_array_equal(streamed_pod.leaf_value,
+                                  streamed_pod_cached.leaf_value)
+
 
 def test_streaming_device_early_leaves_match_inmemory():
     """Deep-narrow config (3 bins, depth 6): most rows freeze at early
