@@ -56,6 +56,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 TPU_FLOOR_MROWS = 35.0
 E2E_CEILING_S = 32.0
 PREDICT_FLOOR_MROWS = 0.8
+# The 64-bin opt-in's paired ratio measured 1.13-1.22 across three runs
+# (median of 10 order-alternating pairs); losing the transposed kernel
+# (e.g. a dispatch change silently routing n_bins<=128 to the row-major
+# form) would put the ratio at ~1.0. 1.05 separates the two.
+AB64_RATIO_FLOOR = 1.05
 # Cross-platform training parity (experiments/chip_parity.py): 2-4/155
 # split flips from MXU f32 summation order straddling bf16 gain-rounding
 # ties; quality-equivalent. Wider divergence means a real kernel bug.
@@ -167,6 +172,11 @@ def main() -> None:
         fails.append(
             f"resident predict {pr['mrows_per_sec']:.2f} Mrows/s < "
             f"{PREDICT_FLOOR_MROWS} floor (descent-path regression)")
+    if ab["ratio_b_over_a"] < AB64_RATIO_FLOOR:
+        fails.append(
+            f"64-bin paired ratio {ab['ratio_b_over_a']:.3f} < "
+            f"{AB64_RATIO_FLOOR} (transposed-kernel dispatch lost? "
+            "measured 1.13-1.22)")
     if parity and (parity["split_agreement"] < PARITY_MIN_AGREEMENT
                    or parity["auc_delta"] > PARITY_MAX_AUC_DELTA):
         fails.append(
