@@ -1,7 +1,10 @@
 """Worker process for the 2-process jax.distributed bring-up test.
 
 Run as:  python tests/mp_worker.py <coordinator> <num_processes> \
-             <process_id> <devices_per_process> <out.npz> <stream_dir>
+             <process_id> <devices_per_process> <out.npz> <stream_dir> \
+             [host_partitions]
+(host_partitions defaults to 2; the single-process comparator passes it
+explicitly so its mesh shape matches the multi-process run's.)
 
 num_processes == 1 skips initialize_multihost (the single-process
 comparator: same mesh shape, same program, one controller). Each process
@@ -25,6 +28,7 @@ def main() -> int:
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
         sys.argv[5], sys.argv[6],
     )
+    host_partitions = int(sys.argv[7]) if len(sys.argv) > 7 else 2
     # sitecustomize may have imported jax already with another platform
     # bound; the config.update below overrides it. XLA_FLAGS is read when
     # the CPU client is instantiated, which is AFTER this line.
@@ -76,7 +80,8 @@ def main() -> int:
     Xb, _ = quantize(X, n_bins=31, seed=31)
     cfg = TrainConfig(
         n_trees=3, max_depth=3, n_bins=31, backend="tpu",
-        host_partitions=2, n_partitions=n_global // 2,
+        host_partitions=host_partitions,
+        n_partitions=n_global // host_partitions,
     )
     be = get_backend(cfg)
     assert be.mesh.devices.size == n_global
