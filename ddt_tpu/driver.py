@@ -68,6 +68,17 @@ from ddt_tpu.utils.profiling import PhaseTimer
 
 log = logging.getLogger("ddt_tpu.driver")
 
+# Cap on rounds per fused dispatch. One block already amortizes dispatch
+# latency to nothing, so bigger buys no throughput — but an UNBOUNDED
+# block turns long configs into one multi-minute device program with
+# zero host interaction, which (a) remote-attached runtimes can kill as
+# hung (the full 500-round depth-8 Covertype config crashed the chip
+# worker as a single ~15-minute dispatch; 100-round blocks — the shape
+# every prior measurement used — run it fine), (b) starves checkpoint
+# and progress-log cadence. 100 rounds ~ 1-2 device-minutes at the
+# deepest shipped config.
+FUSED_BLOCK_ROUNDS = 100
+
 
 def _traverse_one(
     feature: np.ndarray,
@@ -534,7 +545,7 @@ class Driver:
             best = -np.inf
         rnd = start_round
         while rnd < cfg.n_trees:
-            K = cfg.n_trees - rnd
+            K = min(cfg.n_trees - rnd, FUSED_BLOCK_ROUNDS)
             if self.checkpoint_dir is not None:
                 nxt = (rnd // self.checkpoint_every + 1) * \
                     self.checkpoint_every
