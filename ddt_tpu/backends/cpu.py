@@ -178,17 +178,10 @@ class CPUDevice(DeviceBackend):
         if self._native_traverse is None:
             return ens.predict_raw(Xb, binned=True)
         # C++ batch traversal (the CPU twin of the device gather+compare
-        # path); aggregation shared with TreeEnsemble.predict_raw.
-        # Missing-bin models route NaN rows by the learned direction;
-        # categorical one-vs-rest nodes route "bin == thr goes left".
-        cat_node = (
-            np.isin(ens.feature, ens.cat_features)
-            if ens.has_cat_splits else None
-        )
-        leaf = self._native_traverse(
-            Xb, ens.feature, ens.threshold_bin, ens.is_leaf, ens.max_depth,
-            default_left=ens.default_left,
-            missing_bin_value=ens.n_bins - 1 if ens.missing_bin else -1,
-            cat_node=cat_node,
-        )                                                       # [T, R]
+        # path); routing-flag derivation lives in ONE place
+        # (TreeEnsemble._traverse_native), aggregation shared with
+        # TreeEnsemble.predict_raw.
+        leaf = ens._traverse_native(Xb)                         # [T, R]
+        if leaf is None:                    # library unavailable after all
+            return ens.predict_raw(Xb, binned=True)
         return ens.aggregate_leaves(leaf)
