@@ -135,6 +135,18 @@ def best_splits(
     # rather than bitwise. The bit-identity contract is therefore:
     # bitwise within one controller at any partition count; structure-
     # identical + leaf-tolerant across controllers/processes.
+    #
+    # Chunked-ACCUMULATION boundary (round 4, fuzz campaign 2: seed
+    # 197, the one divergence in 210 random streaming cases): streamed training sums per-chunk
+    # histogram partials on host, a different f32 summation tree than
+    # the in-memory single device sum. When a node's two best candidate
+    # gains land within ~1 bf16 ULP of each other (measured: 0.00102997
+    # vs 0.00102234 at the min_split_gain floor, reg_lambda=0), the
+    # rounded argmax can legitimately pick either — ~1 root-cause node
+    # per 160k across the campaigns. Streamed == in-memory is therefore
+    # bitwise EXCEPT provable bf16-boundary candidate ties (the fuzz's
+    # _assert_trees_match_mod_ties states the checkable contract); the
+    # many fixed-seed streaming suites remain bitwise in practice.
     def overlay_cat(gain, valid):
         """Replace cat features' ordinal gains with one-vs-rest gains
         (left child = exactly bin k => GL_k is the per-bin sum itself)."""

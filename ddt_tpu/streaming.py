@@ -18,10 +18,11 @@ any chunk can be regenerated on any host at any time (the deterministic
 synthetic generator data/datasets.stress_binned_chunk is one; a file-backed
 loader fits the same signature). Chunks may differ in size (each distinct
 size jit-compiles its own per-level program — keep the number of distinct
-sizes small); empty chunks are not allowed. This trainer produces
-BIT-IDENTICAL trees to the in-memory Driver on the same data
-(tests/test_streaming.py) — the chunk sum enters the same bf16-rounded
-split selection (ops/split.py).
+sizes small); empty chunks are not allowed. This trainer matches the
+in-memory Driver bitwise on the same data (tests/test_streaming.py),
+except at exact bf16-boundary candidate ties where the chunked f32
+summation order can legitimately pick the other side (~1 node per 160k,
+measured — ops/split.py "Determinism boundary").
 
 Distribution composes: each chunk is row-sharded over the TPUDevice mesh like
 any other upload, so a v5e-64 pod streams 8 host-chunks in parallel while each
@@ -350,10 +351,14 @@ def fit_streaming(
     device for the whole run (ops/stream.py; supports softmax and
     n_partitions/host_partitions > 1). Host backends stream the host
     formulation (binary/mse/softmax — one tree per class per round from
-    round-start preds, like the Driver). Both are bit-identical to the
-    in-memory Driver on the same data, including missing_policy='learn'
+    round-start preds, like the Driver). Both match the in-memory Driver
+    on the same data bitwise — including missing_policy='learn'
     (reserved NaN bin + learned default directions) and categorical
-    one-vs-rest splits (tests/test_streaming.py).
+    one-vs-rest splits (tests/test_streaming.py) — except when a node's
+    two best candidate gains are exact bf16-boundary ties, where the
+    chunked host accumulation's f32 summation order can legitimately
+    pick the other candidate (~1 node per 160k, measured; ops/split.py
+    "Determinism boundary", chunked-accumulation paragraph).
 
     `device_chunk_cache` (device backends only): True caches uploaded
     binned chunks in device memory up to DEVICE_CHUNK_CACHE_BYTES —
