@@ -37,14 +37,27 @@ class FPGADevice(DeviceBackend):
 # programs: a TPUDevice's jitted grow/grad/predict functions live on the
 # instance, and recompiling them costs seconds (tens of seconds through a
 # remote-attached chip) — far more than any training round. Fields like
-# n_trees or seed never enter a trace, so two train() calls differing only
-# there share one compiled backend.
+# n_trees never enter a trace, so two train() calls differing only there
+# share one compiled backend. subsample and seed DO enter the fused trace
+# since round 5 (the in-scan counter-based bagging hash bakes both in);
+# a cached instance reused across them would train with the wrong masks.
+# seed is trace-relevant ONLY under bagging, so the key normalises it to
+# 0 when subsample == 1.0 — a seed sweep over deterministic/colsample-only
+# configs (whose masks are host data, not trace constants) keeps sharing
+# one compiled backend instead of paying a recompile per seed.
 _JIT_FIELDS = (
     "backend", "n_partitions", "feature_partitions", "host_partitions",
     "max_depth", "n_bins", "learning_rate", "loss", "n_classes",
     "reg_lambda", "min_child_weight", "min_split_gain",
     "hist_impl", "matmul_input_dtype", "missing_policy", "cat_features",
+    "subsample",
 )
+
+
+def _cache_key(cfg: TrainConfig) -> tuple:
+    return tuple(getattr(cfg, f) for f in _JIT_FIELDS) + (
+        cfg.seed if cfg.subsample < 1.0 else 0,
+    )
 # LRU-bounded: each cached TPUDevice pins its compiled executables (and any
 # upload-derived device state) for its lifetime, so a hyperparameter sweep
 # over many configs must evict old entries. TrainConfig is frozen, so a
@@ -58,7 +71,7 @@ def get_backend(cfg: TrainConfig, use_cache: bool = True,
     """Instantiate (or reuse) the backend named by cfg.backend (the flag)."""
     key = None
     if use_cache and not kwargs:
-        key = tuple(getattr(cfg, f) for f in _JIT_FIELDS)
+        key = _cache_key(cfg)
         hit = _CACHE.pop(key, None)
         if hit is not None:
             _CACHE[key] = hit      # re-insert: most-recently-used

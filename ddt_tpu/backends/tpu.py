@@ -59,6 +59,36 @@ HAXIS = "hosts"  # cross-slice DCN axis (SURVEY.md §5 "Distributed comm
 #   followed by a DCN allreduce. Must match parallel.mesh.HOSTS_AXIS.
 
 
+def _axis_allreduce(axis):
+    """Collective-or-identity reducer over `axis` (None = single shard):
+    (x, op) with op in sum|min|max — the ONE home of the psum/pmin/pmax
+    dispatch the metric twins and loss reductions share."""
+    def allreduce(x, op="sum"):
+        if axis is None:
+            return x
+        return {"sum": jax.lax.psum, "min": jax.lax.pmin,
+                "max": jax.lax.pmax}[op](x, axis)
+
+    return allreduce
+
+
+def _local_row_offset(axis, rows_axis_size: int, n_local: int):
+    """This shard's first row within the padded global batch — the
+    flattened (hosts, rows) shard index times the local row count; the
+    global-row-id base every in-trace bagging hash derives from (ONE
+    home: fused grow_rounds and the streamed ops must agree bit-for-bit
+    with the host twin's ids). `rows_axis_size` is the "rows" axis
+    extent (needed to flatten the 2-axis case; ignored otherwise)."""
+    if axis is None:
+        return jnp.int32(0)
+    if isinstance(axis, tuple):
+        idx = (jax.lax.axis_index(axis[0]) * rows_axis_size
+               + jax.lax.axis_index(axis[1]))
+    else:
+        idx = jax.lax.axis_index(axis)
+    return (idx * n_local).astype(jnp.int32)
+
+
 def _pack_tree(tree) -> "jax.Array":
     """Stack a grown tree's node arrays into one [6, N] f32 array (single
     device→host fetch; int32/bool values are exact in f32)."""
@@ -443,30 +473,40 @@ class TPUDevice(DeviceBackend):
     # device dispatch (lax.scan over rounds). Per-round dispatch economics
     # dominate wallclock through a remote-attached chip (~10-30 ms of host
     # overhead per call x 3 calls x 100 rounds); the scan collapses that to
-    # one dispatch + ONE tree fetch per block. Deterministic boosting only
-    # (the Driver falls back to the granular path for bagging/colsample/
-    # eval, whose masks are host-drawn by design).
+    # one dispatch + ONE tree fetch per block. Colsample masks ride the
+    # scan as xs; bagging masks are recomputed in-scan from the stateless
+    # counter hash (ops/sampling); eval rides via grow_rounds_eval. Only
+    # profiling and the bagging+eval combination fall back to the
+    # granular path (driver.py fit()).
     # ------------------------------------------------------------------ #
 
-    def grow_rounds(self, data, pred, y: "LabelHandle", n_rounds: int):
+    def grow_rounds(self, data, pred, y: "LabelHandle", n_rounds: int,
+                    first_round: int = 0):
         """Run `n_rounds` boosting rounds on device. Returns device handles
         (packed_trees [n_rounds, C, 5, n_nodes] f32, new_pred,
         losses [n_rounds] f32 — loss AFTER each round, matching
-        loss_value's semantics)."""
+        loss_value's semantics). With cfg.subsample < 1, bagging row
+        masks are recomputed IN-SCAN from the counter-based hash of
+        (cfg.seed, first_round + k, global row id) — ops/sampling — so
+        `first_round` (the absolute round index of the block's first
+        round) is part of the program's inputs, not its cache key."""
         fn = self._rounds_fns.get(n_rounds)
         if fn is None:
             fn = self._build_rounds_fn(n_rounds)
             self._rounds_fns[n_rounds] = fn
-        return fn(data, pred, y.y, y.valid)
+        args = (data, pred, y.y, y.valid)
+        if self.cfg.subsample < 1.0:
+            args = args + (np.int32(first_round),)
+        return fn(*args)
 
     def grow_rounds_masked(self, data, pred, y: "LabelHandle",
-                           n_rounds: int, fmasks: np.ndarray):
+                           n_rounds: int, fmasks: np.ndarray,
+                           first_round: int = 0):
         """grow_rounds with per-round/per-class colsample feature masks
         riding the scan as xs: `fmasks` is host bool [n_rounds, C, F]
-        (KBs — unlike bagging's [K, R] row masks, small enough to ship
-        per block, which is why colsample fuses and subsample does not).
-        Masks are padded to the global column count here; padded columns
-        stay masked out."""
+        (KBs). Masks are padded to the global column count here; padded
+        columns stay masked out. Composes with in-scan bagging (see
+        grow_rounds)."""
         K, C, F = fmasks.shape
         Fg = data.shape[1]          # jax.Array shape is GLOBAL (padded)
         m = np.zeros((K, C, Fg), bool)
@@ -475,7 +515,10 @@ class TPUDevice(DeviceBackend):
         if fn is None:
             fn = self._build_rounds_fn(n_rounds, masked=True)
             self._rounds_masked_fns[n_rounds] = fn
-        return fn(data, pred, y.y, y.valid, m)
+        args = (data, pred, y.y, y.valid, m)
+        if self.cfg.subsample < 1.0:
+            args = args + (np.int32(first_round),)
+        return fn(*args)
 
     @functools.cached_property
     def _rounds_masked_fns(self) -> dict:
@@ -488,8 +531,9 @@ class TPUDevice(DeviceBackend):
         round's trees are applied to the resident validation predictions
         and the metric's f32 device twin evaluates per round — eval runs
         at fused-dispatch speed (no per-round host round-trips; one [K]
-        scores fetch per block). Metric must have a device twin (the
-        Driver falls back to the granular path for auc / early stopping).
+        scores fetch per block). Metric must have a device twin — all
+        metrics have one since round 5's binned-rank auc, except
+        softmax-auc (the Driver falls back to the granular path there).
         Returns (packed_trees, new_pred, losses, new_val_pred,
         scores [n_rounds] f32)."""
         key = (n_rounds, metric)
@@ -515,19 +559,25 @@ class TPUDevice(DeviceBackend):
         # (the Driver routes colsample+eval_set to the granular path).
         assert not (masked and eval_metric is not None), \
             "masked fused blocks do not compose with in-scan eval"
+        from ddt_tpu.ops import sampling as sampling_ops
         from ddt_tpu.ops import stream as stream_ops
         from ddt_tpu.utils.metrics import device_metric
 
         cfg = self.cfg
+        bagging = cfg.subsample < 1.0
+        # The Driver keeps bagging+eval on the granular path; the eval
+        # scan body does not thread round ids.
+        assert not (bagging and eval_metric is not None), \
+            "bagged fused blocks do not compose with in-scan eval"
         C = cfg.n_classes if cfg.loss == "softmax" else 1
         axis = self._row_axes if self.distributed else None
         faxis = FAXIS if self.feature_partitions > 1 else None
         input_dtype = self._input_dtype
-        mfn = device_metric(eval_metric) if eval_metric else None
+        mfn = device_metric(eval_metric, n_classes=C) if eval_metric \
+            else None
         missing = cfg.missing_policy == "learn"
 
-        def allreduce(x):
-            return jax.lax.psum(x, axis) if axis is not None else x
+        allreduce = _axis_allreduce(axis)
 
         def loss_of(pred, ya, valid):
             # Shared loss formulas (ops/grad.mean_loss); reductions psum'd
@@ -536,20 +586,36 @@ class TPUDevice(DeviceBackend):
             return grad_ops.mean_loss(pred, ya, valid, cfg.loss,
                                       allreduce=allreduce)
 
+        hp_n = self.n_partitions
+
         def rounds(data_a, pred0, ya, valid, *rest):
+            rest = list(rest)
+            rnd0 = rest.pop() if bagging else None   # block's first round
             if masked:
-                *rest, fmasks = rest          # [K, C, Fg] bool, scan xs
+                fmasks = rest.pop()           # [K, C, Fg] bool, scan xs
             if mfn is not None:
                 val_data, vpred0, vy, vvalid = rest
                 cat_vec = split_ops.cat_feature_vec(
                     cfg.cat_features,
                     val_data.shape[1] * self.feature_partitions)
 
-            def one_round(pred, vpred, fmask_r=None):
+            def one_round(pred, vpred, fmask_r=None, rid=None):
                 g, h = grad_ops.grad_hess(pred, ya, cfg.loss)
                 v = valid[:, None] if g.ndim == 2 else valid
                 g = g * v
                 h = h * v
+                if rid is not None:
+                    # Counter-based bagging bit per (round, global row) —
+                    # exactly the granular path's host-drawn mask
+                    # (ops/sampling twins are bit-identical; 0/1 f32
+                    # multiplies commute exactly with the valid scaling).
+                    keep = sampling_ops.row_keep_jax(
+                        rid, _local_row_offset(axis, hp_n, ya.shape[0]),
+                        ya.shape[0],
+                        seed=cfg.seed, subsample=cfg.subsample)
+                    kv = keep[:, None] if g.ndim == 2 else keep
+                    g = g * kv
+                    h = h * kv
                 packs = []
                 for c in range(C):
                     gc = g[:, c] if C > 1 else g
@@ -602,20 +668,37 @@ class TPUDevice(DeviceBackend):
                     body, (pred0, vpred0), None, length=K)
                 return trees, predf, losses, vpredf, scores
 
-            if masked:
+            # Per-round absolute ids ride the scan as xs when bagging.
+            rids = (jnp.arange(K, dtype=jnp.int32) + rnd0) if bagging \
+                else None
+            if masked and bagging:
+                def body(carry, x):
+                    fm, rid = x
+                    pred, _, packs, loss = one_round(carry, None, fm, rid)
+                    return pred, (packs, loss)
+
+                predf, (trees, losses) = jax.lax.scan(
+                    body, pred0, (fmasks, rids))
+            elif masked:
                 def body(carry, fm):          # fm [C, Fg]: this round's
                     pred, _, packs, loss = one_round(carry, None, fm)
                     return pred, (packs, loss)
 
                 predf, (trees, losses) = jax.lax.scan(body, pred0, fmasks)
-                return trees, predf, losses
+            elif bagging:
+                def body(carry, rid):
+                    pred, _, packs, loss = one_round(carry, None, None,
+                                                     rid)
+                    return pred, (packs, loss)
 
-            def body(carry, _):
-                pred, _, packs, loss = one_round(carry, None)
-                return pred, (packs, loss)
+                predf, (trees, losses) = jax.lax.scan(body, pred0, rids)
+            else:
+                def body(carry, _):
+                    pred, _, packs, loss = one_round(carry, None)
+                    return pred, (packs, loss)
 
-            predf, (trees, losses) = jax.lax.scan(body, pred0, None,
-                                                  length=K)
+                predf, (trees, losses) = jax.lax.scan(body, pred0, None,
+                                                      length=K)
             return trees, predf, losses
 
         if self.distributed:
@@ -630,6 +713,8 @@ class TPUDevice(DeviceBackend):
                 out_specs = out_specs + (pred_spec, P())
             if masked:
                 in_specs = in_specs + (P(),)   # fmasks replicated
+            if bagging:
+                in_specs = in_specs + (P(),)   # rnd0 scalar replicated
             rounds = jax.shard_map(
                 rounds,
                 mesh=self.mesh,
@@ -650,9 +735,10 @@ class TPUDevice(DeviceBackend):
     # predictions stay RESIDENT on device; each round's freshly grown
     # trees (still-on-device packed handles) are applied by the same
     # routing formulation as training, and the metric is computed on
-    # device when its f32 twin exists (logloss/rmse/accuracy — one scalar
-    # crosses the host boundary per round). AUC stays on host (rank sums
-    # overflow f32): the Driver fetches the raw scores instead.
+    # device when its f32 twin exists (logloss/rmse/accuracy, plus
+    # binary auc via the binned-rank twin since round 5 — one scalar
+    # crosses the host boundary per round). Softmax-auc stays on host:
+    # the Driver fetches the raw scores instead.
     # ------------------------------------------------------------------ #
 
     def eval_round(self, val_data, val_pred, handles, val_y: "LabelHandle",
@@ -679,7 +765,7 @@ class TPUDevice(DeviceBackend):
 
         cfg = self.cfg
         faxis = FAXIS if self.feature_partitions > 1 else None
-        mfn = device_metric(metric) if metric else None
+        mfn = device_metric(metric, n_classes=C) if metric else None
         missing = cfg.missing_policy == "learn"
         rax = self._row_axes
 
@@ -709,11 +795,8 @@ class TPUDevice(DeviceBackend):
                     if self.distributed else pred
                 )
                 return pred, gathered
-            allreduce = (
-                (lambda x: jax.lax.psum(x, rax)) if self.distributed
-                else (lambda x: x)
-            )
-            return pred, mfn(y, pred, valid, allreduce)
+            return pred, mfn(y, pred, valid, _axis_allreduce(
+                rax if self.distributed else None))
 
         if self.distributed:
             pred_spec = P(rax, None) if C > 1 else P(rax)
@@ -799,6 +882,7 @@ class TPUDevice(DeviceBackend):
         fn = self._stream_cache.get(key)
         if fn is not None:
             return fn
+        from ddt_tpu.ops import sampling as sampling_ops
         from ddt_tpu.ops import stream as stream_ops
 
         cfg = self.cfg
@@ -810,26 +894,39 @@ class TPUDevice(DeviceBackend):
         axis = self._row_axes if self.distributed else None
         softmax = cfg.loss == "softmax"
         missing_val = cfg.missing_bin_value
+        # Bagging ops take 3 extra traced scalars — (round id, chunk row
+        # base lo/hi) — and recompute the counter-based keep mask on
+        # device per chunk (ops/sampling; O(chunk), no mask shipping).
+        bagged = cfg.subsample < 1.0 and kind != "update"
+        hp_n = self.n_partitions
+
+        def row_keep_for(Xb, rnd, blo, bhi):
+            return sampling_ops.row_keep_jax(
+                rnd, _local_row_offset(axis, hp_n, Xb.shape[0]),
+                Xb.shape[0], seed=cfg.seed, subsample=cfg.subsample,
+                row_start_lo=blo, row_start_hi=bhi)
 
         def cat_vec_for(Xb):
             return split_ops.cat_feature_vec(cfg.cat_features, Xb.shape[1])
 
         if kind == "hist":
-            def f(Xb, pred, y, valid, feat, thr, leaf, dl):
+            def f(Xb, pred, y, valid, feat, thr, leaf, dl, *bag):
                 return stream_ops.stream_level_hist(
                     Xb, pred, y, valid, feat, thr, leaf, dl,
                     depth=depth, n_bins=cfg.n_bins, loss=cfg.loss,
                     class_idx=class_idx, hist_impl=cfg.hist_impl,
                     input_dtype=self._input_dtype, axis_name=axis,
                     missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
+                    row_keep=row_keep_for(Xb, *bag) if bag else None,
                 )
         elif kind == "leaf":
-            def f(Xb, pred, y, valid, feat, thr, leaf, dl):
+            def f(Xb, pred, y, valid, feat, thr, leaf, dl, *bag):
                 return stream_ops.stream_leaf_gh(
                     Xb, pred, y, valid, feat, thr, leaf, dl,
                     max_depth=depth, loss=cfg.loss, class_idx=class_idx,
                     axis_name=axis,
                     missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
+                    row_keep=row_keep_for(Xb, *bag) if bag else None,
                 )
         elif kind == "update":
             def f(Xb, pred, feat, thr, leaf, val, dl):
@@ -843,7 +940,9 @@ class TPUDevice(DeviceBackend):
             # `depth` carries the previous round's tree count (= C).
             n_prev = depth
 
-            def f(Xb, pred, y, valid, *flat):
+            def f(Xb, pred, y, valid, *rest):
+                bag = rest[5 * n_prev:]
+                flat = rest[:5 * n_prev]
                 trees = tuple(
                     tuple(flat[5 * i: 5 * i + 5]) for i in range(n_prev))
                 return stream_ops.stream_round_start(
@@ -854,12 +953,14 @@ class TPUDevice(DeviceBackend):
                     hist_impl=cfg.hist_impl,
                     input_dtype=self._input_dtype, axis_name=axis,
                     missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
+                    row_keep=row_keep_for(Xb, *bag) if bag else None,
                 )
         else:  # pragma: no cover
             raise ValueError(kind)
 
         if self.distributed:
             rax = self._row_axes
+            bag_specs = (P(), P(), P()) if bagged else ()
             pred_spec = P(rax, None) if softmax else P(rax)
             if kind == "update":
                 in_specs = (P(rax, None), pred_spec, P(), P(), P(), P(),
@@ -867,11 +968,11 @@ class TPUDevice(DeviceBackend):
                 out_specs = pred_spec
             elif kind == "roundstart":
                 in_specs = (P(rax, None), pred_spec, P(rax), P(rax)) + \
-                    (P(),) * (5 * depth)
+                    (P(),) * (5 * depth) + bag_specs
                 out_specs = (pred_spec, P())
             else:
                 in_specs = (P(rax, None), pred_spec, P(rax), P(rax),
-                            P(), P(), P(), P())
+                            P(), P(), P(), P()) + bag_specs
                 out_specs = P()
             f = jax.shard_map(f, mesh=self.mesh, in_specs=in_specs,
                               out_specs=out_specs)
@@ -880,22 +981,38 @@ class TPUDevice(DeviceBackend):
         self._stream_cache[key] = fn
         return fn
 
+    def _bag_args(self, rnd: int, row_start: int) -> tuple:
+        """Traced scalars for the streamed bagging hash: (round id, chunk
+        global-row base as a uint32 pair — 10B-row bases overflow
+        uint32). Empty when cfg.subsample == 1 (the compiled programs
+        take no such operands then)."""
+        if self.cfg.subsample >= 1.0:
+            return ()
+        return (np.int32(rnd),
+                np.uint32(row_start & 0xFFFFFFFF),
+                np.uint32(row_start >> 32))
+
     def stream_level_hist(self, data, pred, y: "LabelHandle", tree,
-                          depth: int, class_idx: int = 0):
+                          depth: int, class_idx: int = 0,
+                          rnd: int = 0, row_start: int = 0):
         """Partial histogram [2^depth, F, B, 2] for one uploaded chunk
         (device handle; includes the cross-shard psum). `tree` is the
         partial tree's host arrays (feature, threshold_bin, is_leaf,
-        default_left)."""
+        default_left). `rnd`/`row_start` feed the counter-based bagging
+        mask when cfg.subsample < 1 (ignored otherwise)."""
         feat, thr, leaf, dl = tree
         return self._stream_fn("hist", depth, class_idx)(
-            data, pred, y.y, y.valid, feat, thr, leaf, dl)
+            data, pred, y.y, y.valid, feat, thr, leaf, dl,
+            *self._bag_args(rnd, row_start))
 
     def stream_leaf_gh(self, data, pred, y: "LabelHandle", tree,
-                       max_depth: int, class_idx: int = 0):
+                       max_depth: int, class_idx: int = 0,
+                       rnd: int = 0, row_start: int = 0):
         """Final-level (G, H) aggregates [2^max_depth, 2] for one chunk."""
         feat, thr, leaf, dl = tree
         return self._stream_fn("leaf", max_depth, class_idx)(
-            data, pred, y.y, y.valid, feat, thr, leaf, dl)
+            data, pred, y.y, y.valid, feat, thr, leaf, dl,
+            *self._bag_args(rnd, row_start))
 
     def stream_update_pred(self, data, pred, tree_full, max_depth: int,
                            class_idx: int = 0):
@@ -907,14 +1024,18 @@ class TPUDevice(DeviceBackend):
             data, pred, feat, thr, leaf, val, dl)
 
     def stream_round_start(self, data, pred, y: "LabelHandle",
-                           prev_trees: list):
+                           prev_trees: list,
+                           rnd: int = 0, row_start: int = 0):
         """Fused round-start pass for one chunk: apply the previous
         round's finished class trees to the resident pred, then return the
         NEXT round's class-0 depth-0 histogram — one dispatch, one data
-        read (ops/stream.stream_round_start). Returns (new_pred, hist)."""
+        read (ops/stream.stream_round_start). Returns (new_pred, hist).
+        `rnd` is the NEW round (its bagging mask feeds the histogram; the
+        pred update applies to every row)."""
         flat = [a for t in prev_trees for a in t]
         return self._stream_fn("roundstart", len(prev_trees), 0)(
-            data, pred, y.y, y.valid, *flat)
+            data, pred, y.y, y.valid, *flat,
+            *self._bag_args(rnd, row_start))
 
     # ------------------------------------------------------------------ #
     # inference (TreeEnsemble.predict → gather+compare, row-sharded)

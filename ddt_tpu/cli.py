@@ -159,20 +159,16 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
     import shutil
     import tempfile
 
-    # cfg (not args) for the TrainConfig-backed fields: a --config file
-    # can set them too, and streaming silently ignoring bagging would be
-    # the exact mismatch this guard exists to prevent.
+    # Sampling configs stream since round 5 (stateless counter-based
+    # masks, ops/sampling); only the profiling knobs stay in-memory-only.
     unsupported = [
-        (cfg.subsample < 1.0, "subsample"),
-        (cfg.colsample_bytree < 1.0, "colsample_bytree"),
         (args.profile, "--profile"),
         (args.trace_dir is not None, "--trace-dir"),
     ]
     bad = [flag for cond, flag in unsupported if cond]
     if bad:
         raise SystemExit(
-            f"--stream-chunks does not compose with {', '.join(bad)} "
-            "(streaming trains on the full stream, deterministically)"
+            f"--stream-chunks does not compose with {', '.join(bad)}"
         )
     t0 = time.perf_counter()
     tmp_cache = None
@@ -443,6 +439,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="row fraction per boosting round (bagging)")
     tp.add_argument("--colsample-bytree", type=float, default=1.0,
                     help="feature fraction per tree")
+    tp.add_argument("--fused-block-rounds", type=_positive_int, default=100,
+                    help="max boosting rounds per fused device dispatch "
+                         "(>= 1); tune DOWN if a watchdogged remote "
+                         "runtime kills long device programs "
+                         "(TrainConfig.fused_block_rounds)")
     tp.add_argument("--hist-impl", default="auto",
                     choices=["auto", "matmul", "segment", "pallas"])
     tp.add_argument("--stream-chunks", type=int, default=0,
@@ -577,6 +578,7 @@ def main(argv: list[str] | None = None) -> int:
             hist_impl=args.hist_impl, seed=args.seed,
             missing_policy=args.missing,
             cat_features=cat_features,
+            fused_block_rounds=args.fused_block_rounds,
         )
         if file_cfg is not None:
             cfg = cfg.replace(**file_cfg)

@@ -72,6 +72,17 @@ class TrainConfig:
     #   feature_partitions.
     hist_impl: str = "auto"     # auto | matmul | segment | pallas
     seed: int = 0
+    # Cap on boosting rounds per fused device dispatch (Driver._fit_fused).
+    # One block already amortizes dispatch latency to nothing, so bigger
+    # buys no throughput — but an UNBOUNDED block turns long configs into
+    # one multi-minute device program with zero host interaction, which
+    # (a) remote-attached runtimes can kill as hung (the full 500-round
+    # depth-8 Covertype config crashed the round-4 chip worker as a single
+    # ~15-minute dispatch; 100-round blocks run it fine) and (b) starves
+    # checkpoint and progress-log cadence. The default's ~1-2 device-
+    # minutes-per-block headroom is deployment-specific — deeper/wider
+    # configs on watchdogged runtimes tune it DOWN (--fused-block-rounds).
+    fused_block_rounds: int = 100
 
     # --- numerics ---
     # Histogram accumulators are always float32 (preferred_element_type on the
@@ -95,6 +106,10 @@ class TrainConfig:
         if (self.n_partitions < 1 or self.feature_partitions < 1
                 or self.host_partitions < 1):
             raise ValueError("partition counts must be >= 1")
+        if self.fused_block_rounds < 1:
+            raise ValueError(
+                f"fused_block_rounds must be >= 1, got "
+                f"{self.fused_block_rounds}")
         if not (0.0 < self.subsample <= 1.0):
             raise ValueError("subsample must be in (0, 1]")
         if not (0.0 < self.colsample_bytree <= 1.0):

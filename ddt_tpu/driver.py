@@ -40,8 +40,11 @@ SCORES):
   twins (utils/metrics.device_metric) while host backends use the f64 host
   implementations, so per-round validation scores — and early-stopping
   choices on rounds tied within f32 resolution — can differ between TPU and
-  CPU backends for the same data. (auc always scores on host in f64, so
-  auc-driven stopping is backend-invariant.)
+  CPU backends for the same data. Binary auc rides the binned-rank device
+  twin (round-5: auc eval/early-stop now stays on the fused dispatch path),
+  whose within-bin tie mass widens this seam to ~1/DEVICE_AUC_BINS (~2e-5)
+  on the score values; softmax auc still fetches raw scores to the f64 host
+  implementation.
 - Resume score seam: on checkpoint resume with a device backend and an
   eval_set, val predictions are reconstituted by host roundwise rescoring,
   which differs from the uninterrupted device accumulation by FMA-contraction
@@ -68,16 +71,10 @@ from ddt_tpu.utils.profiling import PhaseTimer
 
 log = logging.getLogger("ddt_tpu.driver")
 
-# Cap on rounds per fused dispatch. One block already amortizes dispatch
-# latency to nothing, so bigger buys no throughput — but an UNBOUNDED
-# block turns long configs into one multi-minute device program with
-# zero host interaction, which (a) remote-attached runtimes can kill as
-# hung (the full 500-round depth-8 Covertype config crashed the chip
-# worker as a single ~15-minute dispatch; 100-round blocks — the shape
-# every prior measurement used — run it fine), (b) starves checkpoint
-# and progress-log cadence. 100 rounds ~ 1-2 device-minutes at the
-# deepest shipped config.
-FUSED_BLOCK_ROUNDS = 100
+# The cap on rounds per fused dispatch is cfg.fused_block_rounds — a
+# config field (not a constant) because it encodes a remote-runtime
+# watchdog interaction that varies by deployment; rationale in
+# TrainConfig's field docstring.
 
 
 def _traverse_one(
@@ -140,16 +137,15 @@ class Driver:
         self.timer = PhaseTimer() if profile else None
 
     def _draw_colsample_mask(self, rnd: int, c: int, F: int) -> np.ndarray:
-        """The per-(seed, round, class) colsample feature mask — ONE home
-        for the rng tuple and the degenerate-draw rescue, because the
-        fused==granular ensemble-parity guarantee depends on both paths
-        drawing bit-identical masks."""
-        m = (np.random.default_rng(
-            (self.cfg.seed, 104729, rnd, c)).random(F)
-            < self.cfg.colsample_bytree)
-        if not m.any():                 # degenerate draw: keep 1 feature
-            m[rnd % F] = True
-        return m
+        """The per-(seed, round, class) colsample feature mask; the draw
+        itself lives in ops/sampling.colsample_mask (shared with the
+        streaming trainers) because the fused == granular == streamed
+        ensemble-parity guarantee depends on every path drawing
+        bit-identical masks."""
+        from ddt_tpu.ops.sampling import colsample_mask
+
+        return colsample_mask(self.cfg.seed, rnd, c, F,
+                              self.cfg.colsample_bytree)
 
     def _psync(self, x) -> None:
         """Backend barrier on x's producer chain — only when profiling
@@ -270,7 +266,8 @@ class Driver:
         #   round's packed tree handles are applied there (eval_round), so
         #   the host never traverses the val set and the tree-fetch
         #   pipeline stays on. Only the metric crosses to host — a scalar
-        #   when its f32 device twin exists, the raw-score vector for auc.
+        #   when its f32 device twin exists (all metrics but softmax-auc),
+        #   else the raw-score vector.
         #   host (CPUDevice): incremental NumPy traversal per tree.
         metric_name = None
         val_raw = None
@@ -308,7 +305,8 @@ class Driver:
                 use_dev_eval = True
                 dev_metric = (
                     metric_name
-                    if device_metric(metric_name) is not None else None
+                    if device_metric(metric_name, n_classes=C) is not None
+                    else None
                 )
                 val_data_dev = self.backend.upload(Xb_val)
                 val_y_dev = self.backend.upload_labels(y_val)
@@ -342,24 +340,31 @@ class Driver:
             ens.default_left[slot] = tree["default_left"]
             return tree
 
-        # Stochastic training (cfg.subsample / cfg.colsample_bytree): masks
-        # are drawn host-side from per-(seed, round[, class]) generators, so
-        # they are identical on every backend/partition layout AND across
-        # checkpoint resume (no RNG stream to fast-forward).
+        # Stochastic training (cfg.subsample / cfg.colsample_bytree):
+        # bagging row masks are STATELESS counter-based draws — a pure
+        # hash of (seed, round, global row id), ops/sampling — so every
+        # path (host-drawn here, device in-scan on the fused path,
+        # per-chunk in the streaming trainers) computes the identical bit
+        # on every backend/partition layout AND across checkpoint resume.
+        # Colsample [F] feature masks stay host-drawn (KBs; same shared
+        # home, ops/sampling.colsample_mask).
         bagging = cfg.subsample < 1.0
         colsample = cfg.colsample_bytree < 1.0
 
         # Fused block path: backends exposing grow_rounds run whole blocks
         # of rounds in one device dispatch + one tree fetch (per-round
-        # dispatch latency dominates on a remote-attached chip). Only for
-        # deterministic boosting — bagging/colsample masks are host-drawn
-        # by design and profiling wants per-phase barriers. Validation
+        # dispatch latency dominates on a remote-attached chip). Validation
         # rides INSIDE the scan (grow_rounds_eval) when its metric has a
         # device twin; EARLY STOPPING rides too — the stopping rule is
         # replayed post-hoc over the block's per-round scores vector
         # (training past the stop point cannot change earlier trees, so
         # truncation gives the EXACT granular-path model; blocks are
-        # capped at the patience so overrun work is bounded).
+        # capped at the patience so overrun work is bounded). Bagging
+        # fuses since round 5 (the [K, R] row masks are no longer shipped
+        # — the backend recomputes the counter-based bits in-scan); it
+        # stays granular only when composed with eval_set, whose in-scan
+        # program does not thread round ids. Profiling always runs
+        # granular (per-phase barriers).
         fused_eval = (
             eval_set is not None
             and use_dev_eval
@@ -368,8 +373,7 @@ class Driver:
         )
         # colsample fuses too (round 3): its [K, C, F] feature masks are
         # KBs and ride the scan as xs, drawn by the SAME host rngs as the
-        # granular path so fused == granular == cross-backend. Bagging's
-        # [K, R] row masks stay granular (too big to ship per block).
+        # granular path so fused == granular == cross-backend.
         fused_masked = (
             colsample
             and eval_set is None
@@ -380,7 +384,7 @@ class Driver:
             getattr(self.backend, "grow_rounds", None) is not None
             and (eval_set is None or fused_eval)
             and self.timer is None
-            and not bagging
+            and (not bagging or eval_set is None)
             and (not colsample or fused_masked)
         ):
             eval_state = None
@@ -400,10 +404,9 @@ class Driver:
                 g, h = self.backend.grad_hess(pred, y_dev)
                 self._psync(h)
             if bagging:
-                rmask = (
-                    np.random.default_rng((cfg.seed, 7919, rnd)).random(R)
-                    < cfg.subsample
-                )
+                from ddt_tpu.ops.sampling import row_keep_np
+
+                rmask = row_keep_np(cfg.seed, rnd, 0, R, cfg.subsample)
                 g, h = self.backend.apply_row_mask(g, h, rmask)
             for c in range(C):
                 gc = g[:, c] if C > 1 else g
@@ -545,7 +548,7 @@ class Driver:
             best = -np.inf
         rnd = start_round
         while rnd < cfg.n_trees:
-            K = min(cfg.n_trees - rnd, FUSED_BLOCK_ROUNDS)
+            K = min(cfg.n_trees - rnd, cfg.fused_block_rounds)
             if self.checkpoint_dir is not None:
                 nxt = (rnd // self.checkpoint_every + 1) * \
                     self.checkpoint_every
@@ -567,10 +570,10 @@ class Driver:
                         fmasks[k, c] = self._draw_colsample_mask(
                             rnd + k, c, F)
                 trees_h, pred, losses_h = self.backend.grow_rounds_masked(
-                    data, pred, y_dev, K, fmasks)
+                    data, pred, y_dev, K, fmasks, first_round=rnd)
             else:
                 trees_h, pred, losses_h = self.backend.grow_rounds(
-                    data, pred, y_dev, K)
+                    data, pred, y_dev, K, first_round=rnd)
             trees = np.asarray(trees_h)         # [K, C, 5, N] — ONE fetch
             losses = np.asarray(losses_h)
             dt = time.perf_counter() - t0

@@ -25,16 +25,31 @@ BinMapper). Categorical one-vs-rest splits export as LightGBM categorical
 nodes (decision_type bit 0): the node's `threshold` is an index into
 `cat_boundaries`, which offsets into the `cat_threshold` uint32 bitset
 array; a value v routes LEFT when bit v is set. One-vs-rest means every
-exported bitset has exactly ONE bit set (the matched category). The
-re-parser accepts only such single-bit bitsets — a real LightGBM model
-with multi-category bitsets has no TreeEnsemble representation (split
-type here derives from the feature, with one matched category per node)
-and raises. NaN handling on cat nodes mirrors ordinal nodes (missing
-type NaN + per-node default direction) — that matches this repo's
-traversal, not LightGBM's own NaN-in-categorical convention.
+exported bitset has exactly ONE bit set (the matched category). NaN
+handling on cat nodes mirrors ordinal nodes (missing type NaN + per-node
+default direction) — that matches this repo's traversal, not LightGBM's
+own NaN-in-categorical convention (to_lightgbm_text warns when a model
+mixes the two, so users don't assume cross-tool NaN parity on cat
+splits).
+
+Import breadth (round-5): the re-parser ALSO accepts multi-bit bitsets —
+the externally-trained-LightGBM case (a real LightGBM categorical split
+sends a SET of categories left). A k-bit set is expanded into a chain of
+k one-vs-rest nodes: each chain link tests one member category (matched
+goes LEFT into a copy of the original left subtree); the last link's
+right child is the original right subtree. Routing is exactly equivalent
+— including NaN rows, which follow the node's default direction at every
+link (default-left exits into the left subtree at link 0; default-right
+falls through the whole chain into the right subtree). Costs: tree depth
+grows by k-1 per multi-bit node (the heap overflows past depth 30 and
+raises, naming the node), the left subtree is materialised k times, and
+split_gain is recorded on the first link only (0 on the rest) so
+gain-sum feature importances are preserved.
 """
 
 from __future__ import annotations
+
+import warnings
 
 import numpy as np
 
@@ -88,6 +103,16 @@ def to_lightgbm_text(ens: TreeEnsemble,
         "",
     ]
     use_missing = ens.missing_bin and ens.default_left is not None
+    if use_missing and cat_set:
+        warnings.warn(
+            "exporting a model with BOTH learned NaN default directions "
+            "and categorical splits: this repo routes NaN on categorical "
+            "nodes by the per-node default direction, which differs from "
+            "LightGBM's own NaN-in-categorical convention — the exported "
+            "model scores NaN rows differently when loaded into real "
+            "LightGBM (module docstring, 'NaN handling')",
+            stacklevel=2,
+        )
     for t in range(ens.n_trees):
         # Pre-order walk of the heap: internal nodes and leaves numbered
         # in encounter order (root = internal 0, LightGBM's convention).
@@ -186,9 +211,12 @@ def _parse_block(lines: list[str], i: int) -> tuple[dict, int]:
 def from_lightgbm_text(text: str) -> TreeEnsemble:
     """Parse a LightGBM model.txt back into a TreeEnsemble (heap layout).
 
-    Supports what to_lightgbm_text writes: numerical splits, optional
-    NaN-missing default directions. Trees deeper than 30 levels would
-    overflow the heap and raise."""
+    Supports what to_lightgbm_text writes (numerical splits, single-bit
+    categorical nodes, optional NaN-missing default directions) PLUS
+    externally-trained models with multi-category bitsets, which expand
+    into equivalent one-vs-rest chains (module docstring, 'Import
+    breadth'). Trees deeper than 30 levels after chain expansion overflow
+    the heap and raise."""
     lines = text.splitlines()
     head, i = _parse_block(lines, 0)
     n_features = int(head["max_feature_idx"]) + 1
@@ -205,8 +233,56 @@ def from_lightgbm_text(text: str) -> TreeEnsemble:
         blk, i = _parse_block(lines, i)
         trees.append(blk)
 
-    # Depth of each parsed tree (longest root->leaf path).
-    def depth_of(blk) -> int:
+    # Per-internal-node category-bit lists (None for numerical nodes),
+    # parsed ONCE per tree: both the depth computation and the placement
+    # need them — a k-bit categorical set expands into a k-link chain, so
+    # it contributes k levels of depth where a numerical node adds 1.
+    def bits_of(blk, t: int) -> list:
+        if int(blk["num_leaves"]) == 1:
+            return []
+        sf = [int(v) for v in blk["split_feature"].split()]
+        th = [float(v) for v in blk["threshold"].split()]
+        dt = [int(float(v)) for v in blk["decision_type"].split()]
+        cb = ct = None
+        if int(blk.get("num_cat", "0")) != 0:
+            cb = [int(v) for v in blk["cat_boundaries"].split()]
+            ct = [int(v) for v in blk["cat_threshold"].split()]
+        out: list = []
+        for ref in range(len(sf)):
+            if not (dt[ref] & _CATEGORICAL):
+                out.append(None)
+                continue
+            if cb is None:
+                # Malformed/foreign input: categorical decision_type bit
+                # set but the tree block carries no bitset arrays. Fail
+                # loudly like the other validation paths (a None subscript
+                # would raise an opaque TypeError here otherwise).
+                raise ValueError(
+                    f"tree {t} node {ref}: categorical decision_type but "
+                    "num_cat=0 (no cat_boundaries/cat_threshold arrays)"
+                )
+            cat_idx = int(th[ref])
+            words = ct[cb[cat_idx]:cb[cat_idx + 1]]
+            bits = [w * 32 + b for w, word in enumerate(words)
+                    for b in range(32) if word >> b & 1]
+            if not bits and (dt[ref] >> 2) == 2 and dt[ref] & _DEFAULT_LEFT:
+                # Empty bitset + NaN-missing + default-LEFT: no category
+                # matches, but NaN rows still exit into the LEFT subtree,
+                # so the node cannot collapse away. Emit one match-nothing
+                # link (sentinel category -1: LightGBM category values are
+                # non-negative, so no real value ever equals it) whose
+                # default_left carries the NaN route.
+                bits = [-1]
+            out.append(bits)
+        return out
+
+    tree_bits = [bits_of(b, t) for t, b in enumerate(trees)]
+
+    # Depth of each parsed tree (longest root->leaf path), counting each
+    # k-bit categorical node as the k levels its expansion chain occupies
+    # (an all-rows-right empty bitset collapses to its RIGHT subtree:
+    # 0 levels, and the dropped left subtree contributes no depth).
+    def depth_of(blk, bits) -> int:
         if int(blk["num_leaves"]) == 1:
             return 0
         lc = [int(v) for v in blk["left_child"].split()]
@@ -215,12 +291,39 @@ def from_lightgbm_text(text: str) -> TreeEnsemble:
         def d(ref: int) -> int:
             if ref < 0:
                 return 0
-            return 1 + max(d(lc[ref]), d(rc[ref]))
-        return 1 + max(d(lc[0]), d(rc[0]))
+            b = bits[ref]
+            if b is None:                      # numerical node
+                return 1 + max(d(lc[ref]), d(rc[ref]))
+            if not b:                          # collapsed empty bitset
+                return d(rc[ref])
+            return len(b) + max(d(lc[ref]), d(rc[ref]))
+        return d(0)
 
-    max_depth = max(1, max(depth_of(b) for b in trees))
+    max_depth = max(1, max(depth_of(b, bi)
+                           for b, bi in zip(trees, tree_bits)))
     if max_depth > 30:
-        raise ValueError(f"tree depth {max_depth} overflows the heap layout")
+        raise ValueError(
+            f"tree depth {max_depth} (after multi-category chain "
+            "expansion) overflows the heap layout")
+    # The heap is DENSE and its depth is GLOBAL: one k-category set deep
+    # in one tree adds k-1 levels to EVERY tree's 2^(D+1)-1 node arrays.
+    # Real LightGBM categorical splits routinely carry dozens of
+    # categories, where the expansion allocates astronomically — fail
+    # with the cause and the number, not a MemoryError from np.full.
+    # 2^27 total nodes ~ 2.3 GB across the seven node arrays.
+    total_nodes = len(trees) * (2 ** (max_depth + 1) - 1)
+    if total_nodes > 2 ** 27:
+        widest = max((len(b) for bi in tree_bits
+                      for b in bi if b is not None), default=1)
+        raise ValueError(
+            f"multi-category chain expansion needs depth {max_depth} "
+            f"across {len(trees)} trees = {total_nodes} heap nodes "
+            f"(> 2^27): the dense heap layout cannot hold this model "
+            f"(widest category set: {widest} bits). Models with large "
+            "categorical sets are unrepresentable here; score them with "
+            "LightGBM itself, or retrain with "
+            "cat_features one-vs-rest splits"
+        )
     n_nodes = 2 ** (max_depth + 1) - 1
     T = len(trees)
     feature = np.full((T, n_nodes), -1, np.int32)
@@ -235,10 +338,7 @@ def from_lightgbm_text(text: str) -> TreeEnsemble:
     ord_feats: set[int] = set()    # features with numerical nodes
 
     for t, blk in enumerate(trees):
-        cb = ct = None
-        if int(blk.get("num_cat", "0")) != 0:
-            cb = [int(v) for v in blk["cat_boundaries"].split()]
-            ct = [int(v) for v in blk["cat_threshold"].split()]
+        bits_t = tree_bits[t]
         lv = [float(v) for v in blk["leaf_value"].split()]
         if int(blk["num_leaves"]) == 1:
             is_leaf[t, 0] = True
@@ -251,39 +351,59 @@ def from_lightgbm_text(text: str) -> TreeEnsemble:
         lc = [int(v) for v in blk["left_child"].split()]
         rc = [int(v) for v in blk["right_child"].split()]
 
-        def place(ref: int, slot: int) -> None:
+        def place(ref: int, slot: int, dup: bool = False) -> None:
+            # `dup`: this subtree is a repeated COPY made by chain
+            # expansion — its split gains are zeroed so gain-sum feature
+            # importances count each original split exactly once.
             nonlocal any_missing
             if ref < 0:
                 is_leaf[t, slot] = True
                 leaf_value[t, slot] = lv[~ref]
                 return
-            feature[t, slot] = sf[ref]
-            split_gain[t, slot] = sg[ref]
-            if dt[ref] & _CATEGORICAL:
-                # Bitset -> the single matched category (one-vs-rest).
-                cat_idx = int(th[ref])
-                words = ct[cb[cat_idx]:cb[cat_idx + 1]]
-                bits = [w * 32 + b for w, word in enumerate(words)
-                        for b in range(32) if word >> b & 1]
-                if len(bits) != 1:
-                    raise ValueError(
-                        f"categorical node with {len(bits)} set bits: only "
-                        "one-vs-rest (single-category) bitsets have a "
-                        "TreeEnsemble representation"
-                    )
-                cat_feats.add(sf[ref])
+            bits = bits_t[ref]
+            miss = (dt[ref] >> 2) == 2         # NaN missing type
+            if miss:
+                any_missing = True
+            if bits is None:                   # numerical split
+                ord_feats.add(sf[ref])
+                feature[t, slot] = sf[ref]
+                split_gain[t, slot] = 0.0 if dup else sg[ref]
+                threshold_raw[t, slot] = th[ref]
+                if miss:
+                    default_left[t, slot] = bool(dt[ref] & _DEFAULT_LEFT)
+                place(lc[ref], 2 * slot + 1, dup)
+                place(rc[ref], 2 * slot + 2, dup)
+                return
+            if not bits:
+                # Empty bitset reaching here means no category matches
+                # AND NaN routes right too (default-right, or no missing
+                # handling) — bits_of keeps a sentinel link otherwise —
+                # so the node collapses to its right subtree; the no-op
+                # split's gain vanishes with it.
+                place(rc[ref], slot, dup)
+                return
+            # Categorical set -> a chain of one-vs-rest links: link j
+            # tests bits[j] (matched goes LEFT into a copy of the left
+            # subtree); the last link's right child is the right subtree.
+            # NaN rows follow the node's default direction at EVERY link,
+            # so default-left exits left at link 0 and default-right
+            # falls through the chain — exactly the un-expanded routing.
+            cat_feats.add(sf[ref])
+            cur = slot
+            for j, b in enumerate(bits):
+                feature[t, cur] = sf[ref]
+                # Gain on the first link only (same once-per-split rule).
+                split_gain[t, cur] = 0.0 if dup or j > 0 else sg[ref]
                 # Cat columns hold category ids in BOTH representations,
                 # so bin and raw thresholds coincide.
-                threshold_bin[t, slot] = bits[0]
-                threshold_raw[t, slot] = float(bits[0])
-            else:
-                ord_feats.add(sf[ref])
-                threshold_raw[t, slot] = th[ref]
-            if (dt[ref] >> 2) == 2:            # NaN missing type
-                any_missing = True
-                default_left[t, slot] = bool(dt[ref] & _DEFAULT_LEFT)
-            place(lc[ref], 2 * slot + 1)
-            place(rc[ref], 2 * slot + 2)
+                threshold_bin[t, cur] = b
+                threshold_raw[t, cur] = float(b)
+                if miss:
+                    default_left[t, cur] = bool(dt[ref] & _DEFAULT_LEFT)
+                place(lc[ref], 2 * cur + 1, dup or j > 0)
+                if j < len(bits) - 1:
+                    cur = 2 * cur + 2
+            place(rc[ref], 2 * cur + 2, dup)
 
         place(0, 0)
 

@@ -1,0 +1,138 @@
+"""Stateless counter-based sampling masks (round-4 verdict item 2).
+
+Bagging (cfg.subsample) keeps a row in a boosting round by a pure
+function of (seed, round, GLOBAL row id) — a counter-based hash, no RNG
+stream to carry or fast-forward and no O(R) mask to ship. Every trainer
+computes the identical bit for a row wherever that row lives:
+
+- the granular Driver draws the mask host-side (`row_keep_np`) and
+  applies it via backend.apply_row_mask (any backend);
+- the fused TPU path computes it IN-SCAN on device (`row_keep_jax`) —
+  the [K, R] mask-shipping exclusion that kept bagging off the fused
+  dispatch path is gone, the mask is (re)computed where it is used;
+- the streaming trainers compute it per chunk from the chunk's global
+  row offset, O(chunk) — which is what lets fit_streaming support the
+  bagging configs it used to reject (10B-row runs are exactly where
+  bagging is standard practice).
+
+The two twins produce bit-identical uint32 streams (tested in
+tests/test_sampling.py), so bagged training keeps the same
+cross-backend / cross-path ensemble-identity contract as deterministic
+training. Row ids are 64-bit (the 10B-row config overflows uint32);
+devices without x64 carry them as (hi, lo) uint32 pairs.
+
+Hash: the 'lowbias32' integer finalizer (a public-domain, statistically
+tested 16-bit-shift/multiply permutation of uint32) applied to the row
+id words, keyed per (seed, round). The top 24 bits form the uniform —
+exactly representable in f32, so the `< subsample` compare is exact and
+platform-invariant.
+
+colsample_bytree stays host-drawn (`colsample_mask` below): its [F]
+masks are KBs, every path already ships them, and this module is their
+single home (including the degenerate-draw rescue) so fused == granular
+== streamed draws stay bit-identical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_M1 = 0x7FEB352D
+_M2 = 0x846CA68B
+_GOLD = 0x9E3779B9
+_KEY2 = 0x85EBCA6B
+
+
+def _mix32_host(x: int) -> int:
+    """lowbias32 on a python int (mod 2^32) — the scalar key path."""
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * _M1) & 0xFFFFFFFF
+    x ^= x >> 15
+    x = (x * _M2) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x
+
+
+def round_key(seed: int, rnd: int) -> int:
+    """Per-(seed, round) 32-bit key, computed with python ints so both
+    twins (and any future one) can reproduce it exactly."""
+    k = _mix32_host((seed & 0xFFFFFFFF) ^ _GOLD)
+    return _mix32_host(k ^ ((rnd * _KEY2) & 0xFFFFFFFF))
+
+
+def _mix32_np(x: np.ndarray) -> np.ndarray:
+    x = x.astype(np.uint32)
+    x ^= x >> np.uint32(16)
+    x = x * np.uint32(_M1)
+    x ^= x >> np.uint32(15)
+    x = x * np.uint32(_M2)
+    x ^= x >> np.uint32(16)
+    return x
+
+
+def row_keep_np(seed: int, rnd: int, row_start: int, n: int,
+                subsample: float) -> np.ndarray:
+    """bool [n]: keep bits for global rows [row_start, row_start + n)."""
+    ids = np.arange(row_start, row_start + n, dtype=np.uint64)
+    lo = (ids & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (ids >> np.uint64(32)).astype(np.uint32)
+    key = np.uint32(round_key(seed, rnd))
+    bits = _mix32_np(lo ^ _mix32_np(hi ^ key))
+    u = (bits >> np.uint32(8)).astype(np.float32) * np.float32(2.0 ** -24)
+    return u < np.float32(subsample)
+
+
+def row_keep_jax(rnd, local_offset, n: int, *, seed: int,
+                 subsample: float, row_start_lo=None, row_start_hi=None):
+    """f32 [n] 0/1 keep mask, traceable under jit/shard_map — the device
+    twin of row_keep_np (bit-identical by construction).
+
+    `rnd` and `local_offset` are traced int32 scalars (`local_offset` =
+    this shard's first row within the padded global batch, typically
+    flat_shard_index * local_rows — pad rows get ids too, but their
+    valid-weight is 0 so the wasted bits are inert). `row_start_lo/hi`
+    (traced uint32 scalars) carry a 64-bit chunk base for the streaming
+    trainer; None means base 0. Key derivation mirrors round_key()
+    exactly, in uint32 ops."""
+    import jax.numpy as jnp
+
+    rnd32 = rnd.astype(jnp.uint32) if hasattr(rnd, "astype") else \
+        jnp.uint32(rnd)
+
+    def mix(x):
+        x ^= x >> 16
+        x = x * jnp.uint32(_M1)
+        x ^= x >> 15
+        x = x * jnp.uint32(_M2)
+        x ^= x >> 16
+        return x
+
+    key = mix(jnp.uint32((seed & 0xFFFFFFFF) ^ _GOLD))
+    key = mix(key ^ (rnd32 * jnp.uint32(_KEY2)))
+    loc = (jnp.arange(n, dtype=jnp.uint32)
+           + jnp.uint32(local_offset))          # < 2^31: never wraps
+    if row_start_lo is None:
+        lo = loc
+        hi = jnp.zeros((), jnp.uint32)
+    else:
+        base_lo = jnp.uint32(row_start_lo)
+        lo = base_lo + loc
+        carry = (lo < base_lo).astype(jnp.uint32)   # loc < 2^31 => exact
+        hi = jnp.uint32(row_start_hi) + carry
+    bits = mix(lo ^ mix(hi ^ key))
+    u = (bits >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+    return (u < jnp.float32(subsample)).astype(jnp.float32)
+
+
+def colsample_mask(seed: int, rnd: int, c: int, F: int,
+                   colsample_bytree: float) -> np.ndarray:
+    """The per-(seed, round, class) colsample feature mask — ONE home for
+    the rng tuple and the degenerate-draw rescue, because the fused ==
+    granular == streamed ensemble-parity guarantee depends on every path
+    drawing bit-identical masks."""
+    m = (np.random.default_rng(
+        (seed, 104729, rnd, c)).random(F) < colsample_bytree)
+    if not m.any():                 # degenerate draw: keep 1 feature
+        m[rnd % F] = True
+    return m

@@ -158,11 +158,14 @@ def _apply_level_splits(
     leaf_value: np.ndarray,
     split_gain: np.ndarray,
     default_left: np.ndarray | None = None,
+    feature_mask: np.ndarray | None = None,
 ) -> None:
     """Level-`depth` split decisions from the accumulated histogram,
     written into the node arrays in place. The SINGLE home of the
     streamed split rule — both the host and device loops call this, so
-    host/device bit-identity cannot drift."""
+    host/device bit-identity cannot drift. `feature_mask` is the round's
+    colsample mask (ops/sampling.colsample_mask — the identical rule the
+    Driver applies inside grow: masked features never win the argmax)."""
     from ddt_tpu.reference.numpy_trainer import best_splits, node_totals
 
     n_level = 1 << depth
@@ -174,6 +177,7 @@ def _apply_level_splits(
         cat_mask[list(cfg.cat_features)] = True
     gains, feats, bins, dls = best_splits(
         hist, cfg.reg_lambda, cfg.min_child_weight,
+        feature_mask=feature_mask,
         missing_bin=cfg.missing_policy == "learn", cat_mask=cat_mask)
     with np.errstate(divide="ignore", invalid="ignore"):   # empty nodes
         value = np.where(H > 0, -G / (H + cfg.reg_lambda), 0.0).astype(
@@ -310,8 +314,17 @@ class _DeviceChunkCache:
             return h
         Xc = np.asarray(self._chunk_fn(c)[0])
         h = self._backend.upload(Xc)
-        if Xc.nbytes <= self._budget[0]:
-            self._budget[0] -= Xc.nbytes
+        # Budget accounting uses the handle's ACTUAL per-process device
+        # footprint (upload pads rows to the shard count and uneven chunk
+        # sizes pad differently, so host-side Xc.nbytes undercounts).
+        # Summing addressable shards is per-process by construction —
+        # exactly what a per-process HBM budget should track.
+        try:
+            nbytes = sum(s.data.nbytes for s in h.addressable_shards)
+        except (AttributeError, TypeError):
+            nbytes = Xc.nbytes      # host-array backends: no shard view
+        if nbytes <= self._budget[0]:
+            self._budget[0] -= nbytes
             self._cached[c] = h
         return h
 
@@ -360,6 +373,15 @@ def fit_streaming(
     pick the other candidate (~1 node per 160k, measured; ops/split.py
     "Determinism boundary", chunked-accumulation paragraph).
 
+    Sampling configs stream too (round-4 verdict item 2): bagging keeps
+    a row by the stateless counter-based hash of (seed, round, GLOBAL
+    row id) — ops/sampling — computed per chunk from the chunk's row
+    offset (O(chunk), on device on the device path), and colsample draws
+    the same per-(round, class) host masks as the Driver, applied at the
+    shared split-selection home (_apply_level_splits). Both therefore
+    grow the in-memory Driver's exact trees, same contract (and same
+    bf16-boundary-tie seam) as deterministic streaming.
+
     `device_chunk_cache` (device backends only): True caches uploaded
     binned chunks in device memory up to DEVICE_CHUNK_CACHE_BYTES —
     but only when the device has memory of its own (on a CPU-platform
@@ -373,19 +395,6 @@ def fit_streaming(
     (max_depth + 1) times per tree. Host memory stays O(chunk); device
     memory grows to min(dataset, budget).
     """
-    if cfg.subsample < 1.0 or cfg.colsample_bytree < 1.0:
-        # Sampling masks are host-drawn per round over the FULL row/
-        # column index space (driver.py) — incompatible with O(chunk)
-        # streaming by design. Silently training unsampled would diverge
-        # from Driver.fit on the same config; fail at the cause (the CLI
-        # has always rejected this combination, the library path must
-        # too — round-4 streaming fuzz caught the gap).
-        raise ValueError(
-            f"fit_streaming does not support row/column sampling "
-            f"(subsample={cfg.subsample}, colsample_bytree="
-            f"{cfg.colsample_bytree}); use the in-memory Driver for "
-            "bagging configs"
-        )
     if backend is None:
         from ddt_tpu.backends import get_backend
 
@@ -419,6 +428,10 @@ def fit_streaming(
         chunk_lens.append(len(yc))
         if device:
             y_dev.append(backend.upload_labels(np.asarray(yc)))
+    # Global row offset per chunk — the bagging hash is a function of a
+    # row's GLOBAL id, so chunk boundaries cannot change the masks.
+    chunk_starts = np.concatenate(
+        [[0], np.cumsum(chunk_lens)]).astype(np.int64)
     mean = y_sum / max(1, y_cnt)
     if cfg.loss == "logloss":
         p_ = float(np.clip(mean, 1e-6, 1 - 1e-6))
@@ -469,6 +482,7 @@ def fit_streaming(
     if device:
         return _fit_streaming_device(
             chunk_fn, n_chunks, cfg, backend, ens, bs, C, y_dev,
+            chunk_starts,
             start_round=start_round, checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every, ev=ev,
             device_chunk_cache=device_chunk_cache)
@@ -521,11 +535,26 @@ def fit_streaming(
             )
             g, h = grad_hess(pred_c, np.asarray(yc), cfg.loss)
             if g.ndim == 2:
-                return g[:, cls], h[:, cls]
+                g, h = g[:, cls], h[:, cls]
+            if cfg.subsample < 1.0:
+                from ddt_tpu.ops.sampling import row_keep_np
+
+                keep = row_keep_np(cfg.seed, rnd, int(chunk_starts[c]),
+                                   len(yc), cfg.subsample)
+                g, h = g * keep, h * keep
             return g, h
+
+        def colsample_mask_for(cls: int):
+            if cfg.colsample_bytree >= 1.0:
+                return None
+            from ddt_tpu.ops.sampling import colsample_mask
+
+            return colsample_mask(cfg.seed, rnd, cls, F,
+                                  cfg.colsample_bytree)
 
         round_trees = []
         for cls in range(C):
+            fmask = colsample_mask_for(cls)
             # Grow one tree level-by-level; histograms accumulate across
             # chunks.
             feature = np.full(cfg.n_nodes_total, -1, np.int32)
@@ -555,7 +584,8 @@ def fit_streaming(
                     hist = part if hist is None else hist + part
                 _apply_level_splits(hist, cfg, depth, feature,
                                     threshold_bin, is_leaf, leaf_value,
-                                    split_gain, default_left)
+                                    split_gain, default_left,
+                                    feature_mask=fmask)
 
             # Final level: per-terminal (G, H) aggregates streamed the
             # same way.
@@ -648,6 +678,7 @@ def _fit_streaming_device(
     bs: float,
     C: int,
     y_dev: list,
+    chunk_starts: np.ndarray,
     start_round: int = 0,
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 25,
@@ -720,17 +751,19 @@ def _fit_streaming_device(
         if ev is not None:
             _replay(val_pred, val_chunks, ev.n)
 
-    def passes(tree, depth, kind, class_idx):
+    def passes(tree, depth, kind, class_idx, rnd):
         """One full pass over the chunks; yields per-chunk device outputs
         with the next read/upload already in flight."""
         data = chunks.get(0)
         for c in range(n_chunks):
             if kind == "hist":
                 out = backend.stream_level_hist(
-                    data, pred_dev[c], y_dev[c], tree, depth, class_idx)
+                    data, pred_dev[c], y_dev[c], tree, depth, class_idx,
+                    rnd=rnd, row_start=int(chunk_starts[c]))
             else:
                 out = backend.stream_leaf_gh(
-                    data, pred_dev[c], y_dev[c], tree, depth, class_idx)
+                    data, pred_dev[c], y_dev[c], tree, depth, class_idx,
+                    rnd=rnd, row_start=int(chunk_starts[c]))
             if c + 1 < n_chunks:        # prefetch: overlap H2D with compute
                 data = chunks.get(c + 1)
             yield np.asarray(out)       # fetch (device likely done by now)
@@ -750,6 +783,13 @@ def _fit_streaming_device(
         # deferred to the fused round-start pass.
         round_trees = []
         for cls in range(C):
+            fmask = None
+            if cfg.colsample_bytree < 1.0:
+                from ddt_tpu.ops.sampling import colsample_mask
+
+                fmask = colsample_mask(cfg.seed, rnd, cls,
+                                       ens.n_features,
+                                       cfg.colsample_bytree)
             feature = np.full(cfg.n_nodes_total, -1, np.int32)
             threshold_bin = np.zeros(cfg.n_nodes_total, np.int32)
             is_leaf = np.zeros(cfg.n_nodes_total, bool)
@@ -763,25 +803,28 @@ def _fit_streaming_device(
                 if depth == 0 and cls == 0 and prev_trees is not None:
                     # Fused round-start: apply the previous round's trees
                     # to the resident preds AND build this tree's depth-0
-                    # histogram in one dispatch per chunk.
+                    # histogram (the NEW round's bagging mask) in one
+                    # dispatch per chunk.
                     data = chunks.get(0)
                     for c in range(n_chunks):
                         pred_dev[c], out = backend.stream_round_start(
-                            data, pred_dev[c], y_dev[c], prev_trees)
+                            data, pred_dev[c], y_dev[c], prev_trees,
+                            rnd=rnd, row_start=int(chunk_starts[c]))
                         if c + 1 < n_chunks:
                             data = chunks.get(c + 1)
                         part = np.asarray(out)
                         hist = part if hist is None else hist + part
                 else:
-                    for part in passes(tree, depth, "hist", cls):
+                    for part in passes(tree, depth, "hist", cls, rnd):
                         hist = part if hist is None else hist + part
                 _apply_level_splits(hist, cfg, depth, feature,
                                     threshold_bin, is_leaf, leaf_value,
-                                    split_gain, default_left)
+                                    split_gain, default_left,
+                                    feature_mask=fmask)
 
             # Final level: streamed (G, H) aggregates.
             GH = None
-            for part in passes(tree, cfg.max_depth, "leaf", cls):
+            for part in passes(tree, cfg.max_depth, "leaf", cls, rnd):
                 GH = part if GH is None else GH + part
             _apply_final_leaves(GH[:, 0], GH[:, 1], cfg, is_leaf,
                                 leaf_value)

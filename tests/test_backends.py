@@ -221,25 +221,24 @@ def test_api_predict_accepts_model_bundle(tmp_path):
     np.testing.assert_array_equal(got, want)
 
 
-def test_fused_block_cap_multi_block_identity(monkeypatch):
+@pytest.mark.parametrize("block_rounds", [3, 4])
+def test_fused_block_cap_multi_block_identity(block_rounds):
     """Long configs split into multiple fused dispatches
-    (driver.FUSED_BLOCK_ROUNDS caps single-dispatch runtime — an
+    (cfg.fused_block_rounds caps single-dispatch runtime — an
     unbounded 500-round block crashed the remote chip worker in round
     4). Block boundaries must not change results: a 10-round run forced
-    through 3-round blocks equals the single-block run and the CPU
-    oracle exactly."""
-    from ddt_tpu import driver as driver_mod
-
+    through small blocks (both even and uneven final blocks) equals the
+    single-block run and the CPU oracle exactly."""
     Xb, y, _ = _small_problem()
 
-    def fit(backend):
+    def fit(backend, fused_block_rounds=100):
         cfg = TrainConfig(n_trees=10, max_depth=4, n_bins=31,
-                          backend=backend)
+                          backend=backend,
+                          fused_block_rounds=fused_block_rounds)
         return Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
 
     one_block = fit("tpu")
-    monkeypatch.setattr(driver_mod, "FUSED_BLOCK_ROUNDS", 3)
-    multi_block = fit("tpu")
+    multi_block = fit("tpu", fused_block_rounds=block_rounds)
     cpu = fit("cpu")
     for k in ("feature", "threshold_bin", "is_leaf", "leaf_value",
               "split_gain", "default_left"):

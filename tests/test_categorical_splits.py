@@ -333,4 +333,8 @@ def test_cat_eval_set_device_path():
     last = d.history[-1]
     part = ens.truncate(last["round"])
     want = evaluate("auc", y[3000:], part.predict_raw(Xb[3000:], binned=True))
-    np.testing.assert_allclose(last["valid_auc"], want, rtol=1e-6)
+    # The recorded score now comes from the binned-rank DEVICE auc twin
+    # (round 5 - auc rides the fused path); 5e-5 is its documented
+    # within-bin tie tolerance vs the f64 host auc
+    # (utils/metrics.DEVICE_AUC_BINS).
+    np.testing.assert_allclose(last["valid_auc"], want, atol=5e-5)

@@ -1,6 +1,7 @@
 """CLI round-trips (layer L8) on the CPU platform."""
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -206,8 +207,9 @@ def test_cli_config_file(tmp_path, capsys):
 
 def test_cli_config_file_syncs_pipeline_fields(tmp_path, capsys):
     """File-set fields that feed dataset loading / guards apply BEFORE the
-    load: backend is reported truthfully, and file-set bagging is rejected
-    by the streaming guard just like the flag form."""
+    load: backend is reported truthfully, and file-set bagging streams
+    (round 5 — the old streaming-vs-sampling rejection is gone; the
+    counter-based masks made the combination exact)."""
     js = tmp_path / "c.json"
     js.write_text('{"backend": "cpu", "n_trees": 3, "seed": 7}')
     model = str(tmp_path / "m.npz")
@@ -218,7 +220,15 @@ def test_cli_config_file_syncs_pipeline_fields(tmp_path, capsys):
     assert rec["backend"] == "cpu"      # the file's backend, not the flag
 
     bag = tmp_path / "bag.yaml"
-    bag.write_text("subsample: 0.5\n")
-    with pytest.raises(SystemExit, match="subsample"):
+    bag.write_text("subsample: 0.5\nn_trees: 3\n")
+    model2 = str(tmp_path / "bagged.npz")
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--rows=800", "--bins=31",
+        "--stream-chunks=2", f"--config={bag}", f"--out={model2}",
+    ])
+    assert rec["streamed_chunks"] == 2
+    assert os.path.exists(model2)
+    # --profile remains in-memory-only
+    with pytest.raises(SystemExit, match="profile"):
         main(["train", "--backend=cpu", "--rows=800", "--bins=31",
-              "--stream-chunks=2", f"--config={bag}"])
+              "--stream-chunks=2", "--profile"])

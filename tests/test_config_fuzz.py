@@ -15,6 +15,7 @@ from ddt_tpu.data.categorical import fit_categorical_encoder
 from ddt_tpu.data.datasets import synthetic_binary, synthetic_multiclass
 from ddt_tpu.data.quantizer import fit_bin_mapper
 from ddt_tpu.driver import Driver
+from tree_compare import assert_trees_match_mod_ties
 
 
 def _random_case(rng):
@@ -204,66 +205,6 @@ def test_random_model_predict_paths_agree(trial):
                                    err_msg=name)
 
 
-def _assert_trees_match_mod_ties(full, streamed, min_split_gain):
-    """Bitwise tree equality, except provable f32-order boundary ties.
-
-    Streamed training accumulates per-chunk histogram partials on host;
-    the in-memory path sums once on device. The summation TREES differ,
-    so where a decision's competing quantities land within ~1 bfloat16
-    ULP of each other the rounded comparison can legitimately go either
-    way — the same seam as cross-platform (MXU order) and cross-process
-    (gloo order), measured by the round-4 fuzz campaigns at ~1 root-cause
-    node per 160k (seed 197: candidate gains 0.00102997 vs 0.00102234).
-
-    The checkable contract, enforced per tree by walking the heap from
-    the root and PRUNING each divergent subtree:
-      - every node whose ancestors all matched must either match
-        bitwise in its decision (feature, threshold_bin, is_leaf; leaf
-        values to float tolerance, gains to bf16 tolerance), or be a
-        PROVABLE tie: competing gains within 2 bf16 ULPs (cross-feature
-        or cross-bin flip), or a gain within 2 ULPs of min_split_gain
-        (split-vs-leaf flip at the floor);
-      - descendants of a flipped decision legitimately diverge and are
-        excluded (different rows reach them);
-      - root causes stay rare (they are measured to be)."""
-    TIE = 2 ** -6                     # 2 bf16 ULPs, relative
-    T, N = full.feature.shape
-    n_root_causes = 0
-    for t in range(T):
-        queue = [0]
-        while queue:
-            s_ = queue.pop()
-            fa, fb = int(full.feature[t, s_]), int(streamed.feature[t, s_])
-            ba = int(full.threshold_bin[t, s_])
-            bb = int(streamed.threshold_bin[t, s_])
-            la = bool(full.is_leaf[t, s_])
-            lb = bool(streamed.is_leaf[t, s_])
-            ga = float(full.split_gain[t, s_])
-            gb = float(streamed.split_gain[t, s_])
-            if (fa, ba, la) == (fb, bb, lb):
-                np.testing.assert_allclose(
-                    full.leaf_value[t, s_], streamed.leaf_value[t, s_],
-                    rtol=2e-4, atol=2e-5, err_msg=f"tree {t} slot {s_}")
-                assert abs(ga - gb) <= TIE * max(abs(ga), abs(gb), 1e-12), \
-                    (t, s_, ga, gb)
-                if not la and 2 * s_ + 2 < N:
-                    queue += [2 * s_ + 1, 2 * s_ + 2]
-                continue
-            # Divergent decision with matching ancestors: a root cause.
-            n_root_causes += 1
-            if la != lb:
-                # split-vs-leaf flip: the split side's gain must sit at
-                # the min_split_gain floor (leaves record gain 0).
-                g_split = gb if la else ga
-                assert abs(g_split - min_split_gain) <= TIE * max(
-                    g_split, min_split_gain), (t, s_, g_split,
-                                               min_split_gain)
-            else:
-                # both split, different (feature, bin): candidate tie.
-                assert abs(ga - gb) <= TIE * max(abs(ga), abs(gb), 1e-12), \
-                    (t, s_, ga, gb)
-            # Subtree excluded: different rows flow below a flipped node.
-    assert n_root_causes <= max(1, T * N // 500), (n_root_causes, T, N)
 
 
 @pytest.mark.parametrize("case_seed", range(5))
@@ -271,14 +212,16 @@ def test_random_config_streaming_identity(case_seed):
     """Round-4 fuzz dimension: fit_streaming over RANDOM chunk boundaries
     and a RANDOM device-chunk-cache budget (0 .. whole dataset) must grow
     the in-memory Driver's exact trees for any valid config — the cache
-    changes only when the H2D link is paid, never the math."""
+    changes only when the H2D link is paid, never the math. Since round 5
+    the fuzzed config space INCLUDES sampling (_random_case draws
+    subsample/colsample freely): the stateless counter-based masks
+    (ops/sampling) make bagged streaming equal bagged in-memory training
+    bit-for-bit, chunk boundaries notwithstanding."""
     from ddt_tpu.streaming import fit_streaming
 
     rng = np.random.default_rng((113, case_seed))
     Xb, y, cfg = _random_case(rng)
-    # Sampling is the one dimension streaming rejects by contract
-    # (host-drawn full-index masks don't stream; fit_streaming raises).
-    cfg = cfg.replace(backend="tpu", subsample=1.0, colsample_bytree=1.0)
+    cfg = cfg.replace(backend="tpu")
     full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
 
     rows = len(y)
@@ -293,9 +236,4 @@ def test_random_config_streaming_identity(case_seed):
     budget = int(rng.integers(0, Xb.nbytes + 1))   # 0 = no caching
     streamed = fit_streaming(chunk_fn, n_chunks, cfg,
                              device_chunk_cache=budget)
-    _assert_trees_match_mod_ties(full, streamed, cfg.min_split_gain)
-
-    # The guard the round-4 fuzz caught missing: the library path must
-    # reject sampling configs loudly, like the CLI always has.
-    with pytest.raises(ValueError, match="sampling"):
-        fit_streaming(chunk_fn, n_chunks, cfg.replace(subsample=0.8))
+    assert_trees_match_mod_ties(full, streamed, cfg.min_split_gain)

@@ -103,14 +103,146 @@ def test_roundtrip_categorical():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
-def test_categorical_bitset_validation():
-    """Multi-bit bitsets (real LightGBM cat splits) and mixed cat/ordinal
-    feature use are unrepresentable and must fail loudly, not silently
-    misroute."""
+def _lgbm_oracle_raw(txt: str, X: np.ndarray) -> np.ndarray:
+    """Independent NumPy evaluator of LightGBM model.txt semantics (slow
+    per-row walk, no shared code with models/lightgbm_io.py): numerical
+    `v <= thr goes left`, categorical `int(v) in bitset goes left`, NaN
+    follows decision_type's default-left bit. Leaf values are final
+    contributions; returns the raw margin sum per row."""
+    lines = txt.splitlines()
+    blocks, cur = [], None
+    for ln in lines:
+        if ln.startswith("Tree="):
+            cur = {}
+            blocks.append(cur)
+        elif cur is not None and "=" in ln and ln.strip():
+            k, _, v = ln.partition("=")
+            cur[k] = v
+        elif cur is not None and not ln.strip():
+            cur = None
+
+    out = np.zeros(X.shape[0], np.float64)
+    for blk in blocks:
+        lv = [float(v) for v in blk["leaf_value"].split()]
+        if int(blk["num_leaves"]) == 1:
+            out += lv[0]
+            continue
+        sf = [int(v) for v in blk["split_feature"].split()]
+        th = [float(v) for v in blk["threshold"].split()]
+        dt = [int(float(v)) for v in blk["decision_type"].split()]
+        lc = [int(v) for v in blk["left_child"].split()]
+        rc = [int(v) for v in blk["right_child"].split()]
+        cb = ct = None
+        if int(blk.get("num_cat", "0")) != 0:
+            cb = [int(v) for v in blk["cat_boundaries"].split()]
+            ct = [int(v) for v in blk["cat_threshold"].split()]
+        for r in range(X.shape[0]):
+            ref = 0
+            while ref >= 0:
+                v = X[r, sf[ref]]
+                if np.isnan(v):
+                    left = bool(dt[ref] & 2)
+                elif dt[ref] & 1:          # categorical bitset
+                    ci = int(th[ref])
+                    words = ct[cb[ci]:cb[ci + 1]]
+                    k = int(v)
+                    left = (k // 32 < len(words)
+                            and bool(words[k // 32] >> (k % 32) & 1))
+                else:
+                    left = v <= th[ref]
+                ref = lc[ref] if left else rc[ref]
+            out[r] += lv[~ref]
+    return out
+
+
+def test_multibit_categorical_import():
+    """Externally-trained LightGBM models with MULTI-category bitsets
+    (round-4 verdict item 5) import via one-vs-rest chain expansion and
+    score identically to an independent LightGBM-semantics oracle —
+    including bitsets spanning two uint32 words, NaN rows, and an empty
+    bitset whose decision_type demands NaN-default-LEFT (the one case an
+    empty bitset cannot collapse: no category matches but NaN rows still
+    exit left — caught by review, sentinel link in bits_of)."""
+    # Hand-built model: f0 numeric, f1 categorical with 40 categories.
+    # Tree 0's root sends categories {1, 5, 33, 38} left (2-word bitset);
+    # its left child is numeric, right child a 1-bit cat node. Tree 1
+    # has an EMPTY bitset at the root with decision_type=11
+    # (categorical | default-left | NaN missing): real values all go
+    # right, NaN goes LEFT.
+    def words(cats):
+        w = [0, 0]
+        for c in cats:
+            w[c // 32] |= 1 << (c % 32)
+        return w
+
+    w0 = words([1, 5, 33, 38])
+    txt = "\n".join([
+        "tree", "version=v3", "num_class=1", "num_tree_per_iteration=1",
+        "label_index=0", "max_feature_idx=1",
+        "objective=binary sigmoid:1", "feature_names=Column_0 Column_1",
+        "feature_infos=[-inf:inf] [-inf:inf]", "",
+        "Tree=0", "num_leaves=4", "num_cat=2",
+        "split_feature=1 0 1",
+        "split_gain=9 4 2",
+        "threshold=0 0.35 1",
+        "decision_type=1 0 1",
+        "left_child=1 -1 -3",
+        "right_child=2 -2 -4",
+        "leaf_value=0.5 -0.25 0.125 -0.75",
+        "leaf_weight=0 0 0 0", "leaf_count=0 0 0 0",
+        "internal_value=0 0 0", "internal_weight=0 0 0",
+        "internal_count=0 0 0",
+        f"cat_boundaries=0 2 3",
+        f"cat_threshold={w0[0]} {w0[1]} {1 << 7}",
+        "is_linear=0", "shrinkage=1", "",
+        "Tree=1", "num_leaves=2", "num_cat=1",
+        "split_feature=1",
+        "split_gain=1",
+        "threshold=0",
+        "decision_type=11",
+        "left_child=-1",
+        "right_child=-2",
+        "leaf_value=100.0 0.0625",
+        "leaf_weight=0 0", "leaf_count=0 0",
+        "internal_value=0", "internal_weight=0", "internal_count=0",
+        "cat_boundaries=0 1",
+        "cat_threshold=0",
+        "is_linear=0", "shrinkage=1", "",
+        "end of trees", "", "pandas_categorical:null", "",
+    ])
+    back = TreeEnsemble.from_lightgbm_text(txt)
+    assert back.cat_features is not None and 1 in set(back.cat_features)
+    # 4-bit chain under a depth-1 subtree: expanded depth 4+1 = 5
+    assert back.max_depth == 5
+    rng = np.random.default_rng(7)
+    X = np.stack([
+        rng.random(400).astype(np.float32),
+        rng.integers(0, 40, size=400).astype(np.float32),
+    ], axis=1)
+    X[::11, 1] = np.nan            # NaN in the categorical column
+    X[::13, 0] = np.nan            # NaN in the numeric column
+    want = _lgbm_oracle_raw(txt, X)
+    got = back.predict_raw(X, binned=False)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    # The empty-bitset default-left tree: real rows all went right
+    # (0.0625), NaN-in-f1 rows exited LEFT into the 100 leaf.
+    nan_f1 = np.isnan(X[:, 1])
+    assert got[~nan_f1].max() < 50
+    assert (got[nan_f1] > 50).all()
+
+    # Gain-sum importances count each original split once, not once per
+    # chain link or subtree copy: f0 keeps gain 4 (one copy counted),
+    # f1 keeps 9 + 2 + 1 (first links, incl. the sentinel link standing
+    # in for the empty-bitset NaN split) -> normalized [4/16, 12/16].
+    imp = back.feature_importances("gain")
+    np.testing.assert_allclose(imp, [4 / 16, 12 / 16], rtol=1e-6)
+
+
+def test_multibit_roundtrip_of_doctored_export():
+    """A doctored two-extra-bit bitset on a REAL exported model parses
+    (no longer rejected) and scores per LightGBM semantics."""
     res, X, cat = _train_categorical()
     txt = res.ensemble.to_lightgbm_text()
-
-    # Doctor one bitset to carry two categories.
     lines = txt.splitlines()
     for i, ln in enumerate(lines):
         if ln.startswith("cat_threshold="):
@@ -118,8 +250,54 @@ def test_categorical_bitset_validation():
             words[0] = str(int(words[0]) | (1 << 31) | 1)
             lines[i] = "cat_threshold=" + " ".join(words)
             break
-    with pytest.raises(ValueError, match="set bits"):
-        TreeEnsemble.from_lightgbm_text("\n".join(lines))
+    doctored = "\n".join(lines)
+    back = TreeEnsemble.from_lightgbm_text(doctored)
+    want = _lgbm_oracle_raw(doctored, X)
+    got = back.predict_raw(X, binned=False)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_malformed_cat_node_rejected():
+    """Categorical decision_type with num_cat=0 (foreign/corrupt input)
+    fails with a precise ValueError, not a NoneType subscript."""
+    txt = "\n".join([
+        "tree", "version=v3", "num_class=1", "num_tree_per_iteration=1",
+        "label_index=0", "max_feature_idx=0",
+        "objective=binary sigmoid:1", "feature_names=Column_0",
+        "feature_infos=[-inf:inf]", "",
+        "Tree=0", "num_leaves=2", "num_cat=0",
+        "split_feature=0", "split_gain=1", "threshold=0",
+        "decision_type=1", "left_child=-1", "right_child=-2",
+        "leaf_value=1 0", "leaf_weight=0 0", "leaf_count=0 0",
+        "internal_value=0", "internal_weight=0", "internal_count=0",
+        "is_linear=0", "shrinkage=1", "",
+        "end of trees", "", "pandas_categorical:null", "",
+    ])
+    with pytest.raises(ValueError, match="num_cat=0"):
+        TreeEnsemble.from_lightgbm_text(txt)
+
+
+def test_cat_missing_export_warns():
+    """Exporting cat splits together with learned NaN directions warns
+    about the cross-tool NaN-routing difference (round-4 advisor)."""
+    from ddt_tpu.models.tree import empty_ensemble
+
+    ens = empty_ensemble(1, 2, 3, 0.1, 0.0, "logloss",
+                         missing_bin=True, n_bins=31, cat_features=(1,))
+    ens.feature[0, 0] = 1
+    ens.threshold_bin[0, 0] = 2
+    ens.threshold_raw[0, 0] = 2.0
+    ens.is_leaf[0, 1:3] = True
+    ens.has_raw_thresholds = True
+    with pytest.warns(UserWarning, match="NaN"):
+        ens.to_lightgbm_text()
+
+
+def test_categorical_bitset_validation():
+    """Mixed cat/ordinal feature use is unrepresentable and must fail
+    loudly, not silently misroute."""
+    res, X, cat = _train_categorical()
+    txt = res.ensemble.to_lightgbm_text()
 
     # Doctor a cat node's feature to collide with an ordinal feature.
     lines = txt.splitlines()

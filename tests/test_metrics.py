@@ -212,7 +212,115 @@ def test_device_metric_twins_match_host():
         got = float(device_metric(name)(
             jnp.asarray(ym), jnp.asarray(sm), jnp.asarray(vm)))
         np.testing.assert_allclose(got, want, rtol=2e-6, err_msg=name)
-    assert device_metric("auc") is None    # host-only (f32 rank overflow)
+    assert device_metric("auc", n_classes=3) is None   # softmax: host-only
+
+
+def test_device_auc_parity_adversarial():
+    """The binned-rank device auc (round-4 verdict item 3) matches the
+    f64 host auc within the documented ~1/DEVICE_AUC_BINS tolerance on
+    adversarial score distributions: heavy exact ties, near-constant
+    scores (span normalisation must spread them), mixed magnitudes, and
+    pad rows. Exact ties bin identically, so tie-heavy cases are EXACT;
+    the only error source is distinct scores sharing a bin."""
+    import jax.numpy as jnp
+
+    from ddt_tpu.utils.metrics import device_metric
+
+    fn = device_metric("auc")
+    rng = np.random.default_rng(5)
+    R = 20_000
+    y = (rng.random(R) < 0.35).astype(np.float32)
+    cases = {
+        "normal": rng.standard_normal(R).astype(np.float32),
+        # GBDT-shaped: few distinct leaf-sum values -> heavy exact ties
+        "quantized": rng.choice(
+            np.float32(rng.standard_normal(37)), size=R),
+        # near-constant: scores within 1e-5 of each other around 3.0
+        "near_constant": np.float32(3.0)
+        + np.float32(1e-5) * rng.random(R).astype(np.float32),
+        # separated + informative (auc ~0.9)
+        "informative": (y * 2.0 + rng.standard_normal(R)).astype(
+            np.float32),
+        # binary scores only (one bin boundary): everything ties
+        "two_valued": rng.choice(np.float32([0.25, -1.5]), size=R),
+    }
+    for name, s in cases.items():
+        want = metrics.auc(y, s)
+        # padded: 500 pad rows with wild scores/labels must not count
+        sp = np.concatenate([s, np.float32(1e9) * np.ones(500, np.float32)])
+        yp = np.concatenate([y, np.ones(500, np.float32)])
+        valid = np.zeros(R + 500, bool)
+        valid[:R] = True
+        got = float(fn(jnp.asarray(yp), jnp.asarray(sp),
+                       jnp.asarray(valid)))
+        assert abs(got - want) <= 5e-5, (name, got, want)
+
+    # all-equal scores: exactly 0.5 (span-zero branch)
+    const = np.full(R, 7.25, np.float32)
+    got = float(fn(jnp.asarray(y), jnp.asarray(const),
+                   jnp.asarray(np.ones(R, bool))))
+    assert got == 0.5
+    # single-class validation data: NaN (the Driver's guard raises on it)
+    got = float(fn(jnp.asarray(np.ones(R, np.float32)),
+                   jnp.asarray(cases["normal"]),
+                   jnp.asarray(np.ones(R, bool))))
+    assert np.isnan(got)
+
+
+def test_fused_auc_early_stopping_matches_granular():
+    """auc eval + early stopping now rides the fused dispatch path
+    (grow_rounds_eval with the binned-rank device twin, round-4 verdict
+    item 3): the fused run must record the same per-round auc series and
+    pick the same best_round as the granular device path (profile=True
+    forces per-round dispatch; both score with the identical compiled
+    twin)."""
+    X, y = synthetic_binary(4000, n_features=10, seed=3)
+    Xt, yt, Xv, yv = _split(X, y)
+    kw = dict(n_trees=30, max_depth=4, n_bins=63, backend="tpu",
+              log_every=10**9, eval_set=(Xv, yv), eval_metric="auc",
+              early_stopping_rounds=3)
+    fused = api.train(Xt, yt, **kw)
+    granular = api.train(Xt, yt, profile=True, **kw)
+    assert fused.best_round is not None
+    assert fused.best_round == granular.best_round
+    hf = [r["valid_auc"] for r in fused.history if "valid_auc" in r]
+    hg = [r["valid_auc"] for r in granular.history if "valid_auc" in r]
+    np.testing.assert_array_equal(hf, hg)
+    np.testing.assert_array_equal(fused.ensemble.feature,
+                                  granular.ensemble.feature)
+
+
+def test_device_auc_sharded_matches_single():
+    """psum/pmin/pmax-distributed device auc over an 8-way row shard
+    equals the single-device evaluation bitwise (same bin histograms,
+    same summation)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ddt_tpu.utils.metrics import device_metric
+
+    fn = device_metric("auc")
+    rng = np.random.default_rng(11)
+    R = 16_384
+    y = (rng.random(R) < 0.4).astype(np.float32)
+    s = rng.standard_normal(R).astype(np.float32)
+    v = np.ones(R, bool)
+    single = float(fn(jnp.asarray(y), jnp.asarray(s), jnp.asarray(v)))
+
+    mesh = jax.make_mesh((8,), ("rows",))
+
+    def allreduce(x, op="sum"):
+        return {"sum": jax.lax.psum, "min": jax.lax.pmin,
+                "max": jax.lax.pmax}[op](x, "rows")
+
+    sharded_fn = jax.jit(jax.shard_map(
+        lambda y_, s_, v_: fn(y_, s_, v_, allreduce),
+        mesh=mesh, in_specs=(P("rows"), P("rows"), P("rows")),
+        out_specs=P()))
+    sharded = float(sharded_fn(jnp.asarray(y), jnp.asarray(s),
+                               jnp.asarray(v)))
+    assert sharded == single
 
 
 def test_device_eval_matches_host_eval_history():

@@ -212,6 +212,111 @@ def test_sklearn_facade_eval_attributes():
     assert clf.best_iteration_ is None and clf.evals_result_ == {}
 
 
+def test_row_keep_twins_bit_identical():
+    """The NumPy and JAX counter-hash twins (ops/sampling) produce the
+    SAME keep bits — the whole cross-path bagging identity contract rests
+    on this — including 64-bit global row bases past 2^32 (the 10B-row
+    config's range) and shard-local offsets."""
+    import jax.numpy as jnp
+
+    from ddt_tpu.ops import sampling as S
+
+    for seed, rnd, n, frac in [(0, 0, 1000, 0.8), (7, 13, 4096, 0.3),
+                               (2**31, 999, 257, 0.5)]:
+        want = S.row_keep_np(seed, rnd, 0, n, frac).astype(np.float32)
+        got = np.asarray(S.row_keep_jax(
+            jnp.int32(rnd), jnp.int32(0), n, seed=seed, subsample=frac))
+        np.testing.assert_array_equal(want, got, err_msg=str((seed, rnd)))
+    # offset equivalence: shard 1 of 2 equals the tail of the full draw
+    full = S.row_keep_np(3, 2, 0, 2048, 0.6).astype(np.float32)
+    tail = np.asarray(S.row_keep_jax(
+        jnp.int32(2), jnp.int32(1024), 1024, seed=3, subsample=0.6))
+    np.testing.assert_array_equal(full[1024:], tail)
+    # 64-bit base crossing a 2^32 boundary
+    base = (1 << 32) - 500
+    want = S.row_keep_np(3, 5, base + 256, 1000, 0.5).astype(np.float32)
+    got = np.asarray(S.row_keep_jax(
+        jnp.int32(5), jnp.int32(256), 1000, seed=3, subsample=0.5,
+        row_start_lo=jnp.uint32(base & 0xFFFFFFFF),
+        row_start_hi=jnp.uint32(base >> 32)))
+    np.testing.assert_array_equal(want, got)
+    # statistics: keep rate ~ subsample, rounds roughly independent
+    m0 = S.row_keep_np(0, 0, 0, 1_000_000, 0.8)
+    m1 = S.row_keep_np(0, 1, 0, 1_000_000, 0.8)
+    assert abs(m0.mean() - 0.8) < 2e-3
+    assert abs((m0 & m1).mean() - 0.64) < 2e-3
+
+
+def test_bagging_rides_fused_path():
+    """Round-5: bagging row masks are recomputed IN-SCAN (counter-based,
+    ops/sampling) — grow_rounds must engage (no granular fallback) and
+    grow the granular CPU path's exact trees."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.driver import Driver
+
+    X, y = synthetic_binary(2048, n_features=10, seed=3)
+    Xb, _ = quantize(X, n_bins=31, seed=3)
+    cfg = TrainConfig(n_trees=5, max_depth=3, n_bins=31, backend="tpu",
+                      subsample=0.7, seed=7)
+    be = get_backend(cfg)
+    calls = {"fused": 0}
+    orig = be.grow_rounds
+
+    def spy(*a, **k):
+        calls["fused"] += 1
+        return orig(*a, **k)
+
+    be.grow_rounds = spy
+    try:
+        fused = Driver(be, cfg, log_every=10**9).fit(Xb, y)
+    finally:
+        be.grow_rounds = orig
+    assert calls["fused"] >= 1
+
+    cfg_c = cfg.replace(backend="cpu")
+    gran = Driver(get_backend(cfg_c), cfg_c, log_every=10**9).fit(Xb, y)
+    np.testing.assert_array_equal(gran.feature, fused.feature)
+    np.testing.assert_array_equal(gran.threshold_bin, fused.threshold_bin)
+    np.testing.assert_allclose(gran.leaf_value, fused.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_bagging_fused_pod_mesh_identity():
+    """In-scan bagging over a (hosts x rows) pod mesh: each shard derives
+    its rows' global ids from the flattened shard index, so the sharded
+    fused run must equal the single-device fused run exactly."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.driver import Driver
+
+    X, y = synthetic_binary(1536, n_features=8, seed=5)
+    Xb, _ = quantize(X, n_bins=31, seed=5)
+    cfg = TrainConfig(n_trees=4, max_depth=3, n_bins=31, backend="tpu",
+                      subsample=0.6, seed=13)
+    single = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
+    cfg_p = cfg.replace(host_partitions=2, n_partitions=2)
+    pod = Driver(get_backend(cfg_p), cfg_p, log_every=10**9).fit(Xb, y)
+    np.testing.assert_array_equal(single.feature, pod.feature)
+    np.testing.assert_array_equal(single.threshold_bin, pod.threshold_bin)
+    np.testing.assert_array_equal(single.is_leaf, pod.is_leaf)
+
+
+def test_bagged_eval_set_stays_correct():
+    """bagging + eval_set rides the granular path (the eval scan does not
+    thread round ids): histories must match CPU and the run must early
+    stop cleanly."""
+    X, y = synthetic_binary(3000, n_features=8, seed=3)
+    kw = dict(n_trees=12, max_depth=4, n_bins=63, subsample=0.8, seed=5,
+              log_every=1, eval_set=(X[2400:], y[2400:]),
+              eval_metric="logloss")
+    rc = api.train(X[:2400], y[:2400], backend="cpu", **kw)
+    rt = api.train(X[:2400], y[:2400], backend="tpu", **kw)
+    hc = [r["valid_logloss"] for r in rc.history if "valid_logloss" in r]
+    ht = [r["valid_logloss"] for r in rt.history if "valid_logloss" in r]
+    assert len(ht) == 12
+    np.testing.assert_allclose(hc, ht, rtol=2e-5)
+    np.testing.assert_array_equal(rc.ensemble.feature, rt.ensemble.feature)
+
+
 def test_colsample_rides_fused_path():
     """Round-3: colsample's [K, C, F] masks ride the fused scan as xs —
     grow_rounds_masked must engage and grow the same ensemble as the

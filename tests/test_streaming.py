@@ -87,6 +87,53 @@ def test_streaming_softmax_host_matches_inmemory(cache):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("backend_flag", ["cpu", "tpu"])
+def test_streaming_sampling_matches_inmemory(backend_flag):
+    """Bagging + colsample STREAM since round 5 (stateless counter-based
+    row masks + the Driver's host-drawn colsample masks, ops/sampling):
+    the streamed run must grow the in-memory Driver's exact trees — on
+    the host loop (cpu) and the device stream ops (tpu), where the keep
+    mask is recomputed ON DEVICE per chunk from the chunk's global row
+    offset."""
+    X, y = datasets.synthetic_binary(4096, n_features=10, seed=21)
+    Xb, _ = quantize(X, n_bins=31, seed=21)
+    cfg = TrainConfig(n_trees=4, max_depth=4, n_bins=31,
+                      backend=backend_flag, subsample=0.7,
+                      colsample_bytree=0.6, seed=11)
+
+    full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
+
+    chunk_fn, n_chunks = _chunked(Xb, y, 512)
+    streamed = fit_streaming(chunk_fn, n_chunks, cfg)
+
+    np.testing.assert_array_equal(full.feature, streamed.feature)
+    np.testing.assert_array_equal(full.threshold_bin,
+                                  streamed.threshold_bin)
+    np.testing.assert_array_equal(full.is_leaf, streamed.is_leaf)
+    np.testing.assert_allclose(full.leaf_value, streamed.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_streaming_sampling_softmax_device_partitioned():
+    """Sampling x softmax x row shards x streaming, all at once: the
+    sharded device stream (per-class colsample masks at split selection,
+    shard-offset-derived bagging bits) equals the in-memory run."""
+    X, y = datasets.synthetic_multiclass(3072, n_features=8, n_classes=3,
+                                         seed=9)
+    Xb, _ = quantize(X, n_bins=31, seed=9)
+    cfg = TrainConfig(n_trees=3, max_depth=3, n_bins=31, backend="tpu",
+                      loss="softmax", n_classes=3, subsample=0.8,
+                      colsample_bytree=0.7, seed=4, n_partitions=2)
+    full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(Xb, y)
+    chunk_fn, n_chunks = _chunked(Xb, y, 768)
+    streamed = fit_streaming(chunk_fn, n_chunks, cfg)
+    np.testing.assert_array_equal(full.feature, streamed.feature)
+    np.testing.assert_array_equal(full.threshold_bin,
+                                  streamed.threshold_bin)
+    np.testing.assert_allclose(full.leaf_value, streamed.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_streaming_empty_chunk_rejected():
     cfg = TrainConfig(n_trees=2, max_depth=2, backend="cpu")
     with pytest.raises(ValueError, match="empty"):

@@ -1,0 +1,74 @@
+"""Tree-pair comparison helpers shared by the identity fuzz suites.
+
+`assert_trees_match_mod_ties` is the tie-proving comparator the streamed
+and cross-platform identity contracts route through; because a false
+NEGATIVE here would silently void those contracts, the comparator has its
+own adversarial suite (tests/test_tie_comparator.py) proving it rejects
+real divergences — flipped splits at non-boundary gains, perturbed
+leaves, split/leaf flips away from the min_split_gain floor, swapped
+children, and root-cause floods.
+"""
+
+import numpy as np
+
+
+def assert_trees_match_mod_ties(full, streamed, min_split_gain):
+    """Bitwise tree equality, except provable f32-order boundary ties.
+
+    Streamed training accumulates per-chunk histogram partials on host;
+    the in-memory path sums once on device. The summation TREES differ,
+    so where a decision's competing quantities land within ~1 bfloat16
+    ULP of each other the rounded comparison can legitimately go either
+    way — the same seam as cross-platform (MXU order) and cross-process
+    (gloo order), measured by the round-4 fuzz campaigns at ~1 root-cause
+    node per 160k (seed 197: candidate gains 0.00102997 vs 0.00102234).
+
+    The checkable contract, enforced per tree by walking the heap from
+    the root and PRUNING each divergent subtree:
+      - every node whose ancestors all matched must either match
+        bitwise in its decision (feature, threshold_bin, is_leaf; leaf
+        values to float tolerance, gains to bf16 tolerance), or be a
+        PROVABLE tie: competing gains within 2 bf16 ULPs (cross-feature
+        or cross-bin flip), or a gain within 2 ULPs of min_split_gain
+        (split-vs-leaf flip at the floor);
+      - descendants of a flipped decision legitimately diverge and are
+        excluded (different rows reach them);
+      - root causes stay rare (they are measured to be)."""
+    TIE = 2 ** -6                     # 2 bf16 ULPs, relative
+    T, N = full.feature.shape
+    n_root_causes = 0
+    for t in range(T):
+        queue = [0]
+        while queue:
+            s_ = queue.pop()
+            fa, fb = int(full.feature[t, s_]), int(streamed.feature[t, s_])
+            ba = int(full.threshold_bin[t, s_])
+            bb = int(streamed.threshold_bin[t, s_])
+            la = bool(full.is_leaf[t, s_])
+            lb = bool(streamed.is_leaf[t, s_])
+            ga = float(full.split_gain[t, s_])
+            gb = float(streamed.split_gain[t, s_])
+            if (fa, ba, la) == (fb, bb, lb):
+                np.testing.assert_allclose(
+                    full.leaf_value[t, s_], streamed.leaf_value[t, s_],
+                    rtol=2e-4, atol=2e-5, err_msg=f"tree {t} slot {s_}")
+                assert abs(ga - gb) <= TIE * max(abs(ga), abs(gb), 1e-12), \
+                    (t, s_, ga, gb)
+                if not la and 2 * s_ + 2 < N:
+                    queue += [2 * s_ + 1, 2 * s_ + 2]
+                continue
+            # Divergent decision with matching ancestors: a root cause.
+            n_root_causes += 1
+            if la != lb:
+                # split-vs-leaf flip: the split side's gain must sit at
+                # the min_split_gain floor (leaves record gain 0).
+                g_split = gb if la else ga
+                assert abs(g_split - min_split_gain) <= TIE * max(
+                    g_split, min_split_gain), (t, s_, g_split,
+                                               min_split_gain)
+            else:
+                # both split, different (feature, bin): candidate tie.
+                assert abs(ga - gb) <= TIE * max(abs(ga), abs(gb), 1e-12), \
+                    (t, s_, ga, gb)
+            # Subtree excluded: different rows flow below a flipped node.
+    assert n_root_causes <= max(1, T * N // 500), (n_root_causes, T, N)
