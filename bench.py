@@ -48,14 +48,26 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # 32 s clears the slow band with margin. A ~3x granular-dispatch
 # regression lands at 33-69 s and is caught from any band; note a
 # smaller regression inside a fast band can hide under a fixed ceiling —
-# the histogram floor covers the kernel side of that risk. Predict: the
-# resident arm still fetches the [10M] f32 scores through the tunnel,
-# so slow D2H bands drag it from ~2.9 to ~1.0 Mrows/s (measured back to
-# back); 0.8 sits below that while still catching the catastrophic
-# scalar-gather descent regression (~0.3-0.4 in any band).
+# the histogram floor covers the kernel side of that risk. Predict
+# (round-5 formulation round, docs/PERF.md): the resident arm overlaps
+# the [10M] f32 score fetch with compute (paired-protocol 1.33x over
+# the old serial fetch; measured 2.4-3.9 Mrows/s across one run's band
+# samples) — 1.2 sits below that band while catching the catastrophic
+# scalar-gather descent regression (~0.3-0.4) and a slow-band loss of
+# the overlap. The compute-only arm has no row-sized transfers in the
+# timed region (the regression class the old 0.8 floor was really
+# guarding): 4.2-4.4 Mrows/s in the pure-compute sweep, 3.56 in the
+# first bench artifact (whose hist sample, 55.4, sat in a HIGH band —
+# the arm's 5 per-chunk dispatch+sync round-trips still ride the
+# tunnel, so scale by the 40-64 band range: low-band ~2.6). 2.4 sits
+# under that with margin and catches tree_chunk-misdispatch (~2.0) and
+# the scalar-gather catastrophe (~0.3) from any band; a per-level-
+# descent regression (~2.7) lands inside the band and is covered by
+# the phase experiments, not this floor.
 TPU_FLOOR_MROWS = 35.0
 E2E_CEILING_S = 32.0
-PREDICT_FLOOR_MROWS = 0.8
+PREDICT_FLOOR_MROWS = 1.2
+PREDICT_COMPUTE_FLOOR_MROWS = 2.4
 # e2e self-consistency (round-4 verdict item 9): the training loop is
 # histogram-dominated, so rows x levels x trees / e2e_train_s — the
 # throughput the e2e wallclock IMPLIES — must sit near the kernel
@@ -138,9 +150,11 @@ def main() -> None:
                      bins=bins, trees=100, depth=depth)
     implied = rows * depth * tr["trees"] / tr["wallclock_s"] / 1e6
 
-    # Scoring config: device-resident (floored) + total (context), one
-    # shared dataset/ensemble/warm-up.
-    pr, pr_total = bench_predict_both(rows=10_000_000, trees=1000, depth=6)
+    # Scoring config: device-resident (floored) + total (context) +
+    # compute-only (floored, band-stable), one shared
+    # dataset/ensemble/warm-up.
+    pr, pr_total, pr_comp = bench_predict_both(rows=10_000_000, trees=1000,
+                                               depth=6)
 
     parity = _parity_check() if on_tpu else {}
 
@@ -168,8 +182,11 @@ def main() -> None:
         "e2e_consistency_ratio": round(implied / value, 3),
         "predict_mrows_per_sec": round(pr["mrows_per_sec"], 2),
         "predict_total_s": round(pr_total["wallclock_s"], 2),
+        "predict_compute_mrows_per_sec": round(pr_comp["mrows_per_sec"], 2),
         "predict_floor_mrows_per_sec":
             PREDICT_FLOOR_MROWS if on_tpu else None,
+        "predict_compute_floor_mrows_per_sec":
+            PREDICT_COMPUTE_FLOOR_MROWS if on_tpu else None,
         **parity,
     }
     print(json.dumps(rec))
@@ -195,7 +212,13 @@ def main() -> None:
     if pr["mrows_per_sec"] < PREDICT_FLOOR_MROWS:
         fails.append(
             f"resident predict {pr['mrows_per_sec']:.2f} Mrows/s < "
-            f"{PREDICT_FLOOR_MROWS} floor (descent-path regression)")
+            f"{PREDICT_FLOOR_MROWS} floor (overlapped-fetch or "
+            "descent-path regression)")
+    if pr_comp["mrows_per_sec"] < PREDICT_COMPUTE_FLOOR_MROWS:
+        fails.append(
+            f"compute-only predict {pr_comp['mrows_per_sec']:.2f} Mrows/s "
+            f"< {PREDICT_COMPUTE_FLOOR_MROWS} floor (descent/leaf-select "
+            "kernel regression — band-stable, docs/PERF.md round-5)")
     if ab["ratio_b_over_a"] < AB64_RATIO_FLOOR:
         fails.append(
             f"64-bin paired ratio {ab['ratio_b_over_a']:.3f} < "

@@ -1071,13 +1071,25 @@ class TPUDevice(DeviceBackend):
             else:
                 # Single chip: upload the whole batch ONCE (uint8 — 4x less
                 # host→device traffic than int32, which dominates wallclock
-                # on a remote-attached chip), slice chunks on device, fetch
-                # all outputs in one device→host transfer at the end.
+                # on a remote-attached chip), slice chunks on device, and
+                # OVERLAP each chunk's device→host score fetch with the
+                # later chunks' compute: async dispatch keeps the device
+                # busy while finished chunks stream back, so the link and
+                # the chip pay their costs concurrently instead of
+                # back-to-back. Measured on the 10M x 1000 resident
+                # config, the serial fetch-at-the-end was 65% of
+                # wallclock (experiments/predict_phases.py; docs/PERF.md
+                # round-5) — overlapping it is the predict path's one
+                # first-order win.
                 Xd = (Xb if isinstance(Xb, jax.Array)
                       else jax.device_put(np.ascontiguousarray(Xb)))
                 outs = [
                     fn(*ens_dev, Xd[i:i + chunk]) for i in range(0, R, chunk)
                 ]
+                for o in outs:          # start all D2H copies in flight
+                    o.copy_to_host_async()
+                return np.concatenate(
+                    [np.asarray(o) for o in outs])[:R]
             return np.asarray(jnp.concatenate(outs))[:R]
         Xc = self._put_rows(Xb, extra_dims=1)       # uint8; ops widen it
         out = fn(*ens_dev, Xc)

@@ -261,17 +261,22 @@ def bench_predict_both(
     depth: int = 6,
     seed: int = 0,
     reps: int = 2,
-) -> tuple[dict, dict]:
-    """(resident, total) predict measurements sharing ONE dataset,
-    ensemble, and warm-up pass — the 280 MB batch and 1000-tree model
-    are built once, the warm full pass compiles every chunk shape both
-    timed paths hit, and only the timing loops differ. The resident arm
-    (batch device-uploaded ONCE, outside timing) measures scoring
-    compute + result fetch rather than the host→device link — through
-    the remote tunnel the 280 MB upload varies 16-50 s run to run and
-    would swamp any kernel regression the floor exists to catch. The
-    repo-root bench floors the resident number and records total as
-    context."""
+) -> tuple[dict, dict, dict]:
+    """(resident, total, compute) predict measurements sharing ONE
+    dataset, ensemble, and warm-up pass — the 280 MB batch and 1000-tree
+    model are built once, the warm full pass compiles every chunk shape
+    the timed paths hit, and only the timing loops differ. The resident
+    arm (batch device-uploaded ONCE, outside timing) measures scoring
+    compute + the overlapped result fetch rather than the host→device
+    link — through the remote tunnel the 280 MB upload varies 16-50 s
+    run to run and would swamp any kernel regression the floor exists to
+    catch. The COMPUTE arm goes one step further (round-5 phase
+    breakdown: the D2H fetch is ~65% of even the resident wallclock and
+    carries the tunnel's bands): it syncs the chunk outputs on device
+    without copying them back, isolating the descent/leaf-select kernels
+    the 0.8-era floor was actually trying to guard — a band-stable
+    number a tight floor can sit under. The repo-root bench floors
+    resident AND compute and records total as context."""
     import jax
 
     from ddt_tpu.utils.device import device_sync
@@ -292,7 +297,22 @@ def bench_predict_both(
         assert got.shape[0] == rows
         out.append({**base, "resident": resident, "wallclock_s": dt,
                     "mrows_per_sec": rows / dt / 1e6})
-    return out[0], out[1]
+
+    # Compute-only arm: same chunked programs, outputs synced on device,
+    # nothing row-sized crosses to host.
+    fn, ens_dev = be._predict_fn(ens)
+    chunk = be.PREDICT_ROW_CHUNK
+    dt = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = [fn(*ens_dev, data[i:i + chunk])
+                for i in range(0, rows, chunk)]
+        for o in outs:
+            device_sync(o)
+        dt = min(dt, time.perf_counter() - t0)
+    out.append({**base, "resident": "compute_only", "wallclock_s": dt,
+                "mrows_per_sec": rows / dt / 1e6})
+    return out[0], out[1], out[2]
 
 
 def run_bench(kernel: str = "histogram", **kw) -> dict:

@@ -227,9 +227,11 @@ def predict_raw(
     if row_chunk is None:
         # The binned comparison-matrix descent materialises
         # [Rc, chunk, Nint] bits; default to a smaller row chunk there to
-        # bound it (8k rows measured fastest on v5e: 4.2 vs 3.9 Mrows/s at
-        # 16k for 1M x 1000 trees). None is the only "use default" value —
-        # an explicit row_chunk, including 65536, is always honored.
+        # bound it. Round-5 interleaved sweep (docs/PERF.md): the
+        # row_chunk axis is flat within ~4% over 4k-16k while
+        # tree_chunk=64 dominates — (64, 8192) sits on the plateau.
+        # None is the only "use default" value — an explicit row_chunk,
+        # including 65536, is always honored.
         row_chunk = 8_192 if binned else _DEFAULT_ROW_CHUNK
     T = feature.shape[0]               # on device where casts are free
     R, F = Xc.shape

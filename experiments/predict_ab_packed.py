@@ -79,12 +79,9 @@ def _descend_packed(eff_feat, eff_thr, Xc, max_depth):
 
 def volume_fn(descend, fd, td, ld, vd):
     """Full-volume scorer with `descend` plugged in; scalar output."""
-    ef, et, ev, _ = _effective_arrays(
-        fd, td, ld, vd, DEPTH)
-    n_tc = T // TREE_CHUNK
-    featp = ef.reshape(n_tc, TREE_CHUNK, -1)
-    thrp = et.reshape(n_tc, TREE_CHUNK, -1)
-    valp = ev[:, N_INT:].reshape(n_tc, TREE_CHUNK, -1)
+    from experiments.predict_phases import _padded_effective
+
+    featp, thrp, valp = _padded_effective(fd, td, ld, vd)
 
     @jax.jit
     def run(Xd):

@@ -76,18 +76,33 @@ def device_batch(rows, seed=0):
     return x
 
 
+def _padded_effective(feature, thr, is_leaf, leaf_value):
+    """predict_raw's tree padding (all-leaf value-0 trees to a TREE_CHUNK
+    multiple) + leaf pushdown, reshaped into tree chunks."""
+    T_ = feature.shape[0]
+    n_tc = -(-T_ // TREE_CHUNK)
+    tpad = n_tc * TREE_CHUNK - T_
+
+    def pad_t(a, fill=0):
+        return jnp.pad(a, ((0, tpad), (0, 0)), constant_values=fill)
+
+    ef, et, ev, _ = _effective_arrays(
+        pad_t(feature, -1), pad_t(thr), pad_t(is_leaf, True),
+        pad_t(leaf_value), DEPTH)
+    featp = ef.reshape(n_tc, TREE_CHUNK, -1)
+    thrp = et.reshape(n_tc, TREE_CHUNK, -1)
+    valp = ev[:, N_INT:].reshape(n_tc, TREE_CHUNK, -1)
+    return featp, thrp, valp
+
+
 @functools.partial(jax.jit, static_argnames=("stage",))
 def staged(feature, thr, is_leaf, leaf_value, Xc, *, stage):
     """predict_raw's exact chunking with the per-tree-chunk body cut at
     `stage`; returns a f32 scalar so nothing row-sized leaves the chip."""
     Xc = Xc.astype(jnp.int32)
     R = Xc.shape[0]
-    ef, et, ev, _ = _effective_arrays(
-        feature, thr, is_leaf, leaf_value, DEPTH)
-    n_tc = T // TREE_CHUNK
-    featp = ef.reshape(n_tc, TREE_CHUNK, -1)
-    thrp = et.reshape(n_tc, TREE_CHUNK, -1)
-    valp = ev[:, N_INT:].reshape(n_tc, TREE_CHUNK, -1)
+    featp, thrp, valp = _padded_effective(feature, thr, is_leaf,
+                                          leaf_value)
     n_rc = R // ROW_CHUNK
     Xp = Xc.reshape(n_rc, ROW_CHUNK, F)
 

@@ -221,6 +221,25 @@ def test_api_predict_accepts_model_bundle(tmp_path):
     np.testing.assert_array_equal(got, want)
 
 
+def test_predict_backend_row_chunking_identity(monkeypatch):
+    """The backend-level row-chunked scoring path (R > PREDICT_ROW_CHUNK;
+    overlapped per-chunk D2H since round 5) equals the host oracle and
+    the unchunked path exactly — including a non-multiple final chunk."""
+    from ddt_tpu.backends.tpu import TPUDevice
+
+    Xb, y, _ = _small_problem()
+    cfg = TrainConfig(n_trees=6, max_depth=4, n_bins=31, backend="tpu")
+    be = get_backend(cfg)
+    ens = Driver(be, cfg, log_every=10**9).fit(Xb, y)
+    want = be.predict_raw(ens, Xb)                   # single dispatch
+    monkeypatch.setattr(TPUDevice, "PREDICT_ROW_CHUNK", 96)
+    assert Xb.shape[0] % 96 != 0                     # ragged tail chunk
+    got = be.predict_raw(ens, Xb)                    # chunked + async D2H
+    np.testing.assert_array_equal(want, got)
+    np.testing.assert_allclose(
+        got, ens.predict_raw(Xb, binned=True), rtol=3e-4, atol=3e-4)
+
+
 @pytest.mark.parametrize("block_rounds", [3, 4])
 def test_fused_block_cap_multi_block_identity(block_rounds):
     """Long configs split into multiple fused dispatches

@@ -338,3 +338,67 @@ def test_cat_eval_set_device_path():
     # within-bin tie tolerance vs the f64 host auc
     # (utils/metrics.DEVICE_AUC_BINS).
     np.testing.assert_allclose(last["valid_auc"], want, atol=5e-5)
+
+
+def test_config3_partitioned_at_reduced_scale():
+    """Reduced-size twin of the config-3 at-scale witness
+    (experiments/config3_scale.py; PERF.md round-5): Criteo-shaped
+    categorical training over 4 row partitions upholds the scale
+    contract — bitwise-identical tree PREFIX up to the first divergence,
+    any first-divergence root cause a PROVABLE bf16-boundary tie (the
+    cross-partition psum-order seam), later trees quality-equivalent
+    (holdout AUC). At this size divergence usually doesn't occur at all
+    and the whole run is bitwise."""
+    import dataclasses
+
+    from tree_compare import assert_trees_match_mod_ties
+
+    X, y, cat = _ctr_matrix(rows=200_000, seed=5)
+    m = fit_bin_mapper(X, n_bins=63, cat_features=cat)
+    Xb = m.transform(X)
+    ens = {}
+    for parts in (1, 4):
+        cfg = TrainConfig(n_trees=6, max_depth=5, n_bins=63,
+                          backend="tpu", n_partitions=parts,
+                          min_split_gain=1e-3, cat_features=cat)
+        ens[parts] = Driver(get_backend(cfg), cfg,
+                            log_every=10**9).fit(Xb, y)
+
+    same = [
+        bool(np.array_equal(ens[1].feature[t], ens[4].feature[t])
+             and np.array_equal(ens[1].threshold_bin[t],
+                                ens[4].threshold_bin[t])
+             and np.array_equal(ens[1].is_leaf[t], ens[4].is_leaf[t]))
+        for t in range(ens[1].n_trees)
+    ]
+    first = same.index(False) if False in same else len(same)
+    # The matched prefix must ALSO carry equivalent leaf values
+    # (decisions are bitwise; values drift only by the f32 psum-order
+    # ULPs) — a leaf-aggregation bug that preserves structure must not
+    # hide behind the structural predicate.
+    for t in range(first):
+        np.testing.assert_allclose(
+            ens[1].leaf_value[t], ens[4].leaf_value[t],
+            rtol=1e-3, atol=1e-5, err_msg=f"prefix tree {t} leaves")
+    if False in same:
+
+        def one_tree(e, t):
+            return dataclasses.replace(
+                e, feature=e.feature[t:t + 1],
+                threshold_bin=e.threshold_bin[t:t + 1],
+                threshold_raw=e.threshold_raw[t:t + 1],
+                is_leaf=e.is_leaf[t:t + 1],
+                leaf_value=e.leaf_value[t:t + 1],
+                split_gain=e.split_gain[t:t + 1],
+                default_left=(None if e.default_left is None
+                              else e.default_left[t:t + 1]))
+
+        assert_trees_match_mod_ties(
+            one_tree(ens[1], first), one_tree(ens[4], first),
+            1e-3, leaf_rtol=1e-3, max_root_causes=4)
+    from ddt_tpu.utils.metrics import auc
+
+    a1 = auc(y, ens[1].predict_raw(Xb, binned=True))
+    a4 = auc(y, ens[4].predict_raw(Xb, binned=True))
+    assert abs(a1 - a4) < 1e-3, (a1, a4)
+    assert np.isin(ens[4].feature[~ens[4].is_leaf], list(cat)).any()

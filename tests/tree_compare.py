@@ -12,7 +12,9 @@ children, and root-cause floods.
 import numpy as np
 
 
-def assert_trees_match_mod_ties(full, streamed, min_split_gain):
+def assert_trees_match_mod_ties(full, streamed, min_split_gain,
+                                leaf_rtol=2e-4, leaf_atol=2e-5,
+                                max_root_causes=None):
     """Bitwise tree equality, except provable f32-order boundary ties.
 
     Streamed training accumulates per-chunk histogram partials on host;
@@ -33,7 +35,12 @@ def assert_trees_match_mod_ties(full, streamed, min_split_gain):
         (split-vs-leaf flip at the floor);
       - descendants of a flipped decision legitimately diverge and are
         excluded (different rows reach them);
-      - root causes stay rare (they are measured to be)."""
+      - root causes stay rare (they are measured to be). The default
+        rarity cap and leaf tolerances are calibrated for the fuzz
+        suites' scales; million-row witnesses pass explicit
+        `max_root_causes` / `leaf_rtol` (boundary-tie incidence and f32
+        leaf-sum drift both grow with row count — the config-3 witness,
+        experiments/config3_scale.py, documents the measured rates)."""
     TIE = 2 ** -6                     # 2 bf16 ULPs, relative
     T, N = full.feature.shape
     n_root_causes = 0
@@ -51,7 +58,8 @@ def assert_trees_match_mod_ties(full, streamed, min_split_gain):
             if (fa, ba, la) == (fb, bb, lb):
                 np.testing.assert_allclose(
                     full.leaf_value[t, s_], streamed.leaf_value[t, s_],
-                    rtol=2e-4, atol=2e-5, err_msg=f"tree {t} slot {s_}")
+                    rtol=leaf_rtol, atol=leaf_atol,
+                    err_msg=f"tree {t} slot {s_}")
                 assert abs(ga - gb) <= TIE * max(abs(ga), abs(gb), 1e-12), \
                     (t, s_, ga, gb)
                 if not la and 2 * s_ + 2 < N:
@@ -71,4 +79,6 @@ def assert_trees_match_mod_ties(full, streamed, min_split_gain):
                 assert abs(ga - gb) <= TIE * max(abs(ga), abs(gb), 1e-12), \
                     (t, s_, ga, gb)
             # Subtree excluded: different rows flow below a flipped node.
-    assert n_root_causes <= max(1, T * N // 500), (n_root_causes, T, N)
+    cap = (max(1, T * N // 500) if max_root_causes is None
+           else max_root_causes)
+    assert n_root_causes <= cap, (n_root_causes, cap, T, N)
