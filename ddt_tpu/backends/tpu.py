@@ -499,18 +499,23 @@ class TPUDevice(DeviceBackend):
             args = args + (np.int32(first_round),)
         return fn(*args)
 
+    @staticmethod
+    def _pad_fmasks(data, fmasks: np.ndarray) -> np.ndarray:
+        """Pad host [K, C, F] colsample masks to the GLOBAL (padded)
+        column count; padded columns stay masked out."""
+        K, C, F = fmasks.shape
+        Fg = data.shape[1]          # jax.Array shape is GLOBAL (padded)
+        m = np.zeros((K, C, Fg), bool)
+        m[..., :F] = fmasks
+        return m
+
     def grow_rounds_masked(self, data, pred, y: "LabelHandle",
                            n_rounds: int, fmasks: np.ndarray,
                            first_round: int = 0):
         """grow_rounds with per-round/per-class colsample feature masks
         riding the scan as xs: `fmasks` is host bool [n_rounds, C, F]
-        (KBs). Masks are padded to the global column count here; padded
-        columns stay masked out. Composes with in-scan bagging (see
-        grow_rounds)."""
-        K, C, F = fmasks.shape
-        Fg = data.shape[1]          # jax.Array shape is GLOBAL (padded)
-        m = np.zeros((K, C, Fg), bool)
-        m[..., :F] = fmasks
+        (KBs). Composes with in-scan bagging (see grow_rounds)."""
+        m = self._pad_fmasks(data, fmasks)
         fn = self._rounds_masked_fns.get(n_rounds)
         if fn is None:
             fn = self._build_rounds_fn(n_rounds, masked=True)
@@ -526,23 +531,33 @@ class TPUDevice(DeviceBackend):
 
     def grow_rounds_eval(self, data, pred, y: "LabelHandle", n_rounds: int,
                          val_data, val_pred, val_y: "LabelHandle",
-                         metric: str):
+                         metric: str, first_round: int = 0,
+                         fmasks: "np.ndarray | None" = None):
         """grow_rounds with validation scoring INSIDE the scan: each
         round's trees are applied to the resident validation predictions
         and the metric's f32 device twin evaluates per round — eval runs
         at fused-dispatch speed (no per-round host round-trips; one [K]
-        scores fetch per block). Metric must have a device twin — all
-        metrics have one since round 5's binned-rank auc, except
-        softmax-auc (the Driver falls back to the granular path there).
+        scores fetch per block). Metric must have a device twin — every
+        shipped valid metric/loss combination has one since round 5's
+        binned-rank auc (softmax-auc is rejected at fit; a future
+        twin-less metric would ride the granular path). Composes with
+        colsample (`fmasks`, riding the scan as xs) and bagging
+        (in-scan counter masks keyed by first_round — see grow_rounds).
         Returns (packed_trees, new_pred, losses, new_val_pred,
         scores [n_rounds] f32)."""
-        key = (n_rounds, metric)
+        key = (n_rounds, metric, fmasks is not None)
         fn = self._rounds_eval_fns.get(key)
         if fn is None:
-            fn = self._build_rounds_fn(n_rounds, eval_metric=metric)
+            fn = self._build_rounds_fn(n_rounds, eval_metric=metric,
+                                       masked=fmasks is not None)
             self._rounds_eval_fns[key] = fn
-        return fn(data, pred, y.y, y.valid,
-                  val_data, val_pred, val_y.y, val_y.valid)
+        args = (data, pred, y.y, y.valid,
+                val_data, val_pred, val_y.y, val_y.valid)
+        if fmasks is not None:
+            args = args + (self._pad_fmasks(data, fmasks),)
+        if self.cfg.subsample < 1.0:
+            args = args + (np.int32(first_round),)
+        return fn(*args)
 
     @functools.cached_property
     def _rounds_eval_fns(self) -> dict:
@@ -554,21 +569,15 @@ class TPUDevice(DeviceBackend):
 
     def _build_rounds_fn(self, K: int, eval_metric: str | None = None,
                          masked: bool = False):
-        # The mfn scan branch does not thread feature masks; combining
-        # them must fail loudly here, not silently grow unmasked trees
-        # (the Driver routes colsample+eval_set to the granular path).
-        assert not (masked and eval_metric is not None), \
-            "masked fused blocks do not compose with in-scan eval"
+        # One program per (K, eval?, masked?) with bagging cfg-static:
+        # every combination of colsample masks, in-scan bagging, and
+        # in-scan eval composes in the single scan below (round 5).
         from ddt_tpu.ops import sampling as sampling_ops
         from ddt_tpu.ops import stream as stream_ops
         from ddt_tpu.utils.metrics import device_metric
 
         cfg = self.cfg
         bagging = cfg.subsample < 1.0
-        # The Driver keeps bagging+eval on the granular path; the eval
-        # scan body does not thread round ids.
-        assert not (bagging and eval_metric is not None), \
-            "bagged fused blocks do not compose with in-scan eval"
         C = cfg.n_classes if cfg.loss == "softmax" else 1
         axis = self._row_axes if self.distributed else None
         faxis = FAXIS if self.feature_partitions > 1 else None
@@ -657,48 +666,50 @@ class TPUDevice(DeviceBackend):
                 return pred, vpred, jnp.stack(packs), loss_of(
                     pred, ya, valid)
 
+            # Scan xs: the round's colsample masks [C, Fg] and/or its
+            # absolute round id (the bagging hash key) — any combination
+            # composes, with or without in-scan eval.
+            rids = (jnp.arange(K, dtype=jnp.int32) + rnd0) if bagging \
+                else None
+            if masked and bagging:
+                xs = (fmasks, rids)
+            elif masked:
+                xs = fmasks
+            elif bagging:
+                xs = rids
+            else:
+                xs = None
+
+            def unpack(x):
+                if masked and bagging:
+                    return x[0], x[1]
+                if masked:
+                    return x, None
+                if bagging:
+                    return None, x
+                return None, None
+
             if mfn is not None:
-                def body(carry, _):
+                def body(carry, x):
                     pred, vpred = carry
-                    pred, vpred, packs, loss = one_round(pred, vpred)
+                    fm, rid = unpack(x)
+                    pred, vpred, packs, loss = one_round(pred, vpred,
+                                                         fm, rid)
                     return (pred, vpred), (
                         packs, loss, mfn(vy, vpred, vvalid, allreduce))
 
                 (predf, vpredf), (trees, losses, scores) = jax.lax.scan(
-                    body, (pred0, vpred0), None, length=K)
+                    body, (pred0, vpred0), xs,
+                    length=K if xs is None else None)
                 return trees, predf, losses, vpredf, scores
 
-            # Per-round absolute ids ride the scan as xs when bagging.
-            rids = (jnp.arange(K, dtype=jnp.int32) + rnd0) if bagging \
-                else None
-            if masked and bagging:
-                def body(carry, x):
-                    fm, rid = x
-                    pred, _, packs, loss = one_round(carry, None, fm, rid)
-                    return pred, (packs, loss)
+            def body(carry, x):
+                fm, rid = unpack(x)
+                pred, _, packs, loss = one_round(carry, None, fm, rid)
+                return pred, (packs, loss)
 
-                predf, (trees, losses) = jax.lax.scan(
-                    body, pred0, (fmasks, rids))
-            elif masked:
-                def body(carry, fm):          # fm [C, Fg]: this round's
-                    pred, _, packs, loss = one_round(carry, None, fm)
-                    return pred, (packs, loss)
-
-                predf, (trees, losses) = jax.lax.scan(body, pred0, fmasks)
-            elif bagging:
-                def body(carry, rid):
-                    pred, _, packs, loss = one_round(carry, None, None,
-                                                     rid)
-                    return pred, (packs, loss)
-
-                predf, (trees, losses) = jax.lax.scan(body, pred0, rids)
-            else:
-                def body(carry, _):
-                    pred, _, packs, loss = one_round(carry, None)
-                    return pred, (packs, loss)
-
-                predf, (trees, losses) = jax.lax.scan(body, pred0, None,
-                                                      length=K)
+            predf, (trees, losses) = jax.lax.scan(
+                body, pred0, xs, length=K if xs is None else None)
             return trees, predf, losses
 
         if self.distributed:
@@ -737,8 +748,10 @@ class TPUDevice(DeviceBackend):
     # routing formulation as training, and the metric is computed on
     # device when its f32 twin exists (logloss/rmse/accuracy, plus
     # binary auc via the binned-rank twin since round 5 — one scalar
-    # crosses the host boundary per round). Softmax-auc stays on host:
-    # the Driver fetches the raw scores instead.
+    # crosses the host boundary per round). The metric=None branch
+    # (fetch a replicated raw-score copy for host evaluation) remains
+    # as the generic fallback for twin-less metrics; no shipped valid
+    # combination reaches it today.
     # ------------------------------------------------------------------ #
 
     def eval_round(self, val_data, val_pred, handles, val_y: "LabelHandle",

@@ -43,8 +43,8 @@ SCORES):
   CPU backends for the same data. Binary auc rides the binned-rank device
   twin (round-5: auc eval/early-stop now stays on the fused dispatch path),
   whose within-bin tie mass widens this seam to ~1/DEVICE_AUC_BINS (~2e-5)
-  on the score values; softmax auc still fetches raw scores to the f64 host
-  implementation.
+  on the score values. (Softmax-auc is rejected at fit — the rank
+  formulation is binary.)
 - Resume score seam: on checkpoint resume with a device backend and an
   eval_set, val predictions are reconstituted by host roundwise rescoring,
   which differs from the uninterrupted device accumulation by FMA-contraction
@@ -266,8 +266,8 @@ class Driver:
         #   round's packed tree handles are applied there (eval_round), so
         #   the host never traverses the val set and the tree-fetch
         #   pipeline stays on. Only the metric crosses to host — a scalar
-        #   when its f32 device twin exists (all metrics but softmax-auc),
-        #   else the raw-score vector.
+        #   when its f32 device twin exists (every shipped valid metric),
+        #   else a raw-score vector (the twin-less-metric fallback).
         #   host (CPUDevice): incremental NumPy traversal per tree.
         metric_name = None
         val_raw = None
@@ -288,6 +288,14 @@ class Driver:
                 raise ValueError(
                     f"unknown metric {metric_name!r}; "
                     f"have {sorted(GREATER_IS_BETTER)}"
+                )
+            if metric_name == "auc" and C > 1:
+                # The rank formulation is binary; multiclass raw scores
+                # would crash deep inside the host auc (shape mismatch on
+                # ravel) — fail at the cause instead.
+                raise ValueError(
+                    "auc is a binary metric; softmax eval_set supports "
+                    "logloss or accuracy"
                 )
             sign = 1.0 if GREATER_IS_BETTER[metric_name] else -1.0
             if C > 1:
@@ -359,24 +367,21 @@ class Driver:
         # replayed post-hoc over the block's per-round scores vector
         # (training past the stop point cannot change earlier trees, so
         # truncation gives the EXACT granular-path model; blocks are
-        # capped at the patience so overrun work is bounded). Bagging
-        # fuses since round 5 (the [K, R] row masks are no longer shipped
-        # — the backend recomputes the counter-based bits in-scan); it
-        # stays granular only when composed with eval_set, whose in-scan
-        # program does not thread round ids. Profiling always runs
-        # granular (per-phase barriers).
+        # capped at the patience so overrun work is bounded). Every
+        # stochastic-training combination composes with the fused path
+        # since round 5: colsample [K, C, F] masks (KBs) and bagging's
+        # round ids both ride the scan as xs (the row masks themselves
+        # are recomputed in-scan from the counter hash), with or without
+        # in-scan eval. Only profiling always runs granular (per-phase
+        # barriers), plus the host-eval fallbacks below.
         fused_eval = (
             eval_set is not None
             and use_dev_eval
             and dev_metric is not None
             and getattr(self.backend, "grow_rounds_eval", None) is not None
         )
-        # colsample fuses too (round 3): its [K, C, F] feature masks are
-        # KBs and ride the scan as xs, drawn by the SAME host rngs as the
-        # granular path so fused == granular == cross-backend.
         fused_masked = (
             colsample
-            and eval_set is None
             and getattr(self.backend, "grow_rounds_masked", None)
             is not None
         )
@@ -384,7 +389,6 @@ class Driver:
             getattr(self.backend, "grow_rounds", None) is not None
             and (eval_set is None or fused_eval)
             and self.timer is None
-            and (not bagging or eval_set is None)
             and (not colsample or fused_masked)
         ):
             eval_state = None
@@ -395,7 +399,7 @@ class Driver:
                 data, y_dev, pred, ens, start_round, C,
                 eval_state=eval_state,
                 early_stopping_rounds=early_stopping_rounds,
-                colsample_features=F if fused_masked else None)
+                colsample_features=F if colsample else None)
 
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
@@ -556,19 +560,22 @@ class Driver:
             if early_stopping_rounds is not None:
                 K = min(K, max(early_stopping_rounds, 1))
             t0 = time.perf_counter()
-            if eval_state is not None:
-                trees_h, pred, losses_h, val_pred, scores_h = \
-                    self.backend.grow_rounds_eval(
-                        data, pred, y_dev, K,
-                        val_data, val_pred, val_y, metric_name)
-                scores = np.asarray(scores_h)   # [K] — same fetch wave
-            elif colsample_features is not None:
+            fmasks = None
+            if colsample_features is not None:
                 F = colsample_features
                 fmasks = np.zeros((K, C, F), bool)
                 for k in range(K):
                     for c in range(C):
                         fmasks[k, c] = self._draw_colsample_mask(
                             rnd + k, c, F)
+            if eval_state is not None:
+                trees_h, pred, losses_h, val_pred, scores_h = \
+                    self.backend.grow_rounds_eval(
+                        data, pred, y_dev, K,
+                        val_data, val_pred, val_y, metric_name,
+                        first_round=rnd, fmasks=fmasks)
+                scores = np.asarray(scores_h)   # [K] — same fetch wave
+            elif fmasks is not None:
                 trees_h, pred, losses_h = self.backend.grow_rounds_masked(
                     data, pred, y_dev, K, fmasks, first_round=rnd)
             else:

@@ -240,6 +240,13 @@ class _StreamEval:
                 f"unknown metric {self.metric!r}; "
                 f"have {sorted(GREATER_IS_BETTER)}"
             )
+        if self.metric == "auc" and loss == "softmax":
+            # Same guard as Driver.fit: the rank formulation is binary,
+            # and multiclass raw scores crash deep inside the host auc.
+            raise ValueError(
+                "auc is a binary metric; softmax validation supports "
+                "logloss or accuracy"
+            )
         self.sign = 1.0 if GREATER_IS_BETTER[self.metric] else -1.0
         self.patience = early_stopping_rounds
         self.history = history if history is not None else []

@@ -26,10 +26,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault(
-    "XLA_FLAGS",
-    (os.environ.get("XLA_FLAGS", "")
-     + " --xla_force_host_platform_device_count=4").strip())
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
 
 import jax                                          # noqa: E402
 
@@ -96,45 +97,12 @@ def main():
     #   (c) later trees legitimately cascade (they train on the
     #       residuals the tied choice changed) — quality equivalence is
     #       asserted instead (holdout AUC delta).
-    import dataclasses
-
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))), "tests"))
-    from tree_compare import assert_trees_match_mod_ties
+    from tree_compare import assert_prefix_identity_mod_ties
 
-    def one_tree(e, t):
-        return dataclasses.replace(
-            e, feature=e.feature[t:t + 1],
-            threshold_bin=e.threshold_bin[t:t + 1],
-            threshold_raw=e.threshold_raw[t:t + 1],
-            is_leaf=e.is_leaf[t:t + 1],
-            leaf_value=e.leaf_value[t:t + 1],
-            split_gain=e.split_gain[t:t + 1],
-            default_left=(None if e.default_left is None
-                          else e.default_left[t:t + 1]))
-
-    per_tree_same = [
-        bool(np.array_equal(ens[1].feature[t], ens[4].feature[t])
-             and np.array_equal(ens[1].threshold_bin[t],
-                                ens[4].threshold_bin[t])
-             and np.array_equal(ens[1].is_leaf[t], ens[4].is_leaf[t]))
-        for t in range(ens[1].n_trees)
-    ]
-    first_div = (per_tree_same.index(False) if False in per_tree_same
-                 else None)
-    prefix_n = first_div if first_div is not None else ens[1].n_trees
-    # The matched prefix must ALSO carry equivalent leaf values
-    # (decisions bitwise; values drift only by f32 psum-order ULPs) —
-    # a leaf-aggregation bug preserving structure must not hide behind
-    # the structural predicate.
-    for t in range(prefix_n):
-        np.testing.assert_allclose(
-            ens[1].leaf_value[t], ens[4].leaf_value[t],
-            rtol=1e-3, atol=1e-5, err_msg=f"prefix tree {t} leaves")
-    if first_div is not None:
-        assert_trees_match_mod_ties(
-            one_tree(ens[1], first_div), one_tree(ens[4], first_div),
-            1e-3, leaf_rtol=1e-3, max_root_causes=4)
+    prefix_n, first_div = assert_prefix_identity_mod_ties(
+        ens[1], ens[4], 1e-3)
     agreement = float((ens[1].feature == ens[4].feature).mean())
 
     hold_n, hold_seed = 200_000, 77

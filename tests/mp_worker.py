@@ -87,9 +87,12 @@ def main() -> int:
     assert be.mesh.devices.size == n_global
     ens = Driver(be, cfg, log_every=10**9).fit(Xb, y)
 
-    # Exercise the granular path too (eval_set forces it; device-side eval
-    # keeps val preds resident and fetches a replicated copy for auc —
-    # the multi-host-addressability-sensitive fetch path).
+    # Eval-set training on the pod mesh. Binary auc rides the fused path
+    # through the binned-rank device twin since round 5 (one psum'd
+    # scalar per round — no row-sized fetch); eval_round's
+    # replicated-gather branch remains only as the backend-surface
+    # fallback for metrics without a device twin (none of the shipped
+    # valid metric/loss combinations hits it anymore).
     k = 512
     ens2 = Driver(be, cfg, log_every=10**9).fit(
         Xb[k:], y[k:], eval_set=(Xb[:k], y[:k]), eval_metric="auc")
@@ -106,7 +109,13 @@ def main() -> int:
     chunks_mod.shard_arrays(Xb, y, stream_dir, n_chunks=4)
     src = chunks_mod.directory_chunks(stream_dir)
     assert src.binned
-    ens3 = fit_streaming(src, src.n_chunks, cfg, backend=be)
+    # BAGGED streaming (round 5): the counter-based keep bits derive
+    # from global row ids computed per (process, shard) via axis_index —
+    # cross-process identity of the masks is exactly what this layer
+    # can break and the virtual mesh cannot witness.
+    cfg_bag = cfg.replace(subsample=0.8, seed=7)
+    ens3 = fit_streaming(src, src.n_chunks, cfg_bag,
+                         backend=get_backend(cfg_bag))
 
     np.savez(
         out,

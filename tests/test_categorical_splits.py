@@ -344,14 +344,13 @@ def test_config3_partitioned_at_reduced_scale():
     """Reduced-size twin of the config-3 at-scale witness
     (experiments/config3_scale.py; PERF.md round-5): Criteo-shaped
     categorical training over 4 row partitions upholds the scale
-    contract — bitwise-identical tree PREFIX up to the first divergence,
-    any first-divergence root cause a PROVABLE bf16-boundary tie (the
+    contract (tree_compare.assert_prefix_identity_mod_ties — ONE home,
+    shared with the witness): bitwise-identical tree prefix, any
+    first-divergence root cause a PROVABLE bf16-boundary tie (the
     cross-partition psum-order seam), later trees quality-equivalent
     (holdout AUC). At this size divergence usually doesn't occur at all
     and the whole run is bitwise."""
-    import dataclasses
-
-    from tree_compare import assert_trees_match_mod_ties
+    from tree_compare import assert_prefix_identity_mod_ties
 
     X, y, cat = _ctr_matrix(rows=200_000, seed=5)
     m = fit_bin_mapper(X, n_bins=63, cat_features=cat)
@@ -364,38 +363,7 @@ def test_config3_partitioned_at_reduced_scale():
         ens[parts] = Driver(get_backend(cfg), cfg,
                             log_every=10**9).fit(Xb, y)
 
-    same = [
-        bool(np.array_equal(ens[1].feature[t], ens[4].feature[t])
-             and np.array_equal(ens[1].threshold_bin[t],
-                                ens[4].threshold_bin[t])
-             and np.array_equal(ens[1].is_leaf[t], ens[4].is_leaf[t]))
-        for t in range(ens[1].n_trees)
-    ]
-    first = same.index(False) if False in same else len(same)
-    # The matched prefix must ALSO carry equivalent leaf values
-    # (decisions are bitwise; values drift only by the f32 psum-order
-    # ULPs) — a leaf-aggregation bug that preserves structure must not
-    # hide behind the structural predicate.
-    for t in range(first):
-        np.testing.assert_allclose(
-            ens[1].leaf_value[t], ens[4].leaf_value[t],
-            rtol=1e-3, atol=1e-5, err_msg=f"prefix tree {t} leaves")
-    if False in same:
-
-        def one_tree(e, t):
-            return dataclasses.replace(
-                e, feature=e.feature[t:t + 1],
-                threshold_bin=e.threshold_bin[t:t + 1],
-                threshold_raw=e.threshold_raw[t:t + 1],
-                is_leaf=e.is_leaf[t:t + 1],
-                leaf_value=e.leaf_value[t:t + 1],
-                split_gain=e.split_gain[t:t + 1],
-                default_left=(None if e.default_left is None
-                              else e.default_left[t:t + 1]))
-
-        assert_trees_match_mod_ties(
-            one_tree(ens[1], first), one_tree(ens[4], first),
-            1e-3, leaf_rtol=1e-3, max_root_causes=4)
+    assert_prefix_identity_mod_ties(ens[1], ens[4], 1e-3)
     from ddt_tpu.utils.metrics import auc
 
     a1 = auc(y, ens[1].predict_raw(Xb, binned=True))

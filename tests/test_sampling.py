@@ -300,21 +300,102 @@ def test_bagging_fused_pod_mesh_identity():
     np.testing.assert_array_equal(single.is_leaf, pod.is_leaf)
 
 
-def test_bagged_eval_set_stays_correct():
-    """bagging + eval_set rides the granular path (the eval scan does not
-    thread round ids): histories must match CPU and the run must early
-    stop cleanly."""
+def test_bagged_eval_set_rides_fused_and_matches_cpu():
+    """bagging + eval_set rides the FUSED path (round ids ride the eval
+    scan as xs; grow_rounds_eval must engage): histories must match the
+    CPU host-eval path and the models must be identical."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.config import TrainConfig as TC
+
     X, y = synthetic_binary(3000, n_features=8, seed=3)
     kw = dict(n_trees=12, max_depth=4, n_bins=63, subsample=0.8, seed=5,
               log_every=1, eval_set=(X[2400:], y[2400:]),
               eval_metric="logloss")
     rc = api.train(X[:2400], y[:2400], backend="cpu", **kw)
-    rt = api.train(X[:2400], y[:2400], backend="tpu", **kw)
+    be = get_backend(TC(backend="tpu", n_trees=12, max_depth=4, n_bins=63,
+                        subsample=0.8, seed=5))
+    calls = {"fused_eval": 0}
+    orig = be.grow_rounds_eval
+
+    def spy(*a, **k):
+        calls["fused_eval"] += 1
+        return orig(*a, **k)
+
+    be.grow_rounds_eval = spy
+    try:
+        rt = api.train(X[:2400], y[:2400], backend="tpu", **kw)
+    finally:
+        be.grow_rounds_eval = orig
+    assert calls["fused_eval"] >= 1
     hc = [r["valid_logloss"] for r in rc.history if "valid_logloss" in r]
     ht = [r["valid_logloss"] for r in rt.history if "valid_logloss" in r]
     assert len(ht) == 12
     np.testing.assert_allclose(hc, ht, rtol=2e-5)
     np.testing.assert_array_equal(rc.ensemble.feature, rt.ensemble.feature)
+
+
+def test_full_stochastic_eval_combo_fused_matches_cpu():
+    """The whole stochastic matrix at once — colsample + bagging +
+    eval_set + early stopping — rides ONE fused scan (round 5 closes
+    the matrix; only profiling still runs granular): grow_rounds_eval
+    must engage with masks and round ids as xs, and the device run must
+    grow the CPU host-eval path's exact trees with matching histories
+    and stopping decision."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.config import TrainConfig as TC
+
+    X, y = synthetic_binary(3000, n_features=10, seed=13)
+    kw = dict(n_trees=20, max_depth=4, n_bins=63, subsample=0.8,
+              colsample_bytree=0.6, seed=21, log_every=1,
+              eval_set=(X[2400:], y[2400:]), eval_metric="logloss",
+              early_stopping_rounds=5)
+    rc = api.train(X[:2400], y[:2400], backend="cpu", **kw)
+    be = get_backend(TC(backend="tpu", max_depth=4, n_bins=63,
+                        subsample=0.8, colsample_bytree=0.6, seed=21))
+    calls = {"n": 0}
+    orig = be.grow_rounds_eval
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        assert k.get("fmasks") is not None     # masks rode the eval scan
+        return orig(*a, **k)
+
+    be.grow_rounds_eval = spy
+    try:
+        rt = api.train(X[:2400], y[:2400], backend="tpu", **kw)
+    finally:
+        be.grow_rounds_eval = orig
+    assert calls["n"] >= 1
+    assert rc.best_round == rt.best_round
+    hc = [r["valid_logloss"] for r in rc.history if "valid_logloss" in r]
+    ht = [r["valid_logloss"] for r in rt.history if "valid_logloss" in r]
+    np.testing.assert_allclose(hc, ht, rtol=2e-5)
+    np.testing.assert_array_equal(rc.ensemble.feature, rt.ensemble.feature)
+    np.testing.assert_array_equal(rc.ensemble.threshold_bin,
+                                  rt.ensemble.threshold_bin)
+
+
+def test_bagged_auc_early_stop_fused_matches_granular():
+    """The full combination — bagging + auc (binned device twin) + early
+    stopping — on the fused path equals the granular device path (forced
+    by profile=True) round for round."""
+    X, y = synthetic_binary(4000, n_features=10, seed=3)
+    kw = dict(n_trees=25, max_depth=4, n_bins=63, subsample=0.75, seed=9,
+              log_every=10**9, eval_set=(X[3200:], y[3200:]),
+              eval_metric="auc", early_stopping_rounds=4, backend="tpu")
+    fused = api.train(X[:3200], y[:3200], **kw)
+    gran = api.train(X[:3200], y[:3200], profile=True, **kw)
+    assert fused.best_round == gran.best_round
+    hf = [r["valid_auc"] for r in fused.history if "valid_auc" in r]
+    hg = [r["valid_auc"] for r in gran.history if "valid_auc" in r]
+    # The two paths compile DIFFERENT programs around the same ops, so
+    # FMA contraction can move a validation score by f32 ULPs — which
+    # shifts a score across a bin edge and the binned auc by ~1 pair
+    # (the f32 score-boundary seam, driver.py docstring). The MODEL is
+    # bitwise identical; scores agree to that seam.
+    np.testing.assert_allclose(hf, hg, atol=1e-5)
+    np.testing.assert_array_equal(fused.ensemble.feature,
+                                  gran.ensemble.feature)
 
 
 def test_colsample_rides_fused_path():

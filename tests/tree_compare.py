@@ -82,3 +82,57 @@ def assert_trees_match_mod_ties(full, streamed, min_split_gain,
     cap = (max(1, T * N // 500) if max_root_causes is None
            else max_root_causes)
     assert n_root_causes <= cap, (n_root_causes, cap, T, N)
+
+
+def assert_prefix_identity_mod_ties(ens_a, ens_b, min_split_gain,
+                                    leaf_rtol=1e-3, leaf_atol=1e-5,
+                                    max_root_causes=4):
+    """The at-scale cross-partition identity contract (ONE home — the
+    config-3 witness, experiments/config3_scale.py, and its reduced-size
+    suite twin must assert the SAME thing):
+
+      - every tree BEFORE the first structural divergence is bitwise
+        identical in its decisions AND carries equivalent leaf values
+        (f32 psum-order drift only — a leaf-aggregation bug that
+        preserves structure must not hide behind the structural test);
+      - the first divergent tree's root causes are PROVABLE
+        bf16-boundary ties (assert_trees_match_mod_ties, per-tree);
+      - later trees legitimately cascade (they train on the residuals
+        the tied choice changed) and are NOT asserted here — callers
+        add a quality-equivalence check (e.g. holdout AUC).
+
+    Returns (bitwise_prefix_tree_count, first_divergent_tree_or_None).
+    """
+    import dataclasses
+
+    def one_tree(e, t):
+        return dataclasses.replace(
+            e, feature=e.feature[t:t + 1],
+            threshold_bin=e.threshold_bin[t:t + 1],
+            threshold_raw=e.threshold_raw[t:t + 1],
+            is_leaf=e.is_leaf[t:t + 1],
+            leaf_value=e.leaf_value[t:t + 1],
+            split_gain=e.split_gain[t:t + 1],
+            default_left=(None if e.default_left is None
+                          else e.default_left[t:t + 1]))
+
+    same = [
+        bool(np.array_equal(ens_a.feature[t], ens_b.feature[t])
+             and np.array_equal(ens_a.threshold_bin[t],
+                                ens_b.threshold_bin[t])
+             and np.array_equal(ens_a.is_leaf[t], ens_b.is_leaf[t]))
+        for t in range(ens_a.n_trees)
+    ]
+    first = same.index(False) if False in same else None
+    prefix_n = first if first is not None else ens_a.n_trees
+    for t in range(prefix_n):
+        np.testing.assert_allclose(
+            ens_a.leaf_value[t], ens_b.leaf_value[t],
+            rtol=leaf_rtol, atol=leaf_atol,
+            err_msg=f"prefix tree {t} leaves")
+    if first is not None:
+        assert_trees_match_mod_ties(
+            one_tree(ens_a, first), one_tree(ens_b, first),
+            min_split_gain, leaf_rtol=leaf_rtol, leaf_atol=leaf_atol,
+            max_root_causes=max_root_causes)
+    return prefix_n, first
