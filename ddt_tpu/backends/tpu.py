@@ -750,8 +750,10 @@ class TPUDevice(DeviceBackend):
     # binary auc via the binned-rank twin since round 5 — one scalar
     # crosses the host boundary per round). The metric=None branch
     # (fetch a replicated raw-score copy for host evaluation) remains
-    # as the generic fallback for twin-less metrics; no shipped valid
-    # combination reaches it today.
+    # as the generic fallback for twin-less metrics; no shipped metric
+    # is twin-less anymore, so tests/test_metrics.py's
+    # twinless-fallback test forces the registry empty to keep the
+    # branch exercised on a pod mesh.
     # ------------------------------------------------------------------ #
 
     def eval_round(self, val_data, val_pred, handles, val_y: "LabelHandle",

@@ -1,0 +1,91 @@
+"""Round-5 fuzz campaign: streamed == in-memory identity over RANDOM
+configs INCLUDING sampling (the surface round 5 added).
+
+The suite's fuzz (tests/test_config_fuzz.py) runs 5 seeds per run; this
+campaign widens the net the way round 4's 340/210-case campaigns did for
+the deterministic streamed contract: each case draws a random config
+(loss x missing x cat x bins x depth x SUBSAMPLE x COLSAMPLE), random
+chunk boundaries, and a random device-cache budget, trains in-memory and
+streamed on the tpu backend (CPU XLA), and asserts the tie-proving
+comparator contract. Root-cause ties are counted, not hidden.
+
+Usage: python experiments/fuzz_sampling_campaign.py [n_cases] [seed0]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+import jax                                          # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np                                  # noqa: E402
+
+from ddt_tpu.backends import get_backend            # noqa: E402
+from ddt_tpu.driver import Driver                   # noqa: E402
+from ddt_tpu.streaming import fit_streaming         # noqa: E402
+from test_config_fuzz import _random_case           # noqa: E402
+from tree_compare import assert_trees_match_mod_ties  # noqa: E402
+
+
+def main():
+    n_cases = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    seed0 = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    failures = []
+    sampled = 0
+    for i in range(n_cases):
+        case = seed0 + i
+        rng = np.random.default_rng((211, case))
+        Xb, y, cfg = _random_case(rng)
+        cfg = cfg.replace(backend="tpu")
+        if cfg.subsample < 1.0 or cfg.colsample_bytree < 1.0:
+            sampled += 1
+        try:
+            full = Driver(get_backend(cfg), cfg, log_every=10**9).fit(
+                Xb, y)
+            rows = len(y)
+            n_chunks = int(rng.integers(2, 6))
+            bounds = np.linspace(0, rows, n_chunks + 1).astype(int)
+
+            def chunk_fn(c):
+                return (Xb[bounds[c]:bounds[c + 1]],
+                        y[bounds[c]:bounds[c + 1]])
+
+            chunk_fn.labels = lambda c: y[bounds[c]:bounds[c + 1]]
+            chunk_fn.n_features = Xb.shape[1]
+            budget = int(rng.integers(0, Xb.nbytes + 1))
+            streamed = fit_streaming(chunk_fn, n_chunks, cfg,
+                                     device_chunk_cache=budget)
+            assert_trees_match_mod_ties(full, streamed,
+                                        cfg.min_split_gain)
+            status = "ok"
+        except Exception:
+            status = "FAIL"
+            failures.append(case)
+            traceback.print_exc()
+        print(f"case {case}: {status}  (loss={cfg.loss} bins={cfg.n_bins} "
+              f"depth={cfg.max_depth} sub={cfg.subsample} "
+              f"col={cfg.colsample_bytree} "
+              f"miss={cfg.missing_policy} cat={bool(cfg.cat_features)})",
+              flush=True)
+    print(json.dumps({"cases": n_cases, "sampled_cases": sampled,
+                      "failures": failures}), flush=True)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -267,6 +267,31 @@ def test_device_auc_parity_adversarial():
     assert np.isnan(got)
 
 
+def test_twinless_metric_gather_fallback_pod_mesh(monkeypatch):
+    """eval_round's metric=None branch — fetch a REPLICATED raw-score
+    copy for host evaluation — is the generic fallback for metrics
+    without a device twin. No shipped metric is twin-less anymore
+    (round 5 gave auc one), so this test keeps the branch exercised on
+    the multi-host-addressability-sensitive pod mesh by forcing the
+    twin registry empty: histories must still match the CPU host-eval
+    path."""
+    import ddt_tpu.utils.metrics as M
+
+    monkeypatch.setattr(M, "device_metric",
+                        lambda name, n_classes=1: None)
+    X, y = synthetic_binary(3000, n_features=8, seed=3)
+    kw = dict(n_trees=6, max_depth=3, n_bins=31, log_every=1,
+              eval_set=(X[2400:], y[2400:]), eval_metric="logloss")
+    rt = api.train(X[:2400], y[:2400], backend="tpu",
+                   host_partitions=2, n_partitions=2, **kw)
+    monkeypatch.undo()
+    rc = api.train(X[:2400], y[:2400], backend="cpu", **kw)
+    hc = [r["valid_logloss"] for r in rc.history if "valid_logloss" in r]
+    ht = [r["valid_logloss"] for r in rt.history if "valid_logloss" in r]
+    assert len(ht) == 6
+    np.testing.assert_allclose(hc, ht, rtol=2e-5)
+
+
 def test_softmax_auc_rejected_at_fit():
     """auc is binary; with softmax raw scores the host rank formulation
     crashes deep inside ravel — both trainers fail at the cause

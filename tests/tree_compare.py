@@ -13,7 +13,8 @@ import numpy as np
 
 
 def assert_trees_match_mod_ties(full, streamed, min_split_gain,
-                                leaf_rtol=2e-4, leaf_atol=2e-5,
+                                leaf_rtol=1e-3, leaf_atol=2e-5,
+                                leaf_contrib_atol=1e-3,
                                 max_root_causes=None):
     """Bitwise tree equality, except provable f32-order boundary ties.
 
@@ -36,11 +37,25 @@ def assert_trees_match_mod_ties(full, streamed, min_split_gain,
       - descendants of a flipped decision legitimately diverge and are
         excluded (different rows reach them);
       - root causes stay rare (they are measured to be). The default
-        rarity cap and leaf tolerances are calibrated for the fuzz
-        suites' scales; million-row witnesses pass explicit
-        `max_root_causes` / `leaf_rtol` (boundary-tie incidence and f32
-        leaf-sum drift both grow with row count — the config-3 witness,
-        experiments/config3_scale.py, documents the measured rates)."""
+        rarity cap is calibrated for the fuzz suites' scales;
+        million-row witnesses pass explicit `max_root_causes`
+        (boundary-tie incidence grows with row count — the config-3
+        witness, experiments/config3_scale.py, documents the measured
+        rates).
+
+    Leaf values pass when EITHER bound holds: the relative/absolute
+    allclose (leaf_rtol/leaf_atol), or a pred-CONTRIBUTION bound
+    lr * |dv| <= leaf_contrib_atol. The second models legitimate drift
+    cascade, found by the round-5 sampling campaign (case 1063): with
+    reg_lambda=0 a near-empty leaf carries |value| ~ 1/min_child_weight
+    (~1600 there), so an in-contract RELATIVE drift of 2e-4 is ~0.33
+    absolute; times lr*sigmoid' it shifts the next round's gradients
+    and moves downstream leaves by absolute amounts that blow past any
+    fixed RELATIVE tolerance exactly where |v| is small (measured:
+    3.5e-3 on a 0.79 leaf — 4.4e-3 relative, but only 3.5e-4 of pred
+    contribution). What propagates — and what a real leaf-aggregation
+    bug inflates — is lr * |dv|; the adversarial suite's perturbations
+    (lr * 0.1 = 1e-2) stay firmly rejected."""
     TIE = 2 ** -6                     # 2 bf16 ULPs, relative
     T, N = full.feature.shape
     n_root_causes = 0
@@ -56,10 +71,12 @@ def assert_trees_match_mod_ties(full, streamed, min_split_gain,
             ga = float(full.split_gain[t, s_])
             gb = float(streamed.split_gain[t, s_])
             if (fa, ba, la) == (fb, bb, lb):
-                np.testing.assert_allclose(
-                    full.leaf_value[t, s_], streamed.leaf_value[t, s_],
-                    rtol=leaf_rtol, atol=leaf_atol,
-                    err_msg=f"tree {t} slot {s_}")
+                va = float(full.leaf_value[t, s_])
+                vb = float(streamed.leaf_value[t, s_])
+                dv = abs(va - vb)
+                assert (dv <= leaf_atol + leaf_rtol * abs(vb)
+                        or dv * full.learning_rate <= leaf_contrib_atol), \
+                    ("leaf value", t, s_, va, vb)
                 assert abs(ga - gb) <= TIE * max(abs(ga), abs(gb), 1e-12), \
                     (t, s_, ga, gb)
                 if not la and 2 * s_ + 2 < N:
