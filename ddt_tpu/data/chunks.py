@@ -113,6 +113,34 @@ def shard_file(
     return shard_arrays(X, y, out_dir, chunk_rows=chunk_rows)
 
 
+def shard_stress_chunks(
+    out_dir: str,
+    rows: int,
+    n_chunks: int,
+    n_features: int = 64,
+    seed: int = 7,
+    n_bins: int = 63,
+) -> int:
+    """Cut `rows` of the deterministic stress generator
+    (data.datasets.stress_binned_chunk) into npz shards, ONE chunk in
+    memory at a time (the writer itself is O(chunk) — the scale
+    harnesses assert that). The single home of the stress-shard naming
+    contract the scale experiments and RSS tests share; returns the
+    per-chunk row count."""
+    from ddt_tpu.data.datasets import stress_binned_chunk
+
+    os.makedirs(out_dir, exist_ok=True)
+    chunk_rows = rows // n_chunks
+    for c in range(n_chunks):
+        Xc, yc = stress_binned_chunk(
+            c, chunk_rows, n_features=n_features, seed=seed,
+            n_bins=n_bins)
+        np.savez(_chunk_path(out_dir, c), X=Xc, y=yc)
+        del Xc, yc
+    _purge_stale(out_dir, n_chunks)
+    return chunk_rows
+
+
 def directory_chunks(src_dir: str):
     """ChunkFn over a shard directory. Exposes the side-channel accessors
     fit_streaming/binned_chunks use: ``.labels(c)`` (reads only the y

@@ -27,12 +27,9 @@ from ddt_tpu.backends.tpu import enable_persistent_compile_cache  # noqa: E402
 
 enable_persistent_compile_cache()
 
-import numpy as np  # noqa: E402
-
 from ddt_tpu.backends import get_backend  # noqa: E402
 from ddt_tpu.config import TrainConfig  # noqa: E402
 from ddt_tpu.data import chunks as chunks_mod  # noqa: E402
-from ddt_tpu.data import datasets  # noqa: E402
 from ddt_tpu.streaming import fit_streaming  # noqa: E402
 from experiments.paired_protocol import paired_ab  # noqa: E402
 
@@ -46,13 +43,9 @@ def main() -> None:
     print(f"platform={jax.default_backend()} rows={rows}", flush=True)
     shard_dir = os.path.join(WORK, "shards")
     shutil.rmtree(shard_dir, ignore_errors=True)
-    os.makedirs(shard_dir)
-    chunk_rows = rows // N_CHUNKS
-    for c in range(N_CHUNKS):
-        Xc, yc = datasets.stress_binned_chunk(
-            c, chunk_rows, n_features=FEATURES, seed=7, n_bins=BINS)
-        np.savez(os.path.join(shard_dir, f"chunk_{c:05d}.npz"), X=Xc, y=yc)
-        del Xc, yc
+    chunks_mod.shard_stress_chunks(shard_dir, rows, N_CHUNKS,
+                                   n_features=FEATURES, seed=7,
+                                   n_bins=BINS)
     src = chunks_mod.directory_chunks(shard_dir)
 
     def bout_for(subsample):

@@ -39,7 +39,6 @@ import numpy as np  # noqa: E402
 from ddt_tpu.backends import get_backend  # noqa: E402
 from ddt_tpu.config import TrainConfig  # noqa: E402
 from ddt_tpu.data import chunks as chunks_mod  # noqa: E402
-from ddt_tpu.data import datasets  # noqa: E402
 from ddt_tpu.streaming import fit_streaming  # noqa: E402
 
 ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000_000
@@ -60,14 +59,10 @@ def main() -> None:
 
     shard_dir = os.path.join(WORK, "shards")
     shutil.rmtree(shard_dir, ignore_errors=True)
-    os.makedirs(shard_dir)
-    chunk_rows = ROWS // N_CHUNKS
     t0 = time.perf_counter()
-    for c in range(N_CHUNKS):
-        Xc, yc = datasets.stress_binned_chunk(
-            c, chunk_rows, n_features=FEATURES, seed=7, n_bins=BINS)
-        np.savez(os.path.join(shard_dir, f"chunk_{c:05d}.npz"), X=Xc, y=yc)
-        del Xc, yc
+    chunks_mod.shard_stress_chunks(shard_dir, ROWS, N_CHUNKS,
+                                   n_features=FEATURES, seed=7,
+                                   n_bins=BINS)
     t_shard = time.perf_counter() - t0
     print(f"sharded {ROWS * FEATURES / 1e9:.2f} GB in {t_shard:.0f}s "
           f"(rss {rss_mb():.0f} MB)", flush=True)

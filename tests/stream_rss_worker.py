@@ -39,21 +39,15 @@ def main() -> int:
     import numpy as np
 
     from ddt_tpu.data import chunks as chunks_mod
-    from ddt_tpu.data import datasets
 
     jax.devices()                       # force platform init into baseline
     rss_baseline = _rss_mb()
 
     # Cut shards one chunk at a time — the writer itself must be O(chunk).
-    chunk_rows = rows // n_chunks
     shard_dir = os.path.join(work_dir, "shards")
-    os.makedirs(shard_dir, exist_ok=True)
-    for c in range(n_chunks):
-        Xc, yc = datasets.stress_binned_chunk(
-            c, chunk_rows, n_features=features, seed=5, n_bins=bins)
-        np.savez(os.path.join(shard_dir, f"chunk_{c:05d}.npz"),
-                 X=Xc, y=yc)
-        del Xc, yc
+    chunk_rows = chunks_mod.shard_stress_chunks(
+        shard_dir, rows, n_chunks, n_features=features, seed=5,
+        n_bins=bins)
     rss_sharded = _rss_mb()
 
     from ddt_tpu.cli import main as cli_main
