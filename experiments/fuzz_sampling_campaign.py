@@ -9,7 +9,11 @@ chunk boundaries, and a random device-cache budget, trains in-memory and
 streamed on the tpu backend (CPU XLA), and asserts the tie-proving
 comparator contract. Root-cause ties are counted, not hidden.
 
-Usage: python experiments/fuzz_sampling_campaign.py [n_cases] [seed0]
+Usage: python experiments/fuzz_sampling_campaign.py [n_cases] [seed0] [chip]
+(third arg "chip" runs on the default platform — the real TPU under the
+driver — so the streamed==in-memory contract is witnessed ON HARDWARE;
+both arms share the platform, so the cross-platform seam does not
+apply. Default pins the 8-virtual-device CPU mesh.)
 """
 
 from __future__ import annotations
@@ -31,7 +35,8 @@ if "xla_force_host_platform_device_count" not in os.environ.get(
 
 import jax                                          # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if (sys.argv[3] if len(sys.argv) > 3 else "") != "chip":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np                                  # noqa: E402
 
@@ -45,6 +50,7 @@ from tree_compare import assert_trees_match_mod_ties  # noqa: E402
 def main():
     n_cases = int(sys.argv[1]) if len(sys.argv) > 1 else 100
     seed0 = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    print(f"# platform={jax.default_backend()}", flush=True)
     failures = []
     sampled = 0
     for i in range(n_cases):
