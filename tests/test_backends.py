@@ -147,6 +147,67 @@ def test_checkpoint_resume_matches_uninterrupted(tmp_path):
     assert np.any(resumed.split_gain[:6] > 0)
 
 
+def test_checkpoint_resume_with_sampling_matches_uninterrupted(tmp_path):
+    """Round 5: bagging/colsample masks are STATELESS counter draws
+    (ops/sampling) — there is no RNG stream to lose in a crash, so a
+    resumed run recomputes the IDENTICAL masks for the rounds it
+    continues (the fused path rebuilds them in-scan from first_round).
+    6-then-resume-to-10 must equal straight-10, like the deterministic
+    resume contract above."""
+    Xb, y, _ = _small_problem(rows=1500)
+    cfg = TrainConfig(n_trees=10, max_depth=4, n_bins=31, backend="tpu",
+                      subsample=0.75, colsample_bytree=0.7, seed=11)
+
+    be = get_backend(cfg)
+    full = Driver(be, cfg, log_every=10**9).fit(Xb, y)
+
+    ck = str(tmp_path / "ck")
+    be1 = get_backend(cfg.replace(n_trees=6))
+    Driver(be1, cfg.replace(n_trees=6), log_every=10**9,
+           checkpoint_dir=ck, checkpoint_every=3).fit(Xb, y)
+    be2 = get_backend(cfg)
+    resumed = Driver(be2, cfg, log_every=10**9,
+                     checkpoint_dir=ck, checkpoint_every=5).fit(Xb, y)
+
+    np.testing.assert_array_equal(full.feature, resumed.feature)
+    np.testing.assert_array_equal(full.threshold_bin,
+                                  resumed.threshold_bin)
+    np.testing.assert_array_equal(full.is_leaf, resumed.is_leaf)
+    np.testing.assert_allclose(full.leaf_value, resumed.leaf_value,
+                               rtol=2e-4, atol=2e-5)
+    # Gains must survive a sampled resume too (the deterministic resume
+    # test added this for a real round-1 regression; the fused masked
+    # scan is a different writer and deserves the same tripwire).
+    np.testing.assert_allclose(full.split_gain, resumed.split_gain,
+                               rtol=2e-4, atol=2e-5)
+    assert np.any(resumed.split_gain[:6] > 0)
+
+
+def test_streaming_checkpoint_resume_with_sampling(tmp_path):
+    """The streamed twin: a bagged streaming run interrupted at round 4
+    resumes to the straight run's exact trees (per-chunk device masks
+    re-derive from (seed, round, global row id) — nothing to replay)."""
+    from ddt_tpu.streaming import fit_streaming
+
+    Xb, y, _ = _small_problem(rows=2000)
+    cfg = TrainConfig(n_trees=8, max_depth=3, n_bins=31, backend="tpu",
+                      subsample=0.8, colsample_bytree=0.7, seed=5)
+
+    def cf(c):
+        return Xb[c * 500:(c + 1) * 500], y[c * 500:(c + 1) * 500]
+
+    full = fit_streaming(cf, 4, cfg)
+    ck = str(tmp_path / "ck")
+    fit_streaming(cf, 4, cfg.replace(n_trees=4), checkpoint_dir=ck,
+                  checkpoint_every=2)
+    resumed = fit_streaming(cf, 4, cfg, checkpoint_dir=ck,
+                            checkpoint_every=4)
+    np.testing.assert_array_equal(full.feature, resumed.feature)
+    np.testing.assert_array_equal(full.threshold_bin,
+                                  resumed.threshold_bin)
+    np.testing.assert_array_equal(full.leaf_value, resumed.leaf_value)
+
+
 def test_checkpoint_config_mismatch_refuses(tmp_path):
     Xb, y, _ = _small_problem(rows=500)
     ck = str(tmp_path / "ck")
