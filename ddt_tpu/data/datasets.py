@@ -273,13 +273,16 @@ def load_file(
     M = None
     try:
         from ddt_tpu.native import csv_parse_native
-
+    except (ImportError, OSError):   # OSError: unloadable .so via
+        csv_parse_native = None      # ctypes.CDLL (e.g. sanitizer build
+                                     # without its runtime preloaded)
+    if csv_parse_native is not None:
+        # File I/O errors (missing file, permissions, bad gzip) are NOT
+        # guarded — they must surface here, not after a loadtxt re-read.
         opener = gzip.open if path.endswith(".gz") else open
         with opener(path, "rb") as f:
             M = csv_parse_native(f.read(), skip_rows=skip,
                                  max_rows=max_rows)
-    except ImportError:
-        pass
     if M is None:
         with _open_maybe_gzip(path) as f:
             M = np.loadtxt(f, delimiter=",", skiprows=skip,

@@ -149,8 +149,8 @@ class TreeEnsemble:
         predict-path fuzz asserts it)."""
         try:
             from ddt_tpu.native import traverse_native
-        except ImportError:
-            return None
+        except Exception:   # no toolchain, or an unloadable .so (OSError
+            return None     # from ctypes.CDLL) — NumPy path either way
         cat_node = (
             np.isin(self.feature, self.cat_features)
             if self.has_cat_splits else None

@@ -37,7 +37,8 @@ _SO = os.path.join(_DIR, os.environ.get("DDT_NATIVE_LIB", "libddthist.so"))
 # pre-change .so fail the symbol check below instead of being called with
 # a mismatched ABI (which would reinterpret a pointer as the row count).
 _SYMBOLS = ("ddt_build_histograms", "ddt_traverse_v3", "ddt_split_gain",
-            "ddt_split_gain_full", "ddt_csv_parse")
+            "ddt_split_gain_full", "ddt_csv_parse", "ddt_omp_max_threads",
+            "ddt_omp_set_threads")
 
 
 def _stale() -> bool:
@@ -166,9 +167,45 @@ _lib.ddt_csv_parse.argtypes = [
 ]
 _lib.ddt_csv_parse.restype = ctypes.c_int64
 
+_lib.ddt_omp_max_threads.argtypes = []
+_lib.ddt_omp_max_threads.restype = ctypes.c_int32
+_lib.ddt_omp_set_threads.argtypes = [ctypes.c_int32]
+_lib.ddt_omp_set_threads.restype = None
+
 
 def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def omp_max_threads() -> int:
+    """OpenMP max team size the kernels will use (1 = serial path)."""
+    return int(_lib.ddt_omp_max_threads())
+
+
+def omp_set_threads(n: int) -> None:
+    """Pin the kernels' OpenMP team size. The multi-thread histogram
+    reduction is deterministic per team size but its summation order
+    differs from the serial/NumPy row order (~1e-6 float32 reassociation
+    — histogram.cpp reduction comment); bit-exactness contracts pin 1."""
+    _lib.ddt_omp_set_threads(int(n))
+
+
+class omp_threads:
+    """Context manager pinning the native kernels' OpenMP team size
+    (default 1, the serial bit-exact path); restores the previous size on
+    exit even when the body raises."""
+
+    def __init__(self, n: int = 1):
+        self._n = n
+
+    def __enter__(self):
+        self._prev = omp_max_threads()
+        omp_set_threads(self._n)
+        return self
+
+    def __exit__(self, *exc):
+        omp_set_threads(self._prev)
+        return False
 
 
 def histogram_native(

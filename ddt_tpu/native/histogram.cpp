@@ -69,6 +69,10 @@ void ddt_build_histograms(
 #pragma omp parallel
     {
         const int t = omp_get_thread_num();
+        // Actual team size — can be smaller than omp_get_max_threads()
+        // (dynamic adjustment, thread limits); privs[nt..) then stay
+        // empty and must not be read by the reduction below.
+        const int nt = omp_get_num_threads();
         privs[t].assign(total, 0.0f);
         float* priv = privs[t].data();
 
@@ -88,11 +92,23 @@ void ddt_build_histograms(
         }
 
         // Tree-free reduction: each thread owns a disjoint slice of `out`
-        // and sums all private copies into it.
+        // and sums all private copies into it. The cross-thread reads of
+        // privs[tt] are ordered by the implicit barrier at the end of the
+        // row loop above (every assign + private accumulation
+        // happens-before every read here). TSan cannot see that edge when
+        // libgomp is uninstrumented and reports these reads as races —
+        // the documented false-positive class in native/tsan.supp.
+        //
+        // Deterministic for a FIXED team size (static chunks, reduction
+        // in thread order), but the summation ORDER differs from the
+        // serial row-order loop, so multi-thread results differ from the
+        // 1-thread/NumPy oracle at the float32 reassociation level
+        // (~1e-6 relative). Bit-exactness contracts pin 1 thread via
+        // ddt_omp_set_threads.
 #pragma omp for schedule(static)
         for (int64_t i = 0; i < total; ++i) {
             float acc = 0.0f;
-            for (int tt = 0; tt < n_threads; ++tt) acc += privs[tt][i];
+            for (int tt = 0; tt < nt; ++tt) acc += privs[tt][i];
             out[i] += acc;
         }
     }
@@ -156,6 +172,27 @@ void ddt_traverse_v3(
             out_t[r] = node;
         }
     }
+}
+
+// OpenMP thread control for callers that need summation-order
+// determinism (the multi-thread histogram reduction is deterministic per
+// team size but differs from the serial order — see the reduction
+// comment above). The bit-exactness tests pin 1 thread around their
+// assertions through these.
+int32_t ddt_omp_max_threads(void) {
+#ifdef _OPENMP
+    return omp_get_max_threads();
+#else
+    return 1;
+#endif
+}
+
+void ddt_omp_set_threads(int32_t n) {
+#ifdef _OPENMP
+    if (n > 0) omp_set_num_threads(n);
+#else
+    (void)n;
+#endif
 }
 
 }  // extern "C"

@@ -28,3 +28,27 @@ assert jax.default_backend() == "cpu", jax.default_backend()
 assert len(jax.devices()) == 8, jax.devices()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The suite's bit-identity contracts (native == NumPy oracle, CPU == TPU
+# ensembles, N == 1 partitions, streamed == in-memory) assume the native
+# kernels' SERIAL summation order: at OpenMP team sizes > 1 the histogram
+# reduction reassociates float32 sums (~1e-6 — native/histogram.cpp), which
+# can flip near-tie bf16 argmax splits in any module that trains through
+# CPUDevice. Pin one thread for the whole suite regardless of the host's
+# core count or OMP_NUM_THREADS; multi-thread kernel behavior has its own
+# explicit coverage (test_native.py
+# test_native_multithread_allclose_deterministic, which raises the team
+# size inside its body and restores it).
+# Import cost at collection: a fresh .so is one dlopen (~ms); after a
+# .cpp edit this triggers the rebuild here instead of at first CPUDevice
+# use — acceptable, the suite is normally run whole from the repo root.
+# Catch broadly, not just ImportError: ctypes.CDLL raises OSError on a
+# corrupt/wrong-arch/unresolvable library (e.g. a sanitizer build named
+# via DDT_NATIVE_LIB without its runtime preloaded), and the suite must
+# then still run on the NumPy fallback kernels — which need no pin.
+try:
+    from ddt_tpu import native as _native
+
+    _native.omp_set_threads(1)
+except Exception:
+    pass
