@@ -163,6 +163,61 @@ def bench_histogram_ab(
     }
 
 
+def bench_histogram_one_dispatch(
+    rows: int = 1_000_000,
+    features: int = 28,
+    bins: int = 255,
+    n_nodes: int = 32,
+    iters: int = 10,
+    reps: int = 8,
+    seed: int = 0,
+) -> dict:
+    """One-dispatch headline twin: `iters` kernel invocations inside ONE
+    jitted lax.fori_loop — two tunnel round-trips per rep instead of one
+    per dispatch. experiments/hist_dispatch_ab.py measured the
+    dispatch-loop protocol at 33% within-window spread (incl. spuriously
+    FAST samples that min-of-reps then reports) vs 7.6% for this
+    formulation in the same window; device-rate bands remain real across
+    windows (docs/PERF.md round-5 addendum), but this statistic is far
+    better conditioned within one. A tiny data dependence (g advanced by
+    a scalar read of the previous histogram) keeps XLA from hoisting the
+    loop body; the +iters elementwise adds on g are noise against the
+    histogram passes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddt_tpu.ops import histogram as hist_ops
+
+    Xb_h, g_h, h_h, ni_h = _hist_inputs(rows, features, bins, n_nodes, seed)
+    Xb = jnp.asarray(Xb_h)
+    g0 = jnp.asarray(g_h)
+    h = jnp.asarray(h_h)
+    ni = jnp.asarray(ni_h)
+
+    @jax.jit
+    def k_in_one(g):
+        def body(_, carry):
+            g2, acc = carry
+            out = hist_ops.build_histograms(Xb, g2, h, ni, n_nodes, bins)
+            s = out[0, 0, 0, 0] * jnp.float32(1e-30)
+            return g2 + s, acc + s
+        return jax.lax.fori_loop(0, iters, body, (g, jnp.float32(0.0)))[1]
+
+    float(k_in_one(g0))                      # compile + first run
+    dt = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(k_in_one(g0))                  # scalar fetch = the barrier
+        dt = min(dt, (time.perf_counter() - t0) / iters)
+    return {
+        "kernel": "histogram_one_dispatch",
+        "rows": rows, "features": features, "bins": bins,
+        "n_nodes": n_nodes, "iters": iters,
+        "sec_per_build": dt,
+        "mrows_per_sec_per_chip": rows / dt / 1e6,
+    }
+
+
 def bench_train(
     backend: str = "tpu",
     rows: int = 1_000_000,
