@@ -70,13 +70,14 @@ TPU_FLOOR_MROWS = 35.0
 # One-dispatch headline twin (round 5, experiments/hist_dispatch_ab.py
 # + docs/PERF.md): iters kernel invocations in ONE jitted fori_loop —
 # 7.6% within-window spread vs 33% for the dispatch-loop protocol
-# (whose min-of-reps reports its own spuriously-fast tail samples).
-# Device-rate bands remain real ACROSS windows (measured 47.3 one
-# window, 59.5 another), so this floor still tolerates bands — but the
-# tight within-window spread means a trip is far more likely a kernel
-# regression than band luck. Floor 38: under every sample seen
+# (whose min-of-reps reports transient fast-regime excursions as the
+# run's value). The device rate itself is ~bimodal ACROSS windows (a
+# ~47 regime and a ~59-60 regime, minutes timescale — docs/PERF.md), so
+# this floor still tolerates regimes — but the tight within-regime
+# spread means a trip is far more likely a kernel regression than
+# regime luck. Floor 38: under every one-dispatch sample seen
 # (43.9-59.5), above the matmul-fallback known-bad mode (~26).
-# Two-window calibration — refine as artifacts accumulate.
+# Three-window calibration — refine as artifacts accumulate.
 TPU_ONE_DISPATCH_FLOOR_MROWS = 38.0
 E2E_CEILING_S = 32.0
 PREDICT_FLOOR_MROWS = 1.2
