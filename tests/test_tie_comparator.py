@@ -149,3 +149,117 @@ def test_accepts_split_leaf_flip_at_the_floor():
     mut.feature[0, 2] = -1
     mut.split_gain[0, 2] = 0.0
     assert_trees_match_mod_ties(full, mut, MSG)
+
+
+def test_accepts_cascade_gain_drift_after_root_cause():
+    """After an accepted tie root cause in tree 0, later rounds train on
+    legitimately-diverged predictions, so matched decisions there may
+    carry small ABSOLUTE gain drift beyond the relative bf16 window
+    (round-5 campaign case 10030: |dg|=1.5e-4 on a 0.004 gain). The
+    cascade allowance accepts it — in LATER rounds only."""
+    full = _make_ens()
+    mut = copy.deepcopy(full)
+    # Tree 0: accepted candidate tie (gains within the window).
+    mut.feature[0, 1] = 3
+    mut.threshold_bin[0, 1] = 9
+    mut.split_gain[0, 1] = full.split_gain[0, 1] * (1 + TIE / 2)
+    mut.leaf_value[0, 3:5] = [-9.0, 9.0]
+    # Tree 1 (later round, logloss => 1 tree/round): matched decision
+    # with a small-gain node drifted 1.5e-4 absolute (beyond TIE rel).
+    full.split_gain[1, 2] = np.float32(0.004)
+    mut.split_gain[1, 2] = np.float32(0.004 + 1.5e-4)
+    assert_trees_match_mod_ties(full, mut, MSG)
+
+
+def test_rejects_gain_corruption_even_after_root_cause():
+    """The cascade allowance must NOT open the door to real corruption:
+    with the same accepted tie in tree 0, a 10% drift on a LARGE gain
+    (0.035 absolute > cascade_gain_atol) in a later round still fails."""
+    full = _make_ens()
+    mut = copy.deepcopy(full)
+    mut.feature[0, 1] = 3
+    mut.threshold_bin[0, 1] = 9
+    mut.split_gain[0, 1] = full.split_gain[0, 1] * (1 + TIE / 2)
+    mut.leaf_value[0, 3:5] = [-9.0, 9.0]
+    mut.split_gain[1, 0] *= 1.10          # 0.35 -> 0.385: 0.035 absolute
+    _reject(full, mut)
+
+
+def test_rejects_cascade_scale_drift_in_same_round_as_root_cause():
+    """The allowance is scoped to rounds AFTER the first root cause:
+    the same 1.5e-4 absolute small-gain drift inside the root cause's
+    own round (tree 0 here) must still fail the strict window — nodes
+    there trained on identical predictions."""
+    full = _make_ens()
+    mut = copy.deepcopy(full)
+    mut.feature[0, 1] = 3
+    mut.threshold_bin[0, 1] = 9
+    mut.split_gain[0, 1] = full.split_gain[0, 1] * (1 + TIE / 2)
+    mut.leaf_value[0, 3:5] = [-9.0, 9.0]
+    full.split_gain[0, 2] = np.float32(0.004)
+    mut.split_gain[0, 2] = np.float32(0.004 + 1.5e-4)
+    _reject(full, mut)
+
+
+def test_accepts_cascade_leaf_drift_after_root_cause():
+    """Case 10030's leaf face: post-root-cause leaves drift ~1.5x past
+    both tight bounds (measured relative 1.47e-3, contribution 1.69e-3).
+    This drift is sized to REQUIRE the 5x cascade scale with this
+    fixture's lr=0.1: dv=0.03 -> contribution 3e-3, between the 1x
+    (1e-3) and 5x (5e-3) contribution bounds, and beyond both relative
+    bounds — so the test fails if cascade_leaf_scale is lost."""
+    full = _make_ens()
+    mut = copy.deepcopy(full)
+    mut.feature[0, 1] = 3
+    mut.threshold_bin[0, 1] = 9
+    mut.split_gain[0, 1] = full.split_gain[0, 1] * (1 + TIE / 2)
+    mut.leaf_value[0, 3:5] = [-9.0, 9.0]
+    mut.leaf_value[1, 4] = full.leaf_value[1, 4] + np.float32(0.03)
+    assert_trees_match_mod_ties(full, mut, MSG)
+
+
+def test_rejects_leaf_corruption_even_after_root_cause():
+    """The 5x leaf scale must not admit the adversarial perturbation:
+    +0.1 on a later-round leaf (relative 5e-2, contribution 1e-2) still
+    fails with the tree-0 tie accepted."""
+    full = _make_ens()
+    mut = copy.deepcopy(full)
+    mut.feature[0, 1] = 3
+    mut.threshold_bin[0, 1] = 9
+    mut.split_gain[0, 1] = full.split_gain[0, 1] * (1 + TIE / 2)
+    mut.leaf_value[0, 3:5] = [-9.0, 9.0]
+    mut.leaf_value[1, 4] = full.leaf_value[1, 4] + np.float32(0.1)
+    _reject(full, mut)
+
+
+def test_rejects_non_tie_candidate_flip_after_root_cause():
+    """The cascade atol widens the candidate-tie window in later rounds;
+    a cross-feature flip whose gains differ beyond BOTH the bf16 window
+    and cascade_gain_atol (0.21 vs 0.19: dg=0.02 > 2e-3) must still
+    reject with the tree-0 tie accepted."""
+    full = _make_ens()
+    mut = copy.deepcopy(full)
+    mut.feature[0, 1] = 3
+    mut.threshold_bin[0, 1] = 9
+    mut.split_gain[0, 1] = full.split_gain[0, 1] * (1 + TIE / 2)
+    mut.leaf_value[0, 3:5] = [-9.0, 9.0]
+    mut.feature[1, 2] = 3
+    mut.threshold_bin[1, 2] = 9
+    mut.split_gain[1, 2] = full.split_gain[1, 2] - np.float32(0.02)
+    _reject(full, mut)
+
+
+def test_rejects_off_floor_leaf_flip_after_root_cause():
+    """Same for split-vs-leaf flips: post-root-cause, turning a strong
+    split (gain 0.21 >> min_split_gain + cascade_gain_atol) into a leaf
+    must still reject."""
+    full = _make_ens()
+    mut = copy.deepcopy(full)
+    mut.feature[0, 1] = 3
+    mut.threshold_bin[0, 1] = 9
+    mut.split_gain[0, 1] = full.split_gain[0, 1] * (1 + TIE / 2)
+    mut.leaf_value[0, 3:5] = [-9.0, 9.0]
+    mut.is_leaf[1, 2] = True
+    mut.feature[1, 2] = -1
+    mut.split_gain[1, 2] = 0.0
+    _reject(full, mut)

@@ -15,6 +15,8 @@ import numpy as np
 def assert_trees_match_mod_ties(full, streamed, min_split_gain,
                                 leaf_rtol=1e-3, leaf_atol=2e-5,
                                 leaf_contrib_atol=1e-3,
+                                cascade_gain_atol=2e-3,
+                                cascade_leaf_scale=5.0,
                                 max_root_causes=None):
     """Bitwise tree equality, except provable f32-order boundary ties.
 
@@ -55,11 +57,42 @@ def assert_trees_match_mod_ties(full, streamed, min_split_gain,
     3.5e-3 on a 0.79 leaf — 4.4e-3 relative, but only 3.5e-4 of pred
     contribution). What propagates — and what a real leaf-aggregation
     bug inflates — is lr * |dv|; the adversarial suite's perturbations
-    (lr * 0.1 = 1e-2) stay firmly rejected."""
+    (lr * 0.1 = 1e-2) stay firmly rejected.
+
+    Gains get the cascade treatment too (round-5 campaign case 10030):
+    once a root cause is ACCEPTED in round r0, every later round trains
+    on legitimately-diverged predictions (the flipped node routes real
+    rows differently), so matched decisions there carry small ABSOLUTE
+    gain drift that the relative bf16 window rejects exactly where
+    gains are small (measured: |dg| = 1.5e-4 on a 0.004 gain, 3.9%
+    relative, trees 0-6 bit-identical and the tree-7 flip a proven
+    tie). Post-root-cause rounds therefore accept EITHER the relative
+    TIE or |dg| <= cascade_gain_atol (2e-3 — 13x the measured cascade,
+    25x under the adversarial suite's 5e-2 corruption, which also has
+    no root cause and so never activates the allowance). Rounds at or
+    before the first root cause keep the strict window.
+
+    The LEAF bounds scale by cascade_leaf_scale (5x) in post-root-cause
+    rounds for the same reason: different real rows flow through later
+    trees once a flip is accepted, and case 10030's tree-8 leaves
+    measured dv=5.6e-3 on |v|=3.85 — relative 1.47e-3 and contribution
+    1.69e-3, each ~1.5x past the tight bounds. At 5x, the adversarial
+    leaf perturbation (relative 5e-2, contribution 1e-2) stays
+    rejected with >= 2x margin — and scoped to cascade rounds only."""
     TIE = 2 ** -6                     # 2 bf16 ULPs, relative
     T, N = full.feature.shape
     n_root_causes = 0
+    first_rc_round = None
+    trees_per_round = (full.n_classes if full.loss == "softmax" else 1)
     for t in range(T):
+        cascade = (first_rc_round is not None
+                   and t // trees_per_round > first_rc_round)
+
+        def gain_ok(ga, gb):
+            d = abs(ga - gb)
+            return (d <= TIE * max(abs(ga), abs(gb), 1e-12)
+                    or (cascade and d <= cascade_gain_atol))
+
         queue = [0]
         while queue:
             s_ = queue.pop()
@@ -74,27 +107,31 @@ def assert_trees_match_mod_ties(full, streamed, min_split_gain,
                 va = float(full.leaf_value[t, s_])
                 vb = float(streamed.leaf_value[t, s_])
                 dv = abs(va - vb)
-                assert (dv <= leaf_atol + leaf_rtol * abs(vb)
-                        or dv * full.learning_rate <= leaf_contrib_atol), \
+                ls = cascade_leaf_scale if cascade else 1.0
+                assert (dv <= ls * (leaf_atol + leaf_rtol * abs(vb))
+                        or dv * full.learning_rate
+                        <= ls * leaf_contrib_atol), \
                     ("leaf value", t, s_, va, vb)
-                assert abs(ga - gb) <= TIE * max(abs(ga), abs(gb), 1e-12), \
-                    (t, s_, ga, gb)
+                assert gain_ok(ga, gb), (t, s_, ga, gb)
                 if not la and 2 * s_ + 2 < N:
                     queue += [2 * s_ + 1, 2 * s_ + 2]
                 continue
             # Divergent decision with matching ancestors: a root cause.
             n_root_causes += 1
+            if first_rc_round is None:
+                first_rc_round = t // trees_per_round
             if la != lb:
                 # split-vs-leaf flip: the split side's gain must sit at
                 # the min_split_gain floor (leaves record gain 0).
                 g_split = gb if la else ga
-                assert abs(g_split - min_split_gain) <= TIE * max(
-                    g_split, min_split_gain), (t, s_, g_split,
-                                               min_split_gain)
+                assert (abs(g_split - min_split_gain) <= TIE * max(
+                            g_split, min_split_gain)
+                        or (cascade and abs(g_split - min_split_gain)
+                            <= cascade_gain_atol)), \
+                    (t, s_, g_split, min_split_gain)
             else:
                 # both split, different (feature, bin): candidate tie.
-                assert abs(ga - gb) <= TIE * max(abs(ga), abs(gb), 1e-12), \
-                    (t, s_, ga, gb)
+                assert gain_ok(ga, gb), (t, s_, ga, gb)
             # Subtree excluded: different rows flow below a flipped node.
     cap = (max(1, T * N // 500) if max_root_causes is None
            else max_root_causes)
