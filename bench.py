@@ -72,11 +72,11 @@ TPU_FLOOR_MROWS = 35.0
 # 7.6% within-window spread vs 33% for the dispatch-loop protocol
 # (whose min-of-reps reports transient fast-tail excursions as the
 # run's value). The device rate itself DRIFTS externally across roughly
-# 45-60 on a minutes timescale (docs/PERF.md round-5 drift analysis),
+# 45-65 on a minutes timescale (docs/PERF.md round-5 drift analysis),
 # so this floor still tolerates the full span — but the tight
 # within-window spread (3-8%) means a trip is far more likely a kernel
 # regression than drift luck. Floor 38: under every one-dispatch
-# sample seen (43.9-59.5), above the matmul-fallback known-bad mode
+# sample seen (43.9-65.5), above the matmul-fallback known-bad mode
 # (~26). Five-probe calibration — refine as artifacts accumulate.
 TPU_ONE_DISPATCH_FLOOR_MROWS = 38.0
 E2E_CEILING_S = 32.0
@@ -87,7 +87,7 @@ PREDICT_COMPUTE_FLOOR_MROWS = 2.2
 # throughput the e2e wallclock IMPLIES — must sit near the kernel
 # throughput measured minutes earlier in the same process. Round-5
 # recalibration on the DRIFT picture (docs/PERF.md: the device rate
-# drifts externally across ~45-60 on a minutes timescale, plus
+# drifts externally across ~45-65 on a minutes timescale, plus
 # dispatch-protocol tail noise): seven artifacts span ratios
 # 0.813-1.274; the max-adverse LEGIT combination is the whole e2e at
 # the drift's slow end (~44, x0.95 shape mix -> ~42 implied) while the
