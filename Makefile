@@ -1,0 +1,29 @@
+# Repo-root convenience targets. The real build logic lives in
+# ddt_tpu/native/Makefile (C++ kernels + sanitizer builds); these wrap the
+# day-to-day workflows so they are one short command from the repo root.
+
+PY ?= python
+
+# Static analysis gate (docs/ANALYSIS.md): exit 1 on any finding not in
+# the ratchet baseline. Same check tier-1 runs via tests/test_lint.py.
+lint:
+	$(PY) -m tools.ddtlint ddt_tpu/ tests/
+
+# Regenerate the ratchet baseline. Only after confirming every new entry
+# is a deliberate, documented exception — the baseline should only shrink.
+lint-baseline:
+	$(PY) -m tools.ddtlint ddt_tpu/ tests/ --write-baseline
+
+# Mechanized TSan suppression audit (ddt_tpu/native/Makefile tsan-audit):
+# soak with process-wide suppressions dropped, shape-check the survivors.
+tsan-audit:
+	$(PY) -m tools.ddtlint.tsan_audit --run
+
+# Tier-1 test suite (CPU backend; the ROADMAP.md verify command).
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+native:
+	$(MAKE) -C ddt_tpu/native
+
+.PHONY: lint lint-baseline tsan-audit test native

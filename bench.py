@@ -75,9 +75,18 @@ TPU_FLOOR_MROWS = 35.0
 # 45-65 on a minutes timescale (docs/PERF.md round-5 drift analysis),
 # so this floor still tolerates the full span — but the tight
 # within-window spread (3-8%) means a trip is far more likely a kernel
-# regression than drift luck. Floor 38: under every one-dispatch
-# sample seen (43.9-65.5), above the matmul-fallback known-bad mode
-# (~26). Five-probe calibration — refine as artifacts accumulate.
+# regression than drift luck. The floored statistic is the MEDIAN of
+# reps (round-5 advisor finding: min-of-reps is the same
+# fast-tail-promoting stat the dispatch-loop docstring criticizes; the
+# min is still recorded as *_min for artifact comparability). Note the
+# median THROUGHPUT sits at or below the min-of-reps throughput
+# (dt_med >= dt_min), so the historical 43.9-65.5 min-of-reps samples
+# are an UPPER envelope for it: with the protocol's 3-8% within-window
+# spread, the worst observed window's median lands near ~40-42. Floor
+# 38 still sits under that — thinner margin than against the min, so
+# treat an early trip near the floor as "re-measure, then bisect" —
+# and stays above the matmul-fallback known-bad mode (~26).
+# Five-probe calibration — refine as median artifacts accumulate.
 TPU_ONE_DISPATCH_FLOOR_MROWS = 38.0
 E2E_CEILING_S = 32.0
 PREDICT_FLOOR_MROWS = 1.2
@@ -85,21 +94,31 @@ PREDICT_COMPUTE_FLOOR_MROWS = 2.2
 # e2e self-consistency (round-4 verdict item 9): the training loop is
 # histogram-dominated, so rows x levels x trees / e2e_train_s — the
 # throughput the e2e wallclock IMPLIES — must sit near the kernel
-# throughput measured minutes earlier in the same process. Round-5
-# recalibration on the DRIFT picture (docs/PERF.md: the device rate
-# drifts externally across ~45-65 on a minutes timescale, plus
-# dispatch-protocol tail noise): seven artifacts span ratios
-# 0.813-1.274; the max-adverse LEGIT combination is the whole e2e at
-# the drift's slow end (~44, x0.95 shape mix -> ~42 implied) while the
-# headline's min-of-reps catches a fast-tail excursion (~61), ratio
-# 0.69 — so the lower bound is 0.65, which a >=2x fused-path slowdown
-# breaches from any drift combination observed (typical ratios
-# ~0.8-1.3 halve to 0.4-0.65). The old 0.40 bound, calibrated to a
-# band-continuum reading, missed 2x entirely. Upper bound 1.50 covers
-# the reverse split (e2e fast / headline at the slow end, ~1.43 max
-# adverse) while still catching a work miscount (fewer trees/levels
-# than the config claims).
-E2E_CONSISTENCY_RATIO = (0.65, 1.50)
+# throughput measured minutes earlier in the same process. The
+# DENOMINATOR is the band-stable one-dispatch metric (median-of-reps,
+# 3-8% within-window spread), NOT the dispatch-loop headline: round 5's
+# 0.65 bound had to absorb the headline's min-of-reps fast-tail
+# excursions (33% within-window spread, spuriously FAST samples
+# promoted to the run's value, deflating legit ratios) on top of the
+# real external drift, leaving the bound only ~6% below the
+# max-adverse legitimate ratio — a flaky-gate margin (round-5 advisor
+# finding). Against od_v that excursion term is gone: the median
+# cannot report a transient, so the denominator tracks the window's
+# true band, and the adverse combination is drift-only — the od
+# window at the drift's fast end (~61 median; excursions past the
+# band no longer reach the statistic) while the e2e minutes later
+# rides the slow end (~44, x0.95 shape mix -> ~42 implied), ratio
+# 0.74. Lower bound 0.70 sits under that corner with margin, is
+# TIGHTER than the old 0.65 exactly because the denominator lost its
+# fast-tail inflation, and a >=2x fused-path slowdown (typical ratios
+# ~0.8-1.3 halving to 0.4-0.65) still breaches it from every drift
+# combination observed. Upper bound 1.40 covers the reverse split
+# (e2e fast / od window at the slow end, ~1.33 max adverse) while
+# still catching a work miscount (fewer trees/levels than the config
+# claims). The dispatch-loop ratio stays in the artifact
+# (e2e_consistency_ratio_dispatch_loop) for cross-round comparability
+# but is no longer floored.
+E2E_CONSISTENCY_RATIO = (0.70, 1.40)
 # The 64-bin opt-in's paired ratio measured 1.13-1.22 across three runs
 # (median of 10 order-alternating pairs); losing the transposed kernel
 # (e.g. a dispatch change silently routing n_bins<=128 to the row-major
@@ -201,6 +220,8 @@ def main() -> None:
         "floor_mrows_per_sec": TPU_FLOOR_MROWS if on_tpu else None,
         "hist_one_dispatch_mrows_per_sec":
             round(od["mrows_per_sec_per_chip"], 2),
+        "hist_one_dispatch_mrows_per_sec_min":
+            round(od["mrows_per_sec_per_chip_min"], 2),
         "hist_one_dispatch_floor_mrows_per_sec":
             TPU_ONE_DISPATCH_FLOOR_MROWS if on_tpu else None,
         "value_64bin_optin": round(ab["mrows_b"], 2),
@@ -209,7 +230,9 @@ def main() -> None:
         "e2e_ms_per_tree": round(1000 * tr["wallclock_s"] / tr["trees"], 1),
         "e2e_ceiling_s": E2E_CEILING_S if on_tpu else None,
         "e2e_implied_hist_mrows": round(implied, 2),
-        "e2e_consistency_ratio": round(implied / value, 3),
+        "e2e_consistency_ratio":
+            round(implied / od["mrows_per_sec_per_chip"], 3),
+        "e2e_consistency_ratio_dispatch_loop": round(implied / value, 3),
         "predict_mrows_per_sec": round(pr["mrows_per_sec"], 2),
         "predict_total_s": round(pr_total["wallclock_s"], 2),
         "predict_compute_mrows_per_sec": round(pr_comp["mrows_per_sec"], 2),
@@ -241,12 +264,13 @@ def main() -> None:
             f"e2e train {tr['wallclock_s']:.1f}s > {E2E_CEILING_S}s ceiling "
             "(fused-dispatch regression; 11-23s expected across bands)")
     lo, hi = E2E_CONSISTENCY_RATIO
-    if not (lo <= implied / value <= hi):
+    if not (lo <= implied / od_v <= hi):
         fails.append(
             f"e2e-implied histogram throughput {implied:.1f} Mrows/s is "
-            f"{implied / value:.2f}x the measured kernel ({value:.1f}) — "
-            f"outside [{lo}, {hi}] (in-band fused-path regression or "
-            "work miscount; calibration comment at E2E_CONSISTENCY_RATIO)")
+            f"{implied / od_v:.2f}x the band-stable one-dispatch kernel "
+            f"({od_v:.1f}) — outside [{lo}, {hi}] (in-band fused-path "
+            "regression or work miscount; calibration comment at "
+            "E2E_CONSISTENCY_RATIO)")
     if pr["mrows_per_sec"] < PREDICT_FLOOR_MROWS:
         fails.append(
             f"resident predict {pr['mrows_per_sec']:.2f} Mrows/s < "

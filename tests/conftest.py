@@ -42,13 +42,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Import cost at collection: a fresh .so is one dlopen (~ms); after a
 # .cpp edit this triggers the rebuild here instead of at first CPUDevice
 # use — acceptable, the suite is normally run whole from the repo root.
-# Catch broadly, not just ImportError: ctypes.CDLL raises OSError on a
-# corrupt/wrong-arch/unresolvable library (e.g. a sanitizer build named
-# via DDT_NATIVE_LIB without its runtime preloaded), and the suite must
-# then still run on the NumPy fallback kernels — which need no pin.
+# ImportError: no toolchain. OSError: ctypes.CDLL on a corrupt/wrong-arch/
+# unresolvable library (e.g. a sanitizer build named via DDT_NATIVE_LIB
+# without its runtime preloaded). Either way the suite still runs on the
+# NumPy fallback kernels — which need no pin. Anything ELSE (say a
+# TypeError in the ctypes setup) is a real binding bug: swallowing it here
+# used to turn such bugs into nondeterministic bit-identity flakes with no
+# visible cause (round-5 advisor finding), so it now propagates.
 try:
     from ddt_tpu import native as _native
 
     _native.omp_set_threads(1)
-except Exception:
-    pass
+except (ImportError, OSError) as _pin_err:
+    import warnings
+
+    warnings.warn(
+        f"native thread-pin skipped ({type(_pin_err).__name__}: {_pin_err});"
+        " suite runs on the NumPy fallback kernels",
+        RuntimeWarning,
+        stacklevel=1,
+    )

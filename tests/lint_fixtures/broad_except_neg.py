@@ -1,0 +1,15 @@
+# Fixture: broad-except must stay SILENT.
+
+
+def narrow():
+    try:
+        risky()
+    except (ImportError, OSError):
+        pass
+
+
+def translate():
+    try:
+        risky()
+    except Exception as e:           # re-raise pattern: exempt
+        raise RuntimeError("context") from e

@@ -1,0 +1,15 @@
+# Fixture: broad-except MUST fire.
+
+
+def swallow_all():
+    try:
+        risky()
+    except Exception:  # LINT: broad-except
+        pass
+
+
+def bare():
+    try:
+        risky()
+    except:  # LINT: broad-except
+        return None

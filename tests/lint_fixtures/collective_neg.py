@@ -1,0 +1,19 @@
+# Fixture: collective-consistency must stay SILENT.
+import jax
+
+
+def reduce_ok(x):
+    return jax.lax.psum(x, "rows")
+
+
+def pod_ok(x):
+    return jax.lax.psum(x, ("hosts", "rows"))
+
+
+def feature_ok(x):
+    return jax.lax.all_gather(x, axis_name="features")
+
+
+def plumbed_ok(x, axis_name):
+    # variable axis names are the safe pattern (resolved from the mesh)
+    return jax.lax.psum(x, axis_name)

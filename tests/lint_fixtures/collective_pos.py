@@ -1,0 +1,18 @@
+# Fixture: collective-consistency MUST fire (axes: rows/hosts/features).
+import jax
+
+
+def reduce_bad(x):
+    return jax.lax.psum(x, "cols")  # LINT: collective-consistency
+
+
+def gather_bad(x):
+    return jax.lax.all_gather(x, axis_name="replica")  # LINT: collective-consistency
+
+
+def index_bad():
+    return jax.lax.axis_index("batch")  # LINT: collective-consistency
+
+
+def tuple_bad(x):
+    return jax.lax.psum(x, ("hosts", "shards"))  # LINT: collective-consistency

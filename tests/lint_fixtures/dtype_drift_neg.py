@@ -1,0 +1,18 @@
+# Fixture: dtype-drift must stay SILENT.
+import jax.numpy as jnp
+
+
+def make(n):
+    a = jnp.zeros(n, jnp.float32)            # positional dtype
+    b = jnp.ones((n, 2), dtype=jnp.int32)    # keyword dtype
+    c = jnp.array([1, 2, 3], jnp.uint8)
+    d = jnp.zeros_like(a)                    # inherits dtype; not a ctor
+    return a, b, c, d
+
+
+def accumulate(hist, acc, x, ni, n):
+    hist = hist + jnp.float32(0.5)           # pinned literal
+    acc = acc * jnp.float32(2.0)
+    out = build_histograms(x, jnp.float32(1.0), ni, n)
+    scale = 2.0 * n                          # plain python math: fine
+    return hist, acc, out, scale

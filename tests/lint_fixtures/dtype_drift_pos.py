@@ -1,0 +1,16 @@
+# Fixture: dtype-drift MUST fire (linted under a ddt_tpu/ops/ path).
+import jax.numpy as jnp
+
+
+def make(n):
+    a = jnp.zeros(n)  # LINT: dtype-drift
+    b = jnp.ones((n, 2))  # LINT: dtype-drift
+    c = jnp.array([1, 2, 3])  # LINT: dtype-drift
+    return a, b, c
+
+
+def accumulate(hist, acc, x, ni, n):
+    hist = hist + 0.5  # LINT: dtype-drift
+    acc *= 2.0  # LINT: dtype-drift
+    out = build_histograms(x, 1.0, ni, n)  # LINT: dtype-drift
+    return hist, acc, out

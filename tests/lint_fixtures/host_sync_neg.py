@@ -1,0 +1,17 @@
+# Fixture: host-sync must stay SILENT.
+import numpy as np
+
+
+def setup(a, arrs):
+    x = float(a)                # outside any loop: a one-off sync is fine
+    y = np.asarray(a)
+    lim = float("inf")          # literal coercions never flagged
+    for i in range(3):
+        lim = min(lim, i)
+        n = int(7)
+    # documented exception via pragma on the flagged line
+    out = [
+        np.asarray(o)  # ddtlint: disable=host-sync
+        for o in arrs
+    ]
+    return x, y, lim, n, out

@@ -1,0 +1,15 @@
+# Fixture: host-sync MUST fire (linted under ddt_tpu/ops/grow.py path).
+import numpy as np
+
+
+def hot_loop(arrs, dev):
+    total = 0.0
+    for a in arrs:
+        total += float(a)  # LINT: host-sync
+        v = a.item()  # LINT: host-sync
+        host = np.asarray(a)  # LINT: host-sync
+        dev.consume(v, host)
+    while total > 0:
+        total -= int(dev.step())  # LINT: host-sync
+    fetched = [np.asarray(o) for o in arrs]  # LINT: host-sync
+    return total, fetched

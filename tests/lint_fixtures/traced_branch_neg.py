@@ -1,0 +1,38 @@
+# Fixture: traced-branch must stay SILENT.
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def static_branches(x, axis_name=None, flag=True):
+    if axis_name is not None:          # `is` test: static python
+        x = jax.lax.psum(x, axis_name)
+    if flag:                           # parameter: treated as static arg
+        x = x + 1
+    backend = jax.default_backend()    # host value, not a tracer
+    if backend == "cpu":
+        x = x * 2
+    if jnp.issubdtype(x.dtype, jnp.floating):   # host bool
+        x = x + 0
+    return x
+
+
+def unreachable(x):
+    # identical shape to a violation, but no jit root reaches it
+    m = jnp.sum(x)
+    if m > 0:
+        return x
+    return -x
+
+
+@jax.jit
+def no_nested_taint_leak(n):
+    # inner's traced `y` is a separate scope: the OUTER `y` is a plain
+    # python int and branching on it is fine.
+    def inner(x):
+        y = jnp.zeros(3, jnp.float32)
+        return x + y
+    y = 1
+    if y:
+        n = n + 1
+    return inner(n)

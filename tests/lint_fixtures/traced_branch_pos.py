@@ -1,0 +1,35 @@
+# Fixture: traced-branch MUST fire (linted under a ddt_tpu/ops/ path).
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_branch(x):
+    m = jnp.sum(x)
+    if m > 0:  # LINT: traced-branch
+        return x
+    y = x if jnp.any(x) else -x  # LINT: traced-branch
+    return y
+
+
+def traced_body(x):
+    s = jnp.max(x)
+    while s > 1.0:  # LINT: traced-branch
+        s = s / 2.0
+    return s
+
+
+halver = jax.jit(traced_body)
+
+
+def helper(x):
+    # not decorated itself, but called from a jit root below
+    t = jnp.min(x)
+    if t < 0:  # LINT: traced-branch
+        return -x
+    return x
+
+
+@jax.jit
+def root(x):
+    return helper(x)

@@ -1,0 +1,269 @@
+"""ddtlint: repo-wide gate + per-checker fixture tests (tier-1,
+marker-free so `pytest -m 'not slow'` always runs it).
+
+Two layers, deliberately independent:
+* fixture tests — each rule against minimal positive/negative snippets
+  (tests/lint_fixtures/), so a checker that goes blind or noisy fails
+  even while the repo gate stays green;
+* the gate — the real tree against the ratchet baseline
+  (tools/ddtlint/baseline.json): any NEW finding fails, and any STALE
+  baseline entry fails too (fixed findings must be ratcheted out, the
+  baseline only ever shrinks).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tools.ddtlint import callgraph, checkers, runner, tsan_audit  # noqa: E402
+from tools.ddtlint.findings import assign_fingerprints  # noqa: E402
+
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+GATE_PATHS = ["ddt_tpu/", "tests/"]
+
+
+def _fixture_src(fname: str) -> str:
+    with open(os.path.join(FIXTURES, fname), encoding="utf-8") as f:
+        return f.read()
+
+
+def _marker_lines(src: str, rule: str) -> set:
+    return {i for i, line in enumerate(src.splitlines(), start=1)
+            if f"# LINT: {rule}" in line}
+
+
+def _flagged_lines(fname: str, synthetic_path: str, rule: str) -> set:
+    src = _fixture_src(fname)
+    findings = runner.run_on_source(
+        synthetic_path, src, mesh_axes=runner.mesh_axis_names(REPO),
+        rules={rule})
+    assert all(f.rule == rule for f in findings), findings
+    return {f.line for f in findings}
+
+
+# (rule, positive fixture, negative fixture, synthetic path for scoping)
+CASES = [
+    ("traced-branch", "traced_branch_pos.py", "traced_branch_neg.py",
+     "ddt_tpu/ops/fixture_mod.py"),
+    ("host-sync", "host_sync_pos.py", "host_sync_neg.py",
+     "ddt_tpu/ops/grow.py"),
+    ("dtype-drift", "dtype_drift_pos.py", "dtype_drift_neg.py",
+     "ddt_tpu/ops/fixture_mod.py"),
+    ("collective-consistency", "collective_pos.py", "collective_neg.py",
+     "ddt_tpu/ops/fixture_mod.py"),
+    ("broad-except", "broad_except_pos.py", "broad_except_neg.py",
+     "ddt_tpu/fixture_mod.py"),
+]
+
+
+@pytest.mark.parametrize("rule,pos,_neg,path",
+                         CASES, ids=[c[0] for c in CASES])
+def test_checker_fires_on_seeded_violations(rule, pos, _neg, path):
+    src = _fixture_src(pos)
+    want = _marker_lines(src, rule)
+    assert want, f"fixture {pos} has no LINT markers for {rule}"
+    got = _flagged_lines(pos, path, rule)
+    assert got == want, (
+        f"{rule}: flagged lines {sorted(got)} != expected markers "
+        f"{sorted(want)} in {pos}")
+
+
+@pytest.mark.parametrize("rule,_pos,neg,path",
+                         CASES, ids=[c[0] for c in CASES])
+def test_checker_silent_on_clean_code(rule, _pos, neg, path):
+    got = _flagged_lines(neg, path, rule)
+    assert got == set(), f"{rule}: false positives at lines {sorted(got)} " \
+                         f"in {neg}"
+
+
+def test_suppression_hygiene_fires():
+    src = _fixture_src("suppressions_pos.supp")
+    findings = checkers.check_suppressions("ddt_tpu/native/fix.supp", src)
+    assert {f.line_text for f in findings} == {
+        "race:_contig_to_contig", "race:array_dealloc"}
+
+
+def test_suppression_hygiene_silent_with_audit_tag():
+    src = _fixture_src("suppressions_neg.supp")
+    assert checkers.check_suppressions("ddt_tpu/native/fix.supp", src) == []
+
+
+def test_repo_tsan_supp_passes_hygiene():
+    with open(os.path.join(REPO, "ddt_tpu/native/tsan.supp"),
+              encoding="utf-8") as f:
+        src = f.read()
+    findings = checkers.check_suppressions("ddt_tpu/native/tsan.supp", src)
+    assert findings == [], [f.render() for f in findings]
+
+
+# --------------------------------------------------------------------- #
+# cross-module jit reachability (the traced-branch backbone)
+# --------------------------------------------------------------------- #
+def test_callgraph_cross_module_reachability():
+    sources = {
+        "pkg/ops/kern.py": (
+            "import jax.numpy as jnp\n"
+            "def traced_fn(x):\n"
+            "    return helper(x)\n"
+            "def helper(x):\n"
+            "    return x\n"
+            "def cold_fn(x):\n"
+            "    return x\n"
+        ),
+        "pkg/backends/dev.py": (
+            "import jax\n"
+            "from pkg.ops.kern import traced_fn\n"
+            "def make(cfg):\n"
+            "    def grow(x):\n"
+            "        return traced_fn(x)\n"
+            "    return jax.jit(grow)\n"
+        ),
+    }
+    reach = callgraph.build(sources)
+    assert "grow" in {q.split(".")[-1] for q in reach["pkg/backends/dev.py"]}
+    assert "traced_fn" in reach["pkg/ops/kern.py"]
+    assert "helper" in reach["pkg/ops/kern.py"]       # transitive
+    assert "cold_fn" not in reach["pkg/ops/kern.py"]  # no jit reaches it
+
+
+def test_repo_ops_are_jit_reachable():
+    """The backbone invariant on the real tree: the backend's jit roots
+    reach the ops kernels (if this breaks, traced-branch goes blind)."""
+    sources = {}
+    for dirpath, dirnames, fns in os.walk(os.path.join(REPO, "ddt_tpu")):
+        dirnames[:] = [d for d in dirnames
+                       if d not in runner.SKIP_DIRS]
+        for fn in fns:
+            if fn.endswith(".py"):
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, REPO).replace(os.sep, "/")
+                with open(full, encoding="utf-8") as f:
+                    sources[rel] = f.read()
+    reach = callgraph.build(sources)
+    assert "grow_tree" in reach["ddt_tpu/ops/grow.py"]
+    assert "build_histograms" in reach["ddt_tpu/ops/histogram.py"]
+    assert "best_splits" in reach["ddt_tpu/ops/split.py"]
+
+
+# --------------------------------------------------------------------- #
+# baseline mechanics
+# --------------------------------------------------------------------- #
+def test_fingerprints_survive_line_shifts():
+    src = "try:\n    import os\nexcept Exception:\n    pass\n"
+    shifted = "# a new comment line\n# another\n" + src
+    f1 = assign_fingerprints(runner.run_on_source("ddt_tpu/x.py", src))
+    f2 = assign_fingerprints(runner.run_on_source("ddt_tpu/x.py", shifted))
+    assert [f.fingerprint for f in f1] == [f.fingerprint for f in f2]
+    assert f1[0].line != f2[0].line
+
+
+def test_identical_lines_get_distinct_fingerprints():
+    body = "    try:\n        pass\n    except Exception:\n        pass\n"
+    src = "def a():\n" + body + "def b():\n" + body
+    fs = assign_fingerprints(runner.run_on_source("ddt_tpu/x.py", src))
+    assert len(fs) == 2
+    assert fs[0].fingerprint != fs[1].fingerprint
+
+
+def test_baseline_round_trip(tmp_path):
+    src = "try:\n    import os\nexcept Exception:\n    pass\n"
+    fs = assign_fingerprints(runner.run_on_source("ddt_tpu/x.py", src))
+    p = str(tmp_path / "bl.json")
+    runner.save_baseline(p, fs)
+    loaded = runner.load_baseline(p)
+    new, known, stale = runner.split_vs_baseline(fs, loaded)
+    assert (new, len(known), stale) == ([], 1, [])
+
+
+# --------------------------------------------------------------------- #
+# the repo-wide gate
+# --------------------------------------------------------------------- #
+def test_ddtlint_gate():
+    findings = runner.lint_paths(GATE_PATHS, root=REPO)
+    baseline = runner.load_baseline(
+        os.path.join(REPO, runner.DEFAULT_BASELINE))
+    new, _known, stale = runner.split_vs_baseline(findings, baseline)
+    assert not new, (
+        "new ddtlint findings (fix them, add a documented "
+        "`# ddtlint: disable=<rule>` pragma, or — only for a deliberate, "
+        "documented exception — regenerate the baseline via "
+        "`make lint-baseline`):\n  "
+        + "\n  ".join(f.render() for f in new))
+    assert not stale, (
+        "stale ddtlint baseline entries — the finding was fixed, ratchet "
+        "it out with `make lint-baseline`:\n  "
+        + "\n  ".join(f"{e['path']} [{e['rule']}] {e.get('line_text', '')}"
+                      for e in stale))
+
+
+def test_cli_entry_point():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.ddtlint", *GATE_PATHS],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new" in proc.stdout
+
+
+def test_cli_fails_on_stale_baseline_entries(tmp_path):
+    """The CLI must agree with the pytest gate: a stale entry (fixed
+    finding still in the baseline) is a failure until ratcheted out."""
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"findings": [{
+        "fingerprint": "feedfeedfeedfeed", "rule": "broad-except",
+        "path": "tools/ddtlint/findings.py", "line": 1,
+        "line_text": "long gone", "message": "fixed ages ago"}]}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.ddtlint", "tools/ddtlint/findings.py",
+         "--baseline", str(bl)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale" in proc.stdout
+
+
+# --------------------------------------------------------------------- #
+# tsan audit classifier
+# --------------------------------------------------------------------- #
+def test_tsan_classifier_accepts_join_edge_shape():
+    with open(os.path.join(FIXTURES, "tsan_join_edge.log"),
+              encoding="utf-8") as f:
+        summary = tsan_audit.classify_log(f.read())
+    assert summary["ok"], summary
+    assert summary["total_reports"] == 2
+    assert summary["join_edge"] == 2
+
+
+def test_tsan_classifier_rejects_real_race_shape():
+    with open(os.path.join(FIXTURES, "tsan_real_race.log"),
+              encoding="utf-8") as f:
+        summary = tsan_audit.classify_log(f.read())
+    assert not summary["ok"]
+    reasons = json.dumps(summary["findings"])
+    assert "ddt_" in reasons                 # kernel frame was visible
+    assert "failed to restore" in reasons    # both stacks restored
+
+
+def test_tsan_classifier_rejects_report_floods():
+    with open(os.path.join(FIXTURES, "tsan_join_edge.log"),
+              encoding="utf-8") as f:
+        text = f.read()
+    summary = tsan_audit.classify_log(text, max_reports=1)
+    assert not summary["ok"]
+    assert any(c["what"] == "report-count" for c in summary["findings"])
+
+
+def test_audit_supp_drops_only_process_wide_entries(tmp_path):
+    dst = str(tmp_path / "audit.supp")
+    dropped = tsan_audit.write_audit_supp(
+        os.path.join(REPO, "ddt_tpu/native/tsan.supp"), dst)
+    assert dropped == 2
+    with open(dst, encoding="utf-8") as f:
+        lines = [ln.strip() for ln in f
+                 if ln.strip() and not ln.strip().startswith("#")]
+    # every ddt_-scoped entry still active, no process-wide ones left
+    assert lines and all(
+        ln.partition(":")[2].startswith("ddt_") for ln in lines), lines

@@ -9,9 +9,11 @@ import pytest
 
 try:
     from ddt_tpu import native
-except Exception as _e:   # ImportError (no toolchain) but also OSError:
-    # ctypes.CDLL on a corrupt/wrong-arch lib or a DDT_NATIVE_LIB
-    # sanitizer build without its runtime preloaded — skip, don't error.
+except (ImportError, OSError) as _e:
+    # ImportError: no toolchain. OSError: ctypes.CDLL on a corrupt/
+    # wrong-arch lib or a DDT_NATIVE_LIB sanitizer build without its
+    # runtime preloaded — skip, don't error. Other exception types are
+    # real binding bugs and must propagate (round-5 advisor finding).
     pytest.skip(f"native kernels unavailable: {_e}",
                 allow_module_level=True)
 

@@ -1,0 +1,27 @@
+"""ddtlint — project-native static analysis for JAX/TPU correctness hazards.
+
+The Driver/DeviceBackend split puts the tree-growth loop behind jitted XLA
+programs, which makes whole classes of bugs invisible to CPU-only tests
+until they hit real hardware: silent host<->device syncs in the hot loop,
+Python branching on traced values, dtype drift between backends, collective
+axis names that don't exist on any mesh.  ddtlint mechanizes those reviews
+as small AST checkers with a checked-in ratchet baseline (docs/ANALYSIS.md).
+
+Usage:
+    python -m tools.ddtlint ddt_tpu/ tests/            # gate (exit 1 on new)
+    python -m tools.ddtlint --write-baseline ...       # regenerate baseline
+    python -m tools.ddtlint --list-rules
+
+The pytest gate lives in tests/test_lint.py (tier-1, marker-free).
+"""
+
+from tools.ddtlint.findings import Finding, fingerprint
+from tools.ddtlint.runner import lint_paths, load_baseline, run_on_source
+
+__all__ = [
+    "Finding",
+    "fingerprint",
+    "lint_paths",
+    "load_baseline",
+    "run_on_source",
+]

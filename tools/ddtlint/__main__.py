@@ -1,0 +1,78 @@
+"""CLI: `python -m tools.ddtlint [paths...]` — exit 0 iff no new findings.
+
+See docs/ANALYSIS.md for the rule catalogue and the baseline workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.ddtlint import checkers, runner
+
+ALL_RULES = sorted(
+    [c.rule for c in checkers.AST_CHECKERS] + [checkers.SUPPRESSION_RULE])
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.ddtlint",
+        description="project-native static analysis for JAX/TPU hazards")
+    ap.add_argument("paths", nargs="*", default=["ddt_tpu/", "tests/"],
+                    help="files/dirs to lint (default: ddt_tpu/ tests/)")
+    ap.add_argument("--baseline", default=runner.DEFAULT_BASELINE,
+                    help=f"ratchet file (default {runner.DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the ratchet")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="summary line only")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(r)
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = set(args.rules.split(","))
+        unknown = rules - set(ALL_RULES)
+        if unknown:
+            print(f"ddtlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    findings = runner.lint_paths(args.paths or ["ddt_tpu/", "tests/"],
+                                 rules=rules)
+
+    if args.write_baseline:
+        runner.save_baseline(args.baseline, findings)
+        print(f"ddtlint: wrote {len(findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else runner.load_baseline(args.baseline)
+    new, known, stale = runner.split_vs_baseline(findings, baseline)
+
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"ddtlint: stale baseline entry (fixed? ratchet it out "
+                  f"with --write-baseline): {e['path']} [{e['rule']}] "
+                  f"{e.get('line_text', '')}")
+    print(f"ddtlint: {len(findings)} finding(s): {len(new)} new, "
+          f"{len(known)} baselined, {len(stale)} stale baseline entr"
+          f"{'y' if len(stale) == 1 else 'ies'}")
+    # stale entries fail too (matching tests/test_lint.py's gate): a fixed
+    # finding must be ratcheted out so the baseline only ever shrinks.
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,262 @@
+"""Cross-module jit-reachability for the traced-branch checker.
+
+The codebase's tracing roots live in ddt_tpu/backends/ (``jax.jit(grow)``,
+``@jax.jit`` methods) while the traced bodies live in ddt_tpu/ops/ — so a
+module-local analysis would mark nothing in ops/ as traced.  This builds a
+small project-wide call graph instead:
+
+* **roots** — functions decorated with ``jit``/``pjit`` (directly, via
+  ``@partial(jax.jit, ...)``), wrapped as ``jax.jit(f)`` call sites, or
+  passed by name into JAX tracing combinators (``lax.fori_loop``,
+  ``lax.scan``, ``shard_map``, ``vmap``, ...), whose bodies are always
+  traced regardless of an enclosing jit.
+* **edges** — ``Name(...)`` calls resolved through lexical scopes to
+  module-level or nested functions, and ``alias.attr(...)`` calls resolved
+  through ``import``/``from-import`` aliases to functions in other scanned
+  modules.
+* **closure** — BFS from the roots; every function lexically nested inside
+  a reachable function is itself reachable (inner ``def``s of a traced
+  function trace with it).
+
+Deliberately unsound where Python makes static resolution impossible
+(``self.method`` dispatch, functions passed through containers): missed
+edges mean missed findings, never false positives — the right bias for a
+ratcheting lint gate.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+JIT_NAMES = {"jit", "pjit"}
+# Combinators whose function-valued arguments are traced unconditionally.
+TRACING_COMBINATORS = {
+    "fori_loop", "while_loop", "scan", "cond", "switch",
+    "vmap", "pmap", "shard_map", "checkpoint", "remat", "custom_vjp",
+    "grad", "value_and_grad",
+}
+
+
+def dotted(node: ast.AST) -> str | None:
+    """`jax.lax.psum` Attribute/Name chain -> "jax.lax.psum", else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _resolves_to_jit(expr: ast.AST) -> bool:
+    """Does a decorator/callee expression denote jit/pjit?  Covers ``jit``,
+    ``jax.jit``, ``@partial(jax.jit, ...)`` and ``@jax.jit(...)`` forms."""
+    d = dotted(expr)
+    if d is not None and d.split(".")[-1] in JIT_NAMES:
+        return True
+    if isinstance(expr, ast.Call):
+        f = dotted(expr.func)
+        if f is not None and f.split(".")[-1] in JIT_NAMES:
+            return True
+        if f is not None and f.split(".")[-1] == "partial":
+            return any(_resolves_to_jit(a) for a in expr.args)
+    return False
+
+
+@dataclass
+class FuncInfo:
+    qualname: str
+    node: ast.AST
+    parent: str | None              # enclosing function qualname ("" = module)
+    calls_local: set = field(default_factory=set)    # Name callees
+    calls_ext: set = field(default_factory=set)      # (alias_or_mod, attr)
+
+
+@dataclass
+class ModuleInfo:
+    path: str                       # repo-relative
+    modname: str                    # "ddt_tpu.ops.grow"
+    funcs: dict = field(default_factory=dict)        # qualname -> FuncInfo
+    scopes: dict = field(default_factory=dict)       # scope -> {name: qual}
+    imports: dict = field(default_factory=dict)      # alias -> dotted module
+    symbols: dict = field(default_factory=dict)      # alias -> (mod, name)
+    roots: set = field(default_factory=set)          # qualnames
+    _wrap_sites: list = field(default_factory=list)  # (scope, func_name)
+
+
+class _Collector(ast.NodeVisitor):
+    """One pass per module: functions, scopes, imports, roots, call edges."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.stack: list[str] = []        # qualname parts (incl. class names)
+        self.fn_stack: list[str] = []     # enclosing FUNCTION qualnames
+        mod.scopes[""] = {}
+
+    # -- scope helpers -------------------------------------------------- #
+    def _scope(self) -> str:
+        return ".".join(self.stack)
+
+    def _cur_fn(self) -> FuncInfo | None:
+        return self.mod.funcs.get(self.fn_stack[-1]) if self.fn_stack else None
+
+    # -- imports -------------------------------------------------------- #
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            self.mod.imports[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0])
+            if a.asname:
+                self.mod.imports[a.asname] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        base = node.module or ""
+        if node.level:                        # relative: resolve vs package
+            pkg = self.mod.modname.split(".")
+            pkg = pkg[: len(pkg) - node.level]
+            base = ".".join(pkg + ([node.module] if node.module else []))
+        for a in node.names:
+            alias = a.asname or a.name
+            # `from ddt_tpu.ops import histogram` may bind a MODULE or a
+            # symbol; record both readings — resolution prefers whichever
+            # matches a scanned module.
+            self.mod.imports[alias] = f"{base}.{a.name}" if base else a.name
+            self.mod.symbols[alias] = (base, a.name)
+
+    # -- functions ------------------------------------------------------ #
+    def _visit_func(self, node):
+        qual = ".".join(self.stack + [node.name])
+        parent = self.fn_stack[-1] if self.fn_stack else ""
+        fi = FuncInfo(qual, node, parent)
+        self.mod.funcs[qual] = fi
+        self.mod.scopes.setdefault(self._scope(), {})[node.name] = qual
+        if any(_resolves_to_jit(d) for d in node.decorator_list):
+            self.mod.roots.add(qual)
+        self.stack.append(node.name)
+        self.fn_stack.append(qual)
+        self.mod.scopes.setdefault(self._scope(), {})
+        for child in node.body:
+            self.visit(child)
+        self.fn_stack.pop()
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.stack.append(node.name)
+        self.mod.scopes.setdefault(self._scope(), {})
+        for child in node.body:
+            self.visit(child)
+        self.stack.pop()
+
+    # -- calls ---------------------------------------------------------- #
+    def visit_Call(self, node: ast.Call):
+        callee = dotted(node.func)
+        last = callee.split(".")[-1] if callee else None
+        # jax.jit(f) wrap sites and lax.fori_loop(..., body, ...) style
+        # combinators make their function-valued Name args roots.
+        if _resolves_to_jit(node.func) or last in TRACING_COMBINATORS:
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(a, ast.Name):
+                    self.mod._wrap_sites.append((self._scope(), a.id))
+        fn = self._cur_fn()
+        if fn is not None and callee is not None:
+            parts = callee.split(".")
+            if len(parts) == 1:
+                fn.calls_local.add((self._scope(), parts[0]))
+            else:
+                fn.calls_ext.add((parts[0], parts[-1]))
+        self.generic_visit(node)
+
+
+def _resolve_scoped(mod: ModuleInfo, scope: str, name: str) -> str | None:
+    """Find function `name` looking outward from `scope` (lexical)."""
+    parts = scope.split(".") if scope else []
+    for i in range(len(parts), -1, -1):
+        s = ".".join(parts[:i])
+        qual = mod.scopes.get(s, {}).get(name)
+        if qual is not None:
+            return qual
+    return None
+
+
+def build(sources: dict[str, str]) -> dict[str, set[str]]:
+    """{relpath: source} -> {relpath: set of jit-reachable func qualnames}.
+
+    Files that fail to parse contribute nothing (the runner reports syntax
+    errors separately)."""
+    mods: dict[str, ModuleInfo] = {}          # modname -> info
+    by_path: dict[str, ModuleInfo] = {}
+    for path, src in sources.items():
+        modname = path[:-3].replace("/", ".") if path.endswith(".py") else path
+        if modname.endswith(".__init__"):
+            modname = modname[: -len(".__init__")]
+        mi = ModuleInfo(path=path, modname=modname)
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        _Collector(mi).visit(tree)
+        for scope, name in mi._wrap_sites:
+            qual = _resolve_scoped(mi, scope, name)
+            if qual is not None:
+                mi.roots.add(qual)
+        mods[modname] = mi
+        by_path[path] = mi
+
+    def ext_target(mi: ModuleInfo, base: str, attr: str):
+        """alias.attr(...) -> (module, funcqual) in another scanned module."""
+        target_mod = mi.imports.get(base)
+        if target_mod in mods and attr in mods[target_mod].funcs:
+            return mods[target_mod], attr
+        # `from pkg import sub as base` where pkg.sub is a scanned module
+        if base in mi.symbols:
+            b, n = mi.symbols[base]
+            cand = f"{b}.{n}" if b else n
+            if cand in mods and attr in mods[cand].funcs:
+                return mods[cand], attr
+        return None
+
+    def symbol_target(mi: ModuleInfo, name: str):
+        """`from mod import f` call f(...) -> (module, funcqual)."""
+        if name in mi.symbols:
+            b, n = mi.symbols[name]
+            if b in mods and n in mods[b].funcs:
+                return mods[b], n
+        return None
+
+    # BFS over (module, qualname)
+    work = [(mi, q) for mi in mods.values() for q in mi.roots]
+    reach: set[tuple[str, str]] = set()
+    while work:
+        mi, qual = work.pop()
+        if (mi.modname, qual) in reach:
+            continue
+        reach.add((mi.modname, qual))
+        fi = mi.funcs.get(qual)
+        if fi is None:
+            continue
+        # lexically nested defs trace with their parent
+        prefix = qual + "."
+        for q2 in mi.funcs:
+            if q2.startswith(prefix):
+                work.append((mi, q2))
+        for scope, name in fi.calls_local:
+            q2 = _resolve_scoped(mi, scope, name)
+            if q2 is not None:
+                work.append((mi, q2))
+            else:
+                t = symbol_target(mi, name)
+                if t is not None:
+                    work.append(t)
+        for base, attr in fi.calls_ext:
+            t = ext_target(mi, base, attr)
+            if t is not None:
+                work.append(t)
+
+    out: dict[str, set[str]] = {}
+    for path, mi in by_path.items():
+        out[path] = {q for (m, q) in reach if m == mi.modname}
+    return out

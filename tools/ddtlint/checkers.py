@@ -1,0 +1,486 @@
+"""The ddtlint rules — one small, individually-testable visitor per hazard.
+
+Every checker is deliberately biased toward *no false negatives on the
+fixture shapes, no false positives on idiomatic repo code*: anything it
+cannot resolve statically it skips, and the pytest gate's ratchet baseline
+(tools/ddtlint/baseline.json) absorbs the residue.  docs/ANALYSIS.md
+documents each rule's rationale, scope, and escape hatches.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.ddtlint import callgraph
+from tools.ddtlint.findings import Finding
+
+# Attribute-chain roots that produce traced arrays when called.
+_TRACED_ROOTS = ("jnp.", "jax.", "lax.")
+# jax/jnp callables that return HOST values (python bools/strings/ints),
+# not traced arrays — assignments from these must not taint.
+_HOST_FUNCS = {
+    "default_backend", "devices", "local_devices", "device_count",
+    "local_device_count", "process_index", "process_count",
+    "issubdtype", "result_type", "promote_types", "dtype", "shape",
+    "ndim", "iinfo", "finfo", "axis_size", "Precision",
+}
+
+
+def _is_traced_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = callgraph.dotted(node.func)
+    if d is None or not (d + ".").startswith(_TRACED_ROOTS):
+        return False
+    return d.split(".")[-1] not in _HOST_FUNCS \
+        and not callgraph._resolves_to_jit(node.func)
+
+
+class CheckContext:
+    """Per-file inputs plus the project-level facts checkers share."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST,
+                 mesh_axes: set[str] | None = None,
+                 reachable: set[str] | None = None):
+        self.path = path                      # repo-relative, fwd slashes
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.mesh_axes = mesh_axes if mesh_axes is not None else set()
+        self.reachable = reachable if reachable is not None else set()
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class Checker(ast.NodeVisitor):
+    rule = "base"
+    #: relpath regexes this rule runs on (None = every scanned .py file)
+    path_scope: tuple[str, ...] | None = None
+
+    def __init__(self, ctx: CheckContext):
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+
+    @classmethod
+    def applies_to(cls, relpath: str) -> bool:
+        if cls.path_scope is None:
+            return True
+        return any(re.search(p, relpath) for p in cls.path_scope)
+
+    def report(self, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        self.findings.append(Finding(
+            rule=self.rule, path=self.ctx.path, line=line,
+            col=getattr(node, "col_offset", 0) + 1, message=message,
+            line_text=self.ctx.line_text(line),
+        ))
+
+    def run(self) -> list[Finding]:
+        self.visit(self.ctx.tree)
+        return self.findings
+
+
+# --------------------------------------------------------------------- #
+# 1. traced-branch
+# --------------------------------------------------------------------- #
+class TracedBranchChecker(Checker):
+    """Python `if`/`while` (and ternaries) on traced values inside functions
+    reachable from a jit/pjit root — a TracerBoolConversionError on device,
+    invisible to eager CPU tests.  Taint: locals assigned from jnp./jax.
+    calls, propagated through expressions; parameters are NOT tainted
+    (static-argument branches are the dominant legitimate pattern in ops/).
+    `x is None` / isinstance() tests are static Python and exempt."""
+
+    rule = "traced-branch"
+    path_scope = (r"^ddt_tpu/ops/", r"^ddt_tpu/backends/")
+
+    def run(self) -> list[Finding]:
+        for qual in sorted(self.ctx.reachable):
+            fn = self._find_func(qual)
+            if fn is not None:
+                self._check_fn(qual, fn)
+        return self.findings
+
+    def _find_func(self, qual: str):
+        parts = qual.split(".")
+        node: ast.AST = self.ctx.tree
+        for name in parts:
+            found = None
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)) and child.name == name:
+                    found = child
+                    break
+            if found is None:
+                return None
+            node = found
+        return node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+
+    @classmethod
+    def _walk_own(cls, fn: ast.AST):
+        """Descendants of `fn` excluding nested function bodies — nested
+        defs are reachable in their own right (callgraph closure), so
+        checking them here would double-report."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _check_fn(self, qual: str, fn: ast.AST) -> None:
+        tainted = self._taint(fn)
+        for node in self._walk_own(fn):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                test = node.test
+                if self._static_test(test):
+                    continue
+                if self._traced_expr(test, tainted):
+                    kind = {ast.If: "if", ast.While: "while",
+                            ast.IfExp: "conditional expression"}[type(node)]
+                    self.report(node, (
+                        f"Python {kind} on a traced value in jit-reachable "
+                        f"'{qual}' — use jnp.where / lax.cond / "
+                        "lax.while_loop (traces as data, not control flow)"))
+
+    @staticmethod
+    def _static_test(test: ast.AST) -> bool:
+        """Tests that stay in Python even on traced operands."""
+        while isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            test = test.operand
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return True
+        if isinstance(test, ast.Call):
+            d = callgraph.dotted(test.func)
+            if d in ("isinstance", "hasattr", "callable", "len"):
+                return True
+            # host-returning jax/jnp predicates stay python bools even on
+            # traced operands (jnp.issubdtype(x.dtype, ...), etc.)
+            if d is not None and d.split(".")[-1] in _HOST_FUNCS:
+                return True
+        return False
+
+    @classmethod
+    def _taint(cls, fn: ast.AST) -> set[str]:
+        tainted: set[str] = set()
+
+        def expr_traced(e: ast.AST) -> bool:
+            for n in ast.walk(e):
+                if _is_traced_call(n):
+                    return True
+                if isinstance(n, ast.Name) and n.id in tainted:
+                    return True
+            return False
+
+        def add_target(t: ast.AST):
+            if isinstance(t, ast.Name):
+                tainted.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    add_target(e)
+
+        # _walk_own, not ast.walk: nested defs are separate scopes checked
+        # in their own right — a jnp-assigned name INSIDE a nested def must
+        # not taint the same name in the enclosing function.
+        for _ in range(8):                    # fixpoint; converges fast
+            n0 = len(tainted)
+            for node in cls._walk_own(fn):
+                if isinstance(node, ast.Assign) and expr_traced(node.value):
+                    for t in node.targets:
+                        add_target(t)
+                elif isinstance(node, ast.AugAssign) \
+                        and expr_traced(node.value):
+                    add_target(node.target)
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None \
+                        and expr_traced(node.value):
+                    add_target(node.target)
+            if len(tainted) == n0:
+                break
+        return tainted
+
+    def _traced_expr(self, e: ast.AST, tainted: set[str]) -> bool:
+        for n in ast.walk(e):
+            if _is_traced_call(n):
+                return True
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+        return False
+
+
+# --------------------------------------------------------------------- #
+# 2. host-sync
+# --------------------------------------------------------------------- #
+class HostSyncChecker(Checker):
+    """`.item()`, `float()`, `int()`, `np.asarray()` on arrays inside the
+    grow/stream/scoring loops: each one is a blocking device->host fetch
+    that serialises the dispatch pipeline through the tunnel.  Scoped to
+    the hot-loop files; loop bodies (for/while/comprehensions) only."""
+
+    rule = "host-sync"
+    path_scope = (r"^ddt_tpu/ops/grow\.py$", r"^ddt_tpu/ops/stream\.py$",
+                  r"^ddt_tpu/backends/tpu\.py$")
+    _LOOPS = (ast.For, ast.AsyncFor, ast.While, ast.ListComp, ast.SetComp,
+              ast.DictComp, ast.GeneratorExp)
+
+    def run(self) -> list[Finding]:
+        for loop in ast.walk(self.ctx.tree):
+            if isinstance(loop, self._LOOPS):
+                self._check_loop(loop)
+        # dedupe: nested loops visit the same node twice
+        seen, out = set(), []
+        for f in self.findings:
+            k = (f.line, f.col, f.message)
+            if k not in seen:
+                seen.add(k)
+                out.append(f)
+        self.findings = out
+        return self.findings
+
+    def _check_loop(self, loop: ast.AST) -> None:
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            d = callgraph.dotted(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                self.report(node, "`.item()` in a loop body forces a "
+                                  "blocking device->host sync per iteration")
+            elif d in ("float", "int") and len(node.args) == 1 \
+                    and not isinstance(node.args[0], ast.Constant):
+                self.report(node, (
+                    f"`{d}()` on an array in a loop body blocks on the "
+                    "device — hoist the sync out of the loop or keep the "
+                    "value on device"))
+            elif d in ("np.asarray", "np.array", "numpy.asarray",
+                       "numpy.array"):
+                self.report(node, (
+                    f"`{d}()` in a loop body copies device memory to host "
+                    "per iteration — batch the fetch outside the loop"))
+
+
+# --------------------------------------------------------------------- #
+# 3. dtype-drift
+# --------------------------------------------------------------------- #
+class DtypeDriftChecker(Checker):
+    """Array constructors without an explicit dtype in ops/: the default
+    (f32 vs x64-mode f64, plus weak-type promotion) differs between the
+    CPU and TPU backends and between jax configs, so accumulator dtypes
+    must be spelled out.  Also flags bare float literals flowing into
+    histogram builders/accumulators, where a weakly-typed Python float
+    silently upcasts a bf16/f32 accumulation."""
+
+    rule = "dtype-drift"
+    path_scope = (r"^ddt_tpu/ops/",)
+    # ctor -> index of the positional dtype parameter
+    _CTORS = {"jnp.zeros": 1, "jnp.ones": 1, "jnp.array": 1, "jnp.empty": 1}
+    _HIST_RE = re.compile(r"(hist|acc)", re.IGNORECASE)
+
+    def visit_Call(self, node: ast.Call):
+        d = callgraph.dotted(node.func)
+        if d in self._CTORS:
+            pos = self._CTORS[d]
+            has_dtype = len(node.args) > pos or any(
+                k.arg == "dtype" for k in node.keywords)
+            if not has_dtype:
+                self.report(node, (
+                    f"`{d}(...)` without an explicit dtype — the default "
+                    "drifts between backends/x64 mode; pass dtype= "
+                    "(positionally or by keyword)"))
+        if d is not None and "histogram" in d.split(".")[-1].lower():
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                                float):
+                    self.report(arg, (
+                        "bare float literal passed into a histogram "
+                        "builder — wrap in jnp.float32(...) to pin the "
+                        "accumulator dtype"))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if isinstance(node.target, ast.Name) \
+                and self._HIST_RE.search(node.target.id) \
+                and self._bare_float(node.value):
+            self.report(node, (
+                f"bare float literal accumulated into `{node.target.id}` — "
+                "weak-type promotion can upcast the histogram dtype; wrap "
+                "in jnp.float32(...)"))
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp):
+        pairs = ((node.left, node.right), (node.right, node.left))
+        for name_side, lit_side in pairs:
+            if isinstance(name_side, ast.Name) \
+                    and self._HIST_RE.search(name_side.id) \
+                    and isinstance(lit_side, ast.Constant) \
+                    and isinstance(lit_side.value, float):
+                self.report(node, (
+                    f"bare float literal combined with `{name_side.id}` — "
+                    "weak-type promotion can upcast the histogram dtype; "
+                    "wrap in jnp.float32(...)"))
+                break
+        self.generic_visit(node)
+
+    @staticmethod
+    def _bare_float(e: ast.AST) -> bool:
+        return isinstance(e, ast.Constant) and isinstance(e.value, float)
+
+
+# --------------------------------------------------------------------- #
+# 4. collective-consistency
+# --------------------------------------------------------------------- #
+class CollectiveAxisChecker(Checker):
+    """String axis names in collectives must exist on a mesh defined in
+    parallel/mesh.py — a typo'd axis traces fine on one device and dies
+    (or worse, silently no-ops the reduction) under shard_map on the pod.
+    Variable axis arguments are skipped (plumbed from the mesh at runtime,
+    which is exactly the safe pattern)."""
+
+    rule = "collective-consistency"
+    path_scope = (r"^ddt_tpu/",)
+    # collective -> positional index of the axis-name argument
+    _AXIS_POS = {
+        "psum": 1, "psum_scatter": 1, "pmin": 1, "pmax": 1, "pmean": 1,
+        "all_gather": 1, "all_to_all": 1, "ppermute": 1,
+        "axis_index": 0, "axis_size": 0,
+    }
+
+    def visit_Call(self, node: ast.Call):
+        d = callgraph.dotted(node.func)
+        last = d.split(".")[-1] if d else None
+        if last in self._AXIS_POS and d != last:   # require lax./jax.lax.
+            axis = None
+            for k in node.keywords:
+                if k.arg in ("axis_name", "axis_names"):
+                    axis = k.value
+            pos = self._AXIS_POS[last]
+            if axis is None and len(node.args) > pos:
+                axis = node.args[pos]
+            for name in self._literal_axes(axis):
+                if name not in self.ctx.mesh_axes:
+                    known = ", ".join(sorted(self.ctx.mesh_axes)) or "(none)"
+                    self.report(node, (
+                        f"`{last}` over axis {name!r} which no mesh in "
+                        f"parallel/mesh.py defines (known axes: {known}) — "
+                        "mismatched collective axis names deadlock or "
+                        "mis-reduce under shard_map"))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _literal_axes(axis: ast.AST | None) -> list[str]:
+        if axis is None:
+            return []
+        if isinstance(axis, ast.Constant) and isinstance(axis.value, str):
+            return [axis.value]
+        if isinstance(axis, (ast.Tuple, ast.List)):
+            return [e.value for e in axis.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+        return []
+
+
+# --------------------------------------------------------------------- #
+# 5. broad-except
+# --------------------------------------------------------------------- #
+class BroadExceptChecker(Checker):
+    """`except Exception` / bare `except` swallow real faults (the
+    conftest thread-pin finding: a ctypes TypeError became nondeterministic
+    bit-identity flakes).  Handlers that re-raise are exempt — translating
+    an exception type is the legitimate use of a broad catch."""
+
+    rule = "broad-except"
+    path_scope = None                         # everywhere scanned
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        broad = False
+        if node.type is None:
+            broad = True
+        else:
+            names = []
+            if isinstance(node.type, ast.Tuple):
+                names = [callgraph.dotted(e) for e in node.type.elts]
+            else:
+                names = [callgraph.dotted(node.type)]
+            broad = any(n in ("Exception", "BaseException") for n in names)
+        if broad and not any(isinstance(n, ast.Raise)
+                             for n in ast.walk(node)):
+            what = "bare `except:`" if node.type is None \
+                else "`except Exception`"
+            self.report(node, (
+                f"{what} without re-raise swallows unexpected faults — "
+                "narrow to the exception types the fallback is designed "
+                "for (e.g. `except (ImportError, OSError)`)"))
+        self.generic_visit(node)
+
+
+AST_CHECKERS = [
+    TracedBranchChecker,
+    HostSyncChecker,
+    DtypeDriftChecker,
+    CollectiveAxisChecker,
+    BroadExceptChecker,
+]
+
+
+# --------------------------------------------------------------------- #
+# 6. suppression-hygiene  (not AST — .supp files)
+# --------------------------------------------------------------------- #
+SUPPRESSION_RULE = "suppression-hygiene"
+#: suppression patterns scoped to our own kernels are self-justifying
+_SCOPED_PREFIX = "ddt_"
+
+
+def is_process_wide_suppression(line: str) -> bool:
+    """Is a sanitizer-suppression entry (`race:PATTERN`, ...) process-wide,
+    i.e. NOT scoped to one of our own kernel symbols?  Single source of
+    truth shared with tsan_audit.write_audit_supp — the hygiene rule and
+    the mechanized audit must classify entries identically, or the audited
+    configuration stops matching what the gate enforces."""
+    _, _, pattern = line.strip().partition(":")
+    return not pattern.startswith(_SCOPED_PREFIX)
+
+
+def check_suppressions(path: str, text: str) -> list[Finding]:
+    """Sanitizer suppression hygiene: every PROCESS-WIDE entry (pattern not
+    scoped to a ddt_ kernel symbol) must carry a structured `# AUDIT:` tag
+    in its preceding comment block, naming how the suppression is
+    re-verified (`make tsan-audit` reruns the soak without these entries
+    and shape-checks the survivors).  Consecutive suppression lines share
+    the comment block above them."""
+    findings: list[Finding] = []
+    block: list[str] = []                  # current comment block
+    prev_was_comment = False
+    for i, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line:
+            prev_was_comment = False
+            continue
+        if line.startswith("#"):
+            if not prev_was_comment:
+                block = []
+            block.append(line)
+            prev_was_comment = True
+            continue
+        prev_was_comment = False
+        if ":" not in line:
+            continue
+        if not is_process_wide_suppression(line):
+            continue
+        if not any("AUDIT:" in c for c in block):
+            findings.append(Finding(
+                rule=SUPPRESSION_RULE, path=path, line=i, col=1,
+                message=(
+                    f"process-wide suppression `{line}` lacks a structured "
+                    "`# AUDIT:` tag in its comment block — unscoped "
+                    "frame-matches can hide real races (e.g. a kernel "
+                    "returning before its workers finish); tag it with the "
+                    "re-verification procedure (`make tsan-audit`)"),
+                line_text=line,
+            ))
+    return findings

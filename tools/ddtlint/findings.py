@@ -1,0 +1,48 @@
+"""Finding record + stable fingerprints for the ratchet baseline.
+
+A fingerprint must survive unrelated edits (line insertions above the
+finding) but change when the flagged code itself changes — so it hashes
+(rule, path, stripped source line, occurrence index among identical
+lines) rather than the line number.  The occurrence index keeps two
+textually identical violations in one file distinct so fixing one of
+them cannot silently absolve the other.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative, forward slashes
+    line: int            # 1-based
+    col: int
+    message: str
+    line_text: str = ""  # stripped source of the flagged line
+    fingerprint: str = field(default="", compare=False)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+               f"{self.message}"
+
+
+def fingerprint(rule: str, path: str, line_text: str, occurrence: int) -> str:
+    key = f"{rule}|{path}|{line_text.strip()}|{occurrence}"
+    return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
+
+
+def assign_fingerprints(findings: list[Finding]) -> list[Finding]:
+    """Fill in fingerprints, numbering identical (rule, path, line_text)
+    triples by order of appearance.  Sorts by (path, line, col, rule) first
+    so occurrence indices are deterministic."""
+    findings = sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    seen: dict[tuple, int] = {}
+    for f in findings:
+        key = (f.rule, f.path, f.line_text.strip())
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        f.fingerprint = fingerprint(f.rule, f.path, f.line_text, n)
+    return findings

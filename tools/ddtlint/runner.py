@@ -1,0 +1,188 @@
+"""File walking, project context, pragma + baseline handling for ddtlint."""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+from tools.ddtlint import callgraph, checkers
+from tools.ddtlint.findings import Finding, assign_fingerprints
+
+DEFAULT_BASELINE = "tools/ddtlint/baseline.json"
+MESH_FILE = "ddt_tpu/parallel/mesh.py"
+#: directories holding deliberate violations (checker fixtures) — skipped
+#: by the walker; tests exercise them through run_on_source directly.
+SKIP_DIRS = {"lint_fixtures", "__pycache__", ".git"}
+
+_PRAGMA_RE = re.compile(r"ddtlint:\s*disable=([\w,-]+)")
+
+
+# --------------------------------------------------------------------- #
+# project context
+# --------------------------------------------------------------------- #
+def mesh_axis_names(root: str) -> set[str]:
+    """Axis names any mesh in parallel/mesh.py can define: module-level
+    `*_AXIS = "..."` constants plus string literals in the axis-name
+    tuples handed to make_mesh."""
+    path = os.path.join(root, MESH_FILE)
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read())
+        except SyntaxError:
+            return set()
+    axes: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and t.id.endswith("_AXIS")
+                   for t in node.targets) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                axes.add(node.value.value)
+        elif isinstance(node, ast.Call):
+            d = callgraph.dotted(node.func)
+            if d is not None and d.split(".")[-1] == "make_mesh":
+                cands = list(node.args[1:2]) + [
+                    k.value for k in node.keywords
+                    if k.arg in ("axis_names", None)]
+                for c in cands:
+                    if isinstance(c, (ast.Tuple, ast.List)):
+                        for e in c.elts:
+                            if isinstance(e, ast.Constant) \
+                                    and isinstance(e.value, str):
+                                axes.add(e.value)
+    return axes
+
+
+def _walk_py(paths: list[str], root: str) -> list[str]:
+    """Expand files/dirs into sorted repo-relative .py (and .supp) paths."""
+    out: set[str] = set()
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            out.add(os.path.relpath(full, root).replace(os.sep, "/"))
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+            for fn in filenames:
+                if fn.endswith((".py", ".supp")):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    out.add(rel.replace(os.sep, "/"))
+    return sorted(out)
+
+
+# --------------------------------------------------------------------- #
+# linting
+# --------------------------------------------------------------------- #
+def _apply_pragmas(findings: list[Finding],
+                   sources: dict[str, str]) -> list[Finding]:
+    """Drop findings whose source line carries
+    `# ddtlint: disable=<rule>[,rule...]` (or disable=all)."""
+    kept = []
+    line_cache: dict[str, list[str]] = {}
+    for f in findings:
+        lines = line_cache.setdefault(f.path,
+                                      sources.get(f.path, "").splitlines())
+        text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        m = _PRAGMA_RE.search(text)
+        if m and (f.rule in m.group(1).split(",") or m.group(1) == "all"):
+            continue
+        kept.append(f)
+    return kept
+
+
+def run_on_source(path: str, source: str, mesh_axes: set[str] | None = None,
+                  reachable: set[str] | None = None,
+                  rules: set[str] | None = None) -> list[Finding]:
+    """Lint one in-memory python source. For .supp content use
+    checkers.check_suppressions directly."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(rule="syntax-error", path=path,
+                        line=e.lineno or 1, col=(e.offset or 0) + 1,
+                        message=f"does not parse: {e.msg}")]
+    if reachable is None:
+        reachable = callgraph.build({path: source}).get(path, set())
+    out: list[Finding] = []
+    for cls in checkers.AST_CHECKERS:
+        if rules is not None and cls.rule not in rules:
+            continue
+        if not cls.applies_to(path):
+            continue
+        ctx = checkers.CheckContext(path, source, tree, mesh_axes, reachable)
+        out.extend(cls(ctx).run())
+    return _apply_pragmas(out, {path: source})
+
+
+def lint_paths(paths: list[str], root: str | None = None,
+               rules: set[str] | None = None) -> list[Finding]:
+    """Lint files/directories; returns fingerprinted findings sorted by
+    position.  `root` defaults to the repo root (cwd)."""
+    root = os.path.abspath(root or os.getcwd())
+    files = _walk_py(paths, root)
+    sources: dict[str, str] = {}
+    for rel in files:
+        with open(os.path.join(root, rel), encoding="utf-8",
+                  errors="replace") as f:
+            sources[rel] = f.read()
+
+    py_sources = {p: s for p, s in sources.items() if p.endswith(".py")}
+    reach = callgraph.build(py_sources)
+    axes = mesh_axis_names(root)
+
+    findings: list[Finding] = []
+    for rel, src in sources.items():
+        if rel.endswith(".supp"):
+            if rules is None or checkers.SUPPRESSION_RULE in rules:
+                findings.extend(checkers.check_suppressions(rel, src))
+        else:
+            findings.extend(run_on_source(
+                rel, src, mesh_axes=axes, reachable=reach.get(rel, set()),
+                rules=rules))
+    return assign_fingerprints(findings)
+
+
+# --------------------------------------------------------------------- #
+# baseline
+# --------------------------------------------------------------------- #
+def load_baseline(path: str) -> dict[str, dict]:
+    """{fingerprint: entry}; tolerant of a missing file (empty ratchet)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def save_baseline(path: str, findings: list[Finding]) -> None:
+    data = {
+        "comment": (
+            "ddtlint ratchet baseline — known findings the gate tolerates. "
+            "Regenerate with `python -m tools.ddtlint ddt_tpu/ tests/ "
+            "--write-baseline` AFTER confirming every new entry is a "
+            "deliberate, documented exception (docs/ANALYSIS.md); the goal "
+            "is for this list to only ever shrink."),
+        "findings": [
+            {"fingerprint": f.fingerprint, "rule": f.rule, "path": f.path,
+             "line": f.line, "line_text": f.line_text.strip(),
+             "message": f.message}
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def split_vs_baseline(findings: list[Finding], baseline: dict[str, dict]
+                      ) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """(new, known, stale_baseline_entries)."""
+    fps = {f.fingerprint for f in findings}
+    new = [f for f in findings if f.fingerprint not in baseline]
+    known = [f for f in findings if f.fingerprint in baseline]
+    stale = [e for fp, e in baseline.items() if fp not in fps]
+    return new, known, stale

@@ -1,0 +1,264 @@
+"""Mechanized TSan suppression audit (ddt_tpu/native/tsan.supp AUDIT tag).
+
+tsan.supp carries two PROCESS-WIDE suppressions (`race:_contig_to_contig`,
+`race:array_dealloc`) for the join-edge false-positive class: after an
+OpenMP region ends, NumPy copies/frees buffers the workers just wrote, the
+join ordering lives inside uninstrumented libgomp, and the only visible
+frames are NumPy's.  Being process-wide, they would ALSO hide a real
+kernel-returns-before-worker-finishes race, whose report looks identical.
+The prescribed audit — rerun the soak with those entries dropped and check
+every survivor still has the join-edge *shape* — used to be prose a
+reviewer had to remember; this module executes it:
+
+    python -m tools.ddtlint.tsan_audit --run          # full soak (or:
+                                                      #   make tsan-audit)
+    python -m tools.ddtlint.tsan_audit --classify F   # classify a report
+                                                      #   log (pure, fast)
+
+Join-edge shape (all must hold, per report):
+  * it is a `data race` report (not use-after-free / leak / ...);
+  * no visible frame is a ddt_ kernel symbol;
+  * every racing-stack frame is NumPy/libc memory machinery;
+  * at least one side is `[failed to restore the stack]` (the worker
+    whose stack died with the OpenMP team);
+  * total surviving reports stay under a small ceiling.
+Anything else is a FINDING and the audit exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+
+SUPP_PATH = "ddt_tpu/native/tsan.supp"
+NATIVE_DIR = "ddt_tpu/native"
+#: suppression patterns scoped to our kernels stay active during the audit
+_SCOPED_PREFIX = "ddt_"
+#: more survivors than this is "count small and stable" violated
+MAX_REPORTS = 64
+
+# Frames legitimate in a join-edge report's RACING stacks: NumPy's copy /
+# dealloc machinery plus the allocator+interceptor glue around it.
+_NUMPY_FRAME_RE = re.compile(
+    r"(memmove|memcpy|_contig_to_contig|array_dealloc|PyArray|PyDataMem|"
+    r"numpy|npy_|__interceptor_|operator delete|\bfree\b|\bmalloc\b|"
+    r"\b_?Py[A-Z_])",   # CPython frames under the NumPy call are expected
+    re.IGNORECASE)
+# Frames legitimate in the trailing "Thread T<n> ... created by" section
+# (team bring-up is libgomp/pthread by construction).
+_SPAWN_FRAME_RE = re.compile(
+    r"(pthread_create|gomp|GOMP|omp_|clone|start_thread|__kmp)",
+    re.IGNORECASE)
+_FRAME_RE = re.compile(r"^\s+#\d+\s+(\S+)")
+_REPORT_START = re.compile(r"WARNING: ThreadSanitizer: (.+?) \(pid=\d+\)")
+_THREAD_SECTION = re.compile(r"Thread T\d+ .*created by")
+_FAILED_STACK = "[failed to restore the stack]"
+
+
+def split_reports(text: str) -> list[str]:
+    """Cut a TSan log into individual report blocks."""
+    blocks, cur = [], None
+    for line in text.splitlines():
+        if _REPORT_START.search(line):
+            if cur:
+                blocks.append("\n".join(cur))
+            cur = [line]
+        elif cur is not None:
+            if line.strip().startswith("=================="):
+                blocks.append("\n".join(cur))
+                cur = None
+            else:
+                cur.append(line)
+    if cur:
+        blocks.append("\n".join(cur))
+    return blocks
+
+
+def classify_report(block: str) -> dict:
+    """One report block -> {kind: 'join-edge'|'finding', reasons: [...]}."""
+    reasons: list[str] = []
+    m = _REPORT_START.search(block)
+    what = m.group(1) if m else "unknown"
+    if what != "data race":
+        reasons.append(f"report type {what!r}, not a data race")
+
+    in_spawn = False
+    for line in block.splitlines():
+        if _THREAD_SECTION.search(line):
+            in_spawn = True
+            continue
+        fm = _FRAME_RE.match(line)
+        if not fm:
+            continue
+        frame = fm.group(1)
+        if frame.startswith(_SCOPED_PREFIX) or "ddt_" in frame:
+            reasons.append(f"ddt_ kernel frame visible: {frame}")
+        elif in_spawn:
+            if not (_SPAWN_FRAME_RE.search(line)
+                    or _NUMPY_FRAME_RE.search(line)):
+                reasons.append(f"unexpected thread-creation frame: {frame}")
+        elif not _NUMPY_FRAME_RE.search(line):
+            reasons.append(f"non-NumPy racing frame: {frame}")
+
+    if _FAILED_STACK not in block:
+        reasons.append("no '[failed to restore the stack]' side — both "
+                       "stacks restored, which the join-edge class never "
+                       "shows")
+    return {"kind": "finding" if reasons else "join-edge",
+            "what": what, "reasons": reasons,
+            "head": block.splitlines()[0].strip() if block else ""}
+
+
+def classify_log(text: str, max_reports: int = MAX_REPORTS) -> dict:
+    """Full log -> summary dict; 'ok' False iff any report breaks the
+    expected join-edge shape (or there are implausibly many)."""
+    blocks = split_reports(text)
+    classified = [classify_report(b) for b in blocks]
+    findings = [c for c in classified if c["kind"] == "finding"]
+    if len(blocks) > max_reports:
+        findings.append({
+            "kind": "finding", "what": "report-count",
+            "reasons": [f"{len(blocks)} surviving reports > {max_reports} "
+                        "ceiling — join-edge survivors are few and stable"],
+            "head": ""})
+    return {"ok": not findings, "total_reports": len(blocks),
+            "join_edge": sum(1 for c in classified
+                             if c["kind"] == "join-edge"),
+            "findings": findings}
+
+
+# --------------------------------------------------------------------- #
+# orchestration (--run)
+# --------------------------------------------------------------------- #
+def write_audit_supp(src_path: str, dst_path: str) -> int:
+    """Copy tsan.supp with every process-wide suppression commented out
+    (scoped ddt_ entries stay active).  Returns how many were dropped.
+    Entry classification is shared with the suppression-hygiene lint rule
+    (checkers.is_process_wide_suppression) so the audited configuration
+    always matches what the gate enforces."""
+    from tools.ddtlint.checkers import is_process_wide_suppression
+
+    dropped = 0
+    out_lines = []
+    with open(src_path, encoding="utf-8") as f:
+        for line in f:
+            s = line.strip()
+            if s and not s.startswith("#") and ":" in s \
+                    and is_process_wide_suppression(s):
+                out_lines.append(f"# [tsan-audit dropped] {line}")
+                dropped += 1
+            else:
+                out_lines.append(line)
+    with open(dst_path, "w", encoding="utf-8") as f:
+        f.writelines(out_lines)
+    return dropped
+
+
+def _libtsan() -> str | None:
+    gcc = shutil.which("gcc")
+    if gcc is None:
+        return None
+    out = subprocess.run([gcc, "-print-file-name=libtsan.so"],
+                         capture_output=True, text=True).stdout.strip()
+    return out if out and os.path.sep in out and os.path.exists(out) \
+        else None
+
+
+def run_audit(root: str = ".", max_reports: int = MAX_REPORTS,
+              pytest_args: tuple = ("tests/test_native.py", "-q")) -> int:
+    root = os.path.abspath(root)
+    supp = os.path.join(root, SUPP_PATH)
+    if not os.path.exists(supp):
+        print(f"tsan-audit: {SUPP_PATH} not found under {root}",
+              file=sys.stderr)
+        return 2
+    libtsan = _libtsan()
+    if libtsan is None:
+        print("tsan-audit: libtsan.so not available from gcc on this host "
+              "— cannot run the soak (the classifier still works: "
+              "--classify <log>)", file=sys.stderr)
+        return 3
+
+    mk = subprocess.run(["make", "-C", os.path.join(root, NATIVE_DIR),
+                         "-s", "tsan"], capture_output=True, text=True)
+    if mk.returncode != 0:
+        print(f"tsan-audit: TSan build failed:\n{mk.stderr}",
+              file=sys.stderr)
+        return 2
+
+    with tempfile.TemporaryDirectory(prefix="tsan_audit_") as tmp:
+        audit_supp = os.path.join(tmp, "tsan_audit.supp")
+        dropped = write_audit_supp(supp, audit_supp)
+        log_stem = os.path.join(tmp, "tsan-report")
+        env = dict(os.environ)
+        env.update({
+            "TSAN_OPTIONS": (f"suppressions={audit_supp} "
+                             f"log_path={log_stem} exitcode=0"),
+            "LD_PRELOAD": libtsan,
+            "DDT_NATIVE_LIB": "libddthist_tsan.so",
+            "OMP_NUM_THREADS": "4",
+            "JAX_PLATFORMS": "cpu",
+        })
+        print(f"tsan-audit: soak with {dropped} process-wide "
+              f"suppression(s) dropped ...")
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", *pytest_args],
+            cwd=root, env=env, capture_output=True, text=True)
+        text = ""
+        for path in sorted(glob.glob(log_stem + "*")):
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text += f.read() + "\n"
+        # TSan also writes to stderr when log_path misbehaves; include it.
+        if "WARNING: ThreadSanitizer" in proc.stderr:
+            text += proc.stderr
+        summary = classify_log(text, max_reports=max_reports)
+        summary["pytest_exit"] = proc.returncode
+        summary["suppressions_dropped"] = dropped
+        print(json.dumps(summary, indent=2))
+        if proc.returncode != 0:
+            print("tsan-audit: FAIL — the behavioral net itself failed "
+                  "under TSan (pytest nonzero); see output above",
+                  file=sys.stderr)
+            print(proc.stdout[-4000:], file=sys.stderr)
+            return 1
+        if not summary["ok"]:
+            print("tsan-audit: FAIL — surviving report(s) break the "
+                  "join-edge shape; treat as a real race finding",
+                  file=sys.stderr)
+            return 1
+        print(f"tsan-audit: OK — {summary['total_reports']} surviving "
+              "report(s), all join-edge shaped")
+        return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.ddtlint.tsan_audit",
+        description="mechanized tsan.supp process-wide suppression audit")
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--run", action="store_true",
+                   help="build the TSan lib, rerun the soak with "
+                        "process-wide suppressions dropped, classify")
+    g.add_argument("--classify", metavar="LOG",
+                   help="classify an existing TSan report log")
+    ap.add_argument("--root", default=".", help="repo root (default: cwd)")
+    ap.add_argument("--max-reports", type=int, default=MAX_REPORTS)
+    args = ap.parse_args(argv)
+
+    if args.classify:
+        with open(args.classify, encoding="utf-8", errors="replace") as f:
+            summary = classify_log(f.read(), max_reports=args.max_reports)
+        print(json.dumps(summary, indent=2))
+        return 0 if summary["ok"] else 1
+    return run_audit(args.root, max_reports=args.max_reports)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
