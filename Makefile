@@ -23,7 +23,13 @@ tsan-audit:
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
+# Telemetry smoke (docs/OBSERVABILITY.md): train 2 rounds on synthetic
+# data with a run log in a tmpdir, then render it via `cli report` —
+# the round trip the tier-1 suite also asserts (tests/test_telemetry.py).
+report:
+	JAX_PLATFORMS=cpu $(PY) scripts/telemetry_smoke.py
+
 native:
 	$(MAKE) -C ddt_tpu/native
 
-.PHONY: lint lint-baseline tsan-audit test native
+.PHONY: lint lint-baseline tsan-audit test report native

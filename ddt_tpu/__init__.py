@@ -14,6 +14,7 @@ from ddt_tpu.api import TrainResult, predict, train
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble
 from ddt_tpu.sklearn import DDTClassifier, DDTRegressor
+from ddt_tpu.telemetry.events import RunLog
 
 __version__ = "0.1.0"
 
@@ -25,5 +26,6 @@ __all__ = [
     "TreeEnsemble",
     "DDTClassifier",
     "DDTRegressor",
+    "RunLog",
     "__version__",
 ]

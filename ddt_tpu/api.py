@@ -109,13 +109,17 @@ def train(
     early_stopping_rounds: int | None = None,
     sample_weight: np.ndarray | None = None,
     profile: bool = False,
+    run_log=None,
     **cfg_overrides,
 ) -> TrainResult:
     """Train a GBDT. `X` is float features (quantized here) unless
     `binned=True` (uint8 bin indices). `cfg_overrides` are TrainConfig fields
     (e.g. train(X, y, n_trees=50, backend="cpu")). `backend` accepts either
     the flag string (a TrainConfig field) or a pre-built DeviceBackend
-    instance (e.g. one holding a specific mesh)."""
+    instance (e.g. one holding a specific mesh). `run_log` (a JSONL path or
+    a telemetry.RunLog) attaches the structured telemetry stream — run
+    manifest, per-round records, phase timings, counters — rendered by
+    `python -m ddt_tpu.cli report` (docs/OBSERVABILITY.md)."""
     if isinstance(backend, str):
         cfg_overrides["backend"] = backend
         backend = None
@@ -175,6 +179,7 @@ def train(
         checkpoint_dir=checkpoint_dir,
         checkpoint_every=checkpoint_every,
         profile=profile,
+        run_log=run_log,
     )
     ens = driver.fit(
         Xb, np.asarray(y),

@@ -48,6 +48,7 @@ from ddt_tpu.ops import grow as grow_ops
 from ddt_tpu.ops import histogram as hist_ops
 from ddt_tpu.ops import predict as predict_ops
 from ddt_tpu.ops import split as split_ops
+from ddt_tpu.telemetry import counters as tele_counters
 
 P = jax.sharding.PartitionSpec
 
@@ -219,6 +220,10 @@ class TPUDevice(DeviceBackend):
         materialises its addressable shards from the (identical-everywhere)
         global host array via the sharding's index map. Single-process
         meshes keep the plain device_put fast path."""
+        # Telemetry: every host->device transfer funnels through here —
+        # ONE integer add per upload feeds the run log's h2d counter
+        # (telemetry.counters; no device interaction, ~ns).
+        tele_counters.record_h2d(a.nbytes)
         if sh is None:
             return jax.device_put(a)
         if not sh.is_fully_addressable:
@@ -846,6 +851,7 @@ class TPUDevice(DeviceBackend):
 
     def fetch_tree(self, handle) -> HostTree:
         packed = np.asarray(handle)                      # ONE fetch
+        tele_counters.record_d2h(packed.nbytes)          # run-log counter
         return HostTree(
             feature=packed[0].astype(np.int32),
             threshold_bin=packed[1].astype(np.int32),

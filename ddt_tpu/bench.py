@@ -19,6 +19,7 @@ import time
 import numpy as np
 
 from ddt_tpu.config import TrainConfig
+from ddt_tpu.telemetry import counters as tele_counters
 
 
 def _hist_inputs(rows, features, bins, n_nodes, seed):
@@ -56,6 +57,8 @@ def bench_histogram(
         hist_impl=hist_impl,
     )
     be = get_backend(cfg)
+    tele_counters.install_jax_listener()
+    c0 = tele_counters.snapshot()
     Xb, g, h, node_index = _hist_inputs(rows, features, bins, n_nodes, seed)
 
     data = be.upload(Xb)
@@ -101,6 +104,10 @@ def bench_histogram(
         "iters": iters, "partitions": partitions,
         "sec_per_build": dt,
         "mrows_per_sec_per_chip": mrows,
+        # Telemetry counter: compiles triggered by this bench — a value
+        # above the expected warm-up compile means the timed loop is
+        # recompiling (shape churn), which invalidates the throughput.
+        "jit_compiles": tele_counters.delta(c0)["jit_compiles"],
     }
 
 
@@ -241,9 +248,13 @@ def bench_train(
     partitions: int = 1,
     hist_impl: str = "auto",
     seed: int = 0,
+    run_log=None,
 ) -> dict:
     """End-to-end boosted-build wallclock (the Higgs-1M/depth-6/100-tree
-    config when called with defaults)."""
+    config when called with defaults). `run_log` (path or telemetry
+    RunLog) attaches the structured run log to the TIMED run — the bench
+    artifact then carries per-round records and counters alongside the
+    headline wallclock."""
     from ddt_tpu import api
     from ddt_tpu.data import datasets
     from ddt_tpu.data.quantizer import quantize
@@ -254,10 +265,13 @@ def bench_train(
         n_trees=trees, max_depth=depth, n_bins=bins, backend=backend,
         n_partitions=partitions, hist_impl=hist_impl, seed=seed,
     )
+    tele_counters.install_jax_listener()
     # Warm-up: compile the per-tree program on a 2-tree run, then time.
     api.train(Xb, y, cfg.replace(n_trees=2), binned=True, log_every=10**9)
+    c0 = tele_counters.snapshot()
     t0 = time.perf_counter()
-    res = api.train(Xb, y, cfg, binned=True, log_every=10**9)
+    res = api.train(Xb, y, cfg, binned=True, log_every=10**9,
+                    run_log=run_log)
     dt = time.perf_counter() - t0
     return {
         "kernel": "train",
@@ -267,6 +281,12 @@ def bench_train(
         "trees_per_sec": trees / dt,
         "final_train_loss": res.history[-1]["train_loss"]
         if res.history else None,
+        # Compiles INSIDE the timed run (telemetry.counters). Nonzero is
+        # expected once per distinct block/round shape (the warm-up's
+        # 2-round block differs from the timed blocks); a value growing
+        # WITH `trees` means per-round shape churn — the silent killer
+        # the counter exists to surface (arXiv:1810.09868).
+        "jit_compiles_timed": tele_counters.delta(c0)["jit_compiles"],
     }
 
 

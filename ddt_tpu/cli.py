@@ -160,9 +160,10 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
     import tempfile
 
     # Sampling configs stream since round 5 (stateless counter-based
-    # masks, ops/sampling); only the profiling knobs stay in-memory-only.
+    # masks, ops/sampling) and --profile/--run-log since the telemetry
+    # PR (fit_streaming wires its own PhaseTimer); only the XLA trace
+    # capture stays in-memory-only.
     unsupported = [
-        (args.profile, "--profile"),
         (args.trace_dir is not None, "--trace-dir"),
     ]
     bad = [flag for cond, flag in unsupported if cond]
@@ -208,6 +209,8 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
         bi = int(np.argmax([sign * r[mk] for r in history]))
         out["best_round"] = history[bi]["round"]
         out["best_score"] = round(history[bi][mk], 6)
+    if args.run_log:
+        out["run_log"] = args.run_log
     print(json.dumps(out))
     return 0
 
@@ -357,7 +360,9 @@ def _stream_fit(args, X, y, cfg, cache_root):
                         eval_metric=args.metric,
                         early_stopping_rounds=args.early_stop,
                         history=history,
-                        device_chunk_cache=dev_cache)
+                        device_chunk_cache=dev_cache,
+                        run_log=args.run_log,
+                        profile=args.profile)
     return ens, history, mapper, rows, n_chunks, chunk_rows_max
 
 
@@ -434,7 +439,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="log a per-phase wallclock breakdown (adds device "
                          "barriers; rounds run slower than unprofiled)")
     tp.add_argument("--trace-dir", default=None,
-                    help="capture a jax.profiler trace here (TensorBoard)")
+                    help="capture a jax.profiler trace here (TensorBoard/"
+                         "Perfetto; device spans carry the same ddt:<phase> "
+                         "names as --run-log phase timings)")
+    tp.add_argument("--run-log", default=None,
+                    help="write a structured JSONL telemetry run log here "
+                         "(run manifest, per-round records, phase timings, "
+                         "device counters; render with the `report` "
+                         "subcommand — docs/OBSERVABILITY.md)")
     tp.add_argument("--subsample", type=float, default=1.0,
                     help="row fraction per boosting round (bagging)")
     tp.add_argument("--colsample-bytree", type=float, default=1.0,
@@ -505,6 +517,17 @@ def main(argv: list[str] | None = None) -> int:
     bp.add_argument("--iters", type=int, default=10)
     bp.add_argument("--partitions", type=int, default=1)
     bp.add_argument("--hist-impl", default="auto")
+
+    rp = sub.add_parser("report",
+                        help="render a run summary from a JSONL telemetry "
+                             "log (train --run-log)")
+    rp.add_argument("--log", required=True,
+                    help="path to the run log written by train --run-log")
+    rp.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object instead of "
+                         "the human rendering")
+    rp.add_argument("--slowest", type=_positive_int, default=5,
+                    help="how many slowest rounds to list")
 
     ip = sub.add_parser("inspect", help="summarize a saved ensemble")
     ip.add_argument("--model", required=True)
@@ -603,6 +626,7 @@ def main(argv: list[str] | None = None) -> int:
                 eval_set=eval_set, eval_metric=args.metric,
                 early_stopping_rounds=args.early_stop,
                 profile=args.profile,
+                run_log=args.run_log,
             )
         dt = time.perf_counter() - t0
         # Persist the COMPLETE artifact: ensemble + training-time BinMapper
@@ -622,6 +646,8 @@ def main(argv: list[str] | None = None) -> int:
         if res.best_score is not None:
             out["best_round"] = res.best_round + 1
             out["best_score"] = round(res.best_score, 6)
+        if args.run_log:
+            out["run_log"] = args.run_log
         print(json.dumps(out))
         return 0
 
@@ -664,6 +690,22 @@ def main(argv: list[str] | None = None) -> int:
             "trees": ens.n_trees, "wallclock_s": round(dt, 3),
             "rows_per_sec": round(len(X) / dt, 1),
         }))
+        return 0
+
+    if args.cmd == "report":
+        from ddt_tpu.telemetry import report as tele_report
+
+        try:
+            events = tele_report.read_events(args.log)
+            summary = tele_report.summarize(events, slowest=args.slowest)
+            out_text = (json.dumps(summary) if args.json
+                        else tele_report.render(summary))
+        except (OSError, ValueError, TypeError, KeyError) as e:
+            # summarize/render stay inside the guard: a schema-valid log
+            # with wrong field TYPES (hand-edited/corrupted) must exit
+            # with the clean message, not a raw traceback.
+            raise SystemExit(f"report: {e}") from e
+        print(out_text)
         return 0
 
     if args.cmd == "bench":

@@ -19,8 +19,13 @@ and is explicitly "unchanged above the operator layer" when backends swap
 Boosting state (`pred`) is an opaque backend handle — on TPUDevice it lives
 sharded on device for the whole run; the Driver never sees a float of it.
 
-Observability (SURVEY.md §5): structured per-round log records (train loss,
-ms/tree) via `logging`, collected in `Driver.history`. Checkpoint/resume
+Observability (SURVEY.md §5): structured per-round records (train loss,
+ms/tree) via `logging`, collected in `Driver.history`, and — when a
+`run_log` is attached — emitted as schema-versioned JSONL telemetry events
+(ddt_tpu/telemetry: run manifest, per-round records, per-phase timings,
+early-stop decisions, resume/fault events, device counters; render with
+`ddt_tpu.cli report`). With no run_log the hot loop pays nothing: no device
+syncs, no file I/O. Checkpoint/resume
 (SURVEY.md §5): pass `checkpoint_dir` — after every `checkpoint_every` rounds
 the partial ensemble + cursor is written; `fit` resumes from the cursor if a
 checkpoint exists (utils/checkpoint.py).
@@ -60,12 +65,14 @@ import time
 
 import numpy as np
 
-import contextlib
-
 from ddt_tpu.backends.base import DeviceBackend
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
 from ddt_tpu.reference.numpy_trainer import base_score
+from ddt_tpu.telemetry import counters as tele_counters
+from ddt_tpu.telemetry.annotations import phase_ctx
+from ddt_tpu.telemetry.events import (
+    RoundRecorder, RunLog, emit_early_stop, finish_run_log)
 from ddt_tpu.utils import checkpoint
 from ddt_tpu.utils.profiling import PhaseTimer
 
@@ -118,6 +125,7 @@ class Driver:
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 25,
         profile: bool = False,
+        run_log: "RunLog | str | None" = None,
     ):
         self.backend = backend
         self.cfg = cfg
@@ -133,8 +141,19 @@ class Driver:
         # profile=True records a per-phase wallclock breakdown (SURVEY.md §5
         # tracing): each phase ends with a device barrier, so rounds get
         # SLOWER (the fast path pipelines phases without syncs) but the
-        # report shows where device time actually goes.
-        self.timer = PhaseTimer() if profile else None
+        # report shows where device time actually goes. A run_log alone
+        # also times phases — WITHOUT the barriers (numbers then measure
+        # host dispatch + whatever the async queue back-pressures, which
+        # is honest for a pipeline) and WITHOUT forcing the granular path.
+        self.profile = profile
+        # A path string means the Driver OWNS the log (opens and closes
+        # it); a RunLog instance stays the caller's to close.
+        self._own_run_log = isinstance(run_log, str)
+        self.run_log = RunLog.coerce(run_log)
+        self.timer = (
+            PhaseTimer() if (profile or self.run_log is not None) else None
+        )
+        self._recorder: RoundRecorder | None = None
 
     def _draw_colsample_mask(self, rnd: int, c: int, F: int) -> np.ndarray:
         """The per-(seed, round, class) colsample feature mask; the draw
@@ -148,44 +167,23 @@ class Driver:
                               self.cfg.colsample_bytree)
 
     def _psync(self, x) -> None:
-        """Backend barrier on x's producer chain — only when profiling
-        (the fast path must stay sync-free to pipeline rounds); no-op on
-        host-resident backends."""
-        if self.timer is not None:
+        """Backend barrier on x's producer chain — only when PROFILING
+        (the fast path must stay sync-free to pipeline rounds; a run_log
+        alone adds zero syncs); no-op on host-resident backends."""
+        if self.profile:
             self.backend.sync(x)
 
-    def _record_round(self, r: int, ms: float, metric_name,
-                      val_score, loss_fn) -> None:
-        """History/log record for round r, shared by the granular and
-        fused loops: train loss at log cadence only (loss_fn() may cost a
-        device sync; off-cadence records carry train_loss=None so the
-        schema stays uniform for external consumers), eval metric EVERY
-        round — the per-round series (sklearn evals_result_) must not
-        depend on the logging knob.
-
-        ms_per_round semantics differ by path, by construction: the
-        granular loop records each round's real wallclock; the fused loop
-        (_fit_fused) dispatches K rounds in one device call, so every
-        round of a block records the BLOCK AVERAGE (per-round wallclock
-        does not exist there — that is the point of fusing)."""
-        if (r + 1) % self.log_every == 0 or r == self.cfg.n_trees - 1:
-            loss = loss_fn()
-            rec = {"round": r + 1, "train_loss": loss,
-                   "ms_per_round": ms}
-            if val_score is not None:
-                rec[f"valid_{metric_name}"] = val_score
-            self.history.append(rec)
-            log.info(
-                "round %4d/%d  loss=%.6f  %.1f ms/round%s",
-                r + 1, self.cfg.n_trees, loss, ms,
-                f"  valid_{metric_name}={val_score:.6f}"
-                if val_score is not None else "",
-            )
-        elif val_score is not None:
-            self.history.append({
-                "round": r + 1, "train_loss": None, "ms_per_round": ms,
-                f"valid_{metric_name}": val_score,
-            })
+    def _finish_run(self, t0: float, completed_rounds: int,
+                    counters_start: dict | None) -> None:
+        """Telemetry epilogue shared by the granular and fused paths:
+        phase report at INFO (profiled runs), then the shared
+        phase_timings / counters / run_end epilogue
+        (telemetry.events.finish_run_log)."""
+        if self.profile and self.timer is not None:
+            self.timer.log_report(log)
+        finish_run_log(self.run_log, self.timer, counters_start,
+                       completed_rounds,
+                       round(time.perf_counter() - t0, 4))
 
     def fit(
         self,
@@ -203,7 +201,32 @@ class Driver:
         weighted-mean training loss; the base score becomes the weighted
         mean. Integer weights are exactly equivalent to duplicating rows
         (tested). Validation metrics stay unweighted; the streaming
-        trainer does not take weights."""
+        trainer does not take weights.
+
+        (Ownership shim around _fit: a Driver-OWNED run log — one built
+        from a path string — is closed on every exit, success or mid-run
+        exception such as the NaN-eval ValueError, so repeated failing
+        fits cannot leak file handles. fit_streaming carries the same
+        shim.)"""
+        try:
+            return self._fit(
+                Xb, y, eval_set=eval_set, eval_metric=eval_metric,
+                early_stopping_rounds=early_stopping_rounds,
+                sample_weight=sample_weight)
+        finally:
+            if self._own_run_log and self.run_log is not None:
+                self.run_log.close()
+
+    def _fit(
+        self,
+        Xb: np.ndarray,
+        y: np.ndarray,
+        eval_set: tuple[np.ndarray, np.ndarray] | None = None,
+        eval_metric: str | None = None,
+        early_stopping_rounds: int | None = None,
+        sample_weight: np.ndarray | None = None,
+    ) -> TreeEnsemble:
+        """fit's body (see fit for the full contract)."""
         cfg = self.cfg
         R, F = Xb.shape
         if Xb.dtype != np.uint8:
@@ -230,6 +253,23 @@ class Driver:
                 raise ValueError("sample_weight is all zero")
         bs = base_score(np.asarray(y), cfg.loss, cfg.n_classes,
                         sample_weight=sample_weight)
+
+        # Telemetry prologue — BEFORE the first upload so the transfer
+        # counters see the data plane; all of it is host-side bookkeeping
+        # (zero device syncs) and absent entirely when run_log is None.
+        t_fit0 = time.perf_counter()
+        counters_start = None
+        if self.run_log is not None:
+            tele_counters.install_jax_listener()
+            counters_start = tele_counters.snapshot()
+            self.run_log.emit(
+                "run_manifest", trainer="driver",
+                backend=self.backend.name, loss=cfg.loss,
+                n_trees=cfg.n_trees, max_depth=cfg.max_depth,
+                n_bins=cfg.n_bins, rows=int(R), features=int(F),
+                n_classes=C, seed=cfg.seed,
+                distributed=bool(getattr(self.backend, "distributed",
+                                         False)))
 
         data = self.backend.upload(Xb)
         y_dev = self.backend.upload_labels(np.asarray(y),
@@ -259,6 +299,9 @@ class Driver:
                     np.asarray(part.predict_raw_roundwise(Xb, binned=True))
                 )
                 log.info("resumed from checkpoint at round %d", start_round)
+                if self.run_log is not None:
+                    self.run_log.emit("fault", kind="checkpoint_resume",
+                                      round=start_round)
 
         # --- validation-set state ---
         # Two realisations of per-round eval scoring:
@@ -332,10 +375,23 @@ class Driver:
         # the handle on device, so the pipeline stays on.
         pending: tuple | None = None   # (handle, ensemble slot)
 
-        ph = (
-            self.timer.phase if self.timer is not None
-            else (lambda name: contextlib.nullcontext())
-        )
+        # Phase context (telemetry.annotations.phase_ctx): host PhaseTimer
+        # + a `ddt:<phase>` profiler span, so Perfetto host tracks carry
+        # the same names as the run log's phase_timings; bare nullcontext
+        # when neither profiling nor telemetry is on.
+        ph = phase_ctx(self.timer)
+
+        self._recorder = RoundRecorder(
+            self.history, self.run_log, self.log_every, cfg.n_trees,
+            metric_name, log)
+        # Estimated allreduce payload per round (telemetry.counters): the
+        # psum lives inside the fused device program, so the host records
+        # the statically-known histogram shapes instead of observing the
+        # wire. Zero on single-device runs.
+        coll_bytes_round = 0
+        if getattr(self.backend, "distributed", False):
+            coll_bytes_round = C * tele_counters.hist_allreduce_bytes(
+                cfg.max_depth, F, cfg.n_bins)
 
         def _store(handle, slot):
             with ph("fetch_tree"):
@@ -388,18 +444,21 @@ class Driver:
         if (
             getattr(self.backend, "grow_rounds", None) is not None
             and (eval_set is None or fused_eval)
-            and self.timer is None
+            and not self.profile
             and (not colsample or fused_masked)
         ):
             eval_state = None
             if fused_eval:
                 eval_state = (val_data_dev, val_pred_dev, val_y_dev,
                               dev_metric, sign)
-            return self._fit_fused(
+            ens = self._fit_fused(
                 data, y_dev, pred, ens, start_round, C,
                 eval_state=eval_state,
                 early_stopping_rounds=early_stopping_rounds,
-                colsample_features=F if colsample else None)
+                colsample_features=F if colsample else None,
+                coll_bytes_round=coll_bytes_round)
+            self._finish_run(t_fit0, ens.n_trees // C, counters_start)
+            return ens
 
         for rnd in range(start_round, cfg.n_trees):
             t0 = time.perf_counter()
@@ -469,6 +528,8 @@ class Driver:
             elif val_raw is not None:
                 val_score = evaluate(metric_name, y_val, val_raw)
             dt = time.perf_counter() - t0
+            if coll_bytes_round:
+                tele_counters.record_collective(coll_bytes_round)
 
             if val_score is not None:
                 if sign * val_score > best:
@@ -476,8 +537,8 @@ class Driver:
                     self.best_round = rnd
                     self.best_score = val_score
 
-            self._record_round(
-                rnd, dt * 1e3, metric_name, val_score,
+            self._recorder.record(
+                rnd, dt * 1e3, val_score,
                 lambda: self.backend.loss_value(pred, y_dev))
 
             if early_stopping_rounds is not None and self.best_round is None:
@@ -498,6 +559,8 @@ class Driver:
                     rnd + 1, metric_name, self.best_score,
                     self.best_round + 1,
                 )
+                emit_early_stop(self.run_log, rnd + 1, metric_name,
+                                self.best_round + 1, self.best_score)
                 if pending is not None:   # flush BEFORE truncating: the
                     _store(*pending)      # pending slot indexes the full-
                     pending = None        # size arrays
@@ -521,19 +584,15 @@ class Driver:
 
         checkpoint.maybe_save(self.checkpoint_dir, ens, cfg,
                               completed_rounds)
-        if self.timer is not None:
-            for rec in self.timer.report():
-                log.info("phase %-12s %8.2f ms total  %7.3f ms/call  "
-                         "x%-5d %5.1f%%", rec["phase"], rec["ms_total"],
-                         rec["ms_per_call"], rec["calls"],
-                         100 * rec["share"])
+        self._finish_run(t_fit0, completed_rounds, counters_start)
         return ens
 
     def _fit_fused(self, data, y_dev, pred, ens: TreeEnsemble,
                    start_round: int, C: int,
                    eval_state: tuple | None = None,
                    early_stopping_rounds: int | None = None,
-                   colsample_features: int | None = None
+                   colsample_features: int | None = None,
+                   coll_bytes_round: int = 0
                    ) -> TreeEnsemble:
         """Block loop over backend.grow_rounds: K rounds per dispatch,
         K x C trees per fetch. Blocks break at checkpoint_every boundaries
@@ -550,6 +609,11 @@ class Driver:
         if eval_state is not None:
             val_data, val_pred, val_y, metric_name, sign = eval_state
             best = -np.inf
+        # Coarse phase breakdown for telemetry runs: the block dispatch is
+        # async (enqueue returns immediately), so "grow_block" measures
+        # dispatch + whatever back-pressures, and "fetch_tree" — the
+        # np.asarray barrier — carries the block's device wallclock.
+        ph = phase_ctx(self.timer)
         rnd = start_round
         while rnd < cfg.n_trees:
             K = min(cfg.n_trees - rnd, cfg.fused_block_rounds)
@@ -568,22 +632,29 @@ class Driver:
                     for c in range(C):
                         fmasks[k, c] = self._draw_colsample_mask(
                             rnd + k, c, F)
-            if eval_state is not None:
-                trees_h, pred, losses_h, val_pred, scores_h = \
-                    self.backend.grow_rounds_eval(
-                        data, pred, y_dev, K,
-                        val_data, val_pred, val_y, metric_name,
-                        first_round=rnd, fmasks=fmasks)
-                scores = np.asarray(scores_h)   # [K] — same fetch wave
-            elif fmasks is not None:
-                trees_h, pred, losses_h = self.backend.grow_rounds_masked(
-                    data, pred, y_dev, K, fmasks, first_round=rnd)
-            else:
-                trees_h, pred, losses_h = self.backend.grow_rounds(
-                    data, pred, y_dev, K, first_round=rnd)
-            trees = np.asarray(trees_h)         # [K, C, 5, N] — ONE fetch
-            losses = np.asarray(losses_h)
+            with ph("grow_block"):
+                if eval_state is not None:
+                    trees_h, pred, losses_h, val_pred, scores_h = \
+                        self.backend.grow_rounds_eval(
+                            data, pred, y_dev, K,
+                            val_data, val_pred, val_y, metric_name,
+                            first_round=rnd, fmasks=fmasks)
+                elif fmasks is not None:
+                    trees_h, pred, losses_h = \
+                        self.backend.grow_rounds_masked(
+                            data, pred, y_dev, K, fmasks, first_round=rnd)
+                else:
+                    trees_h, pred, losses_h = self.backend.grow_rounds(
+                        data, pred, y_dev, K, first_round=rnd)
+            with ph("fetch_tree"):
+                if eval_state is not None:
+                    scores = np.asarray(scores_h)  # [K] — same fetch wave
+                trees = np.asarray(trees_h)     # [K, C, 5, N] — ONE fetch
+                losses = np.asarray(losses_h)
             dt = time.perf_counter() - t0
+            tele_counters.record_d2h(trees.nbytes + losses.nbytes)
+            if coll_bytes_round:
+                tele_counters.record_collective(coll_bytes_round * K)
             for k in range(K):
                 for c in range(C):
                     slot = (rnd + k) * C + c
@@ -602,8 +673,8 @@ class Driver:
                         best = sign * val_score
                         self.best_round = r
                         self.best_score = val_score
-                self._record_round(
-                    r, dt * 1e3 / K, metric_name, val_score,
+                self._recorder.record(
+                    r, dt * 1e3 / K, val_score,
                     lambda k=k: float(losses[k]))
                 if early_stopping_rounds is not None:
                     if self.best_round is None:
@@ -619,6 +690,9 @@ class Driver:
                             "round %d)", r + 1, metric_name,
                             self.best_score, self.best_round + 1,
                         )
+                        emit_early_stop(self.run_log, r + 1, metric_name,
+                                        self.best_round + 1,
+                                        self.best_score)
                         ens = ens.truncate((self.best_round + 1) * C)
                         checkpoint.maybe_save(self.checkpoint_dir, ens,
                                               cfg, self.best_round + 1)

@@ -41,6 +41,13 @@ import jax.numpy as jnp
 
 from ddt_tpu.ops import histogram as H
 from ddt_tpu.ops import split as S
+from ddt_tpu.telemetry.annotations import traced_scope
+
+# Perfetto alignment (docs/OBSERVABILITY.md): the traced_scope blocks
+# below name the lowered XLA ops `ddt:hist` / `ddt:allreduce` /
+# `ddt:gain` / `ddt:route` / `ddt:leaf`, so a profiler capture's device
+# timeline carries the same phase names as the host PhaseTimer spans.
+# Zero runtime cost — named scopes are HLO metadata, not ops.
 
 
 class TreeArrays(NamedTuple):
@@ -128,11 +135,13 @@ def grow_tree(
         offset = (1 << depth) - 1
         n_level = 1 << depth
         node_index = jnp.where(frozen, -1, node_id - offset).astype(jnp.int32)
-        hist = H.build_histograms(
-            Xb, g, h, node_index, n_level, n_bins,
-            impl=hist_impl, row_chunk=row_chunk, input_dtype=input_dtype,
-        )
-        hist = allreduce(hist)             # the cross-partition allreduce
+        with traced_scope("hist"):
+            hist = H.build_histograms(
+                Xb, g, h, node_index, n_level, n_bins,
+                impl=hist_impl, row_chunk=row_chunk, input_dtype=input_dtype,
+            )
+        with traced_scope("allreduce"):    # the cross-partition allreduce
+            hist = allreduce(hist)
         if feature_axis_name is None:
             G, Hh = S.node_totals(hist)
         else:
@@ -146,24 +155,25 @@ def grow_tree(
                 jnp.where(act, g, 0.0), seg, num_segments=n_level))
             Hh = allreduce(jax.ops.segment_sum(
                 jnp.where(act, h, 0.0), seg, num_segments=n_level))
-        gains, feats, bins, dls = S.best_splits(
-            hist, reg_lambda, min_child_weight, feature_mask,
-            missing_bin=missing_bin, cat_mask=cat_vec)
-        if feature_axis_name is not None:
-            # Combine per-shard winners: all_gather the (gain, feat, bin,
-            # direction) tuples (tiny), argmax over shards — first shard
-            # wins ties, preserving the global first-(feature,bin)
-            # tie-break rule.
-            feats = feats + f_lo
-            ga = jax.lax.all_gather(gains, feature_axis_name)  # [S, n_level]
-            fa = jax.lax.all_gather(feats, feature_axis_name)
-            ba = jax.lax.all_gather(bins, feature_axis_name)
-            da = jax.lax.all_gather(dls, feature_axis_name)
-            w = jnp.argmax(ga, axis=0)                         # [n_level]
-            gains = jnp.take_along_axis(ga, w[None], axis=0)[0]
-            feats = jnp.take_along_axis(fa, w[None], axis=0)[0]
-            bins = jnp.take_along_axis(ba, w[None], axis=0)[0]
-            dls = jnp.take_along_axis(da, w[None], axis=0)[0]
+        with traced_scope("gain"):
+            gains, feats, bins, dls = S.best_splits(
+                hist, reg_lambda, min_child_weight, feature_mask,
+                missing_bin=missing_bin, cat_mask=cat_vec)
+            if feature_axis_name is not None:
+                # Combine per-shard winners: all_gather the (gain, feat,
+                # bin, direction) tuples (tiny), argmax over shards —
+                # first shard wins ties, preserving the global
+                # first-(feature,bin) tie-break rule.
+                feats = feats + f_lo
+                ga = jax.lax.all_gather(gains, feature_axis_name)
+                fa = jax.lax.all_gather(feats, feature_axis_name)
+                ba = jax.lax.all_gather(bins, feature_axis_name)
+                da = jax.lax.all_gather(dls, feature_axis_name)
+                w = jnp.argmax(ga, axis=0)                     # [n_level]
+                gains = jnp.take_along_axis(ga, w[None], axis=0)[0]
+                feats = jnp.take_along_axis(fa, w[None], axis=0)[0]
+                bins = jnp.take_along_axis(ba, w[None], axis=0)[0]
+                dls = jnp.take_along_axis(da, w[None], axis=0)[0]
         # Guarded like the final level and the streamed twin: an EMPTY
         # node at reg_lambda=0 would otherwise store -0/0 = NaN as its
         # leaf value, which a predict-time row (different data) can reach.
@@ -190,55 +200,59 @@ def grow_tree(
         # tables (feature, bin, cat-ness, direction, do_split) are packed
         # into ONE int32 so a single masked reduction covers them:
         # feat<<12 | bin<<3 | cat<<2 | default_left<<1 | split.
-        idx_c = jnp.clip(node_id - offset, 0, n_level - 1)
-        noh = idx_c[:, None] == jnp.arange(n_level, dtype=jnp.int32)[None, :]
-        if cat_vec_g is not None:
-            # Per-NODE cat-ness of the winning (global) feature. An
-            # n_level-sized gather from the replicated [F_global] table is
-            # fine — the gathers this file avoids are [R]-sized ones.
-            cat_n = jnp.take(cat_vec_g, feats, axis=0)
-        else:
-            cat_n = jnp.zeros(n_level, bool)
-        table = ((feats << 12) | (bins << 3)
-                 | (cat_n.astype(jnp.int32) << 2)
-                 | (dls.astype(jnp.int32) << 1)
-                 | do_split.astype(jnp.int32))
-        packed_r = jnp.sum(jnp.where(noh, table[None, :], 0), axis=1)
-        split_here = (packed_r & 1).astype(bool) & ~frozen
-        dl_r = ((packed_r >> 1) & 1).astype(bool)
-        cat_r = ((packed_r >> 2) & 1).astype(bool)
-        feat_r = packed_r >> 12
-        bin_r = (packed_r >> 3) & 0x1FF
-        if feature_axis_name is None:
-            foh = (
-                jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
-                == feat_r[:, None]
-            )
-            fv = jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0), axis=1)
-        else:
-            # Winning columns live on exactly one feature shard: lanes only
-            # match on the owner (out-of-range local index matches nothing),
-            # everyone else contributes zero; psum broadcasts.
-            loc = feat_r - f_lo
-            foh = (
-                jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
-                == loc[:, None]
-            )
-            fv = jax.lax.psum(
-                jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0), axis=1),
-                feature_axis_name,
-            )
-        go_right = fv > bin_r
-        if cat_features:
-            # Categorical one-vs-rest: the matched category goes LEFT.
-            go_right = jnp.where(cat_r, fv != bin_r, go_right)
-        if missing_bin:
-            # NaN rows occupy the reserved top bin and follow the node's
-            # learned default direction.
-            go_right = jnp.where(fv == n_bins - 1, ~dl_r, go_right)
-        go_right = go_right.astype(jnp.int32)
-        node_id = jnp.where(split_here, 2 * node_id + 1 + go_right, node_id)
-        frozen = frozen | ~split_here
+        with traced_scope("route"):
+            idx_c = jnp.clip(node_id - offset, 0, n_level - 1)
+            noh = (idx_c[:, None]
+                   == jnp.arange(n_level, dtype=jnp.int32)[None, :])
+            if cat_vec_g is not None:
+                # Per-NODE cat-ness of the winning (global) feature. An
+                # n_level-sized gather from the replicated [F_global] table is
+                # fine — the gathers this file avoids are [R]-sized ones.
+                cat_n = jnp.take(cat_vec_g, feats, axis=0)
+            else:
+                cat_n = jnp.zeros(n_level, bool)
+            table = ((feats << 12) | (bins << 3)
+                     | (cat_n.astype(jnp.int32) << 2)
+                     | (dls.astype(jnp.int32) << 1)
+                     | do_split.astype(jnp.int32))
+            packed_r = jnp.sum(jnp.where(noh, table[None, :], 0), axis=1)
+            split_here = (packed_r & 1).astype(bool) & ~frozen
+            dl_r = ((packed_r >> 1) & 1).astype(bool)
+            cat_r = ((packed_r >> 2) & 1).astype(bool)
+            feat_r = packed_r >> 12
+            bin_r = (packed_r >> 3) & 0x1FF
+            if feature_axis_name is None:
+                foh = (
+                    jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
+                    == feat_r[:, None]
+                )
+                fv = jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0), axis=1)
+            else:
+                # Winning columns live on exactly one feature shard: lanes only
+                # match on the owner (out-of-range local index matches
+                # nothing),
+                # everyone else contributes zero; psum broadcasts.
+                loc = feat_r - f_lo
+                foh = (
+                    jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
+                    == loc[:, None]
+                )
+                fv = jax.lax.psum(
+                    jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0), axis=1),
+                    feature_axis_name,
+                )
+            go_right = fv > bin_r
+            if cat_features:
+                # Categorical one-vs-rest: the matched category goes LEFT.
+                go_right = jnp.where(cat_r, fv != bin_r, go_right)
+            if missing_bin:
+                # NaN rows occupy the reserved top bin and follow the node's
+                # learned default direction.
+                go_right = jnp.where(fv == n_bins - 1, ~dl_r, go_right)
+            go_right = go_right.astype(jnp.int32)
+            node_id = jnp.where(split_here, 2 * node_id + 1 + go_right,
+                                node_id)
+            frozen = frozen | ~split_here
 
     # Final level: leaf values from per-terminal-node (G, H) aggregates —
     # via one-hot matmul (MXU, f32 HIGHEST) rather than segment_sum: the
@@ -246,27 +260,28 @@ def grow_tree(
     # matmul ~7 ms. Summation order differs from the CPU twin's row-order
     # adds by ULPs only; leaf VALUES are tolerance-compared everywhere
     # (tree STRUCTURE never depends on this level).
-    offset = (1 << max_depth) - 1
-    n_last = 1 << max_depth
-    active = ~frozen
-    idx = jnp.clip(node_id - offset, 0, n_last - 1)
-    ga = jnp.where(active, g, 0.0)
-    ha = jnp.where(active, h, 0.0)
-    leaf_oh = (
-        idx[:, None] == jnp.arange(n_last, dtype=jnp.int32)[None, :]
-    ).astype(jnp.float32)                                   # [R, n_last]
-    gh = jnp.stack([ga, ha], axis=1)                        # [R, 2]
-    GH = jax.lax.dot_general(
-        leaf_oh, gh, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )                                                       # [n_last, 2]
-    Gl = allreduce(GH[:, 0])
-    Hl = allreduce(GH[:, 1])
-    vals = jnp.where(Hl > 0, -Gl / (Hl + reg_lambda), 0.0)
-    sl = slice(offset, offset + n_last)
-    is_leaf = is_leaf.at[sl].set(True)
-    leaf_value = leaf_value.at[sl].set(vals.astype(jnp.float32))
+    with traced_scope("leaf"):
+        offset = (1 << max_depth) - 1
+        n_last = 1 << max_depth
+        active = ~frozen
+        idx = jnp.clip(node_id - offset, 0, n_last - 1)
+        ga = jnp.where(active, g, 0.0)
+        ha = jnp.where(active, h, 0.0)
+        leaf_oh = (
+            idx[:, None] == jnp.arange(n_last, dtype=jnp.int32)[None, :]
+        ).astype(jnp.float32)                                   # [R, n_last]
+        gh = jnp.stack([ga, ha], axis=1)                        # [R, 2]
+        GH = jax.lax.dot_general(
+            leaf_oh, gh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                                       # [n_last, 2]
+        Gl = allreduce(GH[:, 0])
+        Hl = allreduce(GH[:, 1])
+        vals = jnp.where(Hl > 0, -Gl / (Hl + reg_lambda), 0.0)
+        sl = slice(offset, offset + n_last)
+        is_leaf = is_leaf.at[sl].set(True)
+        leaf_value = leaf_value.at[sl].set(vals.astype(jnp.float32))
 
     return TreeArrays(feature, threshold_bin, is_leaf, leaf_value,
                       split_gain, default_left, node_id)

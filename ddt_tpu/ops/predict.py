@@ -32,6 +32,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ddt_tpu.telemetry.annotations import traced_scope
+
 _DEFAULT_ROW_CHUNK = 65_536
 
 
@@ -315,7 +317,11 @@ def predict_raw(
         acc, _ = jax.lax.scan(tree_body, acc0, tuple(xs))
         return None, acc
 
-    _, accs = jax.lax.scan(row_body, None, Xp)               # [n_rc, Rc, C]
+    # `ddt:predict` on the device timeline (telemetry.annotations): the
+    # whole doubly-chunked descent shows as one named span in Perfetto,
+    # matching the host-side scoring phase name.
+    with traced_scope("predict"):
+        _, accs = jax.lax.scan(row_body, None, Xp)           # [n_rc, Rc, C]
     out = base + learning_rate * accs.reshape(n_rc * row_chunk, C)[:R]
     return out[:, 0] if C == 1 else out
 

@@ -92,7 +92,7 @@ class _DDTBase:
         )
 
     def fit(self, X, y, sample_weight=None, *, eval_set=None,
-            eval_metric=None, early_stopping_rounds=None):
+            eval_metric=None, early_stopping_rounds=None, run_log=None):
         from ddt_tpu import api
 
         X = np.asarray(X, np.float32)
@@ -103,10 +103,12 @@ class _DDTBase:
                         np.asarray(eval_set[1]))
         # early_stopping_rounds passes through even without an eval_set so
         # the Driver's "requires an eval_set" error reaches the user.
+        # run_log: the telemetry JSONL stream (path or telemetry.RunLog;
+        # docs/OBSERVABILITY.md).
         res = api.train(X, y, cfg, log_every=10 ** 9, eval_set=eval_set,
                         eval_metric=eval_metric,
                         early_stopping_rounds=early_stopping_rounds,
-                        sample_weight=sample_weight)
+                        sample_weight=sample_weight, run_log=run_log)
         self.ensemble_ = res.ensemble
         self.mapper_ = res.mapper
         self.n_features_in_ = X.shape[1]
@@ -145,7 +147,7 @@ class DDTClassifier(_DDTBase):
         return {}
 
     def fit(self, X, y, sample_weight=None, *, eval_set=None,
-            eval_metric=None, early_stopping_rounds=None):
+            eval_metric=None, early_stopping_rounds=None, run_log=None):
         y = np.asarray(y)
         classes = np.unique(y)
         if len(classes) < 2:
@@ -170,7 +172,7 @@ class DDTClassifier(_DDTBase):
             eval_set = (eval_set[0], np.searchsorted(classes, yv))
         super().fit(X, y_enc, eval_set=eval_set, eval_metric=eval_metric,
                     early_stopping_rounds=early_stopping_rounds,
-                    sample_weight=sample_weight)
+                    sample_weight=sample_weight, run_log=run_log)
         self.classes_ = classes
         return self
 

@@ -32,6 +32,7 @@ chunk's histogram psum rides ICI (SURVEY.md §7 M6).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable
 
 import numpy as np
@@ -39,11 +40,34 @@ import numpy as np
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
 from ddt_tpu.reference.numpy_trainer import grad_hess
+from ddt_tpu.telemetry import counters as tele_counters
+from ddt_tpu.telemetry.annotations import phase_ctx
+from ddt_tpu.telemetry.events import (
+    RoundRecorder, RunLog, emit_early_stop, finish_run_log)
 from ddt_tpu.utils import checkpoint
+from ddt_tpu.utils.profiling import PhaseTimer
 
 log = logging.getLogger("ddt_tpu.streaming")
 
 ChunkFn = Callable[[int], tuple[np.ndarray, np.ndarray]]
+
+
+def _emit_round(run_log: "RunLog | None", rnd: int, ms: float,
+                ev: "_StreamEval | None") -> None:
+    """Streaming round event: ms + the round's eval score when tracked
+    (train loss is deliberately absent — computing it would cost an extra
+    full pass over the chunks)."""
+    if run_log is None:
+        return
+    val_score = None
+    if ev is not None and ev.history:
+        last = ev.history[-1]
+        if last.get("round") == rnd + 1:
+            val_score = last.get(f"valid_{ev.metric}")
+    rec = RoundRecorder.make_record(rnd, ms, None,
+                                    ev.metric if ev is not None else None,
+                                    val_score)
+    run_log.emit("round", **rec)
 
 
 def validate_mapper_config(mapper, cfg: TrainConfig) -> None:
@@ -350,8 +374,60 @@ def fit_streaming(
     early_stopping_rounds: int | None = None,
     history: list | None = None,
     device_chunk_cache: "bool | int" = True,
+    run_log: "RunLog | str | None" = None,
+    profile: bool = False,
+) -> TreeEnsemble:
+    """Train a GBDT over streamed chunks — see _fit_streaming_impl
+    directly below for the full contract (validation, checkpointing,
+    device streaming, sampling, telemetry). This wrapper owns exactly
+    one concern: a run log built HERE from a path string is closed on
+    every exit, success or mid-run exception (the Driver has the same
+    shim on fit), so repeated failing fits cannot leak file handles."""
+    own_run_log = isinstance(run_log, str)
+    run_log = RunLog.coerce(run_log)
+    try:
+        return _fit_streaming_impl(
+            chunk_fn, n_chunks, cfg, backend=backend,
+            cache_preds=cache_preds, checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            valid_chunk_fn=valid_chunk_fn, n_valid_chunks=n_valid_chunks,
+            eval_metric=eval_metric,
+            early_stopping_rounds=early_stopping_rounds, history=history,
+            device_chunk_cache=device_chunk_cache, run_log=run_log,
+            profile=profile)
+    finally:
+        if own_run_log and run_log is not None:
+            run_log.close()
+
+
+def _fit_streaming_impl(
+    chunk_fn: ChunkFn,
+    n_chunks: int,
+    cfg: TrainConfig,
+    backend=None,
+    cache_preds: bool = True,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 25,
+    valid_chunk_fn: ChunkFn | None = None,
+    n_valid_chunks: int = 0,
+    eval_metric: str | None = None,
+    early_stopping_rounds: int | None = None,
+    history: list | None = None,
+    device_chunk_cache: "bool | int" = True,
+    run_log: "RunLog | None" = None,
+    profile: bool = False,
 ) -> TreeEnsemble:
     """Train a GBDT over `n_chunks` streamed chunks.
+
+    Observability: `run_log` (a JSONL path or telemetry.RunLog) emits the
+    same schema-versioned event stream as Driver.fit — run manifest,
+    per-round records (with the round's eval metric when validation is
+    on), per-phase timings, resume events, device counters — rendered by
+    `python -m ddt_tpu.cli report`. `profile=True` additionally logs the
+    PhaseTimer breakdown at INFO; either flag turns phase timing on
+    (host wallclock per hist/gain/leaf/predict/eval phase; the streamed
+    loops' natural pass boundaries already sync, so no extra barriers
+    are added).
 
     Validation/early stopping (round-2 verdict item 3): pass held-out
     chunks via `valid_chunk_fn`/`n_valid_chunks` — each round's freshly
@@ -409,6 +485,17 @@ def fit_streaming(
 
     device = hasattr(backend, "stream_level_hist")
 
+    # Telemetry prologue — BEFORE pass 0 so the transfer counters see the
+    # label uploads; host-side bookkeeping only (no device syncs), and
+    # everything below is skipped when run_log is None and profile False.
+    t_fit0 = time.perf_counter()
+    counters_start = None
+    timer = PhaseTimer() if (profile or run_log is not None) else None
+    ph = phase_ctx(timer)
+    if run_log is not None:
+        tele_counters.install_jax_listener()
+        counters_start = tele_counters.snapshot()
+
     # Pass 0: base score from running label sums + shape discovery — no
     # O(R) host state anywhere in this trainer except the optional preds
     # cache (see below); at the 10B-row target everything else is O(chunk).
@@ -458,6 +545,29 @@ def fit_streaming(
         missing_bin=cfg.missing_policy == "learn", n_bins=cfg.n_bins,
         cat_features=cfg.cat_features,
     )
+
+    if run_log is not None:
+        run_log.emit(
+            "run_manifest",
+            trainer="streaming_device" if device else "streaming_host",
+            backend=getattr(backend, "name", "unknown"), loss=cfg.loss,
+            n_trees=cfg.n_trees, max_depth=cfg.max_depth,
+            n_bins=cfg.n_bins, rows=int(y_cnt), features=int(F),
+            n_classes=C, seed=cfg.seed, n_chunks=n_chunks,
+            distributed=bool(getattr(backend, "distributed", False)))
+
+    def _finish(e: TreeEnsemble) -> TreeEnsemble:
+        """Telemetry epilogue — every fit_streaming return funnels
+        through here (the early-stop returns included) so a run log is
+        always terminated by the shared phase_timings/counters/run_end
+        sequence (telemetry.events.finish_run_log; the owning wrapper
+        closes path-built logs)."""
+        if profile and timer is not None:
+            timer.log_report(log)
+        finish_run_log(run_log, timer, counters_start, e.n_trees // C,
+                       round(time.perf_counter() - t_fit0, 4))
+        return e
+
     # Checkpoint/resume (SURVEY.md §5) — the streamed runs are the LONGEST
     # ones, so restartability matters most here. Boosting state is
     # reconstituted by rescoring the restored partial ensemble per chunk
@@ -473,11 +583,14 @@ def fit_streaming(
         if start_round > 0:
             log.info("streaming: resumed from checkpoint at round %d",
                      start_round)
+            if run_log is not None:
+                run_log.emit("fault", kind="checkpoint_resume",
+                             round=start_round)
         if start_round >= cfg.n_trees:
             # Already finished (e.g. a preemptible-restart loop re-runs
             # the command): return the restored ensemble without the full
             # boosting-state reconstitution pass over the dataset.
-            return ens
+            return _finish(ens)
 
     if early_stopping_rounds is not None and valid_chunk_fn is None:
         raise ValueError("early_stopping_rounds requires valid_chunk_fn")
@@ -487,12 +600,13 @@ def fit_streaming(
                          cfg.loss, early_stopping_rounds, history)
 
     if device:
-        return _fit_streaming_device(
+        return _finish(_fit_streaming_device(
             chunk_fn, n_chunks, cfg, backend, ens, bs, C, y_dev,
             chunk_starts,
             start_round=start_round, checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every, ev=ev,
-            device_chunk_cache=device_chunk_cache)
+            device_chunk_cache=device_chunk_cache,
+            ph=ph, run_log=run_log))
 
     # The ONE optional O(R·C) structure: per-chunk cached raw scores (4C
     # bytes/row). cache_preds=False recomputes scores from the partial
@@ -530,8 +644,13 @@ def fit_streaming(
                     ev.fn(c)[0], binned=True).astype(np.float32)
 
     missing_val = cfg.missing_bin_value
+    coll_bytes_round = 0
+    if getattr(backend, "distributed", False):
+        coll_bytes_round = C * n_chunks * tele_counters.hist_allreduce_bytes(
+            cfg.max_depth, F, cfg.n_bins)
     t_out = start_round * C
     for rnd in range(start_round, cfg.n_trees):
+        t_round = time.perf_counter()
         # Gradients for every class tree of a round come from the
         # ROUND-START preds (the Driver computes grad_hess once per round,
         # then grows C trees from its columns), so pred updates are
@@ -577,39 +696,43 @@ def fit_streaming(
             for depth in range(cfg.max_depth):
                 n_level = 1 << depth
                 hist = None
-                for c in range(n_chunks):
-                    Xc, yc = chunk_fn(c)
-                    ni = _traverse_partial(
-                        Xc, feature, threshold_bin, is_leaf, depth,
-                        **route_kw
-                    )
-                    g, h = chunk_grads(c, Xc, yc, cls)
-                    data = backend.upload(Xc)
-                    part = np.asarray(
-                        backend.build_histograms(data, g, h, ni, n_level)
-                    )
-                    hist = part if hist is None else hist + part
-                _apply_level_splits(hist, cfg, depth, feature,
-                                    threshold_bin, is_leaf, leaf_value,
-                                    split_gain, default_left,
-                                    feature_mask=fmask)
+                with ph("hist"):
+                    for c in range(n_chunks):
+                        Xc, yc = chunk_fn(c)
+                        ni = _traverse_partial(
+                            Xc, feature, threshold_bin, is_leaf, depth,
+                            **route_kw
+                        )
+                        g, h = chunk_grads(c, Xc, yc, cls)
+                        data = backend.upload(Xc)
+                        part = np.asarray(
+                            backend.build_histograms(data, g, h, ni,
+                                                     n_level)
+                        )
+                        hist = part if hist is None else hist + part
+                with ph("gain"):
+                    _apply_level_splits(hist, cfg, depth, feature,
+                                        threshold_bin, is_leaf, leaf_value,
+                                        split_gain, default_left,
+                                        feature_mask=fmask)
 
             # Final level: per-terminal (G, H) aggregates streamed the
             # same way.
             n_last = 1 << cfg.max_depth
             Gl = np.zeros(n_last, np.float32)
             Hl = np.zeros(n_last, np.float32)
-            for c in range(n_chunks):
-                Xc, yc = chunk_fn(c)
-                ni = _traverse_partial(
-                    Xc, feature, threshold_bin, is_leaf, cfg.max_depth,
-                    **route_kw
-                )
-                g, h = chunk_grads(c, Xc, yc, cls)
-                act = ni >= 0
-                np.add.at(Gl, ni[act], g[act])
-                np.add.at(Hl, ni[act], h[act])
-            _apply_final_leaves(Gl, Hl, cfg, is_leaf, leaf_value)
+            with ph("leaf"):
+                for c in range(n_chunks):
+                    Xc, yc = chunk_fn(c)
+                    ni = _traverse_partial(
+                        Xc, feature, threshold_bin, is_leaf, cfg.max_depth,
+                        **route_kw
+                    )
+                    g, h = chunk_grads(c, Xc, yc, cls)
+                    act = ni >= 0
+                    np.add.at(Gl, ni[act], g[act])
+                    np.add.at(Hl, ni[act], h[act])
+                _apply_final_leaves(Gl, Hl, cfg, is_leaf, leaf_value)
 
             ens.feature[t_out] = feature
             ens.threshold_bin[t_out] = threshold_bin
@@ -626,54 +749,66 @@ def fit_streaming(
             # leaf slot per row = heap slot where traversal stopped: either
             # offset+ni (made it to the last level) or the frozen leaf —
             # rescore via the tree to keep it simple and exact.
-            for c in range(n_chunks):
-                Xc, _ = chunk_fn(c)
-                for cls, (feature, threshold_bin, is_leaf, leaf_value,
-                          default_left) in enumerate(round_trees):
-                    slot = _leaf_slot(
-                        Xc, feature, threshold_bin, is_leaf, cfg.max_depth,
-                        default_left=default_left,
-                        missing_bin_value=missing_val,
-                        cat_features=cfg.cat_features,
-                    )
-                    dv = cfg.learning_rate * leaf_value[slot]
-                    if C > 1:
-                        preds[c][:, cls] += dv
-                    else:
-                        preds[c] += dv
+            with ph("predict"):
+                for c in range(n_chunks):
+                    Xc, _ = chunk_fn(c)
+                    for cls, (feature, threshold_bin, is_leaf, leaf_value,
+                              default_left) in enumerate(round_trees):
+                        slot = _leaf_slot(
+                            Xc, feature, threshold_bin, is_leaf,
+                            cfg.max_depth,
+                            default_left=default_left,
+                            missing_bin_value=missing_val,
+                            cat_features=cfg.cat_features,
+                        )
+                        dv = cfg.learning_rate * leaf_value[slot]
+                        if C > 1:
+                            preds[c][:, cls] += dv
+                        else:
+                            preds[c] += dv
 
+        if coll_bytes_round:
+            tele_counters.record_collective(coll_bytes_round)
+        stop = False
         if ev is not None:
-            for c in range(ev.n):
-                Xv, _ = ev.fn(c)
-                for cls, (feature, threshold_bin, is_leaf, leaf_value,
-                          default_left) in enumerate(round_trees):
-                    slot = _leaf_slot(
-                        Xv, feature, threshold_bin, is_leaf, cfg.max_depth,
-                        default_left=default_left,
-                        missing_bin_value=missing_val,
-                        cat_features=cfg.cat_features,
-                    )
-                    dv = cfg.learning_rate * leaf_value[slot]
-                    if C > 1:
-                        val_preds[c][:, cls] += dv
-                    else:
-                        val_preds[c] += dv
-            if ev.record(rnd, np.concatenate(val_preds)):
-                log.info(
-                    "streaming: early stop at round %d (best %s=%.6f at "
-                    "round %d)", rnd + 1, ev.metric, ev.best_score,
-                    ev.best_round + 1)
-                ens = ens.truncate((ev.best_round + 1) * C)
-                checkpoint.maybe_save(checkpoint_dir, ens, cfg,
-                                      ev.best_round + 1)
-                return ens
+            with ph("eval"):
+                for c in range(ev.n):
+                    Xv, _ = ev.fn(c)
+                    for cls, (feature, threshold_bin, is_leaf, leaf_value,
+                              default_left) in enumerate(round_trees):
+                        slot = _leaf_slot(
+                            Xv, feature, threshold_bin, is_leaf,
+                            cfg.max_depth,
+                            default_left=default_left,
+                            missing_bin_value=missing_val,
+                            cat_features=cfg.cat_features,
+                        )
+                        dv = cfg.learning_rate * leaf_value[slot]
+                        if C > 1:
+                            val_preds[c][:, cls] += dv
+                        else:
+                            val_preds[c] += dv
+                stop = ev.record(rnd, np.concatenate(val_preds))
+        _emit_round(run_log, rnd, (time.perf_counter() - t_round) * 1e3,
+                    ev)
+        if stop:
+            log.info(
+                "streaming: early stop at round %d (best %s=%.6f at "
+                "round %d)", rnd + 1, ev.metric, ev.best_score,
+                ev.best_round + 1)
+            emit_early_stop(run_log, rnd + 1, ev.metric,
+                            ev.best_round + 1, ev.best_score)
+            ens = ens.truncate((ev.best_round + 1) * C)
+            checkpoint.maybe_save(checkpoint_dir, ens, cfg,
+                                  ev.best_round + 1)
+            return _finish(ens)
 
         log.info("streaming: round %d/%d done", rnd + 1, cfg.n_trees)
         checkpoint.maybe_save(checkpoint_dir, ens, cfg, rnd + 1,
                               checkpoint_every)
 
     checkpoint.maybe_save(checkpoint_dir, ens, cfg, cfg.n_trees)
-    return ens
+    return _finish(ens)
 
 
 def _fit_streaming_device(
@@ -691,6 +826,8 @@ def _fit_streaming_device(
     checkpoint_every: int = 25,
     ev: "_StreamEval | None" = None,
     device_chunk_cache: "bool | int" = True,
+    ph=None,
+    run_log: "RunLog | None" = None,
 ) -> TreeEnsemble:
     """Device streaming loop: see fit_streaming. Per tree it makes
     max_depth histogram passes + 1 leaf pass (+ 1 pred-update pass between
@@ -700,6 +837,8 @@ def _fit_streaming_device(
     + H2D upload enqueued BEFORE the current chunk's small output is
     fetched, so the transfer rides under the device compute (double
     buffering via JAX's async dispatch)."""
+    if ph is None:
+        ph = phase_ctx(None)
     if device_chunk_cache is True:
         # Platform guard (see fit_streaming's docstring): on the CPU
         # platform the device buffers ARE host RAM — a default-on cache
@@ -783,7 +922,12 @@ def _fit_streaming_device(
     # (pred is dead after the last gradients — same as the old loop, which
     # skipped its trailing update pass).
     prev_trees = None
+    coll_bytes_round = 0
+    if getattr(backend, "distributed", False):
+        coll_bytes_round = C * n_chunks * tele_counters.hist_allreduce_bytes(
+            cfg.max_depth, ens.n_features, cfg.n_bins)
     for rnd in range(start_round, cfg.n_trees):
+        t_round = time.perf_counter()
         # Gradients for EVERY class tree of a round come from the
         # round-start preds (the Driver computes grad_hess once per round,
         # then grows C trees from its columns) — so pred updates are
@@ -807,34 +951,37 @@ def _fit_streaming_device(
 
             for depth in range(cfg.max_depth):
                 hist = None
-                if depth == 0 and cls == 0 and prev_trees is not None:
-                    # Fused round-start: apply the previous round's trees
-                    # to the resident preds AND build this tree's depth-0
-                    # histogram (the NEW round's bagging mask) in one
-                    # dispatch per chunk.
-                    data = chunks.get(0)
-                    for c in range(n_chunks):
-                        pred_dev[c], out = backend.stream_round_start(
-                            data, pred_dev[c], y_dev[c], prev_trees,
-                            rnd=rnd, row_start=int(chunk_starts[c]))
-                        if c + 1 < n_chunks:
-                            data = chunks.get(c + 1)
-                        part = np.asarray(out)
-                        hist = part if hist is None else hist + part
-                else:
-                    for part in passes(tree, depth, "hist", cls, rnd):
-                        hist = part if hist is None else hist + part
-                _apply_level_splits(hist, cfg, depth, feature,
-                                    threshold_bin, is_leaf, leaf_value,
-                                    split_gain, default_left,
-                                    feature_mask=fmask)
+                with ph("hist"):
+                    if depth == 0 and cls == 0 and prev_trees is not None:
+                        # Fused round-start: apply the previous round's
+                        # trees to the resident preds AND build this
+                        # tree's depth-0 histogram (the NEW round's
+                        # bagging mask) in one dispatch per chunk.
+                        data = chunks.get(0)
+                        for c in range(n_chunks):
+                            pred_dev[c], out = backend.stream_round_start(
+                                data, pred_dev[c], y_dev[c], prev_trees,
+                                rnd=rnd, row_start=int(chunk_starts[c]))
+                            if c + 1 < n_chunks:
+                                data = chunks.get(c + 1)
+                            part = np.asarray(out)
+                            hist = part if hist is None else hist + part
+                    else:
+                        for part in passes(tree, depth, "hist", cls, rnd):
+                            hist = part if hist is None else hist + part
+                with ph("gain"):
+                    _apply_level_splits(hist, cfg, depth, feature,
+                                        threshold_bin, is_leaf, leaf_value,
+                                        split_gain, default_left,
+                                        feature_mask=fmask)
 
             # Final level: streamed (G, H) aggregates.
             GH = None
-            for part in passes(tree, cfg.max_depth, "leaf", cls, rnd):
-                GH = part if GH is None else GH + part
-            _apply_final_leaves(GH[:, 0], GH[:, 1], cfg, is_leaf,
-                                leaf_value)
+            with ph("leaf"):
+                for part in passes(tree, cfg.max_depth, "leaf", cls, rnd):
+                    GH = part if GH is None else GH + part
+                _apply_final_leaves(GH[:, 0], GH[:, 1], cfg, is_leaf,
+                                    leaf_value)
 
             round_trees.append(
                 (feature, threshold_bin, is_leaf, leaf_value,
@@ -849,28 +996,38 @@ def _fit_streaming_device(
             t_out += 1
 
         prev_trees = round_trees
+        if coll_bytes_round:
+            tele_counters.record_collective(coll_bytes_round)
 
+        stop = False
         if ev is not None:
             # Apply the round's trees to the resident val preds, fetch the
             # raw scores (pad rows sliced off) and score on host.
-            scores = []
-            data = val_chunks.get(0)
-            for c in range(ev.n):
-                for cls, tree_full in enumerate(round_trees):
-                    val_pred[c] = backend.stream_update_pred(
-                        data, val_pred[c], tree_full, cfg.max_depth, cls)
-                if c + 1 < ev.n:
-                    data = val_chunks.get(c + 1)
-                scores.append(np.asarray(val_pred[c])[: ev.lens[c]])
-            if ev.record(rnd, np.concatenate(scores)):
-                log.info(
-                    "streaming: early stop at round %d (best %s=%.6f at "
-                    "round %d)", rnd + 1, ev.metric, ev.best_score,
-                    ev.best_round + 1)
-                ens = ens.truncate((ev.best_round + 1) * C)
-                checkpoint.maybe_save(checkpoint_dir, ens, cfg,
-                                      ev.best_round + 1)
-                return ens
+            with ph("eval"):
+                scores = []
+                data = val_chunks.get(0)
+                for c in range(ev.n):
+                    for cls, tree_full in enumerate(round_trees):
+                        val_pred[c] = backend.stream_update_pred(
+                            data, val_pred[c], tree_full, cfg.max_depth,
+                            cls)
+                    if c + 1 < ev.n:
+                        data = val_chunks.get(c + 1)
+                    scores.append(np.asarray(val_pred[c])[: ev.lens[c]])
+                stop = ev.record(rnd, np.concatenate(scores))
+        _emit_round(run_log, rnd, (time.perf_counter() - t_round) * 1e3,
+                    ev)
+        if stop:
+            log.info(
+                "streaming: early stop at round %d (best %s=%.6f at "
+                "round %d)", rnd + 1, ev.metric, ev.best_score,
+                ev.best_round + 1)
+            emit_early_stop(run_log, rnd + 1, ev.metric,
+                            ev.best_round + 1, ev.best_score)
+            ens = ens.truncate((ev.best_round + 1) * C)
+            checkpoint.maybe_save(checkpoint_dir, ens, cfg,
+                                  ev.best_round + 1)
+            return ens
 
         log.info("streaming: round %d/%d done", rnd + 1, cfg.n_trees)
         checkpoint.maybe_save(checkpoint_dir, ens, cfg, rnd + 1,

@@ -1,0 +1,31 @@
+"""Structured telemetry (SURVEY.md §5 "Metrics/logging/observability").
+
+The reference system's operational story is per-phase visibility into the
+hist / allreduce / gain / predict pipeline. This package is that story for
+the reproduction, in three always-available layers (zero overhead when no
+run log is attached — the hot loops never sync, never touch a file, and
+pay at most a handful of host integer adds):
+
+- events   — schema-versioned JSONL run logs (`RunLog`): run manifest,
+             per-round records, per-phase timings, early-stop decisions,
+             fault/recovery events, device counters. An in-memory ring
+             buffer mirrors the file so tests (and callers without a
+             filesystem) can read events back without parsing JSONL.
+- counters — process-wide device counters: jit recompiles (via a
+             jax.monitoring listener on the backend-compile duration
+             event), host↔device transfer bytes, estimated collective
+             payload bytes, device-memory high-water marks.
+- annotations — jax.profiler.TraceAnnotation / jax.named_scope wrappers
+             that give host PhaseTimer phases and device Perfetto
+             timelines the SAME `ddt:<phase>` names, so a trace captured
+             with --trace-dir aligns with the run log's phase breakdown.
+
+`report` renders a run summary from a JSONL log (`python -m ddt_tpu.cli
+report --log run.jsonl`); docs/OBSERVABILITY.md documents the schema and
+workflow.
+"""
+
+from ddt_tpu.telemetry.events import (  # noqa: F401
+    EVENT_FIELDS, SCHEMA_VERSION, RoundRecorder, RunLog, validate_event)
+from ddt_tpu.telemetry import counters  # noqa: F401
+from ddt_tpu.telemetry.annotations import phase_span  # noqa: F401

@@ -1,0 +1,64 @@
+"""Host/device phase annotations sharing ONE naming scheme: `ddt:<phase>`.
+
+Two halves of the Perfetto-alignment story (docs/OBSERVABILITY.md):
+
+- phase_span(name): HOST-side jax.profiler.TraceAnnotation. The Driver
+  enters it around each PhaseTimer phase, so a profiler capture
+  (--trace-dir) shows `ddt:grow`, `ddt:eval`, ... spans on the host
+  track with exactly the names the run log's phase_timings carry.
+- traced_scope(name): jax.named_scope for use INSIDE traced code. The
+  ops kernels wrap their hist/allreduce/gain/route/leaf/predict stages,
+  which names the lowered XLA ops — the device timeline then carries
+  the same `ddt:` prefixes and lines up under the host spans.
+
+Both degrade to no-ops without jax (the cpu-backend CLI contract) and
+cost ~a microsecond when no trace is being captured — cheap enough to
+leave on whenever a PhaseTimer is running, and absent entirely (the
+Driver skips the context) when telemetry is off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+try:
+    import jax
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except ImportError:               # jax-less host: annotations are no-ops
+    jax = None
+    _TraceAnnotation = None
+
+PREFIX = "ddt:"
+
+
+def phase_span(name: str):
+    """Host-side profiler span `ddt:<name>` (no-op without jax)."""
+    if _TraceAnnotation is None:
+        return contextlib.nullcontext()
+    return _TraceAnnotation(PREFIX + name)
+
+
+def traced_scope(name: str):
+    """Named scope `ddt:<name>` for code under jit (no-op without jax)."""
+    if jax is None:
+        return contextlib.nullcontext()
+    return jax.named_scope(PREFIX + name)
+
+
+def phase_ctx(timer):
+    """Phase-context factory — the ONE home of the PhaseTimer +
+    phase_span pairing, shared by the Driver's granular and fused loops
+    and both streaming loops (keeping span naming/ordering from
+    diverging between trainers). `timer` is a utils.profiling.PhaseTimer
+    or None; with None the factory returns bare nullcontexts so
+    disabled-telemetry hot loops stay unannotated."""
+    if timer is None:
+        def ph(name):
+            return contextlib.nullcontext()
+    else:
+        def ph(name):
+            stack = contextlib.ExitStack()
+            stack.enter_context(phase_span(name))
+            stack.enter_context(timer.phase(name))
+            return stack
+    return ph

@@ -1,0 +1,120 @@
+"""Process-wide device counters behind the run log's `counters` event.
+
+Four counters, each chosen because the literature says it is the silent
+TPU perf killer the host wallclock alone cannot see:
+
+- `jit_compiles` — every XLA backend compile, counted by a
+  jax.monitoring listener on the `/jax/core/compile/
+  backend_compile_duration` event (recompiles from shape churn are the
+  classic hidden cost: arXiv:1810.09868). The listener installs lazily
+  (install_jax_listener) so a process that never attaches telemetry
+  never registers it; once installed it is a single host integer add
+  per COMPILE — nothing per dispatch.
+- `h2d_bytes` / `d2h_bytes` — host↔device transfer bytes recorded at
+  the backends' upload/fetch funnels (TPUDevice._put / fetch_tree and
+  the fused tree-fetch). Approximate by design: scalar metric
+  readbacks (~bytes) are not counted, the row-matrix and tree traffic
+  that actually loads the PCIe/tunnel link is.
+- `collective_bytes_est` — ESTIMATED allreduce payload per round
+  (hist_allreduce_bytes), recorded by the Driver only on distributed
+  meshes. An estimate because the psum lives inside a fused device
+  program where the host cannot observe the wire; the histogram shapes
+  are static per config, so the estimate is exact up to XLA's own
+  reduction scheduling.
+
+All counters are monotonic process-wide integers; consumers take a
+snapshot() at run start and publish delta() at run end, so concurrent
+runs in one process each see their own traffic plus any overlap —
+documented, not hidden (docs/OBSERVABILITY.md).
+
+`device_peak_bytes()` reads the accelerator's high-water mark from
+device.memory_stats() where the platform exposes one (TPU/GPU; CPU XLA
+returns None).
+"""
+
+from __future__ import annotations
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# Monotonic process-wide counters (plain ints: the GIL makes += atomic
+# enough for counting; these feed reports, not invariants).
+_c = {
+    "jit_compiles": 0,
+    "h2d_bytes": 0,
+    "d2h_bytes": 0,
+    "collective_bytes_est": 0,
+}
+_listener_installed = False
+
+
+def install_jax_listener() -> None:
+    """Register the recompile-counting jax.monitoring listener (idempotent;
+    no-op when jax is absent — the cpu-backend CLI must run without it)."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        from jax import monitoring
+    except ImportError:
+        return
+
+    def _on_duration(event, duration_secs=None, **kw) -> None:
+        if event == _COMPILE_EVENT:
+            _c["jit_compiles"] += 1
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _listener_installed = True
+
+
+def record_h2d(nbytes: int) -> None:
+    _c["h2d_bytes"] += int(nbytes)
+
+
+def record_d2h(nbytes: int) -> None:
+    _c["d2h_bytes"] += int(nbytes)
+
+
+def record_collective(nbytes: int) -> None:
+    _c["collective_bytes_est"] += int(nbytes)
+
+
+def snapshot() -> dict:
+    """Point-in-time copy of the monotonic counters."""
+    return dict(_c)
+
+
+def delta(start: dict, end: dict | None = None) -> dict:
+    """Counter movement since `start` (a snapshot()); `end` defaults to
+    now."""
+    end = end if end is not None else snapshot()
+    return {k: end[k] - start.get(k, 0) for k in _c}
+
+
+def device_peak_bytes() -> int | None:
+    """Accelerator memory high-water mark, or None where the platform
+    exposes no memory_stats (CPU XLA, some runtimes)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except (ImportError, RuntimeError, IndexError, AttributeError,
+            NotImplementedError):
+        return None
+    if not stats:
+        return None
+    for key in ("peak_bytes_in_use", "bytes_in_use"):
+        if key in stats:
+            return int(stats[key])
+    return None
+
+
+def hist_allreduce_bytes(max_depth: int, n_features: int,
+                         n_bins: int) -> int:
+    """Estimated allreduce payload for ONE tree's histogram phases: the
+    [n_level, F, n_bins, 2] f32 histogram psum'd at every level (the
+    fabric-allreduce analog, ops/grow.py), plus the final level's [2^d, 2]
+    leaf-aggregate reduction."""
+    per_entry = 4 * 2                     # (g, h) float32 pairs
+    levels = sum((1 << d) for d in range(max_depth))
+    return levels * n_features * n_bins * per_entry \
+        + (1 << max_depth) * per_entry

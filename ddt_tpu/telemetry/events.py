@@ -1,0 +1,214 @@
+"""Run-log events: schema-versioned JSONL records + in-memory ring buffer.
+
+Every record is one JSON object per line with a fixed envelope
+(`event`, `schema`, `t`, `seq`) plus the event type's required fields
+(EVENT_FIELDS) and any optional extras. The schema is validated at EMIT
+time (a malformed event is a bug at the producer, not something for the
+report CLI to limp around) and again at READ time (report.read_events),
+so a log that loads is a log every consumer can trust.
+
+Writes are line-buffered appends of complete lines — a run killed mid-
+round (the fault-injection story) loses at most its final partial line,
+which read-side validation then skips with a warning rather than
+discarding the run.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import time
+
+SCHEMA_VERSION = 1
+
+#: event type -> REQUIRED payload fields (extras are allowed and common:
+#: e.g. `round` records carry `valid_<metric>` keys named by the run's
+#: metric, and nullable fields like train_loss simply hold null).
+EVENT_FIELDS: dict[str, set] = {
+    # One per run, first record: what trained, on what, from where.
+    "run_manifest": {"trainer", "backend", "loss", "n_trees", "max_depth",
+                     "rows", "features"},
+    # One per boosting round (the Driver.history record, as an event).
+    "round": {"round", "ms_per_round"},
+    # PhaseTimer.as_json() embedded verbatim under "phases".
+    "phase_timings": {"phases"},
+    # The early-stopping decision, when one fires.
+    "early_stop": {"round", "best_round", "best_score", "metric"},
+    # Fault/recovery events (today: checkpoint resume after a death).
+    "fault": {"kind"},
+    # Device-counter deltas over the run (telemetry.counters).
+    "counters": {"jit_compiles", "h2d_bytes", "d2h_bytes",
+                 "collective_bytes_est"},
+    # Last record of a completed run.
+    "run_end": {"completed_rounds", "wallclock_s"},
+}
+
+ENVELOPE_FIELDS = ("event", "schema", "t", "seq")
+
+
+def validate_event(rec: dict) -> None:
+    """Raise ValueError unless `rec` is a well-formed run-log record."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"run-log record must be an object, got "
+                         f"{type(rec).__name__}")
+    missing = [k for k in ENVELOPE_FIELDS if k not in rec]
+    if missing:
+        raise ValueError(f"run-log record missing envelope fields {missing}")
+    if not isinstance(rec["schema"], int) or isinstance(rec["schema"], bool):
+        # A corrupt/hand-edited line must surface as the reader's clean
+        # ValueError, not a TypeError from the comparison below.
+        raise ValueError(
+            f"run-log schema must be an integer, got {rec['schema']!r}")
+    if rec["schema"] > SCHEMA_VERSION:
+        raise ValueError(
+            f"run-log schema {rec['schema']} is newer than this reader "
+            f"(schema {SCHEMA_VERSION}); upgrade ddt_tpu to report on it")
+    ev = rec["event"]
+    if ev not in EVENT_FIELDS:
+        raise ValueError(
+            f"unknown run-log event {ev!r}; have {sorted(EVENT_FIELDS)}")
+    missing = [k for k in EVENT_FIELDS[ev] if k not in rec]
+    if missing:
+        raise ValueError(f"{ev} record missing required fields {missing}")
+
+
+class RunLog:
+    """Append-only JSONL run log + bounded in-memory ring buffer.
+
+    `path=None` keeps events in the ring only (tests, library callers).
+    The file handle opens lazily on the first emit and is line-buffered;
+    `close()` (or context-manager exit) releases it. Emission never
+    touches the device — every field is host data the trainer already
+    had in hand.
+    """
+
+    def __init__(self, path: str | None = None, ring_size: int = 4096):
+        self.path = path
+        self.ring: collections.deque = collections.deque(maxlen=ring_size)
+        self._fh = None
+        self._seq = 0
+
+    @classmethod
+    def coerce(cls, run_log) -> "RunLog | None":
+        """None | path-str | RunLog -> RunLog | None (the api.train /
+        fit_streaming argument convention)."""
+        if run_log is None or isinstance(run_log, cls):
+            return run_log
+        return cls(str(run_log))
+
+    def emit(self, event: str, **fields) -> dict:
+        rec = {"event": event, "schema": SCHEMA_VERSION,
+               "t": time.time(), "seq": self._seq, **fields}
+        validate_event(rec)
+        self._seq += 1
+        self.ring.append(rec)
+        if self.path is not None:
+            if self._fh is None:
+                self._fh = open(self.path, "a", buffering=1,
+                                encoding="utf-8")
+            self._fh.write(json.dumps(rec, sort_keys=False) + "\n")
+        return rec
+
+    def events(self, event: str | None = None) -> list[dict]:
+        """Ring-buffer contents (oldest first), optionally one type."""
+        return [r for r in self.ring if event is None or r["event"] == event]
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "RunLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def emit_early_stop(run_log: "RunLog | None", stop_round: int, metric,
+                    best_round: int, best_score) -> None:
+    """The early_stop event, one emit site for the Driver's granular and
+    fused loops and both streaming loops (rounds are 1-based here)."""
+    if run_log is None:
+        return
+    run_log.emit("early_stop", round=stop_round, metric=metric,
+                 best_round=best_round, best_score=best_score)
+
+
+def finish_run_log(run_log: "RunLog | None", timer, counters_start,
+                   completed_rounds: int, wallclock_s: float) -> None:
+    """Run-log epilogue — phase_timings + counters + run_end — shared by
+    Driver._finish_run and fit_streaming's _finish so the trainers'
+    terminal records cannot drift. `timer` is a PhaseTimer or None;
+    `counters_start` a telemetry.counters.snapshot() (or None). Closing
+    path-owned logs is the trainers' ownership shims' job (Driver.fit /
+    fit_streaming), which also covers the exception paths this helper
+    never sees."""
+    if run_log is None:
+        return
+    from ddt_tpu.telemetry import counters as tele_counters
+
+    if timer is not None and timer.totals:
+        run_log.emit("phase_timings", phases=timer.as_json())
+    d = tele_counters.delta(counters_start or {})
+    d["device_peak_bytes"] = tele_counters.device_peak_bytes()
+    run_log.emit("counters", **d)
+    run_log.emit("run_end", completed_rounds=completed_rounds,
+                 wallclock_s=wallclock_s)
+
+
+class RoundRecorder:
+    """Per-round history record + run-log event + progress log line — the
+    ONE home of the round-record shape, shared by the Driver's granular
+    and fused loops (it replaced Driver._record_round) and mirrored by
+    the streaming trainer's round events.
+
+    Semantics preserved from the Driver: train loss at `log_every`
+    cadence only (the loss thunk may cost a device sync; off-cadence
+    records carry train_loss=None so the schema stays uniform), eval
+    metric EVERY round — the per-round series (sklearn evals_result_)
+    must not depend on the logging knob. ms_per_round is the caller's
+    number: real per-round wallclock on the granular path, the block
+    average on the fused path (per-round wallclock does not exist there
+    — that is the point of fusing).
+    """
+
+    def __init__(self, history: list, run_log: RunLog | None,
+                 log_every: int, n_rounds: int, metric_name: str | None,
+                 logger):
+        self.history = history
+        self.run_log = run_log
+        self.log_every = log_every
+        self.n_rounds = n_rounds
+        self.metric_name = metric_name
+        self.log = logger
+
+    @staticmethod
+    def make_record(r: int, ms: float, train_loss,
+                    metric_name=None, val_score=None) -> dict:
+        """THE round-record dict shape ({round, train_loss, ms_per_round
+        [, valid_<metric>]}) — also used by the streaming trainer's round
+        events so the two emitters cannot drift."""
+        rec = {"round": r + 1, "train_loss": train_loss,
+               "ms_per_round": ms}
+        if val_score is not None:
+            rec[f"valid_{metric_name}"] = val_score
+        return rec
+
+    def record(self, r: int, ms: float, val_score, loss_fn) -> None:
+        on_cadence = (r + 1) % self.log_every == 0 or r == self.n_rounds - 1
+        if not on_cadence and val_score is None and self.run_log is None:
+            return                       # nothing records this round
+        loss = loss_fn() if on_cadence else None
+        rec = self.make_record(r, ms, loss, self.metric_name, val_score)
+        if on_cadence or val_score is not None:
+            self.history.append(rec)
+        if self.run_log is not None:
+            self.run_log.emit("round", **rec)
+        if on_cadence:
+            self.log.info(
+                "round %4d/%d  loss=%.6f  %.1f ms/round%s",
+                r + 1, self.n_rounds, loss, ms,
+                f"  valid_{self.metric_name}={val_score:.6f}"
+                if val_score is not None else "",
+            )

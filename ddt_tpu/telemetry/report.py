@@ -1,0 +1,201 @@
+"""Render a run summary from a JSONL run log (`ddt_tpu.cli report`).
+
+Pure host-side post-processing: read_events -> summarize -> render. The
+summary is a plain dict (the CLI's --json form); render() formats it for
+a terminal. No jax, no device, no repo state — a run log copied off a
+pod host reports anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ddt_tpu.telemetry.events import validate_event
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse + validate a JSONL run log. Raises ValueError naming the
+    line on a malformed record; a TRAILING partial line (the run was
+    killed mid-write) is tolerated and dropped — everything above it is
+    intact by the append-only write contract."""
+    events: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines, start=1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            if i == len(lines):
+                # Torn FINAL line (the run was killed mid-write): the
+                # crash-consistency contract (events.py) says everything
+                # above it is intact, so drop just the tail. Records stay
+                # schema-pure — no out-of-schema marker keys.
+                break
+            raise ValueError(f"{path}:{i}: not JSON: {e}") from None
+        try:
+            validate_event(rec)
+        except ValueError as e:
+            raise ValueError(f"{path}:{i}: {e}") from None
+        events.append(rec)
+    if not events:
+        raise ValueError(f"{path}: no run-log events")
+    return events
+
+
+def _metric_key(rec: dict) -> str | None:
+    for k in rec:
+        if k.startswith("valid_"):
+            return k
+    return None
+
+
+def summarize(events: list[dict], slowest: int = 5) -> dict:
+    """Aggregate a run log into the report dict (see render for the
+    shape as prose)."""
+    # Append-mode logs can hold several run segments (a preemptible
+    # restart re-runs the command into the same file; each fit emits its
+    # own manifest). Report the LAST segment — the run that completed —
+    # and surface the segment count so earlier attempts stay visible.
+    n_runs = sum(1 for e in events if e["event"] == "run_manifest")
+    for i in range(len(events) - 1, -1, -1):
+        if events[i]["event"] == "run_manifest":
+            events = events[i:]
+            break
+
+    manifest = next((e for e in events if e["event"] == "run_manifest"), {})
+    rounds = [e for e in events if e["event"] == "round"]
+    phase_ev = [e for e in events if e["event"] == "phase_timings"]
+    counter_ev = [e for e in events if e["event"] == "counters"]
+    run_end = next((e for e in events if e["event"] == "run_end"), None)
+
+    metric_curve = []
+    metric = None
+    for r in rounds:
+        mk = _metric_key(r)
+        if mk is not None:
+            metric = mk[len("valid_"):]
+            metric_curve.append({"round": r["round"], "score": r[mk]})
+    losses = [{"round": r["round"], "train_loss": r["train_loss"]}
+              for r in rounds if r.get("train_loss") is not None]
+
+    timed = sorted((r for r in rounds if r.get("ms_per_round") is not None),
+                   key=lambda r: -r["ms_per_round"])
+    summary = {
+        "manifest": {k: v for k, v in manifest.items()
+                     if k not in ("event", "schema", "t", "seq")},
+        "n_runs_in_log": n_runs,
+        "n_round_records": len(rounds),
+        "completed_rounds": run_end["completed_rounds"] if run_end else None,
+        "wallclock_s": run_end["wallclock_s"] if run_end else None,
+        "metric": metric,
+        "metric_curve": metric_curve,
+        "train_loss_curve": losses,
+        "phases": phase_ev[-1]["phases"] if phase_ev else [],
+        "counters": (
+            {k: v for k, v in counter_ev[-1].items()
+             if k not in ("event", "schema", "t", "seq")}
+            if counter_ev else {}),
+        "slowest_rounds": [
+            {"round": r["round"], "ms_per_round": r["ms_per_round"]}
+            for r in timed[:slowest]],
+        "early_stop": next(
+            ({k: e[k] for k in ("round", "best_round", "best_score",
+                                "metric")}
+             for e in events if e["event"] == "early_stop"), None),
+        "faults": [
+            {k: v for k, v in e.items()
+             if k not in ("event", "schema", "t", "seq")}
+            for e in events if e["event"] == "fault"],
+    }
+    return summary
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "n/a"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n}"
+
+
+def render(summary: dict) -> str:
+    """Terminal rendering of summarize()'s dict."""
+    out: list[str] = []
+    m = summary["manifest"]
+    head = " ".join(
+        f"{k}={m[k]}" for k in ("trainer", "backend", "loss", "n_trees",
+                                "max_depth", "rows", "features") if k in m)
+    out.append(f"run: {head or '(no manifest)'}")
+    if summary.get("n_runs_in_log", 1) > 1:
+        out.append(f"note: log holds {summary['n_runs_in_log']} run "
+                   "segments; reporting the last")
+    done = summary["completed_rounds"]
+    wc = summary["wallclock_s"]
+    out.append(
+        f"rounds: {summary['n_round_records']} recorded"
+        + (f", {done} completed" if done is not None else "")
+        + (f", {wc:.2f}s wallclock" if wc is not None else ""))
+
+    if summary["early_stop"]:
+        es = summary["early_stop"]
+        out.append(
+            f"early stop at round {es['round']} "
+            f"(best {es['metric']}={es['best_score']:.6f} "
+            f"at round {es['best_round']})")
+    for f in summary["faults"]:
+        detail = {k: v for k, v in f.items() if k != "kind"}
+        out.append(f"fault/recovery: {f['kind']} {detail or ''}".rstrip())
+
+    if summary["phases"]:
+        out.append("phases (host wallclock):")
+        for p in summary["phases"]:
+            out.append(
+                f"  {p['phase']:<14} {p['ms_total']:>9.1f} ms total  "
+                f"{p['ms_per_call']:>8.2f} ms/call  x{p['calls']:<6} "
+                f"{100 * p['share']:5.1f}%")
+
+    curve = summary["metric_curve"]
+    if curve:
+        name = summary["metric"]
+        first, last = curve[0], curve[-1]
+        # Direction from the ONE metrics table (utils.metrics) — a copy
+        # here would silently label the worst round "best" for any
+        # metric added there later. Unknown names (a log from a newer
+        # build) default to lower-is-better, the loss convention.
+        from ddt_tpu.utils.metrics import GREATER_IS_BETTER
+
+        best = max(curve, key=lambda c: c["score"]) \
+            if GREATER_IS_BETTER.get(name, False) \
+            else min(curve, key=lambda c: c["score"])
+        out.append(
+            f"valid_{name}: first={first['score']:.6f} "
+            f"(round {first['round']})  best={best['score']:.6f} "
+            f"(round {best['round']})  last={last['score']:.6f} "
+            f"(round {last['round']})  [{len(curve)} rounds]")
+    losses = summary["train_loss_curve"]
+    if losses:
+        out.append(
+            f"train_loss: first={losses[0]['train_loss']:.6f} "
+            f"(round {losses[0]['round']})  "
+            f"last={losses[-1]['train_loss']:.6f} "
+            f"(round {losses[-1]['round']})")
+
+    c = summary["counters"]
+    if c:
+        out.append(
+            "counters: "
+            f"jit_compiles={c.get('jit_compiles')}  "
+            f"h2d={_fmt_bytes(c.get('h2d_bytes'))}  "
+            f"d2h={_fmt_bytes(c.get('d2h_bytes'))}  "
+            f"collective≈{_fmt_bytes(c.get('collective_bytes_est'))}  "
+            f"device_peak={_fmt_bytes(c.get('device_peak_bytes'))}")
+
+    if summary["slowest_rounds"]:
+        slow = ", ".join(f"#{r['round']} ({r['ms_per_round']:.1f} ms)"
+                         for r in summary["slowest_rounds"])
+        out.append(f"slowest rounds: {slow}")
+    return "\n".join(out)

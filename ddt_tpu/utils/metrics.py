@@ -91,8 +91,18 @@ GREATER_IS_BETTER = {
 
 
 def default_metric(loss: str) -> str:
-    """Metric used for eval_set tracking when the caller names none."""
-    return {"logloss": "logloss", "softmax": "logloss", "mse": "rmse"}[loss]
+    """Metric used for eval_set tracking when the caller names none.
+    Unknown losses raise ValueError naming the known ones — the same
+    error contract as evaluate() (a bare KeyError here used to surface
+    as an inscrutable traceback deep inside Driver.fit)."""
+    defaults = {"logloss": "logloss", "softmax": "logloss", "mse": "rmse"}
+    try:
+        return defaults[loss]
+    except KeyError:
+        raise ValueError(
+            f"no default metric for loss {loss!r}; have "
+            f"{sorted(defaults)}"
+        ) from None
 
 
 # Score bins for the device AUC twin. 2^16 keeps the within-bin pair

@@ -36,7 +36,12 @@ class PhaseTimer:
         self.totals[name] += time.perf_counter() - t0
         self.counts[name] += 1
 
-    def report(self) -> list[dict]:
+    def as_json(self) -> list[dict]:
+        """Stable JSON form of the phase breakdown, embedded verbatim in
+        the telemetry run log's `phase_timings.phases` field
+        (docs/OBSERVABILITY.md). The keys — phase, ms_total, ms_per_call,
+        calls, share — are a COMPATIBILITY CONTRACT with external log
+        consumers; extend, never rename."""
         total = sum(self.totals.values()) or 1.0
         return [
             {
@@ -50,6 +55,20 @@ class PhaseTimer:
                 self.totals.items(), key=lambda kv: -kv[1]
             )
         ]
+
+    def report(self) -> list[dict]:
+        """Human-consumption twin of as_json() (same records; kept as the
+        logging-oriented name the Driver has always exposed)."""
+        return self.as_json()
+
+    def log_report(self, logger) -> None:
+        """The INFO-level phase table — one formatting home for every
+        trainer that prints a breakdown (Driver, fit_streaming)."""
+        for rec in self.as_json():
+            logger.info("phase %-12s %8.2f ms total  %7.3f ms/call  "
+                        "x%-5d %5.1f%%", rec["phase"], rec["ms_total"],
+                        rec["ms_per_call"], rec["calls"],
+                        100 * rec["share"])
 
 
 @contextlib.contextmanager

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Tier-1-safe telemetry smoke: train 2 rounds on synthetic data with a
+run log in a tmpdir, then render it with the report subcommand.
+
+`make report` runs this; tests/test_telemetry.py runs main() in-process.
+Exit 0 iff the round trip holds: the log is schema-valid, the report
+renders, and the core events (manifest, rounds, counters, run_end) are
+present with a nonzero jit-recompile counter.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ddt_tpu.cli import main as cli_main
+    from ddt_tpu.telemetry import report
+
+    with tempfile.TemporaryDirectory(prefix="ddt_smoke_") as td:
+        log = os.path.join(td, "run.jsonl")
+        model = os.path.join(td, "ens.npz")
+        rc = cli_main([
+            "train", "--backend=tpu", "--dataset=higgs", "--rows=3001",
+            "--trees=2", "--depth=3", "--bins=31", "--valid-frac=0.2",
+            f"--run-log={log}", f"--out={model}",
+        ])
+        if rc != 0:
+            print(f"telemetry smoke: train exited {rc}", file=sys.stderr)
+            return 1
+
+        events = report.read_events(log)          # validates every record
+        got = {e["event"] for e in events}
+        need = {"run_manifest", "round", "counters", "run_end"}
+        if not need <= got:
+            print(f"telemetry smoke: missing events {need - got}",
+                  file=sys.stderr)
+            return 1
+        summary = report.summarize(events)
+        if not summary["counters"].get("jit_compiles"):
+            print("telemetry smoke: jit_compiles counter is zero",
+                  file=sys.stderr)
+            return 1
+        rc = cli_main(["report", "--log", log])
+        if rc != 0:
+            print(f"telemetry smoke: report exited {rc}", file=sys.stderr)
+            return 1
+        print(json.dumps({"smoke": "telemetry", "ok": True,
+                          "events": sorted(got),
+                          "rounds": summary["n_round_records"],
+                          "jit_compiles":
+                              summary["counters"]["jit_compiles"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""no-print negative fixture: idiomatic library output paths."""
+
+import logging
+
+log = logging.getLogger("ddt_tpu.fixture")
+
+
+def dump_progress(rnd, loss):
+    log.info("round %d loss %.6f", rnd, loss)     # the logger, not stdout
+
+
+def with_injected_printer(printer):
+    printer("ok")                                 # a parameter, not builtin
+
+
+class Reporter:
+    def print(self):                              # a METHOD named print
+        return "rendered"
+
+
+def use(reporter):
+    return reporter.print()                       # attribute call is fine
+
+
+def mentions():
+    return "print( in a string literal is not a call"

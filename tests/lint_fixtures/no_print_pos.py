@@ -1,0 +1,13 @@
+"""no-print positive fixture: bare builtin print() in library code."""
+
+
+def dump_progress(rnd, loss):
+    print("round", rnd, "loss", loss)             # LINT: no-print
+    if loss > 1.0:
+        print(f"diverging: {loss}")               # LINT: no-print
+
+
+def nested():
+    def inner(x):
+        print(x)                                  # LINT: no-print
+    return inner

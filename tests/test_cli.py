@@ -228,7 +228,17 @@ def test_cli_config_file_syncs_pipeline_fields(tmp_path, capsys):
     ])
     assert rec["streamed_chunks"] == 2
     assert os.path.exists(model2)
-    # --profile remains in-memory-only
-    with pytest.raises(SystemExit, match="profile"):
+    # --profile composes with streaming since the telemetry PR
+    # (fit_streaming wires its own PhaseTimer); the XLA trace capture
+    # remains in-memory-only.
+    with pytest.raises(SystemExit, match="trace-dir"):
         main(["train", "--backend=cpu", "--rows=800", "--bins=31",
-              "--stream-chunks=2", "--profile"])
+              "--stream-chunks=2", "--trace-dir", str(tmp_path / "tr")])
+    model3 = str(tmp_path / "profiled.npz")
+    rec = _run(capsys, [
+        "train", "--backend=cpu", "--rows=800", "--bins=31",
+        "--stream-chunks=2", "--profile", f"--config={bag}",
+        f"--out={model3}",
+    ])
+    assert rec["streamed_chunks"] == 2
+    assert os.path.exists(model3)

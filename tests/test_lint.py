@@ -58,6 +58,8 @@ CASES = [
      "ddt_tpu/ops/fixture_mod.py"),
     ("broad-except", "broad_except_pos.py", "broad_except_neg.py",
      "ddt_tpu/fixture_mod.py"),
+    ("no-print", "no_print_pos.py", "no_print_neg.py",
+     "ddt_tpu/fixture_mod.py"),
 ]
 
 
@@ -79,6 +81,17 @@ def test_checker_silent_on_clean_code(rule, _pos, neg, path):
     got = _flagged_lines(neg, path, rule)
     assert got == set(), f"{rule}: false positives at lines {sorted(got)} " \
                          f"in {neg}"
+
+
+def test_no_print_exempts_cli_and_non_library_paths():
+    """The rule is scoped to LIBRARY code: the same print-bearing source
+    must not be flagged when it lives in the CLI (stdout is its
+    interface) or outside ddt_tpu/ (tools, tests)."""
+    src = _fixture_src("no_print_pos.py")
+    for path in ("ddt_tpu/cli.py", "tools/ddtlint/__main__.py",
+                 "tests/test_cli.py", "scripts/telemetry_smoke.py"):
+        findings = runner.run_on_source(path, src, rules={"no-print"})
+        assert findings == [], (path, [f.render() for f in findings])
 
 
 def test_suppression_hygiene_fires():

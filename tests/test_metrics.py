@@ -49,6 +49,18 @@ def test_accuracy_rmse():
         np.sqrt(12.5))
 
 
+def test_default_metric_known_losses_and_error_contract():
+    assert metrics.default_metric("logloss") == "logloss"
+    assert metrics.default_metric("softmax") == "logloss"
+    assert metrics.default_metric("mse") == "rmse"
+    # Unknown losses raise ValueError naming the known ones — the same
+    # contract as evaluate() (was a bare KeyError before the telemetry PR).
+    with pytest.raises(ValueError, match="no default metric.*huber"):
+        metrics.default_metric("huber")
+    with pytest.raises(ValueError, match="logloss"):
+        metrics.default_metric("huber")
+
+
 def _split(X, y, frac=0.2, seed=0):
     rng = np.random.default_rng(seed)
     idx = rng.permutation(len(y))

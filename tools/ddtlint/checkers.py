@@ -419,12 +419,38 @@ class BroadExceptChecker(Checker):
         self.generic_visit(node)
 
 
+# --------------------------------------------------------------------- #
+# 6. no-print
+# --------------------------------------------------------------------- #
+class NoPrintChecker(Checker):
+    """Bare `print(...)` in ddt_tpu/ LIBRARY code: invisible to logging
+    config, unparseable by log shippers, and — since the telemetry PR —
+    redundant with the structured event stream every trainer can emit.
+    The CLI (ddt_tpu/cli.py) is exempt (stdout JSON lines ARE its
+    interface), as are tools/ and tests/ (outside the scanned scope /
+    path_scope). Only the BUILTIN name counts: methods named print and
+    callables passed in as parameters are fine."""
+
+    rule = "no-print"
+    # Negative lookahead: everything under ddt_tpu/ except the CLI.
+    path_scope = (r"^ddt_tpu/(?!cli\.py$)",)
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            self.report(node, (
+                "bare `print(...)` in ddt_tpu library code — emit a "
+                "telemetry event (ddt_tpu.telemetry.RunLog.emit) or use "
+                "the module logger; stdout belongs to the CLI"))
+        self.generic_visit(node)
+
+
 AST_CHECKERS = [
     TracedBranchChecker,
     HostSyncChecker,
     DtypeDriftChecker,
     CollectiveAxisChecker,
     BroadExceptChecker,
+    NoPrintChecker,
 ]
 
 
