@@ -198,6 +198,25 @@ def train(
     )
 
 
+# Memoised row-mesh scoring backends, one per partition count: an explicit
+# mesh bypasses the get_backend instance cache, and rebuilding the backend
+# per call would discard its compiled-ensemble device cache (the very
+# thing the predict overhaul keeps resident).
+_ROW_MESH_BACKENDS: dict[int, DeviceBackend] = {}
+
+
+def _row_mesh_backend(n_partitions: int) -> DeviceBackend:
+    be = _ROW_MESH_BACKENDS.get(n_partitions)
+    if be is None:
+        from ddt_tpu.parallel.mesh import make_row_mesh
+
+        be = get_backend(
+            TrainConfig(backend="tpu", n_partitions=n_partitions),
+            mesh=make_row_mesh(n_partitions))
+        _ROW_MESH_BACKENDS[n_partitions] = be
+    return be
+
+
 def predict(
     ens: "TreeEnsemble | ModelBundle",
     X: np.ndarray,
@@ -207,6 +226,7 @@ def predict(
     raw: bool = False,
     backend: DeviceBackend | None = None,
     cfg: TrainConfig | None = None,
+    n_partitions: int | None = None,
 ) -> np.ndarray:
     """Score a batch. Routes through the device gather+compare path when a
     backend is given (or cfg selects one); NumPy otherwise. A ModelBundle
@@ -216,7 +236,16 @@ def predict(
     columns are categorical-raw — api.train's contract is that callers
     encode); X must carry categorical columns already encoded with
     bundle.encoder.transform, exactly as at training time. The CLI predict
-    path does that re-encoding itself."""
+    path does that re-encoding itself.
+
+    `n_partitions > 1` makes multi-chip scoring a FLAG: a 1-D row mesh is
+    built via parallel.mesh.make_row_mesh and the batch is row-sharded
+    over it — trees replicate, each chip traverses its own rows, no
+    collectives (the MULTICHIP dryrun's phase-4 path, now public).
+    Ignored when an explicit `backend`/`cfg` already selects one."""
+    if n_partitions is not None and n_partitions > 1 \
+            and backend is None and cfg is None:
+        backend = _row_mesh_backend(n_partitions)
     if isinstance(ens, ModelBundle):
         if mapper is None:
             mapper = ens.mapper

@@ -49,8 +49,8 @@ _JIT_FIELDS = (
     "backend", "n_partitions", "feature_partitions", "host_partitions",
     "max_depth", "n_bins", "learning_rate", "loss", "n_classes",
     "reg_lambda", "min_child_weight", "min_split_gain",
-    "hist_impl", "matmul_input_dtype", "missing_policy", "cat_features",
-    "subsample",
+    "hist_impl", "predict_impl", "matmul_input_dtype", "missing_policy",
+    "cat_features", "subsample",
 )
 
 

@@ -48,7 +48,9 @@ from ddt_tpu.ops import grow as grow_ops
 from ddt_tpu.ops import histogram as hist_ops
 from ddt_tpu.ops import predict as predict_ops
 from ddt_tpu.ops import split as split_ops
+from ddt_tpu.parallel import mesh as mesh_lib
 from ddt_tpu.telemetry import counters as tele_counters
+from ddt_tpu.telemetry.annotations import phase_span
 
 P = jax.sharding.PartitionSpec
 
@@ -315,7 +317,7 @@ class TPUDevice(DeviceBackend):
 
         if self.distributed:
             def sharded(Xb, g, h, node_index, *, n_nodes):
-                f = jax.shard_map(
+                f = mesh_lib.shard_map(
                     functools.partial(hist, n_nodes=n_nodes),
                     mesh=self.mesh,
                     in_specs=(P(rax, None), P(rax), P(rax), P(rax)),
@@ -438,7 +440,7 @@ class TPUDevice(DeviceBackend):
             in_specs = (data_spec, P(rax), P(rax))
             if with_mask:
                 in_specs = in_specs + (P(),)       # mask replicated
-            grow = jax.shard_map(
+            grow = mesh_lib.shard_map(
                 grow,
                 mesh=self.mesh,
                 in_specs=in_specs,
@@ -731,7 +733,7 @@ class TPUDevice(DeviceBackend):
                 in_specs = in_specs + (P(),)   # fmasks replicated
             if bagging:
                 in_specs = in_specs + (P(),)   # rnd0 scalar replicated
-            rounds = jax.shard_map(
+            rounds = mesh_lib.shard_map(
                 rounds,
                 mesh=self.mesh,
                 in_specs=in_specs,
@@ -823,7 +825,7 @@ class TPUDevice(DeviceBackend):
             data_spec = P(rax, FAXIS) if faxis else P(rax, None)
             in_specs = (data_spec, pred_spec, P(rax), P(rax)) + (P(),) * C
             out_specs = (pred_spec, P())
-            f = jax.shard_map(
+            f = mesh_lib.shard_map(
                 f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
                 # Same rationale as _build_grow_fn: the feature-axis
                 # psum-broadcast routing — and the tiled all_gather of the
@@ -995,7 +997,7 @@ class TPUDevice(DeviceBackend):
                 in_specs = (P(rax, None), pred_spec, P(rax), P(rax),
                             P(), P(), P(), P()) + bag_specs
                 out_specs = P()
-            f = jax.shard_map(f, mesh=self.mesh, in_specs=in_specs,
+            f = mesh_lib.shard_map(f, mesh=self.mesh, in_specs=in_specs,
                               out_specs=out_specs)
         donate = (1,) if kind in ("update", "roundstart") else ()
         fn = jax.jit(f, donate_argnums=donate)
@@ -1068,11 +1070,17 @@ class TPUDevice(DeviceBackend):
     # 10M-row x 1000-tree config [BASELINE] OOM-kills the chip if scored in
     # one dispatch. 2M rows/chip/call keeps the peak well under 1 GB.
     PREDICT_ROW_CHUNK = 2_000_000
+    # Device-resident CompiledEnsemble slots per backend instance: each
+    # entry pins the model's pushed-down node tables on device (~MBs for a
+    # 1000-tree model) across predict calls. Small because backend
+    # instances are themselves cached and serving stacks typically score
+    # a handful of live model versions.
+    PREDICT_CACHE_MAX = 4
 
     def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
         R = Xb.shape[0]
         chunk = self.PREDICT_ROW_CHUNK * max(1, self.row_shards)
-        fn, ens_dev = self._predict_fn(ens)     # upload the ensemble ONCE
+        fn, ens_dev = self._predict_fn(ens)     # compiled-ensemble cache
         if isinstance(Xb, jax.Array) and (R <= chunk or self.distributed):
             # Device-resident input is only special-cased on the
             # single-chip big-batch loop below (where it skips the bulk
@@ -1102,8 +1110,9 @@ class TPUDevice(DeviceBackend):
                 # wallclock (experiments/predict_phases.py; docs/PERF.md
                 # round-5) — overlapping it is the predict path's one
                 # first-order win.
-                Xd = (Xb if isinstance(Xb, jax.Array)
-                      else jax.device_put(np.ascontiguousarray(Xb)))
+                with phase_span("predict:upload"):
+                    Xd = (Xb if isinstance(Xb, jax.Array)
+                          else jax.device_put(np.ascontiguousarray(Xb)))
                 outs = [
                     fn(*ens_dev, Xd[i:i + chunk]) for i in range(0, R, chunk)
                 ]
@@ -1115,57 +1124,67 @@ class TPUDevice(DeviceBackend):
                     [np.asarray(o)  # ddtlint: disable=host-sync
                      for o in outs])[:R]
             return np.asarray(jnp.concatenate(outs))[:R]
-        Xc = self._put_rows(Xb, extra_dims=1)       # uint8; ops widen it
+        with phase_span("predict:upload"):
+            Xc = self._put_rows(Xb, extra_dims=1)   # uint8; ops widen it
         out = fn(*ens_dev, Xc)
         return np.asarray(out)[:R]
 
+    @functools.cached_property
+    def _predict_cache(self) -> dict:
+        # token -> (fn, device arrays); insertion order = LRU order.
+        return {}
+
+    @property
+    def _use_pallas(self) -> "bool | None":
+        """cfg.predict_impl as predict_raw_effective's use_pallas value
+        (None = auto-dispatch; ops/predict.resolve_use_pallas)."""
+        return {"auto": None, "pallas": True,
+                "onehot": False}[self.cfg.predict_impl]
+
     def _predict_fn(self, ens: TreeEnsemble):
-        """(jittable scoring fn, device-resident ensemble arrays)."""
-        C = ens.n_classes if ens.loss == "softmax" else 1
-        feat = self._put(ens.feature.astype(np.int32), self._sharding())
-        thr = self._put(ens.threshold_bin.astype(np.int32), self._sharding())
-        leaf = self._put(ens.is_leaf, self._sharding())
-        val = self._put(ens.leaf_value, self._sharding())
-        use_missing = ens.missing_bin and ens.default_left is not None
-        use_cat = ens.has_cat_splits
-        if use_missing or use_cat:
-            extras = []
-            if use_missing:
-                extras.append(self._put(ens.default_left, self._sharding()))
-            if use_cat:
-                cat_node = np.isin(ens.feature, ens.cat_features)
-                extras.append(self._put(cat_node, self._sharding()))
+        """(jittable scoring fn, device-resident compiled-ensemble arrays).
 
-            def fn0(feat, thr, leaf, val, *rest):
-                *opt, Xc = rest
-                opt = list(opt)
-                dl = opt.pop(0) if use_missing else None
-                cn = opt.pop(0) if use_cat else None
-                return predict_ops.predict_raw(
-                    feat, thr, leaf, val, Xc,
-                    max_depth=ens.max_depth,
-                    learning_rate=ens.learning_rate,
-                    base=ens.base_score,
-                    n_classes=C,
-                    default_left=dl,
-                    missing_bin_value=(ens.n_bins - 1 if use_missing
-                                       else -1),
-                    cat_node=cn,
-                )
+        The pushed-down/padded scoring layout (models/tree.
+        CompiledEnsemble) and its device copies are cached per model
+        version: the cache key is a content digest of the node arrays, so
+        in-place trainer mutation can never serve stale trees, and a hit
+        skips pushdown AND re-upload entirely (the resident-vs-total
+        bench gap showed ~27% of predict wall time there). Hits feed the
+        run log's `compiled_ensemble_cache_hits` counter."""
+        token = ens.cache_token()
+        hit = self._predict_cache.pop(token, None)
+        if hit is not None:
+            self._predict_cache[token] = hit     # most-recently-used
+            tele_counters.record_compiled_ensemble_hit()
+            return hit
+        ce = ens.compile(tree_chunk=64)
+        with phase_span("predict:upload"):
+            ens_dev = tuple(self._put(a, self._sharding())
+                            for a in ce.arrays())
+        use_missing = ce.eff_dl is not None
+        use_cat = ce.eff_cat is not None
+        use_pallas = self._use_pallas
 
-            ens_dev: tuple = (feat, thr, leaf, val, *extras)
-            fn = fn0
-            n_rep = 4 + len(extras)
-        else:
-            fn = functools.partial(
-                predict_ops.predict_raw,
-                max_depth=ens.max_depth,
-                learning_rate=ens.learning_rate,
-                base=ens.base_score,
-                n_classes=C,
+        def fn0(ef, et, bv, coh, *rest):
+            *opt, Xc = rest
+            opt = list(opt)
+            dl = opt.pop(0) if use_missing else None
+            cn = opt.pop(0) if use_cat else None
+            return predict_ops.predict_raw_effective(
+                ef, et, bv, coh, Xc,
+                max_depth=ce.max_depth,
+                learning_rate=ce.learning_rate,
+                base=ce.base_score,
+                n_classes=ce.n_classes_out,
+                tree_chunk=ce.tree_chunk,
+                eff_dl=dl,
+                missing_bin_value=ce.missing_bin_value,
+                eff_cat=cn,
+                use_pallas=use_pallas,
             )
-            ens_dev = (feat, thr, leaf, val)
-            n_rep = 4
+
+        fn = fn0
+        n_rep = len(ens_dev)
         if self.distributed:
             # Row-sharded scoring is embarrassingly parallel: trees are
             # replicated, each shard traverses its own rows, no collectives
@@ -1173,8 +1192,9 @@ class TPUDevice(DeviceBackend):
             # sharding explicit — XLA cannot infer it through the
             # take_along_axis traversal.
             rax = self._row_axes
+            C = ce.n_classes_out
             out_spec = P(rax) if C == 1 else P(rax, None)
-            fn = jax.shard_map(
+            fn = mesh_lib.shard_map(
                 fn,
                 mesh=self.mesh,
                 in_specs=(P(),) * n_rep + (P(rax, None),),
@@ -1185,4 +1205,7 @@ class TPUDevice(DeviceBackend):
                 # here (no collectives anywhere in the traversal).
                 check_vma=False,
             )
+        self._predict_cache[token] = (fn, ens_dev)
+        while len(self._predict_cache) > self.PREDICT_CACHE_MAX:
+            self._predict_cache.pop(next(iter(self._predict_cache)))
         return fn, ens_dev

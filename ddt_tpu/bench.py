@@ -373,8 +373,16 @@ def bench_predict_both(
     be.predict_raw(ens, Xb)                       # warm-up, all shapes
     data = jax.device_put(Xb)
     device_sync(data)
+    # Which traversal the auto dispatch resolved to (pallas on a real TPU
+    # at VMEM-fitting shapes since the inference overhaul; one-hot
+    # otherwise) — recorded so floor trips can be attributed.
+    from ddt_tpu.ops.predict import resolve_use_pallas
+
+    tpad = -(-trees // 64) * 64
+    impl = ("pallas" if resolve_use_pallas(None, True, tpad, 64, depth,
+                                           features, 1) else "onehot")
     base = {"kernel": "predict", "backend": "tpu", "rows": rows,
-            "trees": trees, "depth": depth}
+            "trees": trees, "depth": depth, "impl": impl}
     out = []
     for resident, arg, n in ((True, data, reps), (False, Xb, 1)):
         dt = float("inf")
@@ -401,6 +409,81 @@ def bench_predict_both(
     out.append({**base, "resident": "compute_only", "wallclock_s": dt,
                 "mrows_per_sec": rows / dt / 1e6})
     return out[0], out[1], out[2]
+
+
+def bench_predict_pallas_ab(
+    rows: int = 4_000_000,
+    features: int = 28,
+    bins: int = 255,
+    trees: int = 1000,
+    depth: int = 6,
+    seed: int = 0,
+    reps: int = 8,
+) -> dict:
+    """PAIRED pallas-vs-one-hot traversal timing, compute-only + resident.
+
+    Same protocol as bench_histogram_ab (the only statistic that survives
+    the tunnel's ±20% bands): per-rep PAIRED ratio with the arm order
+    alternating every rep, median-of-ratios as the A/B evidence and
+    median-of-reps per-arm throughput as the headline (the histogram
+    protocol's statistic — min-of-reps promotes fast-tail excursions).
+    Both arms run predict_raw_effective on the SAME device-resident
+    CompiledEnsemble arrays and batch, so only the traversal formulation
+    differs; outputs are asserted equal first (the kernel's exactness
+    contract, witnessed per bench run like split_agreement).
+
+    Meaningful on a real chip only — off-TPU the pallas arm runs the
+    interpreter (minutes per dispatch); the repo-root bench gates on
+    on_tpu."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddt_tpu.ops import predict as predict_ops
+    from ddt_tpu.utils.device import device_sync
+
+    _, Xb, ens = _predict_setup(rows, features, bins, trees, depth, seed)
+    ce = ens.compile(tree_chunk=64)
+    dev = [jnp.asarray(a) for a in ce.arrays()]
+    Xd = jax.device_put(Xb)
+    device_sync(Xd)
+
+    def run(use_pallas):
+        out = predict_ops.predict_raw_effective(
+            *dev, Xd, max_depth=ce.max_depth,
+            learning_rate=ce.learning_rate, base=ce.base_score,
+            n_classes=ce.n_classes_out, tree_chunk=ce.tree_chunk,
+            use_pallas=use_pallas)
+        device_sync(out)
+        return out
+    # Warm-up compiles both arms AND witnesses the exactness contract.
+    a0, b0 = run(True), run(False)
+    assert bool(jnp.all(a0 == b0)), \
+        "pallas traversal diverged from the one-hot path"
+
+    def bout(use_pallas):
+        t0 = time.perf_counter()
+        run(use_pallas)
+        return time.perf_counter() - t0
+
+    dts = {True: [], False: []}
+    ratios = []
+    for rep in range(reps):
+        order = (True, False) if rep % 2 == 0 else (False, True)
+        pair = {}
+        for arm in order:
+            pair[arm] = bout(arm)
+            dts[arm].append(pair[arm])
+        ratios.append(pair[False] / pair[True])   # >1 = pallas faster
+    med = {arm: float(np.median(v)) for arm, v in dts.items()}
+    return {
+        "kernel": "predict_pallas_ab",
+        "rows": rows, "features": features, "bins": bins,
+        "trees": trees, "depth": depth, "reps": reps,
+        "pallas_mrows_per_sec": rows / med[True] / 1e6,
+        "onehot_mrows_per_sec": rows / med[False] / 1e6,
+        "ratio_pallas_over_onehot": float(np.median(ratios)),
+        "exact_match": True,            # asserted above
+    }
 
 
 def run_bench(kernel: str = "histogram", **kw) -> dict:

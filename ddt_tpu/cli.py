@@ -110,21 +110,37 @@ def _predict_streaming(args, bundle) -> int:
             f"shards have {src.n_features} features but the model was "
             f"trained with {ens.n_features}")
     cfg = TrainConfig(backend=args.backend, loss=ens.loss,
-                      n_classes=max(ens.n_classes, 2))
+                      n_classes=max(ens.n_classes, 2),
+                      n_partitions=max(1, getattr(args, "partitions", 1)))
     out_dir = args.out or "scores"
     os.makedirs(out_dir, exist_ok=True)
     t0 = time.perf_counter()
-    rows = 0
-    for c in range(src.n_chunks):
-        X, _ = src(c)
-        if src.binned:
-            scores = api.predict(ens, X, binned=True, cfg=cfg)
-        elif bundle.mapper is not None:
-            scores = api.predict(ens, X, mapper=bundle.mapper, cfg=cfg)
-        else:   # raw-value thresholds traversal (mapper-less artifact)
-            scores = api.predict(ens, X, cfg=cfg)
+
+    def sink(c, scores):
         np.save(os.path.join(out_dir, f"scores_{c:05d}.npy"), scores)
-        rows += len(scores)
+
+    if src.binned:
+        # Binned shards + any backend: the double-buffered scoring
+        # pipeline (streaming.predict_streaming) — the next shard's read
+        # + upload rides under the current shard's traversal, scores
+        # drain asynchronously, and the compiled ensemble stays resident
+        # across shards. Per-shard outputs keep host memory O(chunk).
+        from ddt_tpu.backends import get_backend
+        from ddt_tpu.streaming import predict_streaming
+
+        rows = predict_streaming(
+            src, src.n_chunks, ens, backend=get_backend(cfg),
+            raw=False, sink=sink)
+    else:
+        rows = 0
+        for c in range(src.n_chunks):
+            X, _ = src(c)
+            if bundle.mapper is not None:
+                scores = api.predict(ens, X, mapper=bundle.mapper, cfg=cfg)
+            else:   # raw-value thresholds traversal (mapper-less artifact)
+                scores = api.predict(ens, X, cfg=cfg)
+            sink(c, scores)
+            rows += len(scores)
     dt = time.perf_counter() - t0
     print(json.dumps({
         "cmd": "predict", "backend": args.backend, "rows": rows,
@@ -498,6 +514,10 @@ def main(argv: list[str] | None = None) -> int:
     pp = sub.add_parser("predict", help="score a batch with a saved ensemble")
     _add_common(pp)
     pp.add_argument("--model", required=True)
+    pp.add_argument("--partitions", type=int, default=1,
+                    help="row-shard scoring over this many chips "
+                         "(parallel.mesh row mesh; trees replicate, each "
+                         "chip traverses its own rows)")
     pp.add_argument("--out", default=None, help="write scores to this .npy "
                     "(with --stream-dir: a DIRECTORY of per-shard "
                     "scores_NNNNN.npy files)")
@@ -668,7 +688,8 @@ def main(argv: list[str] | None = None) -> int:
         X, y, _, _ = _load_dataset(args, encoder=bundle.encoder,
                                    n_features=ens.n_features)
         cfg = TrainConfig(backend=args.backend, loss=ens.loss,
-                          n_classes=max(ens.n_classes, 2))
+                          n_classes=max(ens.n_classes, 2),
+                          n_partitions=max(1, args.partitions))
         t0 = time.perf_counter()
         if bundle.mapper is not None:
             # Training-time binning, loaded from the artifact — NEVER refit

@@ -71,6 +71,12 @@ class TrainConfig:
     #   Total devices used = host_partitions x n_partitions x
     #   feature_partitions.
     hist_impl: str = "auto"     # auto | matmul | segment | pallas
+    # Batch-scoring traversal implementation (ops/predict.py dispatch):
+    # "auto" takes the Pallas VMEM traversal kernel on binned data when a
+    # real TPU backs the computation and the shape fits its VMEM budget,
+    # falling back to the one-hot compare+reduce path; "pallas"/"onehot"
+    # force one side (pallas off-TPU runs the interpreter — tests only).
+    predict_impl: str = "auto"  # auto | pallas | onehot
     seed: int = 0
     # Cap on boosting rounds per fused device dispatch (Driver._fit_fused).
     # One block already amortizes dispatch latency to nothing, so bigger
@@ -114,6 +120,11 @@ class TrainConfig:
             raise ValueError("subsample must be in (0, 1]")
         if not (0.0 < self.colsample_bytree <= 1.0):
             raise ValueError("colsample_bytree must be in (0, 1]")
+        if self.predict_impl not in ("auto", "pallas", "onehot"):
+            raise ValueError(
+                f"predict_impl must be auto|pallas|onehot, got "
+                f"{self.predict_impl!r}"
+            )
         if self.missing_policy not in ("zero", "learn"):
             raise ValueError(
                 f"missing_policy must be zero|learn, got "

@@ -23,12 +23,51 @@
 // out is a caller-allocated row-major double buffer of
 // out_cap_rows * n_cols doubles (callers size it by counting '\n').
 
+#include <cerrno>
 #include <charconv>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <locale.h>
 #include <vector>
 
 namespace {
+
+// Floating-point std::from_chars shipped well after the header itself
+// (libstdc++ < 11 has only the integer overloads — this build image's
+// gcc-10 among them). Feature-tested fallback: strtod_l against a
+// pinned "C" locale on a bounded stack copy — locale-INDEPENDENT even
+// when the embedding process called setlocale (plain strtod would stop
+// at '.' under an LC_NUMERIC=de_DE process), and out-of-range values
+// are rejected via ERANGE, matching from_chars' result_out_of_range so
+// both build variants parse the same file identically. The copy is
+// NUL-terminated and end-checked, preserving the trimmed-span contract.
+struct fc_result {
+    const char* ptr;
+    std::errc ec;
+};
+
+inline fc_result parse_double(const char* first, const char* last,
+                              double& value) {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+    auto r = std::from_chars(first, last, value);
+    return {r.ptr, r.ec};
+#else
+    static const locale_t c_loc = newlocale(LC_ALL_MASK, "C",
+                                            static_cast<locale_t>(nullptr));
+    char buf[64];
+    size_t n = static_cast<size_t>(last - first);
+    if (n == 0 || n >= sizeof(buf)) return {first, std::errc::invalid_argument};
+    memcpy(buf, first, n);
+    buf[n] = '\0';
+    char* endp = nullptr;
+    errno = 0;
+    value = strtod_l(buf, &endp, c_loc);
+    if (endp == buf) return {first, std::errc::invalid_argument};
+    if (errno == ERANGE) return {first, std::errc::result_out_of_range};
+    return {first + (endp - buf), std::errc()};
+#endif
+}
 
 // One line's extent [p, q) excluding the terminator; advances *cur past
 // the terminator. Returns false at end of buffer.
@@ -87,7 +126,7 @@ long parse_line(const Line& L, long n_cols, double* out_row, char* err,
         while (tq > ts && (tq[-1] == ' ' || tq[-1] == '\t')) --tq;
         double v = 0.0;
         if (ts < tq && *ts == '+') ++ts;   // loadtxt accepts leading '+'
-        auto res = std::from_chars(ts, tq, v);
+        auto res = parse_double(ts, tq, v);
         if (ts == tq || res.ec != std::errc() || res.ptr != tq) {
             snprintf(err, static_cast<size_t>(err_len),
                      "line %ld: empty or unparseable field %ld: '%.32s'",

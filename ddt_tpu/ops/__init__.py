@@ -11,7 +11,13 @@ from ddt_tpu.ops.histogram import (
     build_histograms_segment,
     resolve_hist_impl,
 )
-from ddt_tpu.ops.predict import predict_proba, predict_raw, traverse
+from ddt_tpu.ops.predict import (
+    predict_proba,
+    predict_raw,
+    predict_raw_effective,
+    resolve_use_pallas,
+    traverse,
+)
 from ddt_tpu.ops.split import best_splits, node_totals
 
 __all__ = [
@@ -26,7 +32,9 @@ __all__ = [
     "node_totals",
     "predict_proba",
     "predict_raw",
+    "predict_raw_effective",
     "resolve_hist_impl",
+    "resolve_use_pallas",
     "traverse",
     "tree_predict_delta",
 ]

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 
 from ddt_tpu.ops import histogram as H
 from ddt_tpu.ops import split as S
+from ddt_tpu.parallel import mesh as mesh_lib
 from ddt_tpu.telemetry.annotations import traced_scope
 
 # Perfetto alignment (docs/OBSERVABILITY.md): the traced_scope blocks
@@ -101,7 +102,7 @@ def grow_tree(
     # offset applied below), so the bound must cover shards x local width,
     # not just the local F. axis_size is static at trace time.
     F_global = F if feature_axis_name is None else (
-        F * jax.lax.axis_size(feature_axis_name))
+        F * mesh_lib.static_axis_size(feature_axis_name))
     assert F_global < 2 ** 19, \
         f"routing pack needs global F < 2^19, got {F_global}"
     N = 2 ** (max_depth + 1) - 1

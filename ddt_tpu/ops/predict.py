@@ -23,6 +23,17 @@ Doubly chunked via lax.scan — trees in chunks of `tree_chunk`, rows in chunks
 of `row_chunk` — so the working set stays bounded for the 10M-row x
 1000-tree inference config [BASELINE] (a flat [1000, 10M] int32 node state
 alone would be 40 GB).
+
+Since the inference-overhaul PR the module exposes THREE related entries:
+
+- `predict_raw` — the original raw-arrays contract (pushdown computed
+  in-trace); kept for tests/experiments and host callers.
+- `predict_raw_effective` — the same scoring core fed PRE-pushed-down,
+  pre-padded arrays (models/tree.CompiledEnsemble builds them ONCE per
+  model on host; backends keep them device-resident across calls).
+- the Pallas fast path (`ops/predict_pallas.py`) — dispatched from either
+  entry via `use_pallas` (None = auto: binned data on a real TPU whose
+  shape fits the kernel's VMEM budget; the one-hot path is the fallback).
 """
 
 from __future__ import annotations
@@ -44,6 +55,10 @@ def _effective_arrays(feature, thr, is_leaf, leaf_value, max_depth):
 
     All ops are on tiny [T, N] arrays (N = 2^(D+1)-1); the per-level parent
     indexing uses STATIC index vectors, which XLA lowers to cheap slices.
+
+    `leaf_value=None` skips the value chain entirely (eff_val comes back
+    None) — `traverse` only needs slots, and the old throwaway
+    `jnp.zeros`-shaped value array bought nothing but flops.
     """
     T, N = feature.shape
     big = (
@@ -65,8 +80,9 @@ def _effective_arrays(feature, thr, is_leaf, leaf_value, max_depth):
             jnp.where(pch, -1, eff_feat[:, lo:hi]))
         eff_thr = eff_thr.at[:, lo:hi].set(
             jnp.where(pch, big, eff_thr[:, lo:hi]))
-        eff_val = eff_val.at[:, lo:hi].set(
-            jnp.where(pch, eff_val[:, par], eff_val[:, lo:hi]))
+        if eff_val is not None:
+            eff_val = eff_val.at[:, lo:hi].set(
+                jnp.where(pch, eff_val[:, par], eff_val[:, lo:hi]))
         eff_slot = eff_slot.at[:, lo:hi].set(
             jnp.where(pch, eff_slot[:, par], eff_slot[:, lo:hi]))
         chained = chained.at[:, lo:hi].set(pch | is_leaf[:, lo:hi])
@@ -182,19 +198,210 @@ def traverse(
     max_depth: int,
 ) -> jax.Array:
     """Leaf slot per (tree, row): int32 [T, R] (the ORIGINAL heap slot the
-    row lands in, as with explicit frozen-node traversal)."""
+    row lands in, as with explicit frozen-node traversal).
+
+    Routed through the shared effective-arrays helper with leaf_value=None
+    — no throwaway value array is allocated or pushed down; persistent
+    cross-call reuse of the pushdown lives one level up
+    (models/tree.CompiledEnsemble + the backend cache)."""
     eff_feat, eff_thr, _, eff_slot = _effective_arrays(
-        feature, thr, is_leaf, jnp.zeros(feature.shape, jnp.float32),
-        max_depth)
+        feature, thr, is_leaf, None, max_depth)
     k = _descend(eff_feat, eff_thr, Xc, max_depth)
     lo = (1 << max_depth) - 1
     return _select_level(k, eff_slot[:, lo:])
 
 
+def resolve_use_pallas(use_pallas, binned: bool, n_trees_padded: int,
+                       tree_chunk: int, max_depth: int, n_features: int,
+                       n_classes: int) -> bool:
+    """The ONE home of the pallas-vs-one-hot predict dispatch rule.
+
+    None = auto: the Pallas traversal kernel is taken when the data is
+    binned, a real TPU backs the computation, and the kernel's VMEM
+    working set fits (predict_pallas.predict_pallas_fits). Explicit True
+    demands the kernel (binned data required — raises otherwise; off-TPU
+    it runs in interpret mode, the test contract); explicit False always
+    takes the one-hot path."""
+    if use_pallas is False:
+        return False
+    from ddt_tpu.ops import predict_pallas
+
+    if use_pallas is None:
+        return (binned and jax.default_backend() == "tpu"
+                and predict_pallas.predict_pallas_fits(
+                    n_trees_padded, tree_chunk, max_depth, n_features,
+                    n_classes))
+    if not binned:
+        raise ValueError(
+            "use_pallas=True requires binned (integer) data; the Pallas "
+            "traversal kernel has no raw-threshold form — use the one-hot "
+            "path for float features")
+    return True
+
+
+def _predict_effective(
+    eff_feat, eff_thr, bot_val, cls_oh, Xc, *,
+    max_depth: int, learning_rate, base, n_classes: int,
+    tree_chunk: int, row_chunk: int | None,
+    missing_bin_value: int, eff_dl=None, eff_cat=None,
+    use_pallas=None,
+):
+    """Scoring core on PRE-pushed-down, tree-padded arrays.
+
+    eff_feat/eff_thr [Tpad, N], bot_val [Tpad, 2^D] (bottom level of the
+    pushed-down values), cls_oh [Tpad, C] (round-major class one-hot;
+    padded trees carry value 0 so their class column gains exactly 0.0).
+    eff_dl/eff_cat are the pushdown-aligned routing masks or None. The
+    doubly chunked scan is unchanged from the original predict_raw body —
+    the pushdown just moved out (models/tree.CompiledEnsemble computes it
+    once per model on host; predict_raw still computes it in-trace)."""
+    binned = bool(jnp.issubdtype(Xc.dtype, jnp.integer))
+    if binned:
+        Xc = Xc.astype(jnp.int32)      # uint8 uploads are 4x cheaper; widen
+    R, F = Xc.shape
+    C = n_classes
+    if R == 0:
+        out = jnp.full((0, C), base, jnp.float32)
+        return out[:, 0] if C == 1 else out
+    Tpad = eff_feat.shape[0]
+    if resolve_use_pallas(use_pallas, binned, Tpad, tree_chunk, max_depth,
+                          F, C):
+        from ddt_tpu.ops import predict_pallas
+
+        return predict_pallas.predict_effective_pallas(
+            eff_feat, eff_thr, bot_val, cls_oh, Xc,
+            max_depth=max_depth, learning_rate=learning_rate, base=base,
+            n_classes=C, tree_chunk=tree_chunk,
+            missing_bin_value=missing_bin_value,
+            eff_dl=eff_dl, eff_cat=eff_cat,
+        )
+    if row_chunk is None:
+        # The binned comparison-matrix descent materialises
+        # [Rc, chunk, Nint] bits; default to a smaller row chunk there to
+        # bound it. Round-5 interleaved sweep (docs/PERF.md): the
+        # row_chunk axis is flat within ~4% over 4k-16k while
+        # tree_chunk=64 dominates — (64, 8192) sits on the plateau.
+        # None is the only "use default" value — an explicit row_chunk,
+        # including 65536, is always honored.
+        row_chunk = 8_192 if binned else _DEFAULT_ROW_CHUNK
+    n_tc = Tpad // tree_chunk
+    featp = eff_feat.reshape(n_tc, tree_chunk, -1)
+    thrp = eff_thr.reshape(n_tc, tree_chunk, -1)
+    use_missing = eff_dl is not None
+    if use_missing:
+        dlp = eff_dl.reshape(n_tc, tree_chunk, -1)
+    use_cat = eff_cat is not None
+    if use_cat:
+        catp = eff_cat.reshape(n_tc, tree_chunk, -1)
+    valp = bot_val.reshape(n_tc, tree_chunk, -1)      # bottom level only
+    cls_ohp = cls_oh.reshape(n_tc, tree_chunk, C)
+
+    row_chunk = min(row_chunk, R)
+    n_rc = -(-R // row_chunk)
+    rpad = n_rc * row_chunk - R
+    Xp = jnp.pad(Xc, ((0, rpad), (0, 0))).reshape(n_rc, row_chunk, F)
+
+    def row_body(_, xrc):
+        def tree_body(acc, args):
+            f, t, v, coh = args[:4]
+            rest = list(args[4:])
+            dlc = rest.pop(0) if use_missing else None
+            catc = rest.pop(0) if use_cat else None
+            with traced_scope("predict:traverse"):
+                if binned:
+                    k = _descend_comp(f, t, xrc, max_depth, dl=dlc,
+                                      missing_bin_value=missing_bin_value,
+                                      cat_node=catc)
+                else:
+                    k = _descend(f, t, xrc, max_depth, dl=dlc,
+                                 missing_bin_value=missing_bin_value,
+                                 cat_node=catc)
+            with traced_scope("predict:accumulate"):
+                if binned:
+                    W = v.shape[1]                               # [Rc, chunk]
+                    noh = (
+                        k[:, :, None]
+                        == jnp.arange(W, dtype=jnp.int32)[None, None, :]
+                    )
+                    vals = jnp.sum(
+                        jnp.where(noh, v[None, :, :], 0.0), axis=-1
+                    )                                            # [Rc, chunk]
+                    contract = (((1,), (0,)), ((), ()))
+                else:
+                    vals = _select_level(k, v)                   # [chunk, Rc]
+                    contract = (((0,), (0,)), ((), ()))
+                # Scatter chunk sums into classes: one_hot [chunk, C]
+                # matmul.
+                acc = acc + jax.lax.dot_general(
+                    vals, coh, contract,
+                    preferred_element_type=jnp.float32,
+                    # Exact: one operand is a 0/1 one-hot, so HIGHEST costs
+                    # little and keeps predictions bit-stable across
+                    # platforms.
+                    precision=jax.lax.Precision.HIGHEST,
+                )                                                # [Rc, C]
+            return acc, None
+
+        acc0 = jnp.zeros((row_chunk, C), jnp.float32)
+        xs = [featp, thrp, valp, cls_ohp]
+        if use_missing:
+            xs.append(dlp)
+        if use_cat:
+            xs.append(catp)
+        acc, _ = jax.lax.scan(tree_body, acc0, tuple(xs))
+        return None, acc
+
+    # `ddt:predict` on the device timeline (telemetry.annotations): the
+    # whole doubly-chunked descent shows as one named span in Perfetto,
+    # matching the host-side scoring phase name; `ddt:predict:traverse` /
+    # `ddt:predict:accumulate` sub-spans nest inside it.
+    with traced_scope("predict"):
+        _, accs = jax.lax.scan(row_body, None, Xp)           # [n_rc, Rc, C]
+    out = base + learning_rate * accs.reshape(n_rc * row_chunk, C)[:R]
+    return out[:, 0] if C == 1 else out
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("max_depth", "n_classes", "tree_chunk", "row_chunk",
-                     "missing_bin_value"),
+                     "missing_bin_value", "use_pallas"),
+)
+def predict_raw_effective(
+    eff_feat: jax.Array,       # [Tpad, N] pushed-down features
+    eff_thr: jax.Array,        # [Tpad, N] pushed-down thresholds
+    bot_val: jax.Array,        # float32 [Tpad, 2^D] bottom-level values
+    cls_oh: jax.Array,         # float32 [Tpad, C] class one-hot
+    Xc: jax.Array,             # [R, F]
+    max_depth: int,
+    learning_rate: float,
+    base: float,
+    n_classes: int = 1,
+    tree_chunk: int = 64,
+    row_chunk: int | None = None,
+    eff_dl: jax.Array | None = None,
+    missing_bin_value: int = -1,
+    eff_cat: jax.Array | None = None,
+    use_pallas: bool | None = None,
+) -> jax.Array:
+    """predict_raw on a CompiledEnsemble's precomputed arrays — no
+    pushdown, no padding, no class-one-hot construction in-trace. The
+    backend keeps these arrays device-resident across calls (the
+    resident-vs-total bench gap showed ~27% of predict wall time was
+    re-upload/setup). Tpad must be a multiple of tree_chunk
+    (CompiledEnsemble.build guarantees it)."""
+    return _predict_effective(
+        eff_feat, eff_thr, bot_val, cls_oh, Xc,
+        max_depth=max_depth, learning_rate=learning_rate, base=base,
+        n_classes=n_classes, tree_chunk=tree_chunk, row_chunk=row_chunk,
+        missing_bin_value=missing_bin_value, eff_dl=eff_dl,
+        eff_cat=eff_cat, use_pallas=use_pallas,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_depth", "n_classes", "tree_chunk", "row_chunk",
+                     "missing_bin_value", "use_pallas"),
 )
 def predict_raw(
     feature: jax.Array,        # int32 [T, N]
@@ -216,6 +423,9 @@ def predict_raw(
     #   split nodes ("bin == thr goes left", cfg.cat_features). For raw
     #   float data the caller must put the BIN id in thr for these nodes
     #   (categorical columns carry bin ids in both representations).
+    use_pallas: bool | None = None,          # None = auto (binned data on
+    #   a real TPU at a VMEM-fitting shape); the one-hot path is the
+    #   fallback. ops/predict_pallas.py documents the kernel.
 ) -> jax.Array:
     """Raw margin scores: [R] (n_classes==1) or [R, C].
 
@@ -223,24 +433,8 @@ def predict_raw(
     are accumulated into the per-class output (round-major tree->class
     interleave for softmax, matching reference/numpy_trainer.fit).
     """
-    binned = bool(jnp.issubdtype(Xc.dtype, jnp.integer))
-    if binned:
-        Xc = Xc.astype(jnp.int32)      # uint8 uploads are 4x cheaper; widen
-    if row_chunk is None:
-        # The binned comparison-matrix descent materialises
-        # [Rc, chunk, Nint] bits; default to a smaller row chunk there to
-        # bound it. Round-5 interleaved sweep (docs/PERF.md): the
-        # row_chunk axis is flat within ~4% over 4k-16k while
-        # tree_chunk=64 dominates — (64, 8192) sits on the plateau.
-        # None is the only "use default" value — an explicit row_chunk,
-        # including 65536, is always honored.
-        row_chunk = 8_192 if binned else _DEFAULT_ROW_CHUNK
     T = feature.shape[0]               # on device where casts are free
-    R, F = Xc.shape
     C = n_classes
-    if R == 0:
-        out = jnp.full((0, C), base, jnp.float32)
-        return out[:, 0] if C == 1 else out
     n_tc = -(-T // tree_chunk)
     tpad = n_tc * tree_chunk - T
 
@@ -252,78 +446,19 @@ def predict_raw(
         pad_t(feature, -1), pad_t(thr), pad_t(is_leaf, True),
         pad_t(leaf_value), max_depth,
     )
-    featp = ef.reshape(n_tc, tree_chunk, -1)
-    thrp = et.reshape(n_tc, tree_chunk, -1)
-    use_missing = default_left is not None
-    if use_missing:
-        dlp = pad_t(default_left).reshape(n_tc, tree_chunk, -1)
-    use_cat = cat_node is not None
-    if use_cat:
-        catp = pad_t(cat_node).reshape(n_tc, tree_chunk, -1)
     lo = (1 << max_depth) - 1
-    valp = ev[:, lo:].reshape(n_tc, tree_chunk, -1)   # bottom level only
     # Class of tree t is t % C (round-major interleave).
-    cls = (jnp.arange(n_tc * tree_chunk, dtype=jnp.int32) % C).reshape(
-        n_tc, tree_chunk
+    cls = jnp.arange(n_tc * tree_chunk, dtype=jnp.int32) % C
+    cls_oh = jax.nn.one_hot(cls, C, dtype=jnp.float32)   # [Tpad, C]
+    return _predict_effective(
+        ef, et, ev[:, lo:], cls_oh, Xc,
+        max_depth=max_depth, learning_rate=learning_rate, base=base,
+        n_classes=C, tree_chunk=tree_chunk, row_chunk=row_chunk,
+        missing_bin_value=missing_bin_value,
+        eff_dl=pad_t(default_left) if default_left is not None else None,
+        eff_cat=pad_t(cat_node) if cat_node is not None else None,
+        use_pallas=use_pallas,
     )
-    cls_oh = jax.nn.one_hot(cls, C, dtype=jnp.float32)  # [n_tc, chunk, C]
-
-    row_chunk = min(row_chunk, R)
-    n_rc = -(-R // row_chunk)
-    rpad = n_rc * row_chunk - R
-    Xp = jnp.pad(Xc, ((0, rpad), (0, 0))).reshape(n_rc, row_chunk, F)
-
-    def row_body(_, xrc):
-        def tree_body(acc, args):
-            f, t, v, coh = args[:4]
-            rest = list(args[4:])
-            dlc = rest.pop(0) if use_missing else None
-            catc = rest.pop(0) if use_cat else None
-            if binned:
-                k = _descend_comp(f, t, xrc, max_depth, dl=dlc,
-                                  missing_bin_value=missing_bin_value,
-                                  cat_node=catc)
-                W = v.shape[1]                               # [Rc, chunk]
-                noh = (
-                    k[:, :, None]
-                    == jnp.arange(W, dtype=jnp.int32)[None, None, :]
-                )
-                vals = jnp.sum(
-                    jnp.where(noh, v[None, :, :], 0.0), axis=-1
-                )                                            # [Rc, chunk]
-                contract = (((1,), (0,)), ((), ()))
-            else:
-                k = _descend(f, t, xrc, max_depth, dl=dlc,
-                             missing_bin_value=missing_bin_value,
-                             cat_node=catc)
-                vals = _select_level(k, v)                   # [chunk, Rc]
-                contract = (((0,), (0,)), ((), ()))
-            # Scatter chunk sums into classes: one_hot [chunk, C] matmul.
-            acc = acc + jax.lax.dot_general(
-                vals, coh, contract,
-                preferred_element_type=jnp.float32,
-                # Exact: one operand is a 0/1 one-hot, so HIGHEST costs
-                # little and keeps predictions bit-stable across platforms.
-                precision=jax.lax.Precision.HIGHEST,
-            )                                                # [Rc, C]
-            return acc, None
-
-        acc0 = jnp.zeros((row_chunk, C), jnp.float32)
-        xs = [featp, thrp, valp, cls_oh]
-        if use_missing:
-            xs.append(dlp)
-        if use_cat:
-            xs.append(catp)
-        acc, _ = jax.lax.scan(tree_body, acc0, tuple(xs))
-        return None, acc
-
-    # `ddt:predict` on the device timeline (telemetry.annotations): the
-    # whole doubly-chunked descent shows as one named span in Perfetto,
-    # matching the host-side scoring phase name.
-    with traced_scope("predict"):
-        _, accs = jax.lax.scan(row_body, None, Xp)           # [n_rc, Rc, C]
-    out = base + learning_rate * accs.reshape(n_rc * row_chunk, C)[:R]
-    return out[:, 0] if C == 1 else out
 
 
 def predict_proba(raw: jax.Array, loss: str) -> jax.Array:

@@ -131,8 +131,13 @@ class _DDTBase:
     def _raw(self, X) -> np.ndarray:
         from ddt_tpu import api
 
+        # Score through the estimator's configured backend: device
+        # backends serve repeat calls from the compiled-ensemble cache
+        # (pushdown + upload paid once per fitted model — backends/tpu),
+        # and CPUDevice's native traversal is bitwise-equal to the NumPy
+        # scorer, so this routing changes no prediction.
         return api.predict(self.ensemble_, np.asarray(X, np.float32),
-                           mapper=self.mapper_, raw=True)
+                           mapper=self.mapper_, raw=True, cfg=self._cfg())
 
 
 class DDTClassifier(_DDTBase):
@@ -181,8 +186,9 @@ class DDTClassifier(_DDTBase):
 
         # The raw->probability transform lives in TreeEnsemble.predict
         # (api.predict raw=False); binary returns p(class 1), stacked here.
+        # cfg routes through the backend's compiled-ensemble cache (_raw).
         p = api.predict(self.ensemble_, np.asarray(X, np.float32),
-                        mapper=self.mapper_)
+                        mapper=self.mapper_, cfg=self._cfg())
         if p.ndim == 2:            # softmax: already a distribution
             return p
         return np.stack([1.0 - p, p], axis=1)

@@ -1037,6 +1037,125 @@ def _fit_streaming_device(
     return ens
 
 
+def predict_streaming(
+    chunk_fn: ChunkFn,
+    n_chunks: int,
+    ens: TreeEnsemble,
+    backend=None,
+    raw: bool = True,
+    sink=None,
+    max_in_flight: int = 3,
+) -> "np.ndarray | int":
+    """Out-of-core batch scoring: stream binned chunks through a
+    DOUBLE-BUFFERED host→device pipeline; returns the concatenated scores
+    ([R] or [R, C] raw margins; `raw=False` applies the loss's
+    probability transform) — or, with a `sink`, streams them out too.
+
+    The pipeline shape (device backends): chunk c's scoring program is
+    dispatched asynchronously, chunk c+1's host read + H2D upload is
+    enqueued WHILE c computes, and c's device→host score fetch is started
+    (`copy_to_host_async`) as soon as its dispatch returns — so the H2D
+    link, the traversal kernels, and the D2H drain all run concurrently
+    (the round-5 overlapped-fetch result, extended to out-of-core input).
+    The ensemble's pushed-down tables upload ONCE via the backend's
+    compiled-ensemble cache and stay resident across chunks AND calls.
+    Chunks may differ in size (each distinct size compiles one program —
+    keep the number of distinct sizes small). Host backends (or
+    backend=None) fall back to per-chunk scoring, same contract.
+
+    `sink(chunk_idx, scores)` — when given, per-chunk scores stream out
+    through it (at most `max_in_flight` chunks of scores are ever
+    host-resident) and the TOTAL ROW COUNT is returned instead of an
+    array: a 10B-row score vector has no business being concatenated in
+    host memory (the CLI's --stream-dir predict writes per-shard .npy
+    files through this).
+
+    `chunk_fn` is the fit_streaming chunk source convention:
+    (chunk_idx) -> (Xb_chunk uint8 [r, F], labels) — labels are ignored
+    here, so score-time sources may return anything (e.g. None) there.
+    Composes with distribution: each chunk row-shards over the backend's
+    mesh like any other upload (multi-chip scoring from the same flag).
+    """
+    if n_chunks < 1:
+        raise ValueError("predict_streaming needs n_chunks >= 1")
+
+    def transform(out_np):
+        if raw:
+            return out_np
+        from ddt_tpu.ops.predict import predict_proba
+        import jax.numpy as jnp
+
+        return np.asarray(predict_proba(jnp.asarray(out_np), ens.loss))
+
+    rows = 0
+    collected: list = []
+
+    def emit(c, scores):
+        nonlocal rows
+        scores = transform(scores)
+        rows += len(scores)
+        if sink is None:
+            collected.append(scores)
+        else:
+            sink(c, scores)
+
+    if getattr(backend, "_predict_fn", None) is None:
+        # Host path: no pipeline to overlap — score chunk by chunk
+        # (through the backend's scorer when one was given: CPUDevice
+        # prefers the native C++ traversal, bitwise-equal to NumPy).
+        for c in range(n_chunks):
+            Xc = np.asarray(chunk_fn(c)[0])
+            emit(c, backend.predict_raw(ens, Xc) if backend is not None
+                 else ens.predict_raw(Xc, binned=True))
+    else:
+        fn, ens_dev = backend._predict_fn(ens)   # compiled-ensemble cache
+        # Device working-set bound: a chunk past the backend's per-call
+        # row limit may NOT go down as one dispatch (the 10M x 1000
+        # config OOM-kills the chip that way — backends/tpu.py
+        # PREDICT_ROW_CHUNK). Oversized chunks route through
+        # backend.predict_raw, whose internal chunking + overlapped
+        # fetch already handle the big-batch case; the double-buffered
+        # pipeline below covers the (normal) bounded-chunk regime.
+        limit = (getattr(backend, "PREDICT_ROW_CHUNK", None) or 0) \
+            * max(1, getattr(backend, "row_shards", 1))
+        def fits(x):
+            return not limit or x.shape[0] <= limit
+
+        Xc = np.asarray(chunk_fn(0)[0])
+        data = backend._put_rows(Xc, extra_dims=1) if fits(Xc) else None
+        pending: list = []                       # (idx, device scores, n)
+
+        def drain(keep: int) -> None:
+            # Copies are already in flight; asarray only materialises.
+            while len(pending) > keep:
+                ci, o, n = pending.pop(0)
+                emit(ci, np.asarray(o)[:n])  # ddtlint: disable=host-sync
+
+        for c in range(n_chunks):
+            cur, n_rows = Xc, Xc.shape[0]
+            out_c = None if data is None else fn(*ens_dev, data)
+            if c + 1 < n_chunks:                 # overlap next H2D
+                Xc = np.asarray(chunk_fn(c + 1)[0])
+                data = (backend._put_rows(Xc, extra_dims=1)
+                        if fits(Xc) else None)
+            if out_c is None:
+                # Oversized chunk: drain the pipeline in order, then let
+                # the backend's own chunked/overlapped path score it.
+                drain(0)
+                emit(c, backend.predict_raw(ens, cur))
+                continue
+            try:
+                out_c.copy_to_host_async()       # start D2H drain now
+            except AttributeError:               # non-jax backend arrays
+                pass
+            pending.append((c, out_c, n_rows))
+            drain(max_in_flight)                 # bounded host residency
+        drain(0)
+    if sink is not None:
+        return rows
+    return np.concatenate(collected)
+
+
 def _leaf_slot(Xb, feature, threshold_bin, is_leaf, max_depth,
                default_left=None, missing_bin_value=-1,
                cat_features=()) -> np.ndarray:

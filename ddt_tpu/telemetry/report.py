@@ -193,6 +193,10 @@ def render(summary: dict) -> str:
             f"d2h={_fmt_bytes(c.get('d2h_bytes'))}  "
             f"collective≈{_fmt_bytes(c.get('collective_bytes_est'))}  "
             f"device_peak={_fmt_bytes(c.get('device_peak_bytes'))}")
+        # Scoring-cache effectiveness (absent in pre-overhaul logs).
+        hits = c.get("compiled_ensemble_cache_hits")
+        if hits is not None:
+            out.append(f"predict: compiled_ensemble_cache_hits={hits}")
 
     if summary["slowest_rounds"]:
         slow = ", ".join(f"#{r['round']} ({r['ms_per_round']:.1f} ms)"

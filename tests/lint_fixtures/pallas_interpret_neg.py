@@ -1,0 +1,33 @@
+"""pallas-interpret negatives: the hist_pallas dispatch idiom — an
+`interpret` parameter auto-selected off-TPU and threaded to every
+pallas_call as a live variable (True constants are fine too: tests may
+force the interpreter)."""
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref, *, scale):
+    o_ref[:] = x_ref[:] * scale
+
+
+def dispatch(x, interpret=None):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=2),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x)
+
+
+def forced_interpreter(x):
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=3),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True,
+    )(x)

@@ -1,0 +1,36 @@
+"""pallas-interpret positives: pallas_call sites with no live interpret
+operand — no interpret kwarg at all, and hard-coded False/None."""
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref, *, scale):
+    o_ref[:] = x_ref[:] * scale
+
+
+def missing_interpret(x):
+    return pl.pallas_call(  # LINT: pallas-interpret
+        functools.partial(_kernel, scale=2),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(x)
+
+
+def hard_false(x):
+    return pl.pallas_call(  # LINT: pallas-interpret
+        functools.partial(_kernel, scale=3),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=False,
+    )(x)
+
+
+def hard_none(x):
+    return pl.pallas_call(  # LINT: pallas-interpret
+        functools.partial(_kernel, scale=4),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=None,
+    )(x)

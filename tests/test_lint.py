@@ -60,6 +60,8 @@ CASES = [
      "ddt_tpu/fixture_mod.py"),
     ("no-print", "no_print_pos.py", "no_print_neg.py",
      "ddt_tpu/fixture_mod.py"),
+    ("pallas-interpret", "pallas_interpret_pos.py",
+     "pallas_interpret_neg.py", "ddt_tpu/ops/fixture_mod.py"),
 ]
 
 
@@ -161,6 +163,12 @@ def test_repo_ops_are_jit_reachable():
     assert "grow_tree" in reach["ddt_tpu/ops/grow.py"]
     assert "build_histograms" in reach["ddt_tpu/ops/histogram.py"]
     assert "best_splits" in reach["ddt_tpu/ops/split.py"]
+    # Pallas kernels are traced roots (pallas_call is a tracing
+    # combinator, including partial()-wrapped kernels) — if this breaks,
+    # traced-branch goes blind inside every kernel body.
+    assert "_hist_kernel" in reach["ddt_tpu/ops/hist_pallas.py"]
+    assert "_hist_kernel_t" in reach["ddt_tpu/ops/hist_pallas.py"]
+    assert "_traverse_kernel" in reach["ddt_tpu/ops/predict_pallas.py"]
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,284 @@
+"""Pallas traversal kernel exactness sweep + compiled-ensemble cache tests.
+
+The kernel contract (ops/predict_pallas.py): BIT-EXACT agreement with the
+one-hot predict path at the same tree_chunk — missing-value routing,
+categorical one-vs-rest, softmax round-major classes, uneven tree/row
+remainders, R=0 — and oracle-grade agreement with the NumPy scorer. Runs
+through Pallas interpret mode on CPU (the identical kernel logic the chip
+compiles; same pattern as tests/test_hist_pallas.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ddt_tpu import api
+from ddt_tpu.backends import get_backend
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.data.datasets import synthetic_binary
+from ddt_tpu.data.quantizer import quantize
+from ddt_tpu.models.tree import CompiledEnsemble, TreeEnsemble
+from ddt_tpu.ops import predict as jpred
+from ddt_tpu.ops import predict_pallas as jpp
+from ddt_tpu.reference import numpy_trainer as oracle
+
+
+def _rand_ensemble(T=9, depth=3, F=6, bins=31, n_classes=1, seed=0,
+                   missing=False, cat=()):
+    """Random full-ish trees wrapped in a TreeEnsemble (the NumPy oracle
+    needs the object; the device paths take its arrays)."""
+    rng = np.random.default_rng(seed)
+    N = 2 ** (depth + 1) - 1
+    ens = TreeEnsemble(
+        feature=rng.integers(0, F, size=(T, N)).astype(np.int32),
+        threshold_bin=rng.integers(0, bins - 1, (T, N)).astype(np.int32),
+        threshold_raw=np.zeros((T, N), np.float32),
+        is_leaf=rng.random((T, N)) < 0.25,
+        leaf_value=rng.standard_normal((T, N)).astype(np.float32),
+        split_gain=np.zeros((T, N), np.float32),
+        max_depth=depth, n_features=F, learning_rate=0.1, base_score=0.3,
+        loss="softmax" if n_classes > 1 else "logloss",
+        n_classes=max(n_classes, 2),
+        default_left=(rng.random((T, N)) < 0.5) if missing else None,
+        missing_bin=missing, n_bins=bins,
+        cat_features=np.asarray(cat, np.int32) if cat else None,
+    )
+    return ens
+
+
+def _dev_args(ens):
+    use_missing = ens.missing_bin and ens.default_left is not None
+    kw = dict(
+        max_depth=ens.max_depth, learning_rate=ens.learning_rate,
+        base=ens.base_score,
+        n_classes=ens.n_classes if ens.loss == "softmax" else 1,
+        missing_bin_value=ens.n_bins - 1 if use_missing else -1,
+    )
+    opt = {}
+    if use_missing:
+        opt["default_left"] = jnp.asarray(ens.default_left)
+    if ens.has_cat_splits:
+        opt["cat_node"] = jnp.asarray(
+            np.isin(ens.feature, ens.cat_features))
+    args = (jnp.asarray(ens.feature), jnp.asarray(ens.threshold_bin),
+            jnp.asarray(ens.is_leaf), jnp.asarray(ens.leaf_value))
+    return args, kw, opt
+
+
+@pytest.mark.parametrize("n_classes,tree_chunk,rows", [
+    (1, 64, 500),      # T=9 < tree_chunk: one ragged tree chunk
+    (1, 4, 511),       # uneven tree remainder (9 % 4) + odd row count
+    (3, 2, 257),       # softmax round-major, rows not a tile multiple
+    (1, 3, 0),         # R = 0
+])
+@pytest.mark.parametrize("missing,cat", [
+    (False, ()), (True, ()), (False, (1, 4)), (True, (2,)),
+])
+def test_pallas_exact_vs_onehot_sweep(n_classes, tree_chunk, rows,
+                                      missing, cat):
+    """The kernel's headline contract: bit-exact vs the one-hot path over
+    the full routing matrix x chunk-remainder x class sweep."""
+    ens = _rand_ensemble(n_classes=n_classes, missing=missing, cat=cat,
+                         seed=n_classes * 7 + tree_chunk)
+    args, kw, opt = _dev_args(ens)
+    Xb = np.random.default_rng(rows + 1).integers(
+        0, ens.n_bins, size=(rows, ens.n_features)).astype(np.int32)
+    want = np.asarray(jpred.predict_raw(
+        *args, jnp.asarray(Xb), tree_chunk=tree_chunk, use_pallas=False,
+        **kw, **opt))
+    got = np.asarray(jpp.predict_raw_pallas(
+        *args, jnp.asarray(Xb), tree_chunk=tree_chunk, **kw, **opt))
+    np.testing.assert_array_equal(want, got)
+    # and the dispatch flag reaches the same kernel
+    via_flag = np.asarray(jpred.predict_raw(
+        *args, jnp.asarray(Xb), tree_chunk=tree_chunk, use_pallas=True,
+        **kw, **opt))
+    np.testing.assert_array_equal(want, via_flag)
+
+
+@pytest.mark.parametrize("missing,cat", [
+    (False, ()), (True, ()), (False, (0, 3)),
+])
+def test_pallas_matches_numpy_oracle(missing, cat):
+    """Three-way agreement: pallas == one-hot (exact) and both match the
+    NumPy reference scorer to float tolerance (accumulation order is the
+    only seam — selection is integer-exact everywhere)."""
+    ens = _rand_ensemble(T=11, depth=4, missing=missing, cat=cat, seed=5)
+    args, kw, opt = _dev_args(ens)
+    rng = np.random.default_rng(9)
+    Xb = rng.integers(0, ens.n_bins, size=(800, ens.n_features))
+    want_np = ens.predict_raw(Xb.astype(np.uint8), binned=True)
+    onehot = np.asarray(jpred.predict_raw(
+        *args, jnp.asarray(Xb.astype(np.int32)), tree_chunk=4,
+        use_pallas=False, **kw, **opt))
+    pallas = np.asarray(jpp.predict_raw_pallas(
+        *args, jnp.asarray(Xb.astype(np.int32)), tree_chunk=4, **kw,
+        **opt))
+    np.testing.assert_array_equal(onehot, pallas)
+    np.testing.assert_allclose(pallas, want_np, rtol=2e-4, atol=2e-5)
+
+
+def test_pallas_trained_model_softmax_and_binary():
+    """Oracle-trained ensembles (not random trees) through the kernel:
+    the reference trainer's exact leaf layout, both losses."""
+    X, y = synthetic_binary(600, n_features=5, seed=7)
+    Xb, mapper = quantize(X, n_bins=32)
+    for loss_kw, C in [({}, 1),
+                       ({"loss": "softmax", "n_classes": 3}, 3)]:
+        yy = (y + (X[:, 0] > 0)).astype(np.int32) if C == 3 else y
+        cfg = TrainConfig(n_trees=5, max_depth=3, n_bins=32,
+                          backend="cpu", **loss_kw)
+        ens = oracle.fit(Xb, yy, cfg, mapper=mapper)
+        args, kw, opt = _dev_args(ens)
+        want = ens.predict_raw(Xb, binned=True)
+        onehot = np.asarray(jpred.predict_raw(
+            *args, jnp.asarray(Xb.astype(np.int32)), tree_chunk=4,
+            use_pallas=False, **kw, **opt))
+        pallas = np.asarray(jpp.predict_raw_pallas(
+            *args, jnp.asarray(Xb.astype(np.int32)), tree_chunk=4, **kw,
+            **opt))
+        np.testing.assert_array_equal(onehot, pallas)
+        np.testing.assert_allclose(pallas, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_rejects_float_data():
+    ens = _rand_ensemble()
+    args, kw, _ = _dev_args(ens)
+    X = np.random.default_rng(0).standard_normal(
+        (10, ens.n_features)).astype(np.float32)
+    with pytest.raises(ValueError, match="binned"):
+        jpred.predict_raw(*args, jnp.asarray(X), use_pallas=True, **kw)
+
+
+def test_pallas_fits_guard():
+    from ddt_tpu.ops.predict_pallas import predict_pallas_fits
+
+    assert predict_pallas_fits(1024, 64, 6, 28, 1)       # the bench shape
+    assert not predict_pallas_fits(1000, 64, 6, 28, 1)   # not a multiple
+    # monster shape blows the VMEM/trace budget
+    assert not predict_pallas_fits(1 << 20, 64, 10, 512, 1)
+
+
+# --------------------------------------------------------------------- #
+# CompiledEnsemble: host layout + device-resident cache
+# --------------------------------------------------------------------- #
+
+def test_compiled_ensemble_effective_arrays_match_traced():
+    """The host pushdown twin is bitwise-identical to the traced one —
+    the compiled path may never drift from predict_raw's prologue."""
+    ens = _rand_ensemble(T=6, depth=4, seed=3)
+    ce = CompiledEnsemble.build(ens, tree_chunk=4)
+    tpad = ce.n_trees_padded - ens.n_trees
+
+    def pad(a, fill=0):
+        return jnp.pad(jnp.asarray(a), ((0, tpad), (0, 0)),
+                       constant_values=fill)
+
+    ef, et, ev, _ = jpred._effective_arrays(
+        pad(ens.feature, -1), pad(ens.threshold_bin),
+        pad(ens.is_leaf, True), pad(ens.leaf_value), ens.max_depth)
+    np.testing.assert_array_equal(ce.eff_feat, np.asarray(ef))
+    np.testing.assert_array_equal(ce.eff_thr, np.asarray(et))
+    lo = (1 << ens.max_depth) - 1
+    np.testing.assert_array_equal(ce.bot_val, np.asarray(ev)[:, lo:])
+
+
+def test_backend_compiled_ensemble_cache_hits_and_invalidation():
+    """Repeat scoring hits the device-resident cache (counter moves);
+    mutating the model in place changes the token and serves fresh
+    trees — a cached compiled ensemble may never go stale."""
+    from ddt_tpu.telemetry import counters as tele_counters
+
+    Xb = np.random.default_rng(0).integers(
+        0, 31, size=(400, 6), dtype=np.uint8)
+    ens = _rand_ensemble(T=5, depth=3, F=6, bins=31, seed=11)
+    be = get_backend(TrainConfig(backend="tpu", n_bins=31))
+    c0 = tele_counters.snapshot()
+    a = be.predict_raw(ens, Xb)
+    b = be.predict_raw(ens, Xb)
+    np.testing.assert_array_equal(a, b)
+    assert tele_counters.delta(c0)["compiled_ensemble_cache_hits"] == 1
+    tok0 = ens.cache_token()
+    ens.leaf_value[:] += 1.0                      # in-place mutation
+    assert ens.cache_token() != tok0
+    c = be.predict_raw(ens, Xb)
+    assert not np.allclose(a, c)                  # fresh trees served
+    np.testing.assert_allclose(
+        c, ens.predict_raw(Xb, binned=True), rtol=2e-4, atol=2e-5)
+
+
+def test_backend_predict_impl_pallas_matches_onehot():
+    """cfg.predict_impl='pallas' forces the kernel through the whole
+    backend path (compiled cache + chunking) — same scores, bit-exact."""
+    Xb = np.random.default_rng(2).integers(
+        0, 31, size=(300, 5), dtype=np.uint8)
+    ens = _rand_ensemble(T=7, depth=3, F=5, bins=31, seed=2)
+    be_1h = get_backend(TrainConfig(backend="tpu", n_bins=31,
+                                    predict_impl="onehot"))
+    be_pl = get_backend(TrainConfig(backend="tpu", n_bins=31,
+                                    predict_impl="pallas"))
+    np.testing.assert_array_equal(be_1h.predict_raw(ens, Xb),
+                                  be_pl.predict_raw(ens, Xb))
+
+
+def test_predict_impl_flag_validation():
+    with pytest.raises(ValueError, match="predict_impl"):
+        TrainConfig(predict_impl="cuda")
+
+
+# --------------------------------------------------------------------- #
+# overlapped streaming + the multi-chip flag
+# --------------------------------------------------------------------- #
+
+def test_predict_streaming_matches_in_memory():
+    from ddt_tpu.streaming import predict_streaming
+
+    X, y = synthetic_binary(2000, n_features=6, seed=4)
+    Xb, _ = quantize(X, n_bins=31)
+    cfg = TrainConfig(n_trees=6, max_depth=3, n_bins=31, backend="tpu")
+    ens = api.train(Xb, y, cfg, binned=True, log_every=10**9).ensemble
+    be = get_backend(cfg)
+    want = be.predict_raw(ens, Xb)
+
+    def cf(c):                    # ragged last chunk: 600*3 + 200
+        return Xb[c * 600:(c + 1) * 600], None
+
+    got = predict_streaming(cf, 4, ens, backend=be)
+    np.testing.assert_array_equal(want, got)
+    # sink form streams per-chunk scores and returns the row count
+    parts = {}
+    rows = predict_streaming(cf, 4, ens, backend=be,
+                             sink=lambda c, s: parts.__setitem__(c, s))
+    assert rows == 2000
+    np.testing.assert_array_equal(
+        np.concatenate([parts[i] for i in range(4)]), want)
+    # host fallback (backend=None) agrees to scorer tolerance
+    host = predict_streaming(cf, 4, ens, backend=None)
+    np.testing.assert_allclose(host, want, rtol=2e-4, atol=2e-5)
+    # oversized chunks (past the backend's per-dispatch row bound) must
+    # route through the backend's own chunked path, not one big dispatch
+    # (the 10M x 1000 single-dispatch OOM class), and stay in order
+    from ddt_tpu.backends.tpu import TPUDevice
+
+    old = TPUDevice.PREDICT_ROW_CHUNK
+    TPUDevice.PREDICT_ROW_CHUNK = 256
+    try:
+        big = predict_streaming(cf, 4, ens, backend=be)
+    finally:
+        TPUDevice.PREDICT_ROW_CHUNK = old
+    np.testing.assert_array_equal(big, want)
+
+
+def test_api_predict_n_partitions_flag():
+    """Multi-chip scoring is a flag: api.predict(n_partitions=4) row-
+    shards over a parallel.mesh row mesh and matches the single-chip
+    path exactly (8 virtual CPU devices, conftest)."""
+    X, y = synthetic_binary(1500, n_features=6, seed=6)
+    Xb, _ = quantize(X, n_bins=31)
+    cfg = TrainConfig(n_trees=4, max_depth=3, n_bins=31, backend="tpu")
+    ens = api.train(Xb, y, cfg, binned=True, log_every=10**9).ensemble
+    want = api.predict(ens, Xb, binned=True, backend=get_backend(cfg),
+                       raw=True)
+    got = api.predict(ens, Xb, binned=True, n_partitions=4, raw=True)
+    np.testing.assert_array_equal(want, got)

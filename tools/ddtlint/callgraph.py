@@ -31,10 +31,14 @@ from dataclasses import dataclass, field
 
 JIT_NAMES = {"jit", "pjit"}
 # Combinators whose function-valued arguments are traced unconditionally.
+# pallas_call is one of them: the kernel body is traced (by Mosaic or the
+# interpreter), so Pallas kernels are jit-reachability roots — the
+# traced-branch rule covers them and the pallas-interpret rule can anchor
+# on their call sites.
 TRACING_COMBINATORS = {
     "fori_loop", "while_loop", "scan", "cond", "switch",
     "vmap", "pmap", "shard_map", "checkpoint", "remat", "custom_vjp",
-    "grad", "value_and_grad",
+    "grad", "value_and_grad", "pallas_call",
 }
 
 
@@ -84,6 +88,11 @@ class ModuleInfo:
     symbols: dict = field(default_factory=dict)      # alias -> (mod, name)
     roots: set = field(default_factory=set)          # qualnames
     _wrap_sites: list = field(default_factory=list)  # (scope, func_name)
+    #: `name = functools.partial(fn, ...)` bindings: (scope, name) -> the
+    #: partial's function-valued Name args. Wrap sites referencing such a
+    #: name root the underlying functions (the predict_pallas idiom:
+    #: kernel = partial(_traverse_kernel, ...); pl.pallas_call(kernel,...)).
+    _partial_aliases: dict = field(default_factory=dict)
 
 
 class _Collector(ast.NodeVisitor):
@@ -151,6 +160,22 @@ class _Collector(ast.NodeVisitor):
             self.visit(child)
         self.stack.pop()
 
+    # -- assignments ---------------------------------------------------- #
+    def visit_Assign(self, node: ast.Assign):
+        v = node.value
+        if isinstance(v, ast.Call):
+            f = dotted(v.func)
+            if f is not None and f.split(".")[-1] == "partial":
+                names = [a.id for a in list(v.args)
+                         + [k.value for k in v.keywords]
+                         if isinstance(a, ast.Name)]
+                if names:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.mod._partial_aliases[
+                                (self._scope(), t.id)] = names
+        self.generic_visit(node)
+
     # -- calls ---------------------------------------------------------- #
     def visit_Call(self, node: ast.Call):
         callee = dotted(node.func)
@@ -161,6 +186,18 @@ class _Collector(ast.NodeVisitor):
             for a in list(node.args) + [k.value for k in node.keywords]:
                 if isinstance(a, ast.Name):
                     self.mod._wrap_sites.append((self._scope(), a.id))
+                elif isinstance(a, ast.Call):
+                    # functools.partial(kernel, ...) — the idiomatic way
+                    # static parameters reach Pallas kernels (and scan/
+                    # fori bodies): the partial's function-valued args
+                    # are traced exactly like bare names.
+                    f = dotted(a.func)
+                    if f is not None and f.split(".")[-1] == "partial":
+                        for pa in list(a.args) + [k.value
+                                                  for k in a.keywords]:
+                            if isinstance(pa, ast.Name):
+                                self.mod._wrap_sites.append(
+                                    (self._scope(), pa.id))
         fn = self._cur_fn()
         if fn is not None and callee is not None:
             parts = callee.split(".")
@@ -199,10 +236,25 @@ def build(sources: dict[str, str]) -> dict[str, set[str]]:
         except SyntaxError:
             continue
         _Collector(mi).visit(tree)
+
+        def alias_targets(scope: str, name: str) -> list[str]:
+            """partial-alias expansion, looking outward from `scope`."""
+            parts = scope.split(".") if scope else []
+            for i in range(len(parts), -1, -1):
+                s = ".".join(parts[:i])
+                if (s, name) in mi._partial_aliases:
+                    return mi._partial_aliases[(s, name)]
+            return []
+
         for scope, name in mi._wrap_sites:
             qual = _resolve_scoped(mi, scope, name)
             if qual is not None:
                 mi.roots.add(qual)
+                continue
+            for fn_name in alias_targets(scope, name):
+                qual = _resolve_scoped(mi, scope, fn_name)
+                if qual is not None:
+                    mi.roots.add(qual)
         mods[modname] = mi
         by_path[path] = mi
 

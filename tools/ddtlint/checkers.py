@@ -444,6 +444,52 @@ class NoPrintChecker(Checker):
         self.generic_visit(node)
 
 
+# --------------------------------------------------------------------- #
+# 7. pallas-interpret
+# --------------------------------------------------------------------- #
+class PallasInterpretChecker(Checker):
+    """`pl.pallas_call` sites must carry a LIVE `interpret=` operand — a
+    variable the dispatcher resolves (the hist_pallas/predict_pallas
+    idiom: `interpret=None` auto-selects the Pallas interpreter off-TPU).
+    A call site with no interpret kwarg, or a hard `interpret=False`,
+    has no interpret-mode fallback path: the kernel cannot run on the
+    CPU tier-1 suite, so its logic ships untested and every later edit
+    is verified only on a real chip.  Pallas kernels are jit-reachability
+    roots (callgraph.TRACING_COMBINATORS includes pallas_call, bare or
+    partial()-wrapped), so the traced-branch rule already covers the
+    kernel BODY; this rule covers its DISPATCH."""
+
+    rule = "pallas-interpret"
+    path_scope = (r"^ddt_tpu/",)
+
+    def visit_Call(self, node: ast.Call):
+        d = callgraph.dotted(node.func)
+        if d is not None and d.split(".")[-1] == "pallas_call":
+            interp = None
+            has_kwarg = False
+            for k in node.keywords:
+                if k.arg == "interpret":
+                    has_kwarg = True
+                    interp = k.value
+            if not has_kwarg:
+                self.report(node, (
+                    "`pallas_call` without an `interpret=` operand — the "
+                    "kernel has no interpret-mode fallback path and "
+                    "cannot run on the CPU test suite; thread an "
+                    "`interpret` parameter through the dispatcher "
+                    "(None = auto-select off-TPU, the hist_pallas "
+                    "pattern)"))
+            elif isinstance(interp, ast.Constant) \
+                    and interp.value in (False, None):
+                self.report(node, (
+                    f"`pallas_call` hard-codes interpret="
+                    f"{interp.value!r} — the interpreter fallback is "
+                    "unreachable; pass a dispatcher-resolved variable "
+                    "(None = auto-select off-TPU, the hist_pallas "
+                    "pattern)"))
+        self.generic_visit(node)
+
+
 AST_CHECKERS = [
     TracedBranchChecker,
     HostSyncChecker,
@@ -451,6 +497,7 @@ AST_CHECKERS = [
     CollectiveAxisChecker,
     BroadExceptChecker,
     NoPrintChecker,
+    PallasInterpretChecker,
 ]
 
 
