@@ -29,7 +29,20 @@ test:
 report:
 	JAX_PLATFORMS=cpu $(PY) scripts/telemetry_smoke.py
 
+# Flight-recorder smoke (docs/OBSERVABILITY.md): 2-round 2-partition CPU
+# mesh train -> per-host log merge -> Perfetto trace export -> parse.
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/trace_smoke.py
+
+# Bench regression sentinel (docs/OBSERVABILITY.md): band every metric
+# of the newest BENCH_r*/MULTICHIP_r* artifact against the history
+# (median ± max(3*MAD, 20%)); exit 1 on an adverse excursion. Point a
+# fresh run at it with `python -m tools.benchwatch --current out.json`.
+benchwatch:
+	$(PY) -m tools.benchwatch
+
 native:
 	$(MAKE) -C ddt_tpu/native
 
-.PHONY: lint lint-baseline tsan-audit test report native
+.PHONY: lint lint-baseline tsan-audit test report trace-smoke benchwatch \
+	native

@@ -35,9 +35,30 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import uuid
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Artifact schema stamp (tools/benchwatch keys history on these instead
+# of filenames): bump when a metric's meaning — not just its value —
+# changes.
+BENCH_SCHEMA = 1
+
+
+def _git_rev() -> str | None:
+    """Short HEAD rev of the repo this bench ran from, or None outside a
+    work tree — provenance for the artifact, never a failure cause."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    rev = out.stdout.strip()
+    return rev if out.returncode == 0 and rev else None
 
 # Perf-regression floors (SURVEY.md §4). Histogram: the shipped Pallas
 # kernel measures 40-64 Mrows/s/chip across tunnel bands (individual
@@ -237,6 +258,12 @@ def main() -> None:
     # quote.
     rec = {
         "metric": "higgs1m_histogram_throughput",
+        # Provenance stamp (benchwatch satellite): a unique id per bench
+        # RUN, the artifact schema version, and the git rev the numbers
+        # were measured at — history keying that survives file renames.
+        "run_id": uuid.uuid4().hex[:12],
+        "bench_schema": BENCH_SCHEMA,
+        "git_rev": _git_rev(),
         "value": round(value, 2),
         "unit": "Mrows/s/chip",
         "vs_baseline": round(value / baseline, 2),

@@ -475,6 +475,21 @@ class TPUDevice(DeviceBackend):
 
         device_sync(x)
 
+    @property
+    def host_index(self) -> int:
+        """This process's index in the pod (0 single-process) — stamped
+        into run manifests so cross-host log merges (telemetry.merge)
+        can label lanes."""
+        return int(jax.process_index())
+
+    def partition_ready_ms(self, handle) -> "list | None":
+        """Per-device completion times of a dispatched output handle —
+        [(device_id, perf_counter time)], the flight recorder's probe
+        (telemetry.events.PartitionRecorder rides this; the probe is a
+        barrier on the handle, so it runs only on mesh runs WITH a run
+        log attached)."""
+        return mesh_lib.shard_ready_times(handle)
+
     # ------------------------------------------------------------------ #
     # fused multi-round training: a whole block of boosting rounds in ONE
     # device dispatch (lax.scan over rounds). Per-round dispatch economics

@@ -541,13 +541,26 @@ def main(argv: list[str] | None = None) -> int:
     rp = sub.add_parser("report",
                         help="render a run summary from a JSONL telemetry "
                              "log (train --run-log)")
-    rp.add_argument("--log", required=True,
-                    help="path to the run log written by train --run-log")
+    rp.add_argument("--log", required=True, action="append",
+                    help="path to the run log written by train --run-log; "
+                         "repeat for a multi-host run's per-host logs "
+                         "(merged by run id with manifest-estimated clock "
+                         "offsets — docs/OBSERVABILITY.md)")
     rp.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead of "
                          "the human rendering")
     rp.add_argument("--slowest", type=_positive_int, default=5,
                     help="how many slowest rounds to list")
+
+    xp = sub.add_parser("trace",
+                        help="export a run log as Chrome trace-event JSON "
+                             "(open in ui.perfetto.dev): round slices, "
+                             "per-partition lanes, instant markers")
+    xp.add_argument("--log", required=True, action="append",
+                    help="run-log JSONL path; repeat for per-host logs of "
+                         "one pod run (merged before export)")
+    xp.add_argument("--out", default="trace.json",
+                    help="output trace path (default trace.json)")
 
     ip = sub.add_parser("inspect", help="summarize a saved ensemble")
     ip.add_argument("--model", required=True)
@@ -714,10 +727,11 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.cmd == "report":
+        from ddt_tpu.telemetry import merge as tele_merge
         from ddt_tpu.telemetry import report as tele_report
 
         try:
-            events = tele_report.read_events(args.log)
+            events = tele_merge.merge_paths(args.log)
             summary = tele_report.summarize(events, slowest=args.slowest)
             out_text = (json.dumps(summary) if args.json
                         else tele_report.render(summary))
@@ -727,6 +741,21 @@ def main(argv: list[str] | None = None) -> int:
             # with the clean message, not a raw traceback.
             raise SystemExit(f"report: {e}") from e
         print(out_text)
+        return 0
+
+    if args.cmd == "trace":
+        from ddt_tpu.telemetry import merge as tele_merge
+        from ddt_tpu.telemetry import perfetto as tele_perfetto
+
+        try:
+            events = tele_merge.merge_paths(args.log)
+            n = tele_perfetto.write_trace(events, args.out)
+        except (OSError, ValueError, TypeError, KeyError) as e:
+            raise SystemExit(f"trace: {e}") from e
+        print(json.dumps({
+            "cmd": "trace", "logs": args.log, "events": len(events),
+            "trace_events": n, "out": args.out,
+        }))
         return 0
 
     if args.cmd == "bench":

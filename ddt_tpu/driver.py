@@ -60,6 +60,7 @@ SCORES):
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 
@@ -72,7 +73,8 @@ from ddt_tpu.reference.numpy_trainer import base_score
 from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry.annotations import phase_ctx
 from ddt_tpu.telemetry.events import (
-    RoundRecorder, RunLog, emit_early_stop, finish_run_log)
+    PartitionRecorder, RoundRecorder, RunLog, derive_run_id,
+    emit_early_stop, finish_run_log)
 from ddt_tpu.utils import checkpoint
 from ddt_tpu.utils.profiling import PhaseTimer
 
@@ -154,6 +156,7 @@ class Driver:
             PhaseTimer() if (profile or self.run_log is not None) else None
         )
         self._recorder: RoundRecorder | None = None
+        self._part_rec: PartitionRecorder | None = None
 
     def _draw_colsample_mask(self, rnd: int, c: int, F: int) -> np.ndarray:
         """The per-(seed, round, class) colsample feature mask; the draw
@@ -183,7 +186,8 @@ class Driver:
             self.timer.log_report(log)
         finish_run_log(self.run_log, self.timer, counters_start,
                        completed_rounds,
-                       round(time.perf_counter() - t0, 4))
+                       round(time.perf_counter() - t0, 4),
+                       partitions=self._part_rec)
 
     def fit(
         self,
@@ -269,7 +273,16 @@ class Driver:
                 n_bins=cfg.n_bins, rows=int(R), features=int(F),
                 n_classes=C, seed=cfg.seed,
                 distributed=bool(getattr(self.backend, "distributed",
-                                         False)))
+                                         False)),
+                # v2 extras: the cross-host merge key + lane label
+                # (telemetry.merge) — identical on every pod host by SPMD
+                # construction. The FULL config feeds the digest: two
+                # sweep points differing only in, say, learning_rate must
+                # refuse to merge, so no field may be left out.
+                run_id=derive_run_id(
+                    trainer="driver", rows=int(R), features=int(F),
+                    **dataclasses.asdict(cfg)),
+                host=int(getattr(self.backend, "host_index", 0)))
 
         data = self.backend.upload(Xb)
         y_dev = self.backend.upload_labels(np.asarray(y),
@@ -392,6 +405,13 @@ class Driver:
         if getattr(self.backend, "distributed", False):
             coll_bytes_round = C * tele_counters.hist_allreduce_bytes(
                 cfg.max_depth, F, cfg.n_bins)
+        # Per-partition attribution (the distributed flight recorder):
+        # active only on mesh runs WITH a run log — it probes per-device
+        # shard completion, which is a barrier on the observed handle.
+        # Single-device runs and disabled telemetry get the inert
+        # recorder (no probes, no syncs — the PR-2 invariant).
+        self._part_rec = part_rec = PartitionRecorder(
+            self.run_log, self.backend, bytes_per_round=coll_bytes_round)
 
         def _store(handle, slot):
             with ph("fetch_tree"):
@@ -478,10 +498,15 @@ class Driver:
                     self._draw_colsample_mask(rnd, c, F) if colsample
                     else None
                 )
+                tg0 = time.perf_counter()
                 with ph("grow"):
                     handle, delta = self.backend.grow_tree(
                         data, gc, hc, feature_mask=fmask)
                     self._psync(delta)
+                # Flight recorder: per-device completion of this tree's
+                # growth (hist + allreduce + gain + route). No-op unless
+                # distributed AND a run log is attached.
+                part_rec.observe("grow", handle, tg0)
                 with ph("apply_delta"):
                     pred = self.backend.apply_delta(pred, delta, c)
                     self._psync(pred)
@@ -540,6 +565,7 @@ class Driver:
             self._recorder.record(
                 rnd, dt * 1e3, val_score,
                 lambda: self.backend.loss_value(pred, y_dev))
+            part_rec.flush_round(rnd)
 
             if early_stopping_rounds is not None and self.best_round is None:
                 # NaN never compares greater, so a NaN-from-round-1 metric
@@ -646,12 +672,21 @@ class Driver:
                 else:
                     trees_h, pred, losses_h = self.backend.grow_rounds(
                         data, pred, y_dev, K, first_round=rnd)
+            # Flight recorder: per-device completion of the whole block
+            # (one lane sample per device per block; the probe is the
+            # block barrier, so the fetch below materialises already-done
+            # transfers). Inert unless distributed + run log.
+            part_rec = self._part_rec
+            if part_rec is not None:
+                part_rec.observe("grow_block", trees_h, t0)
             with ph("fetch_tree"):
                 if eval_state is not None:
                     scores = np.asarray(scores_h)  # [K] — same fetch wave
                 trees = np.asarray(trees_h)     # [K, C, 5, N] — ONE fetch
                 losses = np.asarray(losses_h)
             dt = time.perf_counter() - t0
+            if part_rec is not None:
+                part_rec.flush_round(rnd, n_rounds=K)
             tele_counters.record_d2h(trees.nbytes + losses.nbytes)
             if coll_bytes_round:
                 tele_counters.record_collective(coll_bytes_round * K)

@@ -16,6 +16,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ddt_tpu.telemetry.annotations import op_scope
+
 
 def base_score(y: jax.Array, loss: str) -> jax.Array:
     """Raw-score init: log-odds for logloss, mean for mse, 0 for softmax."""
@@ -27,6 +29,7 @@ def base_score(y: jax.Array, loss: str) -> jax.Array:
     return jnp.float32(0.0)
 
 
+@op_scope("loss")
 def mean_loss(
     pred_raw: jax.Array,
     y: jax.Array,
@@ -60,6 +63,7 @@ def mean_loss(
     raise ValueError(loss)
 
 
+@op_scope("grad")
 def grad_hess(
     pred_raw: jax.Array, y: jax.Array, loss: str
 ) -> tuple[jax.Array, jax.Array]:

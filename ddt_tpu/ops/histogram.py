@@ -37,6 +37,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ddt_tpu.telemetry.annotations import op_scope
+
 
 def _mask_inactive(
     g: jax.Array, h: jax.Array, node_index: jax.Array
@@ -54,6 +56,7 @@ def _mask_inactive(
 # --------------------------------------------------------------------------- #
 
 @functools.partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
+@op_scope("hist")
 def build_histograms_segment(
     Xb: jax.Array,          # uint8 [R, F]
     g: jax.Array,           # float32 [R]
@@ -133,6 +136,7 @@ def _hist_chunk_matmul(
     jax.jit,
     static_argnames=("n_nodes", "n_bins", "row_chunk", "input_dtype"),
 )
+@op_scope("hist")
 def build_histograms_matmul(
     Xb: jax.Array,          # uint8 [R, F]
     g: jax.Array,

@@ -43,7 +43,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ddt_tpu.telemetry.annotations import traced_scope
+from ddt_tpu.telemetry.annotations import op_scope, traced_scope
 
 _DEFAULT_ROW_CHUNK = 65_536
 
@@ -403,6 +403,7 @@ def predict_raw_effective(
     static_argnames=("max_depth", "n_classes", "tree_chunk", "row_chunk",
                      "missing_bin_value", "use_pallas"),
 )
+@op_scope("predict")
 def predict_raw(
     feature: jax.Array,        # int32 [T, N]
     thr: jax.Array,            # [T, N]

@@ -57,7 +57,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ddt_tpu.telemetry.annotations import traced_scope
+from ddt_tpu.telemetry.annotations import op_scope, traced_scope
 
 # VMEM ceiling for auto-dispatch: the per-chunk [TILE_R, Nint*Tc] colval
 # (bf16) + comparison bits + the resident tree tables + Mosaic's
@@ -298,6 +298,7 @@ def predict_effective_pallas(
     static_argnames=("max_depth", "n_classes", "tree_chunk",
                      "missing_bin_value", "tile_r", "interpret"),
 )
+@op_scope("predict")
 def predict_raw_pallas(
     feature: jax.Array,        # int32 [T, N]
     thr: jax.Array,            # [T, N] int32 bins

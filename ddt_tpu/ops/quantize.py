@@ -26,10 +26,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ddt_tpu.telemetry.annotations import op_scope
+
 
 @functools.partial(
     jax.jit, static_argnames=("n_bins", "missing_bin", "row_block")
 )
+@op_scope("quantize")
 def transform_binned(
     X: jax.Array,           # float32 [R, F] raw features (NaN allowed)
     edges: jax.Array,       # float32 [F, n_bins - 1] (trailing cols +inf)

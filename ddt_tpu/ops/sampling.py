@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ddt_tpu.telemetry.annotations import op_scope
+
 _M1 = 0x7FEB352D
 _M2 = 0x846CA68B
 _GOLD = 0x9E3779B9
@@ -83,6 +85,7 @@ def row_keep_np(seed: int, rnd: int, row_start: int, n: int,
     return u < np.float32(subsample)
 
 
+@op_scope("sample")
 def row_keep_jax(rnd, local_offset, n: int, *, seed: int,
                  subsample: float, row_start_lo=None, row_start_hi=None):
     """f32 [n] 0/1 keep mask, traceable under jit/shard_map — the device

@@ -17,7 +17,10 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ddt_tpu.telemetry.annotations import op_scope
 
+
+@op_scope("cat_vec")
 def cat_feature_vec(cat_features, n_features: int) -> "jax.Array | None":
     """bool [n_features] mask of one-vs-rest (categorical) columns, or
     None when there are none — the single home of the cat_features →
@@ -39,6 +42,7 @@ def node_totals(hist: jax.Array) -> tuple[jax.Array, jax.Array]:
     jax.jit, static_argnames=("reg_lambda", "min_child_weight",
                               "missing_bin")
 )
+@op_scope("gain")
 def best_splits(
     hist: jax.Array,            # float32 [n_nodes, F, B, 2]
     reg_lambda: float,

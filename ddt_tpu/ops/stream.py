@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from ddt_tpu.ops import grad as grad_ops
 from ddt_tpu.ops import histogram as H
+from ddt_tpu.telemetry.annotations import op_scope
 
 
 def partial_node_index(
@@ -115,6 +116,7 @@ def chunk_grads(
     return g * v, h * v
 
 
+@op_scope("hist")
 def stream_level_hist(
     Xb: jax.Array,            # uint8 [R, F] chunk
     pred: jax.Array,
@@ -156,6 +158,7 @@ def stream_level_hist(
     return out
 
 
+@op_scope("leaf")
 def stream_leaf_gh(
     Xb: jax.Array,
     pred: jax.Array,
@@ -201,6 +204,7 @@ def stream_leaf_gh(
     return GH
 
 
+@op_scope("route")
 def apply_tree_pred(
     Xb: jax.Array,
     pred: jax.Array,
@@ -288,6 +292,7 @@ def apply_tree_pred(
     return pred + learning_rate * dv
 
 
+@op_scope("roundstart")
 def stream_round_start(
     Xb: jax.Array,
     pred: jax.Array,
@@ -335,6 +340,7 @@ def stream_round_start(
     return pred, out
 
 
+@op_scope("route")
 def stream_update_pred(
     Xb: jax.Array,
     pred: jax.Array,

@@ -114,6 +114,45 @@ def make_pod_mesh(
     )
 
 
+def shard_ready_times(arr, poll_interval_s: float = 5e-5,
+                      timeout_s: float = 600.0) -> "list | None":
+    """Per-device completion times of `arr`'s addressable shards:
+    [(device_id, time.perf_counter() at readiness)], device-id sorted.
+
+    The flight recorder's probe (telemetry.events.PartitionRecorder):
+    polling each shard's is_ready() records every device's completion
+    moment independently — the per-partition wall-time signal a single
+    block_until_ready collapses into one number. Where the runtime
+    exposes no is_ready (old jax array wrappers), falls back to blocking
+    shard-by-shard in device order, which keeps the MAX (the straggler)
+    exact while flattening earlier lanes onto the running prefix-max —
+    documented bias, not silent error. Returns None for values with no
+    shard view (host arrays). Only meaningful to call on a handle whose
+    producer has been dispatched; the probe IS a barrier on the array."""
+    import time as _time
+
+    try:
+        shards = arr.addressable_shards
+    except AttributeError:
+        return None
+    pending = {int(s.device.id): s.data for s in shards}
+    out: dict[int, float] = {}
+    can_poll = all(hasattr(d, "is_ready") for d in pending.values())
+    if can_poll:
+        deadline = _time.perf_counter() + timeout_s
+        while pending and _time.perf_counter() < deadline:
+            for dev in list(pending):
+                if pending[dev].is_ready():
+                    out[dev] = _time.perf_counter()
+                    del pending[dev]
+            if pending:
+                _time.sleep(poll_interval_s)
+    for dev in sorted(pending):              # fallback / timeout residue
+        pending[dev].block_until_ready()
+        out[dev] = _time.perf_counter()
+    return sorted(out.items())
+
+
 # Args of the successful initialize_multihost call, for the idempotence
 # guard below (None = never initialised in this process).
 _init_args: dict | None = None

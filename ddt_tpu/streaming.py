@@ -31,6 +31,7 @@ chunk's histogram psum rides ICI (SURVEY.md §7 M6).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 from typing import Callable
@@ -43,7 +44,8 @@ from ddt_tpu.reference.numpy_trainer import grad_hess
 from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry.annotations import phase_ctx
 from ddt_tpu.telemetry.events import (
-    RoundRecorder, RunLog, emit_early_stop, finish_run_log)
+    PartitionRecorder, RoundRecorder, RunLog, derive_run_id,
+    emit_early_stop, finish_run_log)
 from ddt_tpu.utils import checkpoint
 from ddt_tpu.utils.profiling import PhaseTimer
 
@@ -546,15 +548,33 @@ def _fit_streaming_impl(
         cat_features=cfg.cat_features,
     )
 
+    trainer_name = "streaming_device" if device else "streaming_host"
     if run_log is not None:
         run_log.emit(
             "run_manifest",
-            trainer="streaming_device" if device else "streaming_host",
+            trainer=trainer_name,
             backend=getattr(backend, "name", "unknown"), loss=cfg.loss,
             n_trees=cfg.n_trees, max_depth=cfg.max_depth,
             n_bins=cfg.n_bins, rows=int(y_cnt), features=int(F),
             n_classes=C, seed=cfg.seed, n_chunks=n_chunks,
-            distributed=bool(getattr(backend, "distributed", False)))
+            distributed=bool(getattr(backend, "distributed", False)),
+            # v2 extras (telemetry.merge): deterministic across pod
+            # hosts; the FULL config feeds the digest so sweep points
+            # differing in any field refuse to merge.
+            run_id=derive_run_id(
+                trainer=trainer_name, rows=int(y_cnt), features=int(F),
+                n_chunks=n_chunks, **dataclasses.asdict(cfg)),
+            host=int(getattr(backend, "host_index", 0)))
+
+    # Per-partition attribution for mesh runs (inert otherwise — the
+    # recorder only probes when distributed AND a run log is attached;
+    # the streamed estimate is per chunk-pass, n_chunks allreduces/round).
+    part_rec = PartitionRecorder(
+        run_log, backend,
+        bytes_per_round=(
+            C * n_chunks * tele_counters.hist_allreduce_bytes(
+                cfg.max_depth, int(F), cfg.n_bins)
+            if getattr(backend, "distributed", False) else 0))
 
     def _finish(e: TreeEnsemble) -> TreeEnsemble:
         """Telemetry epilogue — every fit_streaming return funnels
@@ -565,7 +585,8 @@ def _fit_streaming_impl(
         if profile and timer is not None:
             timer.log_report(log)
         finish_run_log(run_log, timer, counters_start, e.n_trees // C,
-                       round(time.perf_counter() - t_fit0, 4))
+                       round(time.perf_counter() - t_fit0, 4),
+                       partitions=part_rec)
         return e
 
     # Checkpoint/resume (SURVEY.md §5) — the streamed runs are the LONGEST
@@ -606,7 +627,7 @@ def _fit_streaming_impl(
             start_round=start_round, checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every, ev=ev,
             device_chunk_cache=device_chunk_cache,
-            ph=ph, run_log=run_log))
+            ph=ph, run_log=run_log, part_rec=part_rec))
 
     # The ONE optional O(R·C) structure: per-chunk cached raw scores (4C
     # bytes/row). cache_preds=False recomputes scores from the partial
@@ -828,6 +849,7 @@ def _fit_streaming_device(
     device_chunk_cache: "bool | int" = True,
     ph=None,
     run_log: "RunLog | None" = None,
+    part_rec: "PartitionRecorder | None" = None,
 ) -> TreeEnsemble:
     """Device streaming loop: see fit_streaming. Per tree it makes
     max_depth histogram passes + 1 leaf pass (+ 1 pred-update pass between
@@ -839,6 +861,8 @@ def _fit_streaming_device(
     buffering via JAX's async dispatch)."""
     if ph is None:
         ph = phase_ctx(None)
+    if part_rec is None:
+        part_rec = PartitionRecorder(None, backend)      # inert
     if device_chunk_cache is True:
         # Platform guard (see fit_streaming's docstring): on the CPU
         # platform the device buffers ARE host RAM — a default-on cache
@@ -902,6 +926,7 @@ def _fit_streaming_device(
         with the next read/upload already in flight."""
         data = chunks.get(0)
         for c in range(n_chunks):
+            tc0 = time.perf_counter()
             if kind == "hist":
                 out = backend.stream_level_hist(
                     data, pred_dev[c], y_dev[c], tree, depth, class_idx,
@@ -912,6 +937,11 @@ def _fit_streaming_device(
                     rnd=rnd, row_start=int(chunk_starts[c]))
             if c + 1 < n_chunks:        # prefetch: overlap H2D with compute
                 data = chunks.get(c + 1)
+            # Flight recorder: per-device completion of this chunk's pass
+            # — AFTER the prefetch enqueue so the probe barrier rides
+            # under the next chunk's H2D; the asarray below was already
+            # a sync, so active-recorder cost is the probe bookkeeping.
+            part_rec.observe(kind, out, tc0)
             yield np.asarray(out)       # fetch (device likely done by now)
 
     t_out = start_round * C
@@ -959,11 +989,13 @@ def _fit_streaming_device(
                         # bagging mask) in one dispatch per chunk.
                         data = chunks.get(0)
                         for c in range(n_chunks):
+                            tc0 = time.perf_counter()
                             pred_dev[c], out = backend.stream_round_start(
                                 data, pred_dev[c], y_dev[c], prev_trees,
                                 rnd=rnd, row_start=int(chunk_starts[c]))
                             if c + 1 < n_chunks:
                                 data = chunks.get(c + 1)
+                            part_rec.observe("roundstart", out, tc0)
                             part = np.asarray(out)
                             hist = part if hist is None else hist + part
                     else:
@@ -1017,6 +1049,7 @@ def _fit_streaming_device(
                 stop = ev.record(rnd, np.concatenate(scores))
         _emit_round(run_log, rnd, (time.perf_counter() - t_round) * 1e3,
                     ev)
+        part_rec.flush_round(rnd)
         if stop:
             log.info(
                 "streaming: early stop at round %d (best %s=%.6f at "

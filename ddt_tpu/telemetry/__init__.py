@@ -20,12 +20,28 @@ pay at most a handful of host integer adds):
              timelines the SAME `ddt:<phase>` names, so a trace captured
              with --trace-dir aligns with the run log's phase breakdown.
 
+Since the distributed flight recorder (schema v2) two more consumers sit
+on the same stream:
+
+- merge    — joins N per-host JSONL logs of one pod run into a single
+             host-0-clock timeline (run_id join key, manifest-estimated
+             clock offsets).
+- perfetto — converts a (possibly merged) log into Chrome trace-event
+             JSON loadable in ui.perfetto.dev: round slices, per-device
+             partition lanes, instant markers.
+
+And mesh runs with a run log attached additionally record per-partition
+phase completion times (`partition_phases` per round, a `partition_skew`
+straggler reduction at run end — events.PartitionRecorder).
+
 `report` renders a run summary from a JSONL log (`python -m ddt_tpu.cli
-report --log run.jsonl`); docs/OBSERVABILITY.md documents the schema and
+report --log run.jsonl`, repeat --log to merge hosts); `trace` exports
+the Perfetto JSON; docs/OBSERVABILITY.md documents the schema and
 workflow.
 """
 
 from ddt_tpu.telemetry.events import (  # noqa: F401
-    EVENT_FIELDS, SCHEMA_VERSION, RoundRecorder, RunLog, validate_event)
+    EVENT_FIELDS, SCHEMA_VERSION, PartitionRecorder, RoundRecorder,
+    RunLog, derive_run_id, partition_skew_summary, validate_event)
 from ddt_tpu.telemetry import counters  # noqa: F401
 from ddt_tpu.telemetry.annotations import phase_span  # noqa: F401

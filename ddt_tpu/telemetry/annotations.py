@@ -20,6 +20,7 @@ Driver skips the context) when telemetry is off.
 from __future__ import annotations
 
 import contextlib
+import functools
 
 try:
     import jax
@@ -43,6 +44,24 @@ def traced_scope(name: str):
     if jax is None:
         return contextlib.nullcontext()
     return jax.named_scope(PREFIX + name)
+
+
+def op_scope(name: str):
+    """Whole-function traced_scope as a decorator — the canonical fix for
+    ddtlint's `named-scope` rule on op ENTRY POINTS whose entire body is
+    one pipeline stage (a `with` block would just re-indent the full
+    function). Composes under jit: place it BELOW the @jit/@partial(jax.
+    jit, ...) decorator; functools.wraps preserves the signature, so
+    static_argnames keep resolving. Trace-time-only indirection — the
+    lowered HLO carries `ddt:<name>` metadata and the runtime never sees
+    the wrapper again after compilation."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with traced_scope(name):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
 
 
 def phase_ctx(timer):

@@ -119,6 +119,23 @@ def device_peak_bytes() -> int | None:
     return None
 
 
+def host_peak_rss_bytes() -> int | None:
+    """Peak HOST resident-set size of this process (resource.getrusage
+    ru_maxrss), or None where the resource module is unavailable
+    (non-POSIX). The host-side twin of device_peak_bytes: the streaming
+    trainers' O(chunk) host contract and the predict sink's bounded
+    residency are claims about THIS number, so the run log records it
+    next to the device high-water mark. Linux reports ru_maxrss in KiB,
+    macOS in bytes — normalised to bytes here."""
+    try:
+        import resource
+        import sys
+    except ImportError:
+        return None
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return int(ru) if sys.platform == "darwin" else int(ru) * 1024
+
+
 def hist_allreduce_bytes(max_depth: int, n_features: int,
                          n_bins: int) -> int:
     """Estimated allreduce payload for ONE tree's histogram phases: the

@@ -16,22 +16,40 @@ discarding the run.
 from __future__ import annotations
 
 import collections
+import hashlib
 import json
 import time
 
-SCHEMA_VERSION = 1
+# v2 (the distributed flight recorder): adds the per-partition events
+# `partition_phases` / `partition_skew` and the `run_id` / `host`
+# manifest extras the cross-host merge keys on. v1 logs remain readable
+# (no required field of an existing event changed).
+SCHEMA_VERSION = 2
 
 #: event type -> REQUIRED payload fields (extras are allowed and common:
 #: e.g. `round` records carry `valid_<metric>` keys named by the run's
 #: metric, and nullable fields like train_loss simply hold null).
 EVENT_FIELDS: dict[str, set] = {
     # One per run, first record: what trained, on what, from where.
+    # Since schema v2 manifests also carry `run_id` (deterministic config
+    # digest, identical on every host of a pod run — the cross-host merge
+    # key) and `host` (jax.process_index) as extras.
     "run_manifest": {"trainer", "backend", "loss", "n_trees", "max_depth",
                      "rows", "features"},
     # One per boosting round (the Driver.history record, as an event).
     "round": {"round", "ms_per_round"},
     # PhaseTimer.as_json() embedded verbatim under "phases".
     "phase_timings": {"phases"},
+    # Per-partition attribution for ONE round (or fused block) of a mesh
+    # run: `partitions` is [{device, phases: {name: ms}, rows?,
+    # hist_allreduce_bytes}] — per-device completion wall times observed
+    # by the host-side shard probe (PartitionRecorder).
+    "partition_phases": {"round", "partitions"},
+    # End-of-run straggler reduction over the partition_phases stream:
+    # `phases` is [{phase, ms_max, ms_median, skew, max_device}]
+    # (partition_skew_summary's exact output — tests recompute it
+    # offline from the partition_phases events and compare).
+    "partition_skew": {"phases"},
     # The early-stopping decision, when one fires.
     "early_stop": {"round", "best_round", "best_score", "metric"},
     # Fault/recovery events (today: checkpoint resume after a death).
@@ -136,11 +154,13 @@ def emit_early_stop(run_log: "RunLog | None", stop_round: int, metric,
 
 
 def finish_run_log(run_log: "RunLog | None", timer, counters_start,
-                   completed_rounds: int, wallclock_s: float) -> None:
-    """Run-log epilogue — phase_timings + counters + run_end — shared by
-    Driver._finish_run and fit_streaming's _finish so the trainers'
-    terminal records cannot drift. `timer` is a PhaseTimer or None;
-    `counters_start` a telemetry.counters.snapshot() (or None). Closing
+                   completed_rounds: int, wallclock_s: float,
+                   partitions: "PartitionRecorder | None" = None) -> None:
+    """Run-log epilogue — [partition_skew +] phase_timings + counters +
+    run_end — shared by Driver._finish_run and fit_streaming's _finish so
+    the trainers' terminal records cannot drift. `timer` is a PhaseTimer
+    or None; `counters_start` a telemetry.counters.snapshot() (or None);
+    `partitions` the mesh run's PartitionRecorder (or None). Closing
     path-owned logs is the trainers' ownership shims' job (Driver.fit /
     fit_streaming), which also covers the exception paths this helper
     never sees."""
@@ -148,13 +168,152 @@ def finish_run_log(run_log: "RunLog | None", timer, counters_start,
         return
     from ddt_tpu.telemetry import counters as tele_counters
 
+    if partitions is not None:
+        partitions.emit_skew()
     if timer is not None and timer.totals:
         run_log.emit("phase_timings", phases=timer.as_json())
     d = tele_counters.delta(counters_start or {})
     d["device_peak_bytes"] = tele_counters.device_peak_bytes()
+    d["host_peak_rss_bytes"] = tele_counters.host_peak_rss_bytes()
     run_log.emit("counters", **d)
     run_log.emit("run_end", completed_rounds=completed_rounds,
                  wallclock_s=wallclock_s)
+
+
+def derive_run_id(**fields) -> str:
+    """Deterministic 12-hex run id from the run's config facts. Every
+    host of a multi-host run derives the IDENTICAL id from its (identical
+    by SPMD construction) config — the key telemetry.merge joins per-host
+    logs on. Same config rerun -> same id; the merge additionally keys on
+    file identity, so that is a feature (retry logs join), not a
+    collision."""
+    blob = json.dumps(fields, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def partition_skew_summary(totals: dict) -> list[dict]:
+    """Host-side straggler reduction: {lane: {phase: ms}} accumulated
+    per-lane phase wall times -> [{phase, ms_max, ms_median, skew,
+    max_device}], phases sorted by ms_max descending. A lane key is a
+    device id (single-host collection) or a (host, device) tuple (the
+    report's cross-host recompute — the record then also carries
+    max_host). `skew` is max/median (1.0 = perfectly balanced); the ONE
+    reduction home — PartitionRecorder emits it and the tests recompute
+    it offline from the partition_phases events, so the two cannot
+    drift."""
+    phases: dict[str, dict] = {}
+    for lane, per_phase in totals.items():
+        for name, ms in per_phase.items():
+            # one value per (lane, phase) by construction — assign
+            phases.setdefault(name, {})[lane] = ms
+    out = []
+    for name, by_lane in phases.items():
+        vals = sorted(by_lane.values())
+        n = len(vals)
+        median = (vals[n // 2] if n % 2 else
+                  (vals[n // 2 - 1] + vals[n // 2]) / 2.0)
+        # max over sorted keys -> the SMALLEST lane wins exact ties
+        # (deterministic for int and tuple keys alike)
+        max_lane = max(sorted(by_lane), key=lambda k: by_lane[k])
+        ms_max = by_lane[max_lane]
+        rec = {
+            "phase": name,
+            "ms_max": round(ms_max, 3),
+            "ms_median": round(median, 3),
+            "skew": round(ms_max / median, 3) if median > 0 else None,
+        }
+        if isinstance(max_lane, tuple):
+            rec["max_host"] = int(max_lane[0])
+            rec["max_device"] = int(max_lane[1])
+        else:
+            rec["max_device"] = int(max_lane)
+        out.append(rec)
+    out.sort(key=lambda r: -r["ms_max"])
+    return out
+
+
+class PartitionRecorder:
+    """Per-partition phase attribution for mesh runs (the distributed
+    flight recorder's collection half).
+
+    Protocol: at an instrumented phase boundary the trainer hands the
+    phase's device OUTPUT handle plus the phase's host start time to
+    observe(); the backend's shard probe (TPUDevice.partition_ready_ms,
+    riding parallel.mesh.shard_ready_times) reports, per addressable
+    device, the host-clock moment that device's shard of the output
+    completed. The per-device wall time is that completion offset — the
+    honest host-observable per-partition signal: inside a psum'd program
+    every shard completes only after the collective, so what this
+    measures is COMPLETION skew (a straggling partition delays its own
+    shard's availability and shows up as the max lane).
+
+    Cost: one device barrier per observed phase — paid ONLY on
+    distributed runs with a run log attached. Single-device runs,
+    host backends, and disabled telemetry construct an inactive recorder
+    whose observe()/flush_round() are attribute checks (no probe, no
+    sync, no allocation) — the PR-2 zero-overhead invariant, extended
+    (tests/test_telemetry.py guard).
+
+    Emits one `partition_phases` event per flushed round (per fused
+    block on the fused path, with the block's first round and a
+    `rounds` extra) and, via emit_skew() at run end, one
+    `partition_skew` event reducing the whole run
+    (partition_skew_summary)."""
+
+    def __init__(self, run_log: "RunLog | None", backend,
+                 bytes_per_round: int = 0):
+        probe = getattr(backend, "partition_ready_ms", None)
+        self.active = (run_log is not None and probe is not None
+                       and bool(getattr(backend, "distributed", False)))
+        self.run_log = run_log
+        self._probe = probe
+        self.bytes_per_round = int(bytes_per_round)
+        # device -> phase -> ms, current round / whole run
+        self._round: dict[int, dict[str, float]] = {}
+        self._totals: dict[int, dict[str, float]] = {}
+
+    def observe(self, phase: str, handle, t0: float) -> None:
+        """Record the per-device wall time of one phase from its output
+        handle (`t0` = the phase's host start, time.perf_counter())."""
+        if not self.active:
+            return
+        ready = self._probe(handle)
+        if not ready:
+            return
+        for dev, t_ready in ready:
+            ms = max(0.0, (t_ready - t0) * 1e3)
+            self._round.setdefault(dev, {})
+            self._round[dev][phase] = self._round[dev].get(phase, 0.0) + ms
+
+    def flush_round(self, rnd: int, n_rounds: int = 1) -> None:
+        """Emit the round's partition_phases event (rnd is 0-based here;
+        the event carries the 1-based round like every other record).
+        `n_rounds` > 1 on the fused path: the event covers a whole
+        block."""
+        if not self.active or not self._round:
+            return
+        parts = []
+        for dev in sorted(self._round):
+            phases = {k: round(v, 3) for k, v in self._round[dev].items()}
+            parts.append({
+                "device": int(dev), "phases": phases,
+                "hist_allreduce_bytes": self.bytes_per_round * n_rounds,
+            })
+            tot = self._totals.setdefault(dev, {})
+            for k, v in self._round[dev].items():
+                tot[k] = tot.get(k, 0.0) + v
+        self.run_log.emit("partition_phases", round=rnd + 1,
+                          rounds=n_rounds, partitions=parts)
+        self._round = {}
+
+    def emit_skew(self) -> None:
+        """End-of-run partition_skew event (finish_run_log calls this
+        before the terminal phase_timings/counters/run_end triplet)."""
+        if not self.active or not self._totals:
+            return
+        self.run_log.emit(
+            "partition_skew", phases=partition_skew_summary(self._totals),
+            n_partitions=len(self._totals))
 
 
 class RoundRecorder:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import json
 
-from ddt_tpu.telemetry.events import validate_event
+from ddt_tpu.telemetry.events import partition_skew_summary, validate_event
 
 
 def read_events(path: str) -> list[dict]:
@@ -51,6 +51,21 @@ def _metric_key(rec: dict) -> str | None:
     return None
 
 
+def _cross_host_totals(part_ev: list[dict]) -> dict:
+    """{(host, device): {phase: ms}} accumulated over every host's
+    partition_phases stream — the merged-log straggler recompute's
+    input (device ids are lane-local per host's probe, so the composite
+    key keeps hosts' lanes distinct even if ids collide)."""
+    totals: dict = {}
+    for e in part_ev:
+        h = e.get("host", 0)
+        for part in e["partitions"]:
+            lane = totals.setdefault((h, part["device"]), {})
+            for name, ms in part["phases"].items():
+                lane[name] = lane.get(name, 0.0) + ms
+    return totals
+
+
 def summarize(events: list[dict], slowest: int = 5) -> dict:
     """Aggregate a run log into the report dict (see render for the
     shape as prose)."""
@@ -58,16 +73,45 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
     # restart re-runs the command into the same file; each fit emits its
     # own manifest). Report the LAST segment — the run that completed —
     # and surface the segment count so earlier attempts stay visible.
-    n_runs = sum(1 for e in events if e["event"] == "run_manifest")
-    for i in range(len(events) - 1, -1, -1):
-        if events[i]["event"] == "run_manifest":
-            events = events[i:]
-            break
+    # A cross-host MERGE (telemetry.merge) holds one manifest per host
+    # for the SAME run: manifests sharing a run_id (v2) count as ONE
+    # segment, and the report covers every host's events of that run.
+    manifests = [e for e in events if e["event"] == "run_manifest"]
+    hosts = sorted({m.get("host", 0) for m in manifests}) or [0]
+    # Segment grouping: consecutive manifests join the current segment
+    # only when they share its run_id AND come from a host not yet in it
+    # (a restart re-derives the same config-deterministic run_id on the
+    # same host — that is a new segment, not a new lane).
+    segments: list[dict] = []          # {"first": manifest, "hosts": set}
+    for m in manifests:
+        rid = m.get("run_id")
+        h = m.get("host", 0)
+        cur = segments[-1] if segments else None
+        if (cur is not None and rid is not None
+                and cur["first"].get("run_id") == rid
+                and h not in cur["hosts"]):
+            cur["hosts"].add(h)
+        else:
+            segments.append({"first": m, "hosts": {h}})
+    n_runs = len(segments)
+    if segments:
+        anchor = segments[-1]["first"]
+        first = next(i for i, e in enumerate(events) if e is anchor)
+        events = events[first:]
+        hosts = sorted(segments[-1]["hosts"])   # the REPORTED segment's
 
     manifest = next((e for e in events if e["event"] == "run_manifest"), {})
     rounds = [e for e in events if e["event"] == "round"]
+    if len(hosts) > 1:
+        # Merged pod logs: every host emitted its own (SPMD-identical)
+        # round records — report one lane's curve, not N copies.
+        rounds = [r for r in rounds if r.get("host", 0) == hosts[0]]
     phase_ev = [e for e in events if e["event"] == "phase_timings"]
     counter_ev = [e for e in events if e["event"] == "counters"]
+    part_ev = [e for e in events if e["event"] == "partition_phases"]
+    skew_ev = [e for e in events if e["event"] == "partition_skew"]
+    cross_totals = (_cross_host_totals(part_ev)
+                    if len(hosts) > 1 and part_ev else None)
     run_end = next((e for e in events if e["event"] == "run_end"), None)
 
     metric_curve = []
@@ -100,6 +144,26 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
         "slowest_rounds": [
             {"round": r["round"], "ms_per_round": r["ms_per_round"]}
             for r in timed[:slowest]],
+        "hosts": hosts,
+        # Straggler view (distributed flight recorder): the run's
+        # partition_skew reduction + how many rounds carried per-device
+        # lanes (fused blocks cover `rounds` rounds per event; merged
+        # logs count one host's stream, like the round curve above —
+        # single logs are never host-filtered: a lone pod host's events
+        # carry no host field). A single host's own skew event is used
+        # verbatim (exact, as emitted); a MERGE recomputes over every
+        # host's raw lanes, since each per-host event covers only its
+        # addressable devices. Empty/None on single-device logs.
+        "partition_skew": (
+            partition_skew_summary(cross_totals)
+            if cross_totals is not None
+            else (skew_ev[-1]["phases"] if skew_ev else None)),
+        "n_partitions": (
+            len(cross_totals) if cross_totals is not None
+            else (skew_ev[-1].get("n_partitions") if skew_ev else None)),
+        "partition_rounds_observed": sum(
+            e.get("rounds", 1) for e in part_ev
+            if len(hosts) == 1 or e.get("host", 0) == hosts[0]),
         "early_stop": next(
             ({k: e[k] for k in ("round", "best_round", "best_score",
                                 "metric")}
@@ -150,6 +214,10 @@ def render(summary: dict) -> str:
         detail = {k: v for k, v in f.items() if k != "kind"}
         out.append(f"fault/recovery: {f['kind']} {detail or ''}".rstrip())
 
+    if len(summary.get("hosts", [0])) > 1:
+        out.append(f"hosts: {len(summary['hosts'])} merged "
+                   f"({', '.join(str(h) for h in summary['hosts'])})")
+
     if summary["phases"]:
         out.append("phases (host wallclock):")
         for p in summary["phases"]:
@@ -157,6 +225,22 @@ def render(summary: dict) -> str:
                 f"  {p['phase']:<14} {p['ms_total']:>9.1f} ms total  "
                 f"{p['ms_per_call']:>8.2f} ms/call  x{p['calls']:<6} "
                 f"{100 * p['share']:5.1f}%")
+
+    if summary.get("partition_skew"):
+        n = summary.get("n_partitions")
+        out.append(
+            f"partitions ({n} lanes, "
+            f"{summary.get('partition_rounds_observed', 0)} rounds "
+            "observed; straggler = max/median completion):")
+        for p in summary["partition_skew"]:
+            skew = f"{p['skew']:.2f}x" if p.get("skew") is not None \
+                else "n/a"
+            where = (f"h{p['max_host']}/dev{p['max_device']}"
+                     if "max_host" in p else f"dev{p['max_device']}")
+            out.append(
+                f"  {p['phase']:<14} max {p['ms_max']:>9.1f} ms "
+                f"@{where:<8} median "
+                f"{p['ms_median']:>9.1f} ms  skew {skew}")
 
     curve = summary["metric_curve"]
     if curve:
@@ -192,7 +276,8 @@ def render(summary: dict) -> str:
             f"h2d={_fmt_bytes(c.get('h2d_bytes'))}  "
             f"d2h={_fmt_bytes(c.get('d2h_bytes'))}  "
             f"collective≈{_fmt_bytes(c.get('collective_bytes_est'))}  "
-            f"device_peak={_fmt_bytes(c.get('device_peak_bytes'))}")
+            f"device_peak={_fmt_bytes(c.get('device_peak_bytes'))}  "
+            f"host_rss_peak={_fmt_bytes(c.get('host_peak_rss_bytes'))}")
         # Scoring-cache effectiveness (absent in pre-overhaul logs).
         hits = c.get("compiled_ensemble_cache_hits")
         if hits is not None:

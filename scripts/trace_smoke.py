@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Tier-1-safe flight-recorder smoke: train 2 rounds on a 2-partition
+CPU mesh with a run log, fabricate a second host's log (clock skewed),
+merge the two, export a Perfetto trace, and assert it parses with
+partition lanes present.
+
+tests/test_flight_recorder.py exercises each stage with real asserts;
+this script is the one-command end-to-end witness
+(docs/OBSERVABILITY.md). Exit 0 iff the whole pipeline holds.
+"""
+
+import copy
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    import numpy as np
+
+    from ddt_tpu import api
+    from ddt_tpu.telemetry import merge, perfetto, report
+    from ddt_tpu.telemetry.events import RunLog
+
+    rng = np.random.default_rng(0)
+    Xb = rng.integers(0, 31, size=(2048, 7), dtype=np.uint8)
+    y = (Xb[:, 0] > 15).astype(np.float32)
+
+    with tempfile.TemporaryDirectory(prefix="ddt_trace_smoke_") as td:
+        p0 = os.path.join(td, "host0.jsonl")
+        with RunLog(p0) as rl:
+            api.train(Xb, y, binned=True, n_trees=2, max_depth=3,
+                      n_bins=31, backend="tpu", n_partitions=2,
+                      run_log=rl)
+        ev0 = report.read_events(p0)
+        if not any(e["event"] == "partition_phases" for e in ev0):
+            print("trace smoke: mesh run emitted no partition_phases",
+                  file=sys.stderr)
+            return 1
+
+        # Fabricated host 1: same run, clock 3 s ahead — the merge must
+        # estimate the offset away and interleave the rounds.
+        p1 = os.path.join(td, "host1.jsonl")
+        with open(p1, "w", encoding="utf-8") as f:
+            for e in ev0:
+                e2 = copy.deepcopy(e)
+                e2["t"] += 3.0
+                e2["host"] = 1
+                f.write(json.dumps(e2) + "\n")
+
+        merged = merge.merge_paths([p0, p1])
+        if len(merged) != 2 * len(ev0):
+            print("trace smoke: merge lost events", file=sys.stderr)
+            return 1
+
+        out = os.path.join(td, "trace.json")
+        n = perfetto.write_trace(merged, out)
+        with open(out, encoding="utf-8") as f:
+            trace = json.load(f)              # asserts it parses
+        recs = trace["traceEvents"]
+        lanes = {r["tid"] for r in recs
+                 if r["ph"] == "X" and r["name"].startswith("ddt:")}
+        pids = {r["pid"] for r in recs}
+        ok = (len(recs) == n and trace["displayTimeUnit"] == "ms"
+              and lanes and pids == {0, 1}
+              and all(r["dur"] >= 0 for r in recs if r["ph"] == "X"))
+        if not ok:
+            print(f"trace smoke: malformed trace (lanes={lanes}, "
+                  f"pids={pids})", file=sys.stderr)
+            return 1
+        print(json.dumps({
+            "smoke": "trace", "ok": True, "events": len(merged),
+            "trace_events": n, "partition_lanes": sorted(lanes),
+            "hosts": sorted(pids),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

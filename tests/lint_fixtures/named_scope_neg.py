@@ -1,0 +1,47 @@
+"""named-scope negatives: scoped entry points, host-only helpers,
+private functions, and unreachable code — none may be flagged."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ddt_tpu.telemetry.annotations import op_scope, traced_scope
+
+
+@jax.jit
+def scoped_with_block(x):
+    with traced_scope("hist"):
+        return jnp.sum(x)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+@op_scope("gain")
+def scoped_by_decorator(x, k):
+    return jnp.argmax(x) + k
+
+
+@jax.jit
+def scoped_named_scope_literal(x):
+    with jax.named_scope("ddt:route"):
+        return jnp.cumsum(x)
+
+
+def host_only_resolver(impl, n_nodes):
+    # no traced calls: shape math never lowers HLO, nothing to name
+    if impl == "auto":
+        return "matmul" if n_nodes > 8 else "segment"
+    return impl
+
+
+def _private_entry(x):
+    return jnp.sum(x)
+
+
+@jax.jit
+def caller(x):
+    return _private_entry(x) + scoped_with_block(x)
+
+
+def cold_public_fn(x):
+    # public and device-lowering but NOT jit-reachable: never traced
+    return jnp.sum(x)

@@ -1,0 +1,24 @@
+"""named-scope positives: jit-reachable public op entry points that
+lower device work without opening a ddt: scope."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bare_entry(x):  # LINT: named-scope
+    return jnp.sum(x * 2.0)
+
+
+def _helper_reached(x):     # private: traces under its caller's scope
+    return jnp.tanh(x)
+
+
+@jax.jit
+def entry_via_helper(x):  # LINT: named-scope
+    return _helper_reached(x) + jnp.float32(1.0)
+
+
+@jax.jit
+def scoped_wrong_prefix(x):  # LINT: named-scope
+    with jax.named_scope("hist"):   # missing the ddt: prefix
+        return jnp.cumsum(x)

@@ -62,6 +62,8 @@ CASES = [
      "ddt_tpu/fixture_mod.py"),
     ("pallas-interpret", "pallas_interpret_pos.py",
      "pallas_interpret_neg.py", "ddt_tpu/ops/fixture_mod.py"),
+    ("named-scope", "named_scope_pos.py", "named_scope_neg.py",
+     "ddt_tpu/ops/fixture_mod.py"),
 ]
 
 

@@ -49,7 +49,18 @@ def test_runlog_emits_and_round_trips_every_event_type(tmp_path):
                            metric="logloss"),
         "fault": dict(kind="checkpoint_resume", round=1),
         "counters": dict(jit_compiles=1, h2d_bytes=10, d2h_bytes=5,
-                         collective_bytes_est=0, device_peak_bytes=None),
+                         collective_bytes_est=0, device_peak_bytes=None,
+                         host_peak_rss_bytes=123456),
+        "partition_phases": dict(
+            round=1, rounds=1,
+            partitions=[{"device": 0, "phases": {"grow": 1.5},
+                         "hist_allreduce_bytes": 64},
+                        {"device": 1, "phases": {"grow": 2.0},
+                         "hist_allreduce_bytes": 64}]),
+        "partition_skew": dict(
+            phases=[{"phase": "grow", "ms_max": 2.0, "ms_median": 1.75,
+                     "skew": 1.143, "max_device": 1}],
+            n_partitions=2),
         "run_end": dict(completed_rounds=2, wallclock_s=0.1),
     }
     assert set(payloads) == set(EVENT_FIELDS)   # exhaustive by contract
@@ -145,6 +156,7 @@ def test_disabled_path_no_syncs_no_file_io(monkeypatch, tmp_path):
     perform no run-log file I/O, asserted by making any RunLog
     construction or emission explode."""
     from ddt_tpu.backends.tpu import TPUDevice
+    from ddt_tpu.parallel import mesh as mesh_lib
     import ddt_tpu.telemetry.events as ev_mod
 
     def _boom(*a, **k):
@@ -152,6 +164,9 @@ def test_disabled_path_no_syncs_no_file_io(monkeypatch, tmp_path):
 
     monkeypatch.setattr(ev_mod.RunLog, "__init__", _boom)
     monkeypatch.setattr(ev_mod.RunLog, "emit", _boom)
+    # Flight-recorder collectors (schema v2) are held to the same bar:
+    # no shard probes while telemetry is off (the probe is a barrier).
+    monkeypatch.setattr(mesh_lib, "shard_ready_times", _boom)
 
     cfg = TrainConfig(n_trees=3, max_depth=3, n_bins=29, backend="tpu")
     be = TPUDevice(cfg)

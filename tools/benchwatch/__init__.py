@@ -1,0 +1,223 @@
+"""benchwatch — the bench-artifact regression sentinel (`make benchwatch`).
+
+Five BENCH_r*.json / MULTICHIP_r*.json artifacts accumulated with zero
+consumers; this tool is the consumer. It ingests the artifact history
+plus a "current" run, computes a robust per-metric band (median ± the
+larger of K·MAD and a relative floor), and exits nonzero when the
+current run sits ADVERSELY outside the band — a one-sided check, so a
+pleasantly fast run never fails the gate.
+
+Why median/MAD with a relative floor instead of mean/σ or MAD alone:
+the remote-tunnel throughput drifts in ±20% bands run to run
+(docs/PERF.md drift analysis), so (a) the mean is polluted by band
+outliers a median shrugs off, and (b) with ~5 samples that happen to
+land in one band the raw MAD collapses toward zero and would flag
+ordinary band-hopping — the REL_FLOOR (default 20% of the median)
+keeps the gate wider than the known noise while a real 30% regression
+still trips it. Metrics with fewer than MIN_HISTORY samples are
+reported as skipped, never guessed at.
+
+Artifact shapes accepted (load_artifact):
+- driver-harness wrappers: {"n": .., "rc": .., "tail": .., "parsed":
+  {metrics...}} — BENCH_r*.json;
+- raw bench.py output: the metrics dict itself (has "metric"/"value");
+- multichip dryrun records: {"n_devices", "rc", "ok", "skipped",
+  "tail"} — checked as pass/fail facts (ok must be true, rc 0), not
+  banded.
+
+Metric directions are EXPLICIT (METRICS below): an unknown numeric
+field is skipped, never auto-classified — silently banding a field
+whose good direction we guessed wrong would invert the gate. Ordering:
+artifacts sort by the harness round number (the wrapper's `n` field,
+falling back to the rNN in the filename; a raw bench.py output has
+neither and sorts first — point the gate at it with --current, which
+is the intended mode for a fresh run). The run_id/git_rev stamps
+bench.py writes are identity/provenance — a flagged excursion names
+the rev it appeared at — not the sort key.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import re
+
+#: metric -> direction whose LOSS is a regression.
+#: "higher": smaller-than-band current value fails; "lower": larger fails.
+METRICS: dict[str, str] = {
+    "value": "higher",                               # hist Mrows/s/chip
+    "vs_baseline": "higher",
+    "hist_one_dispatch_mrows_per_sec": "higher",
+    "hist_one_dispatch_mrows_per_sec_min": "higher",
+    "value_64bin_optin": "higher",
+    "ab_ratio_64bin": "higher",
+    "e2e_train_s": "lower",
+    "e2e_ms_per_tree": "lower",
+    "e2e_implied_hist_mrows": "higher",
+    "predict_mrows_per_sec": "higher",
+    "predict_total_s": "lower",
+    "predict_compute_mrows_per_sec": "higher",
+    "predict_pallas_mrows_per_sec": "higher",
+    "predict_onehot_mrows_per_sec": "higher",
+    "predict_pallas_ab_ratio": "higher",
+    "split_agreement": "higher",
+    "auc_delta": "lower",
+}
+
+MAD_K = 3.0          # band half-width in MADs...
+REL_FLOOR = 0.20     # ...but never narrower than 20% of |median|
+MIN_HISTORY = 3      # metrics with fewer samples are skipped, not banded
+
+DEFAULT_GLOBS = ("BENCH_r*.json", "MULTICHIP_r*.json")
+
+
+def load_artifact(path: str) -> dict:
+    """Parse one artifact file into {"path", "kind", "order", "metrics",
+    "facts"}. kind: "bench" | "multichip" | "unknown". `order` is the
+    history sort key (run_id-stamped artifacts keep their harness round
+    as primary order; the stamp makes the identity robust, the round the
+    sequence). `facts` are pass/fail booleans (multichip ok/rc)."""
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    rec = raw.get("parsed", raw) if isinstance(raw, dict) else {}
+    if not isinstance(rec, dict):
+        rec = {}
+    kind = "unknown"
+    facts = {}
+    if "metric" in rec or "value" in rec:
+        kind = "bench"
+    elif "n_devices" in raw or "ok" in raw:
+        kind = "multichip"
+        facts = {"ok": bool(raw.get("ok", False)),
+                 "rc": int(raw.get("rc", 1)),
+                 "skipped": bool(raw.get("skipped", False))}
+    metrics = {k: float(v) for k, v in rec.items()
+               if k in METRICS and isinstance(v, (int, float))
+               and not isinstance(v, bool)}
+    order = raw.get("n") if isinstance(raw, dict) else None
+    if order is None:
+        m = re.search(r"r(\d+)", os.path.basename(path))
+        order = int(m.group(1)) if m else 0
+    return {"path": path, "kind": kind, "order": int(order),
+            "metrics": metrics, "facts": facts,
+            "run_id": rec.get("run_id"), "git_rev": rec.get("git_rev")}
+
+
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def robust_band(vals: list[float]) -> tuple[float, float]:
+    """(median, tolerance): tolerance = max(MAD_K * MAD,
+    REL_FLOOR * |median|) — the adverse deviation the gate accepts."""
+    med = _median(vals)
+    mad = _median([abs(v - med) for v in vals])
+    return med, max(MAD_K * mad, REL_FLOOR * abs(med))
+
+
+def check(history: list[dict], current: dict,
+          min_history: int = MIN_HISTORY) -> dict:
+    """Band every shared metric of `current` (a load_artifact record of
+    kind "bench") against `history` (same-kind records). Returns
+    {"regressions": [...], "checked": [...], "skipped": [...]} —
+    regressions carry metric, direction, current, median, tolerance."""
+    regressions, checked, skipped = [], [], []
+    for name, cur in sorted(current["metrics"].items()):
+        vals = [h["metrics"][name] for h in history
+                if name in h["metrics"]]
+        if len(vals) < min_history:
+            skipped.append({"metric": name, "history": len(vals)})
+            continue
+        med, tol = robust_band(vals)
+        direction = METRICS[name]
+        delta = cur - med
+        adverse = -delta if direction == "higher" else delta
+        rec = {"metric": name, "direction": direction,
+               "current": cur, "median": round(med, 4),
+               "tolerance": round(tol, 4), "n_history": len(vals)}
+        if adverse > tol:
+            regressions.append(rec)
+        else:
+            checked.append(rec)
+    return {"regressions": regressions, "checked": checked,
+            "skipped": skipped}
+
+
+def check_facts(current: dict) -> list[dict]:
+    """Pass/fail facts of a multichip record: a current artifact that
+    FAILED (ok false / rc nonzero) is a regression regardless of
+    history; a skipped run (no devices) is not."""
+    f = current.get("facts") or {}
+    if not f or f.get("skipped"):
+        return []
+    fails = []
+    if not f.get("ok", False):
+        fails.append({"metric": "multichip.ok", "current": False,
+                      "expected": True, "path": current["path"]})
+    if f.get("rc", 1) != 0:
+        fails.append({"metric": "multichip.rc", "current": f.get("rc"),
+                      "expected": 0, "path": current["path"]})
+    return fails
+
+
+def run(paths: list[str], current_path: str | None = None,
+        min_history: int = MIN_HISTORY) -> dict:
+    """The sentinel over a set of artifact files. Without
+    `current_path`, the newest artifact of each kind (by `order`) is the
+    current run and the rest are its history — `make benchwatch`'s
+    zero-argument mode. Returns the full report dict; "ok" is the exit
+    verdict."""
+    arts = [load_artifact(p) for p in paths]
+    report: dict = {"ok": True, "bench": None, "multichip": [],
+                    "files": len(arts)}
+    cur_art = None
+    if current_path is not None:
+        cur_art = load_artifact(current_path)
+        report["current"] = current_path
+        if cur_art["kind"] == "unknown":
+            # A current run the loader cannot classify must FAIL, not
+            # silently fall back to re-banding the newest history file
+            # as if it were the run under test.
+            report["ok"] = False
+            report["error"] = (
+                f"--current {current_path}: unrecognized artifact shape "
+                "(no bench metrics, no multichip facts) — schema drift "
+                "or a torn write; nothing was checked")
+            return report
+    bench = sorted((a for a in arts if a["kind"] == "bench"),
+                   key=lambda a: a["order"])
+    if cur_art is not None and cur_art["kind"] == "bench":
+        history, current = bench, cur_art
+    elif bench:
+        history, current = bench[:-1], bench[-1]
+    else:
+        history = current = None
+    if current is not None:
+        res = check(history, current, min_history=min_history)
+        res["current_path"] = current["path"]
+        res["n_history"] = len(history)
+        report["bench"] = res
+        if res["regressions"]:
+            report["ok"] = False
+    multichip = [a for a in arts if a["kind"] == "multichip"]
+    if cur_art is not None and cur_art["kind"] == "multichip":
+        multichip = [cur_art]
+    elif multichip:
+        multichip = [sorted(multichip, key=lambda a: a["order"])[-1]]
+    for a in multichip:
+        fails = check_facts(a)
+        report["multichip"].append(
+            {"path": a["path"], "regressions": fails})
+        if fails:
+            report["ok"] = False
+    return report
+
+
+def collect_default_paths(root: str = ".") -> list[str]:
+    out: list[str] = []
+    for g in DEFAULT_GLOBS:
+        out.extend(sorted(_glob.glob(os.path.join(root, g))))
+    return out

@@ -1,0 +1,78 @@
+"""CLI: `python -m tools.benchwatch [artifacts...]` — exit 1 on a bench
+regression, 0 on a clean bill, 2 when there is nothing to check.
+
+Default (no arguments): glob BENCH_r*.json + MULTICHIP_r*.json in the
+repo root, treat the newest of each kind as the current run and the
+rest as history — the `make benchwatch` mode. `--current` points at a
+fresh `python bench.py` output file instead (then every globbed
+artifact is history).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.benchwatch import (
+    MIN_HISTORY, collect_default_paths, run)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.benchwatch",
+        description="bench-artifact regression sentinel (median/MAD band "
+                    "per metric; one-sided, adverse direction only)")
+    ap.add_argument("paths", nargs="*",
+                    help="artifact files (default: BENCH_r*.json + "
+                         "MULTICHIP_r*.json in the cwd)")
+    ap.add_argument("--current", default=None,
+                    help="treat THIS file as the current run (all "
+                         "positional/globbed artifacts become history)")
+    ap.add_argument("--min-history", type=int, default=MIN_HISTORY,
+                    help="minimum history samples before a metric is "
+                         f"banded (default {MIN_HISTORY}; fewer = skipped)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON object")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or collect_default_paths()
+    if not paths and args.current is None:
+        print("benchwatch: no artifacts found (BENCH_r*.json / "
+              "MULTICHIP_r*.json)", file=sys.stderr)
+        return 2
+
+    report = run(paths, current_path=args.current,
+                 min_history=args.min_history)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        if report.get("error"):
+            print(f"benchwatch: ERROR {report['error']}")
+        b = report.get("bench")
+        if b:
+            print(f"benchwatch: {b['current_path']} vs {b['n_history']} "
+                  f"history artifact(s): {len(b['checked'])} in band, "
+                  f"{len(b['skipped'])} skipped (thin history), "
+                  f"{len(b['regressions'])} regression(s)")
+            for r in b["regressions"]:
+                want = ">=" if r["direction"] == "higher" else "<="
+                bound = (r["median"] - r["tolerance"]
+                         if r["direction"] == "higher"
+                         else r["median"] + r["tolerance"])
+                print(f"  REGRESSION {r['metric']}: {r['current']} "
+                      f"(band {want} {round(bound, 4)}; median "
+                      f"{r['median']} ± {r['tolerance']} over "
+                      f"{r['n_history']} runs)")
+        for m in report["multichip"]:
+            state = "FAIL" if m["regressions"] else "ok"
+            print(f"benchwatch: multichip {m['path']}: {state}")
+            for r in m["regressions"]:
+                print(f"  REGRESSION {r['metric']}: {r['current']} "
+                      f"(expected {r['expected']})")
+        print(f"benchwatch: {'OK' if report['ok'] else 'REGRESSION'}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

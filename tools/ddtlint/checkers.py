@@ -490,6 +490,68 @@ class PallasInterpretChecker(Checker):
         self.generic_visit(node)
 
 
+# --------------------------------------------------------------------- #
+# 8. named-scope
+# --------------------------------------------------------------------- #
+class NamedScopeChecker(Checker):
+    """Every jit-reachable op ENTRY POINT in ddt_tpu/ops/ — a public
+    top-level function that lowers device work (contains jnp./jax./lax.
+    array calls) — must open a `ddt:`-prefixed scope
+    (telemetry.annotations.traced_scope, or jax.named_scope with a
+    literal "ddt:..." name) somewhere in its body, so XLA op metadata —
+    and therefore Perfetto/trace-export timelines — stays attributable
+    to the pipeline stage that emitted it (docs/OBSERVABILITY.md
+    "Phase timing and Perfetto alignment"). Host-only helpers (shape
+    math, impl resolvers) contain no traced calls and are exempt;
+    private helpers and nested defs trace under their caller's scope."""
+
+    rule = "named-scope"
+    path_scope = (r"^ddt_tpu/ops/",)
+
+    def run(self) -> list[Finding]:
+        for node in ast.iter_child_nodes(self.ctx.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if node.name.startswith("_"):
+                continue
+            if node.name not in self.ctx.reachable:
+                continue                      # never traced: no HLO to name
+            if not self._does_device_work(node):
+                continue                      # host-only helper
+            if self._opens_ddt_scope(node):
+                continue
+            self.report(node, (
+                f"jit-reachable op entry point '{node.name}' opens no "
+                "`ddt:` named scope — wrap its device work in "
+                "telemetry.annotations.traced_scope(...) so traces stay "
+                "attributable (docs/OBSERVABILITY.md)"))
+        return self.findings
+
+    @staticmethod
+    def _does_device_work(fn: ast.AST) -> bool:
+        return any(_is_traced_call(n) for n in ast.walk(fn))
+
+    @staticmethod
+    def _opens_ddt_scope(fn: ast.AST) -> bool:
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            d = callgraph.dotted(n.func)
+            if d is None:
+                continue
+            last = d.split(".")[-1]
+            # Both telemetry.annotations spellings add the ddt: prefix
+            # themselves: traced_scope (with-block) / op_scope (decorator).
+            if last in ("traced_scope", "op_scope"):
+                return True
+            if last == "named_scope" and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str) \
+                    and n.args[0].value.startswith("ddt:"):
+                return True
+        return False
+
+
 AST_CHECKERS = [
     TracedBranchChecker,
     HostSyncChecker,
@@ -498,6 +560,7 @@ AST_CHECKERS = [
     BroadExceptChecker,
     NoPrintChecker,
     PallasInterpretChecker,
+    NamedScopeChecker,
 ]
 
 
