@@ -34,6 +34,12 @@ report:
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/trace_smoke.py
 
+# xprof capture-window smoke (docs/OBSERVABILITY.md): 2-round CPU train
+# with a programmatic jax.profiler window over rounds 1:2; asserts the
+# trace lands and the manifest carries the run-id cross-reference.
+profile-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/profile_smoke.py
+
 # Bench regression sentinel (docs/OBSERVABILITY.md): band every metric
 # of the newest BENCH_r*/MULTICHIP_r* artifact against the history
 # (median ± max(3*MAD, 20%)); exit 1 on an adverse excursion. Point a
@@ -44,5 +50,5 @@ benchwatch:
 native:
 	$(MAKE) -C ddt_tpu/native
 
-.PHONY: lint lint-baseline tsan-audit test report trace-smoke benchwatch \
-	native
+.PHONY: lint lint-baseline tsan-audit test report trace-smoke \
+	profile-smoke benchwatch native

@@ -301,6 +301,17 @@ def main() -> None:
             round(pab["onehot_mrows_per_sec"], 2) if pab else None,
         "predict_pallas_ab_ratio":
             round(pab["ratio_pallas_over_onehot"], 3) if pab else None,
+        # Roofline utilization stamps (device-truth cost observatory):
+        # achieved/peak fractions from XLA's own cost model at the
+        # measured wallclocks (telemetry/costmodel.py; benchwatch bands
+        # them higher-is-better — a dispatch regression that hides
+        # inside wallclock drift still collapses utilization).
+        "hist_roofline_flops_util": ab.get("hist_roofline_flops_util"),
+        "hist_roofline_hbm_util": ab.get("hist_roofline_hbm_util"),
+        "predict_roofline_flops_util":
+            pr_comp.get("predict_roofline_flops_util"),
+        "predict_roofline_hbm_util":
+            pr_comp.get("predict_roofline_hbm_util"),
         **parity,
     }
     print(json.dumps(rec))

@@ -110,6 +110,7 @@ def train(
     sample_weight: np.ndarray | None = None,
     profile: bool = False,
     run_log=None,
+    profiler_window=None,
     **cfg_overrides,
 ) -> TrainResult:
     """Train a GBDT. `X` is float features (quantized here) unless
@@ -118,8 +119,11 @@ def train(
     the flag string (a TrainConfig field) or a pre-built DeviceBackend
     instance (e.g. one holding a specific mesh). `run_log` (a JSONL path or
     a telemetry.RunLog) attaches the structured telemetry stream — run
-    manifest, per-round records, phase timings, counters — rendered by
-    `python -m ddt_tpu.cli report` (docs/OBSERVABILITY.md)."""
+    manifest, per-round records, phase timings, counters, XLA cost
+    analysis — rendered by `python -m ddt_tpu.cli report`
+    (docs/OBSERVABILITY.md). `profiler_window` (a
+    telemetry.profiler.CaptureWindow) captures a programmatic xprof trace
+    around a selected round range, cross-referenced into the manifest."""
     if isinstance(backend, str):
         cfg_overrides["backend"] = backend
         backend = None
@@ -180,6 +184,7 @@ def train(
         checkpoint_every=checkpoint_every,
         profile=profile,
         run_log=run_log,
+        profiler_window=profiler_window,
     )
     ens = driver.fit(
         Xb, np.asarray(y),

@@ -51,6 +51,7 @@ from ddt_tpu.ops import split as split_ops
 from ddt_tpu.parallel import mesh as mesh_lib
 from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry.annotations import phase_span
+from ddt_tpu.telemetry.costmodel import costed
 
 P = jax.sharding.PartitionSpec
 
@@ -384,7 +385,7 @@ class TPUDevice(DeviceBackend):
                 v = valid
             return g * v, h * v  # pad rows contribute nothing anywhere
 
-        return f
+        return costed("grad", phase="grad")(f)
 
     def grad_hess(self, pred, y):
         return self._grad_fn(pred, y.y, y.valid)
@@ -455,7 +456,11 @@ class TPUDevice(DeviceBackend):
                 # argmax, so it is disabled for this path only.
                 check_vma=faxis is None,
             )
-        return jax.jit(grow)
+        # Cost observatory registration: on telemetry runs the first call
+        # per shape pulls XLA's cost/memory analysis for the whole
+        # per-tree growth program (telemetry/costmodel.py); inert wrapper
+        # otherwise.
+        return costed("grow", phase="grow")(jax.jit(grow))
 
     def grow_tree(self, data, g, h,
                   feature_mask=None) -> tuple[Any, Any]:
@@ -761,7 +766,11 @@ class TPUDevice(DeviceBackend):
         # Both block-reassigned prediction buffers are donated (the Driver
         # rebinds pred AND val_pred from the return every block).
         donate = (1, 5) if mfn is not None else (1,)
-        return jax.jit(rounds, donate_argnums=donate)
+        # Cost registration for the fused block program (the roofline's
+        # grow_block row folds in the fetch_tree barrier that carries the
+        # block's device wallclock — telemetry/costmodel.roofline_table).
+        return costed("grow_block", phase="grow_block")(
+            jax.jit(rounds, donate_argnums=donate))
 
     # ------------------------------------------------------------------ #
     # device-side eval_set scoring (round-1 verdict, Weak #5): validation
@@ -848,7 +857,7 @@ class TPUDevice(DeviceBackend):
                 # though both outputs are replicated by construction.
                 check_vma=faxis is None and mfn is not None,
             )
-        return jax.jit(f, donate_argnums=(1,))
+        return costed("eval", phase="eval")(jax.jit(f, donate_argnums=(1,)))
 
     def apply_row_mask(self, g, h, mask):
         # Upload bool (1 byte/row); the cast to f32 is a free fused device op.
@@ -1015,7 +1024,15 @@ class TPUDevice(DeviceBackend):
             f = mesh_lib.shard_map(f, mesh=self.mesh, in_specs=in_specs,
                               out_specs=out_specs)
         donate = (1,) if kind in ("update", "roundstart") else ()
-        fn = jax.jit(f, donate_argnums=donate)
+        # Cost registration per streamed program: op = the stream kind,
+        # phase = the fit_streaming phase its dispatches run under
+        # (roundstart is the fused round-start inside the hist pass;
+        # update applies finished trees to resident predictions — the
+        # device loop's predict phase).
+        stream_phase = {"hist": "hist", "leaf": "leaf",
+                        "roundstart": "hist", "update": "predict"}[kind]
+        fn = costed(f"stream_{kind}", phase=stream_phase)(
+            jax.jit(f, donate_argnums=donate))
         self._stream_cache[key] = fn
         return fn
 

@@ -96,7 +96,7 @@ def bench_histogram(
 
     n_chips = max(1, partitions)
     mrows = rows / dt / 1e6 / n_chips
-    return {
+    out = {
         "kernel": "histogram",
         "backend": backend,
         "impl": impl,
@@ -108,6 +108,40 @@ def bench_histogram(
         # above the expected warm-up compile means the timed loop is
         # recompiling (shape churn), which invalidates the throughput.
         "jit_compiles": tele_counters.delta(c0)["jit_compiles"],
+    }
+    if backend == "tpu" and partitions == 1:
+        # Roofline stamp (cost-observatory satellite): XLA's own cost
+        # model for the measured program joined against the measured
+        # per-build wallclock — achieved/peak fractions the benchwatch
+        # sentinel can band (a silent dispatch regression shows up as a
+        # utilization collapse even when absolute Mrows/s drift hides it).
+        out.update(_roofline_util(
+            "hist",
+            lambda d, gg, hh, ni: be.build_histograms(d, gg, hh, ni,
+                                                      n_nodes),
+            (data, g_d, h_d, ni_d), dt))
+    return out
+
+
+def _roofline_util(prefix: str, fn, args: tuple,
+                   sec_per_call: float) -> dict:
+    """{<prefix>_roofline_flops_util, <prefix>_roofline_hbm_util} from
+    costmodel.analyze of the measured program at the measured per-call
+    wallclock (arrays ride as real arguments, never closure constants —
+    XLA would fold constants out of the cost model). Returns {} when the
+    analysis fails (capture must never fail a bench)."""
+    from ddt_tpu.telemetry import costmodel
+
+    rec = costmodel.analyze(fn, *args)
+    if rec.get("error") or sec_per_call <= 0:
+        return {}
+    peaks = costmodel.peaks_for(rec.get("platform"))
+    return {
+        f"{prefix}_roofline_flops_util":
+            round(rec["flops"] / sec_per_call / 1e9 / peaks["gflops"], 5),
+        f"{prefix}_roofline_hbm_util":
+            round(rec["bytes_accessed"] / sec_per_call / 1e9
+                  / peaks["gbs"], 5),
     }
 
 
@@ -161,13 +195,23 @@ def bench_histogram_ab(
             arm["dt"] = min(arm["dt"], dts[arm["bins"]])
         ratios.append(dts[bins_a] / dts[bins_b])
     m_a, m_b = (rows / arm["dt"] / 1e6 for arm in arms)
-    return {
+    out = {
         "kernel": "histogram_ab",
         "rows": rows, "features": features, "n_nodes": n_nodes,
         "bins_a": bins_a, "bins_b": bins_b,
         "mrows_a": m_a, "mrows_b": m_b,
         "ratio_b_over_a": float(np.median(ratios)),   # median paired ratio
     }
+    # Roofline stamp for the headline (255-bin) arm: XLA's cost model at
+    # the arm's measured per-build wallclock (cost-observatory satellite;
+    # benchwatch bands the utilization fractions).
+    be_a, args_a = arms[0]["be"], arms[0]["args"]
+    out.update(_roofline_util(
+        "hist",
+        lambda d, gg, hh, ni: be_a.build_histograms(d, gg, hh, ni,
+                                                    n_nodes),
+        args_a, arms[0]["dt"]))
+    return out
 
 
 def bench_histogram_one_dispatch(
@@ -406,8 +450,16 @@ def bench_predict_both(
         for o in outs:
             device_sync(o)
         dt = min(dt, time.perf_counter() - t0)
-    out.append({**base, "resident": "compute_only", "wallclock_s": dt,
-                "mrows_per_sec": rows / dt / 1e6})
+    rec = {**base, "resident": "compute_only", "wallclock_s": dt,
+           "mrows_per_sec": rows / dt / 1e6}
+    # Roofline stamp for the scoring kernel (cost-observatory satellite):
+    # one full-size chunk's program at its share of the measured compute
+    # wallclock (the chunks are homogeneous up to the remainder).
+    n_chunks = -(-rows // chunk)
+    rec.update(_roofline_util("predict", fn,
+                              (*ens_dev, data[:min(chunk, rows)]),
+                              dt / n_chunks))
+    out.append(rec)
     return out[0], out[1], out[2]
 
 

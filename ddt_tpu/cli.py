@@ -152,6 +152,20 @@ def _predict_streaming(args, bundle) -> int:
     return 0
 
 
+def _capture_window(args):
+    """telemetry.profiler.CaptureWindow from --xprof-dir/--xprof-rounds,
+    or None — ONE construction home for the in-memory and streamed train
+    paths (a bad window spec exits cleanly either way)."""
+    if not getattr(args, "xprof_dir", None):
+        return None
+    from ddt_tpu.telemetry.profiler import CaptureWindow
+
+    try:
+        return CaptureWindow(args.xprof_dir, args.xprof_rounds)
+    except ValueError as e:
+        raise SystemExit(f"--xprof-rounds: {e}") from e
+
+
 def _seeded_split(X, y, frac: float, seed: int):
     """The seeded held-out row split — ONE home for both the in-memory and
     streamed train paths, so their validation semantics cannot drift.
@@ -193,9 +207,10 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
     if cache_root is None:
         tmp_cache = tempfile.mkdtemp(prefix="ddt_binned_")
         cache_root = tmp_cache
+    window = _capture_window(args)
     try:
         ens, history, mapper, rows, n_chunks, chunk_rows_max = \
-            _stream_fit(args, X, y, cfg, cache_root)
+            _stream_fit(args, X, y, cfg, cache_root, window)
     except NotImplementedError as e:   # e.g. feature-parallel streaming
         raise SystemExit(str(e)) from e
     finally:
@@ -227,11 +242,15 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
         out["best_score"] = round(history[bi][mk], 6)
     if args.run_log:
         out["run_log"] = args.run_log
+    if window is not None:
+        # Same stamp the in-memory path prints: scripts locating the
+        # capture read it from the train record, not just the manifest.
+        out["xprof_dir"] = window.trace_dir
     print(json.dumps(out))
     return 0
 
 
-def _stream_fit(args, X, y, cfg, cache_root):
+def _stream_fit(args, X, y, cfg, cache_root, window=None):
     """Chunk-source construction + fit_streaming for _train_streaming
     (separated so its caller's finally-cleanup wraps the WHOLE cache
     lifecycle). Returns (ens, history, mapper, rows, n_chunks,
@@ -378,7 +397,8 @@ def _stream_fit(args, X, y, cfg, cache_root):
                         history=history,
                         device_chunk_cache=dev_cache,
                         run_log=args.run_log,
-                        profile=args.profile)
+                        profile=args.profile,
+                        profiler_window=window)
     return ens, history, mapper, rows, n_chunks, chunk_rows_max
 
 
@@ -458,6 +478,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="capture a jax.profiler trace here (TensorBoard/"
                          "Perfetto; device spans carry the same ddt:<phase> "
                          "names as --run-log phase timings)")
+    tp.add_argument("--xprof-dir", default=None,
+                    help="capture a PROGRAMMATIC jax.profiler trace around "
+                         "the --xprof-rounds window only (vs --trace-dir's "
+                         "whole-run capture); lands in <dir>/run_<run_id> "
+                         "and the window + path are stamped into the run "
+                         "manifest so the trace and the run log cross-"
+                         "reference by run id (docs/OBSERVABILITY.md)")
+    tp.add_argument("--xprof-rounds", default="2:3",
+                    help="1-based inclusive round window LO:HI for "
+                         "--xprof-dir (default 2:3 — round 1's warmup "
+                         "compiles are skipped by starting later)")
     tp.add_argument("--run-log", default=None,
                     help="write a structured JSONL telemetry run log here "
                          "(run manifest, per-round records, phase timings, "
@@ -540,8 +571,8 @@ def main(argv: list[str] | None = None) -> int:
 
     rp = sub.add_parser("report",
                         help="render a run summary from a JSONL telemetry "
-                             "log (train --run-log)")
-    rp.add_argument("--log", required=True, action="append",
+                             "log (train --run-log), or diff two logs")
+    rp.add_argument("--log", action="append",
                     help="path to the run log written by train --run-log; "
                          "repeat for a multi-host run's per-host logs "
                          "(merged by run id with manifest-estimated clock "
@@ -551,6 +582,24 @@ def main(argv: list[str] | None = None) -> int:
                          "the human rendering")
     rp.add_argument("--slowest", type=_positive_int, default=5,
                     help="how many slowest rounds to list")
+    rsub = rp.add_subparsers(dest="report_cmd")
+    dp = rsub.add_parser(
+        "diff",
+        help="align two run logs by phase and counter and flag adverse "
+             "excursions (benchwatch band logic, single-baseline form — "
+             "docs/OBSERVABILITY.md)")
+    dp.add_argument("log_a", help="baseline run log (A)")
+    dp.add_argument("log_b", help="current run log (B)")
+    dp.add_argument("--json", action="store_true",
+                    help="emit the diff as one JSON object")
+    dp.add_argument("--threshold", type=float, default=None,
+                    help="adverse relative excursion that flags "
+                         "(default 0.20 — benchwatch's relative floor)")
+    dp.add_argument("--abs-floor-ms", type=float, default=None,
+                    help="absolute per-phase floor below which moves "
+                         "never flag (default 50 ms; 0 bands micro-runs)")
+    dp.add_argument("--check", action="store_true",
+                    help="exit 1 when any excursion is flagged (CI mode)")
 
     xp = sub.add_parser("trace",
                         help="export a run log as Chrome trace-event JSON "
@@ -652,6 +701,7 @@ def main(argv: list[str] | None = None) -> int:
             from ddt_tpu.utils.profiling import trace
 
             trace_ctx = trace(args.trace_dir)
+        window = _capture_window(args)
         with trace_ctx:
             res = api.train(
                 X, y, cfg, checkpoint_dir=args.checkpoint_dir,
@@ -660,6 +710,7 @@ def main(argv: list[str] | None = None) -> int:
                 early_stopping_rounds=args.early_stop,
                 profile=args.profile,
                 run_log=args.run_log,
+                profiler_window=window,
             )
         dt = time.perf_counter() - t0
         # Persist the COMPLETE artifact: ensemble + training-time BinMapper
@@ -681,6 +732,8 @@ def main(argv: list[str] | None = None) -> int:
             out["best_score"] = round(res.best_score, 6)
         if args.run_log:
             out["run_log"] = args.run_log
+        if window is not None:
+            out["xprof_dir"] = window.trace_dir
         print(json.dumps(out))
         return 0
 
@@ -730,6 +783,30 @@ def main(argv: list[str] | None = None) -> int:
         from ddt_tpu.telemetry import merge as tele_merge
         from ddt_tpu.telemetry import report as tele_report
 
+        if getattr(args, "report_cmd", None) == "diff":
+            from ddt_tpu.telemetry import diffing
+
+            try:
+                sa = tele_report.summarize(
+                    tele_report.read_events(args.log_a))
+                sb = tele_report.summarize(
+                    tele_report.read_events(args.log_b))
+                kw = {}
+                if args.threshold is not None:
+                    kw["threshold"] = args.threshold
+                if args.abs_floor_ms is not None:
+                    kw["abs_floor_ms"] = args.abs_floor_ms
+                d = diffing.diff_summaries(sa, sb, **kw)
+                out_text = (json.dumps(d) if args.json
+                            else diffing.render_diff(d, args.log_a,
+                                                     args.log_b))
+            except (OSError, ValueError, TypeError, KeyError) as e:
+                raise SystemExit(f"report diff: {e}") from e
+            print(out_text)
+            return 1 if (args.check and d["flagged"]) else 0
+
+        if not args.log:
+            ap.error("report requires --log (or the `diff A B` form)")
         try:
             events = tele_merge.merge_paths(args.log)
             summary = tele_report.summarize(events, slowest=args.slowest)

@@ -70,6 +70,7 @@ from ddt_tpu.backends.base import DeviceBackend
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
 from ddt_tpu.reference.numpy_trainer import base_score
+from ddt_tpu.telemetry import costmodel
 from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry.annotations import phase_ctx
 from ddt_tpu.telemetry.events import (
@@ -128,6 +129,7 @@ class Driver:
         checkpoint_every: int = 25,
         profile: bool = False,
         run_log: "RunLog | str | None" = None,
+        profiler_window=None,
     ):
         self.backend = backend
         self.cfg = cfg
@@ -157,6 +159,14 @@ class Driver:
         )
         self._recorder: RoundRecorder | None = None
         self._part_rec: PartitionRecorder | None = None
+        # Device-truth cost capture (telemetry/costmodel.py): a collector
+        # is installed only for telemetry runs (_fit prologue) and torn
+        # down in fit's finally — runs without a log never lower/compile
+        # anything extra (guard-tested).
+        self._cost = None
+        # Programmatic xprof capture window (telemetry/profiler.py), or
+        # None — every hook below is behind an `is not None` check.
+        self._window = profiler_window
 
     def _draw_colsample_mask(self, rnd: int, c: int, F: int) -> np.ndarray:
         """The per-(seed, round, class) colsample feature mask; the draw
@@ -187,7 +197,7 @@ class Driver:
         finish_run_log(self.run_log, self.timer, counters_start,
                        completed_rounds,
                        round(time.perf_counter() - t0, 4),
-                       partitions=self._part_rec)
+                       partitions=self._part_rec, costs=self._cost)
 
     def fit(
         self,
@@ -218,6 +228,13 @@ class Driver:
                 early_stopping_rounds=early_stopping_rounds,
                 sample_weight=sample_weight)
         finally:
+            # Cost capture must not outlive its run (a later telemetry-
+            # less fit in the same process must pay zero capture work),
+            # and a still-open xprof window (death inside the round
+            # range) must be stopped so the trace flushes.
+            costmodel.deactivate(self._cost)
+            if self._window is not None:
+                self._window.close()
             if self._own_run_log and self.run_log is not None:
                 self.run_log.close()
 
@@ -263,9 +280,25 @@ class Driver:
         # (zero device syncs) and absent entirely when run_log is None.
         t_fit0 = time.perf_counter()
         counters_start = None
+        # The deterministic config digest serves two consumers: the v2
+        # manifest merge key AND the xprof capture window's trace-dir
+        # name (telemetry/profiler.py) — computed whenever either wants
+        # it. The FULL config feeds the digest: two sweep points
+        # differing only in, say, learning_rate must refuse to merge, so
+        # no field may be left out.
+        run_id = None
+        if self.run_log is not None or self._window is not None:
+            run_id = derive_run_id(
+                trainer="driver", rows=int(R), features=int(F),
+                **dataclasses.asdict(cfg))
+        if self._window is not None:
+            self._window.bind(run_id)
         if self.run_log is not None:
             tele_counters.install_jax_listener()
             counters_start = tele_counters.snapshot()
+            # Device-truth cost capture (telemetry/costmodel.py): active
+            # for this run only; deactivated in fit's finally.
+            self._cost = costmodel.activate()
             self.run_log.emit(
                 "run_manifest", trainer="driver",
                 backend=self.backend.name, loss=cfg.loss,
@@ -276,13 +309,14 @@ class Driver:
                                          False)),
                 # v2 extras: the cross-host merge key + lane label
                 # (telemetry.merge) — identical on every pod host by SPMD
-                # construction. The FULL config feeds the digest: two
-                # sweep points differing only in, say, learning_rate must
-                # refuse to merge, so no field may be left out.
-                run_id=derive_run_id(
-                    trainer="driver", rows=int(R), features=int(F),
-                    **dataclasses.asdict(cfg)),
-                host=int(getattr(self.backend, "host_index", 0)))
+                # construction.
+                run_id=run_id,
+                host=int(getattr(self.backend, "host_index", 0)),
+                # v3 extras: the xprof cross-reference — a flight-recorder
+                # lane and a profiler session join on run_id through
+                # these (telemetry/profiler.py).
+                **(self._window.manifest_fields()
+                   if self._window is not None else {}))
 
         data = self.backend.upload(Xb)
         y_dev = self.backend.upload_labels(np.asarray(y),
@@ -481,6 +515,8 @@ class Driver:
             return ens
 
         for rnd in range(start_round, cfg.n_trees):
+            if self._window is not None:      # xprof window: start edge
+                self._window.round_start(rnd)
             t0 = time.perf_counter()
             round_handles: list = []
             with ph("grad"):
@@ -566,6 +602,8 @@ class Driver:
                 rnd, dt * 1e3, val_score,
                 lambda: self.backend.loss_value(pred, y_dev))
             part_rec.flush_round(rnd)
+            if self._window is not None:      # xprof window: stop edge
+                self._window.round_end(rnd)
 
             if early_stopping_rounds is not None and self.best_round is None:
                 # NaN never compares greater, so a NaN-from-round-1 metric
@@ -649,6 +687,13 @@ class Driver:
                 K = min(K, nxt - rnd)
             if early_stopping_rounds is not None:
                 K = min(K, max(early_stopping_rounds, 1))
+            if self._window is not None:
+                # xprof window: break blocks at the capture edges (the
+                # checkpoint-boundary treatment) so start/stop land on
+                # true round boundaries, then open the window if this
+                # block enters it.
+                K = self._window.block_cap(rnd, K)
+                self._window.round_start(rnd)
             t0 = time.perf_counter()
             fmasks = None
             if colsample_features is not None:
@@ -685,6 +730,10 @@ class Driver:
                 trees = np.asarray(trees_h)     # [K, C, 5, N] — ONE fetch
                 losses = np.asarray(losses_h)
             dt = time.perf_counter() - t0
+            if self._window is not None:
+                # The fetch above was the block's barrier: the captured
+                # trace now holds every dispatch of rounds [rnd, rnd+K).
+                self._window.round_end(rnd + K - 1)
             if part_rec is not None:
                 part_rec.flush_round(rnd, n_rounds=K)
             tele_counters.record_d2h(trees.nbytes + losses.nbytes)

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ddt_tpu.telemetry.costmodel import costed
+
 LANE = 128
 
 # VMEM working-set ceiling for auto-selection: the one-hot tile
@@ -213,6 +215,7 @@ def build_histograms_pallas(
     )
 
 
+@costed("hist_pallas", phase="hist")
 @functools.partial(
     jax.jit,
     static_argnames=("n_nodes", "n_bins", "tile_r", "interpret",

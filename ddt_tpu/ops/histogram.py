@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from ddt_tpu.telemetry.annotations import op_scope
+from ddt_tpu.telemetry.costmodel import costed
 
 
 def _mask_inactive(
@@ -55,6 +56,7 @@ def _mask_inactive(
 # segment_sum implementation (scatter path; CPU fast path / TPU fallback)
 # --------------------------------------------------------------------------- #
 
+@costed("hist", phase="hist")
 @functools.partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
 @op_scope("hist")
 def build_histograms_segment(
@@ -132,6 +134,7 @@ def _hist_chunk_matmul(
     return jax.vmap(per_feature, in_axes=1)(Xb_c)                 # [F, 2N, B]
 
 
+@costed("hist", phase="hist")
 @functools.partial(
     jax.jit,
     static_argnames=("n_nodes", "n_bins", "row_chunk", "input_dtype"),

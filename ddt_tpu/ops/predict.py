@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from ddt_tpu.telemetry.annotations import op_scope, traced_scope
+from ddt_tpu.telemetry.costmodel import costed
 
 _DEFAULT_ROW_CHUNK = 65_536
 
@@ -361,6 +362,7 @@ def _predict_effective(
     return out[:, 0] if C == 1 else out
 
 
+@costed("predict", phase="predict")
 @functools.partial(
     jax.jit,
     static_argnames=("max_depth", "n_classes", "tree_chunk", "row_chunk",
@@ -398,6 +400,7 @@ def predict_raw_effective(
     )
 
 
+@costed("predict", phase="predict")
 @functools.partial(
     jax.jit,
     static_argnames=("max_depth", "n_classes", "tree_chunk", "row_chunk",

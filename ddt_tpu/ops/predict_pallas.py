@@ -58,6 +58,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ddt_tpu.telemetry.annotations import op_scope, traced_scope
+from ddt_tpu.telemetry.costmodel import costed
 
 # VMEM ceiling for auto-dispatch: the per-chunk [TILE_R, Nint*Tc] colval
 # (bf16) + comparison bits + the resident tree tables + Mosaic's
@@ -293,6 +294,7 @@ def predict_effective_pallas(
     return out[:, 0] if C == 1 else out
 
 
+@costed("predict_pallas", phase="predict")
 @functools.partial(
     jax.jit,
     static_argnames=("max_depth", "n_classes", "tree_chunk",

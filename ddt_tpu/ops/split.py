@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from ddt_tpu.telemetry.annotations import op_scope
+from ddt_tpu.telemetry.costmodel import costed
 
 
 @op_scope("cat_vec")
@@ -38,6 +39,7 @@ def node_totals(hist: jax.Array) -> tuple[jax.Array, jax.Array]:
     return hist[:, 0, :, 0].sum(axis=1), hist[:, 0, :, 1].sum(axis=1)
 
 
+@costed("gain", phase="gain")
 @functools.partial(
     jax.jit, static_argnames=("reg_lambda", "min_child_weight",
                               "missing_bin")

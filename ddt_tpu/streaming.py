@@ -41,6 +41,7 @@ import numpy as np
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
 from ddt_tpu.reference.numpy_trainer import grad_hess
+from ddt_tpu.telemetry import costmodel
 from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry.annotations import phase_ctx
 from ddt_tpu.telemetry.events import (
@@ -378,15 +379,21 @@ def fit_streaming(
     device_chunk_cache: "bool | int" = True,
     run_log: "RunLog | str | None" = None,
     profile: bool = False,
+    profiler_window=None,
 ) -> TreeEnsemble:
     """Train a GBDT over streamed chunks — see _fit_streaming_impl
     directly below for the full contract (validation, checkpointing,
     device streaming, sampling, telemetry). This wrapper owns exactly
-    one concern: a run log built HERE from a path string is closed on
-    every exit, success or mid-run exception (the Driver has the same
-    shim on fit), so repeated failing fits cannot leak file handles."""
+    one concern: run-scoped telemetry state built HERE — a run log
+    coerced from a path string, the cost-capture collector, a still-open
+    xprof window — is torn down on every exit, success or mid-run
+    exception (the Driver has the same shim on fit), so repeated failing
+    fits cannot leak file handles or bill capture work to later runs."""
     own_run_log = isinstance(run_log, str)
     run_log = RunLog.coerce(run_log)
+    # Device-truth cost capture (telemetry/costmodel.py): telemetry runs
+    # only; torn down below even when the fit dies mid-round.
+    cost = costmodel.activate() if run_log is not None else None
     try:
         return _fit_streaming_impl(
             chunk_fn, n_chunks, cfg, backend=backend,
@@ -396,8 +403,12 @@ def fit_streaming(
             eval_metric=eval_metric,
             early_stopping_rounds=early_stopping_rounds, history=history,
             device_chunk_cache=device_chunk_cache, run_log=run_log,
-            profile=profile)
+            profile=profile, cost_collector=cost,
+            profiler_window=profiler_window)
     finally:
+        costmodel.deactivate(cost)
+        if profiler_window is not None:
+            profiler_window.close()
         if own_run_log and run_log is not None:
             run_log.close()
 
@@ -418,6 +429,8 @@ def _fit_streaming_impl(
     device_chunk_cache: "bool | int" = True,
     run_log: "RunLog | None" = None,
     profile: bool = False,
+    cost_collector=None,
+    profiler_window=None,
 ) -> TreeEnsemble:
     """Train a GBDT over `n_chunks` streamed chunks.
 
@@ -549,6 +562,17 @@ def _fit_streaming_impl(
     )
 
     trainer_name = "streaming_device" if device else "streaming_host"
+    # Deterministic config digest: the v2 merge key AND the xprof
+    # window's trace-dir name — computed whenever either consumer wants
+    # it (the FULL config feeds it so sweep points differing in any
+    # field refuse to merge).
+    run_id = None
+    if run_log is not None or profiler_window is not None:
+        run_id = derive_run_id(
+            trainer=trainer_name, rows=int(y_cnt), features=int(F),
+            n_chunks=n_chunks, **dataclasses.asdict(cfg))
+    if profiler_window is not None:
+        profiler_window.bind(run_id)
     if run_log is not None:
         run_log.emit(
             "run_manifest",
@@ -558,13 +582,11 @@ def _fit_streaming_impl(
             n_bins=cfg.n_bins, rows=int(y_cnt), features=int(F),
             n_classes=C, seed=cfg.seed, n_chunks=n_chunks,
             distributed=bool(getattr(backend, "distributed", False)),
-            # v2 extras (telemetry.merge): deterministic across pod
-            # hosts; the FULL config feeds the digest so sweep points
-            # differing in any field refuse to merge.
-            run_id=derive_run_id(
-                trainer=trainer_name, rows=int(y_cnt), features=int(F),
-                n_chunks=n_chunks, **dataclasses.asdict(cfg)),
-            host=int(getattr(backend, "host_index", 0)))
+            run_id=run_id,
+            host=int(getattr(backend, "host_index", 0)),
+            # v3 extras: the xprof cross-reference (telemetry/profiler).
+            **(profiler_window.manifest_fields()
+               if profiler_window is not None else {}))
 
     # Per-partition attribution for mesh runs (inert otherwise — the
     # recorder only probes when distributed AND a run log is attached;
@@ -586,7 +608,7 @@ def _fit_streaming_impl(
             timer.log_report(log)
         finish_run_log(run_log, timer, counters_start, e.n_trees // C,
                        round(time.perf_counter() - t_fit0, 4),
-                       partitions=part_rec)
+                       partitions=part_rec, costs=cost_collector)
         return e
 
     # Checkpoint/resume (SURVEY.md §5) — the streamed runs are the LONGEST
@@ -627,7 +649,8 @@ def _fit_streaming_impl(
             start_round=start_round, checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every, ev=ev,
             device_chunk_cache=device_chunk_cache,
-            ph=ph, run_log=run_log, part_rec=part_rec))
+            ph=ph, run_log=run_log, part_rec=part_rec,
+            window=profiler_window))
 
     # The ONE optional O(R·C) structure: per-chunk cached raw scores (4C
     # bytes/row). cache_preds=False recomputes scores from the partial
@@ -671,6 +694,8 @@ def _fit_streaming_impl(
             cfg.max_depth, F, cfg.n_bins)
     t_out = start_round * C
     for rnd in range(start_round, cfg.n_trees):
+        if profiler_window is not None:       # xprof window: start edge
+            profiler_window.round_start(rnd)
         t_round = time.perf_counter()
         # Gradients for every class tree of a round come from the
         # ROUND-START preds (the Driver computes grad_hess once per round,
@@ -812,6 +837,8 @@ def _fit_streaming_impl(
                 stop = ev.record(rnd, np.concatenate(val_preds))
         _emit_round(run_log, rnd, (time.perf_counter() - t_round) * 1e3,
                     ev)
+        if profiler_window is not None:       # xprof window: stop edge
+            profiler_window.round_end(rnd)
         if stop:
             log.info(
                 "streaming: early stop at round %d (best %s=%.6f at "
@@ -850,6 +877,7 @@ def _fit_streaming_device(
     ph=None,
     run_log: "RunLog | None" = None,
     part_rec: "PartitionRecorder | None" = None,
+    window=None,
 ) -> TreeEnsemble:
     """Device streaming loop: see fit_streaming. Per tree it makes
     max_depth histogram passes + 1 leaf pass (+ 1 pred-update pass between
@@ -957,6 +985,8 @@ def _fit_streaming_device(
         coll_bytes_round = C * n_chunks * tele_counters.hist_allreduce_bytes(
             cfg.max_depth, ens.n_features, cfg.n_bins)
     for rnd in range(start_round, cfg.n_trees):
+        if window is not None:                # xprof window: start edge
+            window.round_start(rnd)
         t_round = time.perf_counter()
         # Gradients for EVERY class tree of a round come from the
         # round-start preds (the Driver computes grad_hess once per round,
@@ -1033,9 +1063,12 @@ def _fit_streaming_device(
 
         stop = False
         if ev is not None:
-            # Apply the round's trees to the resident val preds, fetch the
-            # raw scores (pad rows sliced off) and score on host.
-            with ph("eval"):
+            # Two phases, matching the host loop's naming: "predict"
+            # applies the round's trees to the resident val preds and
+            # drains the raw scores (device work — the stream_update op
+            # carries its XLA cost analysis under this name), "eval" is
+            # the host-side (f64) metric reduction.
+            with ph("predict"):
                 scores = []
                 data = val_chunks.get(0)
                 for c in range(ev.n):
@@ -1046,9 +1079,12 @@ def _fit_streaming_device(
                     if c + 1 < ev.n:
                         data = val_chunks.get(c + 1)
                     scores.append(np.asarray(val_pred[c])[: ev.lens[c]])
+            with ph("eval"):
                 stop = ev.record(rnd, np.concatenate(scores))
         _emit_round(run_log, rnd, (time.perf_counter() - t_round) * 1e3,
                     ev)
+        if window is not None:                # xprof window: stop edge
+            window.round_end(rnd)
         part_rec.flush_round(rnd)
         if stop:
             log.info(

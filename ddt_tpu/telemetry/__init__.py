@@ -34,6 +34,21 @@ And mesh runs with a run log attached additionally record per-partition
 phase completion times (`partition_phases` per round, a `partition_skew`
 straggler reduction at run end — events.PartitionRecorder).
 
+Since the device-truth cost observatory (schema v3) three more:
+
+- costmodel — XLA compiled-executable cost/memory analysis captured at
+             each jit entry point's first compile (telemetry runs only),
+             emitted as `cost_analysis` events and joined against phase
+             wall-times into the report's roofline table with a bound-by
+             verdict (compute / HBM / recompile / host).
+- profiler — programmatic jax.profiler capture windows around a selected
+             round range (`train --xprof-dir --xprof-rounds`), cross-
+             referenced to the run log through the manifest's
+             xprof_dir/xprof_rounds extras and the run_id-named trace dir.
+- diffing  — `cli report diff A B`: per-phase / per-counter deltas with
+             benchwatch-band excursion flags ("gain +34%, jit_compiles
+             12→48, hist bytes-accessed x2.1").
+
 `report` renders a run summary from a JSONL log (`python -m ddt_tpu.cli
 report --log run.jsonl`, repeat --log to merge hosts); `trace` exports
 the Perfetto JSON; docs/OBSERVABILITY.md documents the schema and

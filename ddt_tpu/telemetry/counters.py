@@ -9,7 +9,10 @@ TPU perf killer the host wallclock alone cannot see:
   classic hidden cost: arXiv:1810.09868). The listener installs lazily
   (install_jax_listener) so a process that never attaches telemetry
   never registers it; once installed it is a single host integer add
-  per COMPILE — nothing per dispatch.
+  per COMPILE — nothing per dispatch. The SAME listener accumulates
+  `jit_compile_seconds` (cumulative backend-compile wall time) so the
+  run log carries recompile COST, not just count — the roofline
+  verdict's "recompile" leg reads it (telemetry/costmodel.py).
 - `h2d_bytes` / `d2h_bytes` — host↔device transfer bytes recorded at
   the backends' upload/fetch funnels (TPUDevice._put / fetch_tree and
   the fused tree-fetch). Approximate by design: scalar metric
@@ -34,12 +37,21 @@ returns None).
 
 from __future__ import annotations
 
+import contextlib
+
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 # Monotonic process-wide counters (plain ints: the GIL makes += atomic
 # enough for counting; these feed reports, not invariants).
 _c = {
     "jit_compiles": 0,
+    # Cumulative backend-compile WALL TIME (seconds, float) from the same
+    # jax.monitoring listener: the recompile COUNT says the silent killer
+    # is present, the seconds say what it costs — a run whose compile
+    # seconds rival a phase's wall time is recompile-bound no matter how
+    # healthy its kernels are (the roofline verdict in
+    # telemetry/costmodel.py reads exactly this).
+    "jit_compile_seconds": 0.0,
     "h2d_bytes": 0,
     "d2h_bytes": 0,
     "collective_bytes_est": 0,
@@ -52,6 +64,28 @@ _c = {
     "compiled_ensemble_cache_hits": 0,
 }
 _listener_installed = False
+# When truthy, the compile listener drops events: the cost observatory's
+# ANALYSIS compile (costmodel._capture re-compiles an already-compiled
+# program purely to read XLA's cost model) must not inflate the
+# recompile counters it exists to explain — a telemetry run's
+# jit_compiles would otherwise read ~2x a telemetry-less run's, and
+# `report diff` against a pre-v3 baseline would flag the observatory
+# itself as a regression. XLA compiles synchronously on the calling
+# thread, so a plain flag scoped by the context manager is sufficient.
+_suppressed = False
+
+
+@contextlib.contextmanager
+def suppress_compile_counting():
+    """Drop backend-compile counter events for the duration (the cost
+    observatory's analysis compiles — see _suppressed above)."""
+    global _suppressed
+    prev = _suppressed
+    _suppressed = True
+    try:
+        yield
+    finally:
+        _suppressed = prev
 
 
 def install_jax_listener() -> None:
@@ -66,8 +100,9 @@ def install_jax_listener() -> None:
         return
 
     def _on_duration(event, duration_secs=None, **kw) -> None:
-        if event == _COMPILE_EVENT:
+        if event == _COMPILE_EVENT and not _suppressed:
             _c["jit_compiles"] += 1
+            _c["jit_compile_seconds"] += float(duration_secs or 0.0)
 
     monitoring.register_event_duration_secs_listener(_on_duration)
     _listener_installed = True
@@ -96,9 +131,12 @@ def snapshot() -> dict:
 
 def delta(start: dict, end: dict | None = None) -> dict:
     """Counter movement since `start` (a snapshot()); `end` defaults to
-    now."""
+    now. Float counters (compile seconds) are rounded to keep the run
+    log's JSON readable; integer counters pass through exact."""
     end = end if end is not None else snapshot()
-    return {k: end[k] - start.get(k, 0) for k in _c}
+    out = {k: end[k] - start.get(k, 0) for k in _c}
+    out["jit_compile_seconds"] = round(out["jit_compile_seconds"], 4)
+    return out
 
 
 def device_peak_bytes() -> int | None:

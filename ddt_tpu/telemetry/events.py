@@ -22,9 +22,15 @@ import time
 
 # v2 (the distributed flight recorder): adds the per-partition events
 # `partition_phases` / `partition_skew` and the `run_id` / `host`
-# manifest extras the cross-host merge keys on. v1 logs remain readable
-# (no required field of an existing event changed).
-SCHEMA_VERSION = 2
+# manifest extras the cross-host merge keys on.
+# v3 (the device-truth cost observatory): adds the `cost_analysis` event
+# (XLA compiled-executable cost/memory analysis per jit entry point —
+# telemetry/costmodel.py) and the manifest's optional `xprof_dir` /
+# `xprof_rounds` extras (telemetry/profiler.py capture windows).
+# v1/v2 logs remain readable (no required field of an existing event
+# ever changed — the back-compat contract tests/test_observatory.py
+# pins).
+SCHEMA_VERSION = 3
 
 #: event type -> REQUIRED payload fields (extras are allowed and common:
 #: e.g. `round` records carry `valid_<metric>` keys named by the run's
@@ -57,6 +63,13 @@ EVENT_FIELDS: dict[str, set] = {
     # Device-counter deltas over the run (telemetry.counters).
     "counters": {"jit_compiles", "h2d_bytes", "d2h_bytes",
                  "collective_bytes_est"},
+    # XLA's own cost model for one jit-compiled op entry point at one
+    # argument signature (telemetry/costmodel.py): per-call FLOPs and
+    # bytes accessed from compile().cost_analysis(), plus extras —
+    # phase (the phase_timings name the roofline join keys on), calls,
+    # platform, arg/output/temp HBM bytes from memory_analysis(),
+    # signature. Emitted in the run epilogue, one per (op, signature).
+    "cost_analysis": {"op", "flops", "bytes_accessed"},
     # Last record of a completed run.
     "run_end": {"completed_rounds", "wallclock_s"},
 }
@@ -155,21 +168,27 @@ def emit_early_stop(run_log: "RunLog | None", stop_round: int, metric,
 
 def finish_run_log(run_log: "RunLog | None", timer, counters_start,
                    completed_rounds: int, wallclock_s: float,
-                   partitions: "PartitionRecorder | None" = None) -> None:
-    """Run-log epilogue — [partition_skew +] phase_timings + counters +
-    run_end — shared by Driver._finish_run and fit_streaming's _finish so
-    the trainers' terminal records cannot drift. `timer` is a PhaseTimer
-    or None; `counters_start` a telemetry.counters.snapshot() (or None);
-    `partitions` the mesh run's PartitionRecorder (or None). Closing
-    path-owned logs is the trainers' ownership shims' job (Driver.fit /
-    fit_streaming), which also covers the exception paths this helper
-    never sees."""
+                   partitions: "PartitionRecorder | None" = None,
+                   costs=None) -> None:
+    """Run-log epilogue — [partition_skew +] [cost_analysis... +]
+    phase_timings + counters + run_end — shared by Driver._finish_run
+    and fit_streaming's _finish so the trainers' terminal records cannot
+    drift. `timer` is a PhaseTimer or None; `counters_start` a
+    telemetry.counters.snapshot() (or None); `partitions` the mesh run's
+    PartitionRecorder (or None); `costs` the run's costmodel.Collector
+    (or None). Closing path-owned logs is the trainers' ownership shims'
+    job (Driver.fit / fit_streaming), which also covers the exception
+    paths this helper never sees."""
     if run_log is None:
         return
     from ddt_tpu.telemetry import counters as tele_counters
 
     if partitions is not None:
         partitions.emit_skew()
+    if costs is not None:
+        from ddt_tpu.telemetry import costmodel
+
+        costmodel.flush_into(run_log, costs)
     if timer is not None and timer.totals:
         run_log.emit("phase_timings", phases=timer.as_json())
     d = tele_counters.delta(counters_start or {})

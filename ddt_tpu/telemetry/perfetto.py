@@ -37,8 +37,11 @@ from __future__ import annotations
 import json
 
 #: one metadata slot per aggregate event type on the rounds lane
+#: (cost_analysis since schema v3: the observatory's per-op records ride
+#: the export as instants so a trace viewer can read the cost model next
+#: to the lanes).
 _INSTANT_EVENTS = ("early_stop", "fault", "run_end", "phase_timings",
-                   "counters", "partition_skew")
+                   "counters", "partition_skew", "cost_analysis")
 
 
 def _payload(rec: dict) -> dict:

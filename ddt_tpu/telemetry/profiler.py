@@ -1,0 +1,144 @@
+"""Programmatic jax.profiler capture windows around selected rounds.
+
+`--trace-dir` wraps the WHOLE fit in one jax.profiler trace — fine for a
+3-round smoke, useless for a 1000-round run (multi-GB traces, warmup
+compiles burying the steady state). This module captures a device trace
+around a selected round RANGE instead:
+
+    train --xprof-dir /tmp/prof --xprof-rounds 5:8 --run-log run.jsonl
+
+starts the profiler at round 5's first dispatch and stops it after round
+8 — warmup (round 1's compiles) skipped by choosing the window. The
+trace lands under `<xprof-dir>/run_<run_id>/`, and the run manifest is
+stamped with `xprof_dir` + `xprof_rounds`, so a flight-recorder lane and
+an xprof session cross-reference by `run_id` in both directions: the
+straggler table names the round, the manifest names the trace that holds
+that round's device timeline (docs/OBSERVABILITY.md has the worked
+example).
+
+The fused Driver path dispatches whole BLOCKS of rounds; the window caps
+block boundaries (`block_cap`) exactly like the checkpoint cadence does,
+so capture starts and stops on true round edges there too. With no
+window attached (`None`), the trainers skip every hook — the
+zero-overhead disabled-telemetry contract extends here (no profiler
+state, no file IO).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("ddt_tpu.telemetry.profiler")
+
+
+def parse_rounds(spec: str) -> tuple[int, int]:
+    """"5:8" -> (5, 8), 1-based inclusive. A single "5" means 5:5."""
+    s = str(spec).strip()
+    try:
+        if ":" in s:
+            lo_s, hi_s = s.split(":", 1)
+            lo, hi = int(lo_s), int(hi_s)
+        else:
+            lo = hi = int(s)
+    except ValueError:
+        raise ValueError(
+            f"--xprof-rounds must be LO:HI (1-based, inclusive) or a "
+            f"single round, got {spec!r}") from None
+    if lo < 1 or hi < lo:
+        raise ValueError(
+            f"--xprof-rounds window {spec!r} is empty or starts before "
+            "round 1")
+    return lo, hi
+
+
+class CaptureWindow:
+    """One profiler capture around rounds [lo, hi] (1-based, inclusive).
+
+    Protocol (the trainers drive it; every hook is a no-op once done):
+    - bind(run_id): fix the trace directory to <dir>/run_<run_id> —
+      called at manifest time so the path and the log cross-reference.
+    - round_start(rnd0) / round_end(rnd0): 0-based round boundary hooks
+      (granular Driver + both streaming loops).
+    - block_cap(rnd0, K): cap a fused block's round count so block
+      boundaries align with the window edges.
+    - close(): stop a still-open capture (run ended inside the window,
+      early stop, exception) — the trainers call it in `finally`.
+    """
+
+    def __init__(self, out_dir: str, rounds: str = "2:3"):
+        self.out_dir = str(out_dir)
+        self.lo, self.hi = parse_rounds(rounds)
+        self.trace_dir = self.out_dir      # until bind() names the run
+        self._started = False
+        self._done = False
+
+    def bind(self, run_id: str | None) -> None:
+        if run_id:
+            self.trace_dir = os.path.join(self.out_dir, f"run_{run_id}")
+
+    def manifest_fields(self) -> dict:
+        """The run-manifest extras (the cross-reference contract:
+        scripts/profile_smoke.py asserts exactly these)."""
+        return {"xprof_dir": self.trace_dir,
+                "xprof_rounds": [self.lo, self.hi]}
+
+    @property
+    def active(self) -> bool:
+        return self._started and not self._done
+
+    def round_start(self, rnd0: int) -> None:
+        """Start the capture when 0-based round `rnd0` enters the
+        window (>= lo covers resume-into-window starts; a resume PAST
+        the window retires it — capturing later rounds would contradict
+        the xprof_rounds the manifest advertises)."""
+        if rnd0 + 1 > self.hi:
+            self.close()                 # also stops a straggling capture
+            return
+        if self._started or self._done or rnd0 + 1 < self.lo:
+            return
+        try:
+            import jax
+        except ImportError:
+            self._done = True
+            return
+        os.makedirs(self.trace_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self.trace_dir)
+        except RuntimeError as e:        # another capture already running
+            log.warning("xprof capture not started: %s", e)
+            self._done = True
+            return
+        self._started = True
+        log.info("xprof capture started at round %d -> %s",
+                 rnd0 + 1, self.trace_dir)
+
+    def round_end(self, rnd0: int) -> None:
+        if self.active and rnd0 + 1 >= self.hi:
+            self._stop()
+
+    def block_cap(self, rnd0: int, K: int) -> int:
+        """Largest K' <= K such that the block [rnd0, rnd0+K') does not
+        straddle a window edge (start edge lo-1, stop edge hi — both
+        0-based block-boundary positions)."""
+        for b in (self.lo - 1, self.hi):
+            if rnd0 < b < rnd0 + K:
+                K = b - rnd0
+        return max(1, K)
+
+    def close(self) -> None:
+        if self.active:
+            self._stop()
+        self._done = True
+
+    def _stop(self) -> None:
+        self._done = True
+        self._started = False
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError as e:        # lost the race with another stop
+            log.warning("xprof capture stop failed: %s", e)
+            return
+        log.info("xprof capture written: %s", self.trace_dir)

@@ -108,6 +108,16 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
         rounds = [r for r in rounds if r.get("host", 0) == hosts[0]]
     phase_ev = [e for e in events if e["event"] == "phase_timings"]
     counter_ev = [e for e in events if e["event"] == "counters"]
+    cost_ev = [e for e in events if e["event"] == "cost_analysis"]
+    if len(hosts) > 1 and phase_ev:
+        # Merged pod logs: every host captured its own (SPMD-identical)
+        # programs — join ONE host's cost stream against that SAME
+        # host's phase wall-times (the reported phases are the last
+        # phase_timings event's; summing all hosts' flops against one
+        # host's wallclock would overstate achieved rates by the host
+        # count).
+        ph_host = phase_ev[-1].get("host", 0)
+        cost_ev = [e for e in cost_ev if e.get("host", 0) == ph_host]
     part_ev = [e for e in events if e["event"] == "partition_phases"]
     skew_ev = [e for e in events if e["event"] == "partition_skew"]
     cross_totals = (_cross_host_totals(part_ev)
@@ -172,7 +182,24 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
             {k: v for k, v in e.items()
              if k not in ("event", "schema", "t", "seq")}
             for e in events if e["event"] == "fault"],
+        # Device-truth cost observatory (schema v3): the raw
+        # cost_analysis records (the diff tool reads them) — absent-as-
+        # empty on pre-v3 logs.
+        "cost_events": [
+            {k: v for k, v in e.items()
+             if k not in ("event", "schema", "t", "seq")}
+            for e in cost_ev],
     }
+    # Roofline join (telemetry/costmodel.py): only when the log carries
+    # cost_analysis events — pre-v3 logs render exactly as before.
+    summary["roofline"] = None
+    if cost_ev and summary["phases"]:
+        from ddt_tpu.telemetry.costmodel import roofline_table
+
+        summary["roofline"] = roofline_table(
+            summary["phases"], summary["cost_events"],
+            counters=summary["counters"],
+            wallclock_s=summary["wallclock_s"])
     return summary
 
 
@@ -226,6 +253,21 @@ def render(summary: dict) -> str:
                 f"{p['ms_per_call']:>8.2f} ms/call  x{p['calls']:<6} "
                 f"{100 * p['share']:5.1f}%")
 
+    if summary.get("roofline"):
+        out.append("roofline (XLA cost model vs host wallclock; "
+                   "achieved against per-platform peak ceilings):")
+        for r in summary["roofline"]:
+            if r.get("gflops") is None:
+                dev = "no device cost registered"
+            else:
+                dev = (f"{r['gflops']:>9.2f} GFLOP/s "
+                       f"({100 * r['flops_util']:5.1f}%)  "
+                       f"{r['gbs']:>8.2f} GB/s "
+                       f"({100 * r['hbm_util']:5.1f}%)")
+            out.append(
+                f"  {r['phase']:<14} {r['ms']:>9.1f} ms  {dev:<44} "
+                f"-> {r['verdict']}")
+
     if summary.get("partition_skew"):
         n = summary.get("n_partitions")
         out.append(
@@ -270,9 +312,13 @@ def render(summary: dict) -> str:
 
     c = summary["counters"]
     if c:
+        compile_s = c.get("jit_compile_seconds")
         out.append(
             "counters: "
-            f"jit_compiles={c.get('jit_compiles')}  "
+            f"jit_compiles={c.get('jit_compiles')}"
+            + (f" ({compile_s:.2f}s compiling)"
+               if compile_s is not None else "")
+            + "  "
             f"h2d={_fmt_bytes(c.get('h2d_bytes'))}  "
             f"d2h={_fmt_bytes(c.get('d2h_bytes'))}  "
             f"collective≈{_fmt_bytes(c.get('collective_bytes_est'))}  "

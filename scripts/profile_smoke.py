@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Tier-1-safe xprof capture-window smoke (`make profile-smoke`): train 2
+rounds on the CPU backend-interpreted XLA platform with a run log AND a
+programmatic capture window over rounds 1:2, then assert
+
+- the window actually started and stopped (a trace directory exists
+  under <dir>/run_<run_id> and holds profiler output),
+- the run manifest carries the cross-reference fields the flight
+  recorder joins on (`xprof_dir` pointing at that directory,
+  `xprof_rounds` = the requested window, `run_id` embedded in the path),
+- the log still renders through `report` (the window must not perturb
+  the telemetry stream).
+
+tests/test_observatory.py runs this in-process; this script is the
+one-command end-to-end witness (docs/OBSERVABILITY.md). Exit 0 iff the
+whole pipeline holds.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from ddt_tpu import api
+    from ddt_tpu.telemetry import report
+    from ddt_tpu.telemetry.events import RunLog
+    from ddt_tpu.telemetry.profiler import CaptureWindow
+
+    rng = np.random.default_rng(0)
+    Xb = rng.integers(0, 23, size=(1024, 5), dtype=np.uint8)
+    y = (Xb[:, 0] > 11).astype(np.float32)
+
+    with tempfile.TemporaryDirectory(prefix="ddt_profile_smoke_") as td:
+        log_path = os.path.join(td, "run.jsonl")
+        xprof_root = os.path.join(td, "xprof")
+        window = CaptureWindow(xprof_root, "1:2")
+        with RunLog(log_path) as rl:
+            api.train(Xb, y, binned=True, n_trees=2, max_depth=3,
+                      n_bins=23, backend="tpu", run_log=rl,
+                      profiler_window=window)
+
+        events = report.read_events(log_path)
+        manifest = next(e for e in events if e["event"] == "run_manifest")
+        run_id = manifest.get("run_id")
+        fails = []
+        if not run_id:
+            fails.append("manifest carries no run_id")
+        if manifest.get("xprof_rounds") != [1, 2]:
+            fails.append(f"manifest xprof_rounds = "
+                         f"{manifest.get('xprof_rounds')!r}, wanted [1, 2]")
+        xdir = manifest.get("xprof_dir")
+        if not xdir or os.path.basename(xdir) != f"run_{run_id}":
+            fails.append(f"manifest xprof_dir {xdir!r} does not embed "
+                         f"run_{run_id}")
+        if xdir != window.trace_dir:
+            fails.append("manifest xprof_dir disagrees with the window")
+        trace_files = []
+        if xdir and os.path.isdir(xdir):
+            for dirpath, _dirs, fns in os.walk(xdir):
+                trace_files.extend(os.path.join(dirpath, f) for f in fns)
+        if not trace_files:
+            fails.append(f"no profiler output under {xdir!r}")
+        if window.active:
+            fails.append("capture window still open after fit")
+        # The window must not perturb the stream: summary still renders.
+        summary = report.summarize(events)
+        if summary["completed_rounds"] != 2:
+            fails.append(f"completed_rounds = "
+                         f"{summary['completed_rounds']}, wanted 2")
+        if fails:
+            for f in fails:
+                print(f"profile smoke: {f}", file=sys.stderr)
+            return 1
+        print(json.dumps({
+            "smoke": "profile", "ok": True, "run_id": run_id,
+            "xprof_dir": os.path.basename(xdir),
+            "trace_files": len(trace_files),
+            "rounds": manifest["xprof_rounds"],
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""raw-phase-timing negative fixture: the sanctioned timing paths —
+PhaseTimer phases, phase_ctx spans, named scopes — plus time-module
+uses that are not clock reads."""
+
+import time
+
+from ddt_tpu.telemetry.annotations import phase_ctx, traced_scope
+
+
+def grow_level(timer, dispatch, hist):
+    ph = phase_ctx(timer)
+    with ph("hist"):                              # the trainer-layer home
+        out = dispatch(hist)
+    return out
+
+
+def traced(x):
+    with traced_scope("hist"):                    # device-side attribution
+        return x + 1
+
+
+def backoff(retries):
+    time.sleep(0.01 * retries)                    # a sleep, not a clock
+
+
+def injected(clock):
+    return clock()                                # parameter, not time.*
+
+
+def strftime_label():
+    return time.strftime("%Y%m%d")                # formatting, not timing

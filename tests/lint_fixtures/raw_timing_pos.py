@@ -1,0 +1,26 @@
+"""raw-phase-timing positive fixture: host clocks in the device-op
+layer — every one measures dispatch, not the device."""
+
+import time
+
+
+def grow_level(dispatch, hist):
+    t0 = time.perf_counter()                      # LINT: raw-phase-timing
+    out = dispatch(hist)
+    return out, time.perf_counter() - t0          # LINT: raw-phase-timing
+
+
+def stamp_round(run):
+    run["t"] = time.time()                        # LINT: raw-phase-timing
+    return run
+
+
+def poll(handle):
+    deadline = time.monotonic() + 5.0             # LINT: raw-phase-timing
+    return deadline
+
+
+def precise(dispatch):
+    t = time.perf_counter_ns()                    # LINT: raw-phase-timing
+    dispatch()
+    return time.perf_counter_ns() - t             # LINT: raw-phase-timing

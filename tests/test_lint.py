@@ -64,6 +64,8 @@ CASES = [
      "pallas_interpret_neg.py", "ddt_tpu/ops/fixture_mod.py"),
     ("named-scope", "named_scope_pos.py", "named_scope_neg.py",
      "ddt_tpu/ops/fixture_mod.py"),
+    ("raw-phase-timing", "raw_timing_pos.py", "raw_timing_neg.py",
+     "ddt_tpu/ops/fixture_mod.py"),
 ]
 
 

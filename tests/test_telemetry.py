@@ -48,9 +48,17 @@ def test_runlog_emits_and_round_trips_every_event_type(tmp_path):
         "early_stop": dict(round=2, best_round=1, best_score=0.59,
                            metric="logloss"),
         "fault": dict(kind="checkpoint_resume", round=1),
-        "counters": dict(jit_compiles=1, h2d_bytes=10, d2h_bytes=5,
+        "counters": dict(jit_compiles=1, jit_compile_seconds=0.25,
+                         h2d_bytes=10, d2h_bytes=5,
                          collective_bytes_est=0, device_peak_bytes=None,
                          host_peak_rss_bytes=123456),
+        # Schema v3 (device-truth cost observatory): XLA's cost model for
+        # one op entry point at one signature.
+        "cost_analysis": dict(op="hist", flops=2.5e9, bytes_accessed=1e9,
+                              phase="hist", calls=12, platform="cpu",
+                              arg_bytes=1000, output_bytes=200,
+                              temp_bytes=50,
+                              signature="([1000, 7]:uint8)"),
         "partition_phases": dict(
             round=1, rounds=1,
             partitions=[{"device": 0, "phases": {"grow": 1.5},
@@ -167,6 +175,13 @@ def test_disabled_path_no_syncs_no_file_io(monkeypatch, tmp_path):
     # Flight-recorder collectors (schema v2) are held to the same bar:
     # no shard probes while telemetry is off (the probe is a barrier).
     monkeypatch.setattr(mesh_lib, "shard_ready_times", _boom)
+    # Cost observatory (schema v3), same bar: no collector install and —
+    # the acceptance criterion — no compile()/re-lowering on the hot
+    # path while telemetry is off (_capture is the only lowering site).
+    from ddt_tpu.telemetry import costmodel
+
+    monkeypatch.setattr(costmodel, "activate", _boom)
+    monkeypatch.setattr(costmodel, "_capture", _boom)
 
     cfg = TrainConfig(n_trees=3, max_depth=3, n_bins=29, backend="tpu")
     be = TPUDevice(cfg)

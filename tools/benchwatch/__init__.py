@@ -61,6 +61,14 @@ METRICS: dict[str, str] = {
     "predict_pallas_mrows_per_sec": "higher",
     "predict_onehot_mrows_per_sec": "higher",
     "predict_pallas_ab_ratio": "higher",
+    # Roofline utilization stamps (cost observatory): achieved/peak
+    # fractions from XLA's cost model at the measured wallclock — losing
+    # utilization is a regression even when absolute throughput drift
+    # hides it inside the tunnel bands.
+    "hist_roofline_flops_util": "higher",
+    "hist_roofline_hbm_util": "higher",
+    "predict_roofline_flops_util": "higher",
+    "predict_roofline_hbm_util": "higher",
     "split_agreement": "higher",
     "auc_delta": "lower",
 }

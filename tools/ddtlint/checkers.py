@@ -552,6 +552,45 @@ class NamedScopeChecker(Checker):
         return False
 
 
+# --------------------------------------------------------------------- #
+# 9. raw-phase-timing
+# --------------------------------------------------------------------- #
+class RawPhaseTimingChecker(Checker):
+    """Raw host clocks (`time.time()` / `time.perf_counter()` /
+    `time.monotonic()`, and their _ns twins) in the device-op layer
+    (ddt_tpu/ops/, ddt_tpu/backends/): a host timestamp around device
+    work measures DISPATCH, not the device — XLA enqueues asynchronously,
+    so the number silently reports queue depth and looks plausible in a
+    log.  Phase timing belongs at the trainer layer through
+    PhaseTimer/phase_ctx (utils/profiling.py + telemetry/annotations.py,
+    which pair the wallclock with the required sync discipline and emit
+    it into the run log); device-side attribution belongs to the named
+    `ddt:` scopes + the cost observatory (telemetry/costmodel.py), not a
+    clock.  The trainer loops (driver/streaming — PhaseTimer's
+    consumers), the timing subsystem itself, the shard-readiness probe
+    (parallel/mesh.py), bench harnesses, cli, and tests are all outside
+    the scope: their clocks ARE the instrument.  time.sleep and the time
+    module's non-clock helpers are not flagged."""
+
+    rule = "raw-phase-timing"
+    path_scope = (r"^ddt_tpu/ops/", r"^ddt_tpu/backends/")
+    _CLOCKS = {"time.time", "time.perf_counter", "time.monotonic",
+               "time.perf_counter_ns", "time.monotonic_ns",
+               "time.process_time", "time.process_time_ns"}
+
+    def visit_Call(self, node: ast.Call):
+        d = callgraph.dotted(node.func)
+        if d in self._CLOCKS:
+            self.report(node, (
+                f"`{d}()` in the device-op layer times DISPATCH, not the "
+                "device (XLA enqueues asynchronously) — time phases at "
+                "the trainer layer via PhaseTimer/phase_ctx "
+                "(telemetry/annotations.py), or attribute device work "
+                "with `ddt:` scopes + the cost observatory "
+                "(docs/OBSERVABILITY.md)"))
+        self.generic_visit(node)
+
+
 AST_CHECKERS = [
     TracedBranchChecker,
     HostSyncChecker,
@@ -561,6 +600,7 @@ AST_CHECKERS = [
     NoPrintChecker,
     PallasInterpretChecker,
     NamedScopeChecker,
+    RawPhaseTimingChecker,
 ]
 
 
