@@ -40,6 +40,13 @@ trace-smoke:
 profile-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/profile_smoke.py
 
+# Training-kernel smoke (docs/PERF.md "Training kernel"): 2 fused rounds
+# through the VMEM-streaming Pallas histogram (interpret mode) with
+# sibling subtraction on; asserts fused/granular parity and the
+# ddt:fused_round / ddt:hist:{stream,flush,subtract} spans.
+kernel-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/kernel_smoke.py
+
 # Bench regression sentinel (docs/OBSERVABILITY.md): band every metric
 # of the newest BENCH_r*/MULTICHIP_r* artifact against the history
 # (median ± max(3*MAD, 20%)); exit 1 on an adverse excursion. Point a
@@ -51,4 +58,4 @@ native:
 	$(MAKE) -C ddt_tpu/native
 
 .PHONY: lint lint-baseline tsan-audit test report trace-smoke \
-	profile-smoke benchwatch native
+	profile-smoke kernel-smoke benchwatch native

@@ -402,6 +402,10 @@ class TPUDevice(DeviceBackend):
         cfg = self.cfg
         axis = self._row_axes if self.distributed else None
         faxis = FAXIS if self.feature_partitions > 1 else None
+        # Platform-resolved ONCE at program build (trace-time static) —
+        # the fused and granular paths must agree or their bit-exactness
+        # contract breaks.
+        subtract = grow_ops.resolve_hist_subtraction(cfg.hist_subtraction)
 
         def grow(Xb, g, h, fmask=None):
             tree = grow_ops.grow_tree(
@@ -418,6 +422,7 @@ class TPUDevice(DeviceBackend):
                 feature_mask=fmask,
                 missing_bin=cfg.missing_policy == "learn",
                 cat_features=cfg.cat_features,
+                hist_subtraction=subtract,
             )
             delta = grow_ops.tree_predict_delta(tree, cfg.learning_rate)
             # Pack the tiny node arrays into ONE f32 array so the host
@@ -612,6 +617,7 @@ class TPUDevice(DeviceBackend):
         mfn = device_metric(eval_metric, n_classes=C) if eval_metric \
             else None
         missing = cfg.missing_policy == "learn"
+        subtract = grow_ops.resolve_hist_subtraction(cfg.hist_subtraction)
 
         allreduce = _axis_allreduce(axis)
 
@@ -671,6 +677,7 @@ class TPUDevice(DeviceBackend):
                             fmask_r[c] if fmask_r is not None else None),
                         missing_bin=missing,
                         cat_features=cfg.cat_features,
+                        hist_subtraction=subtract,
                     )
                     delta = grow_ops.tree_predict_delta(
                         tree, cfg.learning_rate)

@@ -145,6 +145,27 @@ def _roofline_util(prefix: str, fn, args: tuple,
     }
 
 
+def _paired_ab_reps(bout, key_a, key_b, reps: int):
+    """Order-alternating PAIRED reps — the ONE home of the two-arm A/B
+    timing protocol that survives the tunnel's ±20% bands (round-4/5
+    analysis: both arms of a pair share the band, so the per-rep ratio
+    is robust where cross-run comparisons are not; alternating the
+    order cancels residual within-pair drift). `bout(key)` runs and
+    times one bout of that arm. Returns ({key: [dt, ...]},
+    [dt_a / dt_b per rep]) — callers reduce per-arm dts (min or median)
+    and take the median of the ratios as the A/B evidence."""
+    dts = {key_a: [], key_b: []}
+    ratios = []
+    for rep in range(reps):
+        order = (key_a, key_b) if rep % 2 == 0 else (key_b, key_a)
+        pair = {}
+        for k in order:
+            pair[k] = bout(k)
+            dts[k].append(pair[k])
+        ratios.append(pair[key_a] / pair[key_b])
+    return dts, ratios
+
+
 def bench_histogram_ab(
     bins_a: int = 255,
     bins_b: int = 64,
@@ -168,33 +189,26 @@ def bench_histogram_ab(
     from ddt_tpu.backends import get_backend
     from ddt_tpu.utils.device import device_sync as sync
 
-    arms = []
+    arms = {}
     for bins in (bins_a, bins_b):
         be = get_backend(TrainConfig(n_bins=bins, backend="tpu"))
         Xb, g, h, ni = _hist_inputs(rows, features, bins, n_nodes, seed)
         args = (be.upload(Xb), be._put_rows(g), be._put_rows(h),
                 be._put_rows(ni))
         sync(be.build_histograms(*args, n_nodes))   # compile + first run
-        arms.append({"be": be, "args": args, "bins": bins,
-                     "dt": float("inf")})
+        arms[bins] = {"be": be, "args": args}
 
-    def bout(arm):
-        be, args = arm["be"], arm["args"]
+    def bout(bins):
+        be, args = arms[bins]["be"], arms[bins]["args"]
         t0 = time.perf_counter()
         for _ in range(iters):
             out = be.build_histograms(*args, n_nodes)
         sync(out)
         return (time.perf_counter() - t0) / iters
 
-    ratios = []
-    for rep in range(reps):
-        order = arms if rep % 2 == 0 else arms[::-1]
-        dts = {}
-        for arm in order:
-            dts[arm["bins"]] = bout(arm)
-            arm["dt"] = min(arm["dt"], dts[arm["bins"]])
-        ratios.append(dts[bins_a] / dts[bins_b])
-    m_a, m_b = (rows / arm["dt"] / 1e6 for arm in arms)
+    dts, ratios = _paired_ab_reps(bout, bins_a, bins_b, reps)
+    dt_a, dt_b = min(dts[bins_a]), min(dts[bins_b])
+    m_a, m_b = rows / dt_a / 1e6, rows / dt_b / 1e6
     out = {
         "kernel": "histogram_ab",
         "rows": rows, "features": features, "n_nodes": n_nodes,
@@ -205,12 +219,83 @@ def bench_histogram_ab(
     # Roofline stamp for the headline (255-bin) arm: XLA's cost model at
     # the arm's measured per-build wallclock (cost-observatory satellite;
     # benchwatch bands the utilization fractions).
-    be_a, args_a = arms[0]["be"], arms[0]["args"]
+    be_a, args_a = arms[bins_a]["be"], arms[bins_a]["args"]
     out.update(_roofline_util(
         "hist",
         lambda d, gg, hh, ni: be_a.build_histograms(d, gg, hh, ni,
                                                     n_nodes),
-        args_a, arms[0]["dt"]))
+        args_a, dt_a))
+    return out
+
+
+def bench_hist_fused_ab(
+    rows: int = 1_000_000,
+    features: int = 28,
+    bins: int = 255,
+    depth: int = 6,
+    iters: int = 4,
+    reps: int = 8,
+    seed: int = 0,
+) -> dict:
+    """PAIRED fused-round A/B: the whole per-tree level loop
+    (ops/grow.grow_tree — hist -> [subtract] -> gain -> route, one
+    dispatch) with the sibling-subtraction trick ON vs OFF, at the
+    Higgs-1M depth-6 shape. Same statistic as bench_histogram_ab (the
+    only one that survives the tunnel's ±20% bands): per-rep PAIRED
+    ratio with the arm order alternating every rep, median-of-ratios as
+    the A/B evidence, min-of-reps per-arm timing as the headline.
+    ratio_on_over_off > 1 means subtraction is winning; ~1.0 means the
+    trick silently fell out of the dispatch (the floor's target).
+    Throughputs are NOMINAL hist-row-equivalents (rows x depth levels /
+    sec) so the two arms share a unit."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddt_tpu.ops import grow as grow_ops
+    from ddt_tpu.utils.device import device_sync as sync
+
+    rng = np.random.default_rng(seed)
+    Xb = jnp.asarray(rng.integers(0, bins, size=(rows, features),
+                                  dtype=np.uint8))
+    g = jnp.asarray(rng.standard_normal(rows).astype(np.float32))
+    h = jnp.asarray((rng.random(rows) + 0.5).astype(np.float32))
+
+    def build(sub):
+        return jax.jit(functools.partial(
+            grow_ops.grow_tree, max_depth=depth, n_bins=bins,
+            reg_lambda=1.0, min_child_weight=1e-3, min_split_gain=0.0,
+            hist_subtraction=sub))
+
+    fns = {}
+    for sub in (True, False):
+        fns[sub] = build(sub)
+        sync(fns[sub](Xb, g, h).leaf_value)   # compile + first run
+
+    def bout(sub):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            tree = fns[sub](Xb, g, h)
+        sync(tree.leaf_value)
+        return (time.perf_counter() - t0) / iters
+
+    # ratio = dt_off / dt_on: > 1 means subtraction wins.
+    dts, ratios = _paired_ab_reps(bout, False, True, reps)
+    dt_on, dt_off = min(dts[True]), min(dts[False])
+    out = {
+        "kernel": "hist_fused_ab",
+        "rows": rows, "features": features, "bins": bins, "depth": depth,
+        "iters": iters, "reps": reps,
+        "mrows_on": rows * depth / dt_on / 1e6,
+        "mrows_off": rows * depth / dt_off / 1e6,
+        "ratio_on_over_off": float(np.median(ratios)),
+    }
+    # Roofline stamp for the fused (subtraction-ON) round — XLA's own
+    # cost model at the measured per-tree wallclock; benchwatch bands the
+    # utilization fractions (a silent fallback to full-level builds shows
+    # up here even when wallclock drift hides it).
+    out.update(_roofline_util("hist_fused", fns[True], (Xb, g, h), dt_on))
     return out
 
 
@@ -517,15 +602,8 @@ def bench_predict_pallas_ab(
         run(use_pallas)
         return time.perf_counter() - t0
 
-    dts = {True: [], False: []}
-    ratios = []
-    for rep in range(reps):
-        order = (True, False) if rep % 2 == 0 else (False, True)
-        pair = {}
-        for arm in order:
-            pair[arm] = bout(arm)
-            dts[arm].append(pair[arm])
-        ratios.append(pair[False] / pair[True])   # >1 = pallas faster
+    # ratio = dt_onehot / dt_pallas: > 1 means pallas faster.
+    dts, ratios = _paired_ab_reps(bout, False, True, reps)
     med = {arm: float(np.median(v)) for arm, v in dts.items()}
     return {
         "kernel": "predict_pallas_ab",

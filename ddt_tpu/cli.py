@@ -505,6 +505,12 @@ def main(argv: list[str] | None = None) -> int:
                          "(TrainConfig.fused_block_rounds)")
     tp.add_argument("--hist-impl", default="auto",
                     choices=["auto", "matmul", "segment", "pallas"])
+    tp.add_argument("--hist-subtraction", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="sibling-subtraction trick in the level loop "
+                         "(left children built, right = parent - left); "
+                         "auto = on only on a real TPU chip "
+                         "(TrainConfig.hist_subtraction)")
     tp.add_argument("--stream-chunks", type=int, default=0,
                     help="train via the streaming path (BASELINE config 5) "
                          "with the dataset split into this many chunks: "
@@ -681,6 +687,7 @@ def main(argv: list[str] | None = None) -> int:
             subsample=args.subsample,
             colsample_bytree=args.colsample_bytree,
             hist_impl=args.hist_impl, seed=args.seed,
+            hist_subtraction=args.hist_subtraction,
             missing_policy=args.missing,
             cat_features=cat_features,
             fused_block_rounds=args.fused_block_rounds,

@@ -71,6 +71,16 @@ class TrainConfig:
     #   Total devices used = host_partitions x n_partitions x
     #   feature_partitions.
     hist_impl: str = "auto"     # auto | matmul | segment | pallas
+    # Sibling-subtraction trick in the level loop (ops/grow.
+    # level_histograms): levels >= 1 build histograms only for LEFT
+    # children and recover each right child as parent - left — half the
+    # kernel work and half the allreduce payload per level. "auto"
+    # enables it only on a real TPU chip (ops/grow.
+    # resolve_hist_subtraction): right-child sums differ from a direct
+    # build by f32 ULPs, which model quality never sees but the CPU
+    # suites' streamed == in-memory BITWISE contracts would; "on"/"off"
+    # force either side (tests use "on" with interpret-mode kernels).
+    hist_subtraction: str = "auto"  # auto | on | off
     # Batch-scoring traversal implementation (ops/predict.py dispatch):
     # "auto" takes the Pallas VMEM traversal kernel on binned data when a
     # real TPU backs the computation and the shape fits its VMEM budget,
@@ -120,6 +130,11 @@ class TrainConfig:
             raise ValueError("subsample must be in (0, 1]")
         if not (0.0 < self.colsample_bytree <= 1.0):
             raise ValueError("colsample_bytree must be in (0, 1]")
+        if self.hist_subtraction not in ("auto", "on", "off"):
+            raise ValueError(
+                f"hist_subtraction must be auto|on|off, got "
+                f"{self.hist_subtraction!r}"
+            )
         if self.predict_impl not in ("auto", "pallas", "onehot"):
             raise ValueError(
                 f"predict_impl must be auto|pallas|onehot, got "

@@ -7,6 +7,16 @@ UNROLLED inside one jitted function (static shapes per level: level d has
 round-trips. The reference crosses the host<->device boundary per kernel call;
 on TPU that would serialise ~6 dispatches x 100 trees of latency, so we fuse.
 
+Each level is one FUSED ROUND (`ddt:fused_round`): the VMEM-streaming
+histogram kernel, the optional sibling-SUBTRACTION assembly
+(level_histograms — levels >= 1 build only left children and recover
+right children as parent - left, halving kernel work and allreduce
+payload; arXiv:1812.08295's pipelined on-chip hist->gain architecture is
+the blueprint), the gain epilogue (split.best_splits_impl inlined into
+the same program — no nested pjit boundary), and row routing — with no
+intermediate state landing in HBM between stages beyond the level's own
+[2^d, F, B, 2] histogram.
+
 Distribution (SURVEY.md §1 L2): pass `axis_name` when tracing under
 jax.shard_map over a row-sharded mesh — the histogram (and final-leaf
 aggregate) get a `jax.lax.psum` over ICI, which is the TPU-native realisation
@@ -45,10 +55,97 @@ from ddt_tpu.parallel import mesh as mesh_lib
 from ddt_tpu.telemetry.annotations import traced_scope
 
 # Perfetto alignment (docs/OBSERVABILITY.md): the traced_scope blocks
-# below name the lowered XLA ops `ddt:hist` / `ddt:allreduce` /
-# `ddt:gain` / `ddt:route` / `ddt:leaf`, so a profiler capture's device
-# timeline carries the same phase names as the host PhaseTimer spans.
-# Zero runtime cost — named scopes are HLO metadata, not ops.
+# below name the lowered XLA ops `ddt:fused_round` (one whole level's
+# hist -> subtract -> gain -> route group) with `ddt:hist` /
+# `ddt:allreduce` / `ddt:hist:subtract` / `ddt:gain` / `ddt:route` /
+# `ddt:leaf` nested inside, so a profiler capture's device timeline
+# carries the same phase names as the host PhaseTimer spans. Zero
+# runtime cost — named scopes are HLO metadata, not ops.
+
+
+def resolve_hist_subtraction(flag: str, platform: str | None = None) -> bool:
+    """cfg.hist_subtraction ('auto'|'on'|'off') -> bool for this platform.
+
+    'auto' enables the sibling-subtraction trick only on a real TPU chip:
+    it changes right-child bin sums by float-rounding ULPs (parent - left
+    vs a direct sum), which is invisible to model quality and absorbed by
+    the bf16 gain rounding in almost every decision, but would break the
+    streamed == in-memory BITWISE contracts the CPU fixed-seed suites
+    assert (ops/split.py's determinism-boundary notes). Off-chip runs and
+    oracles therefore default off; tests opt in with 'on'."""
+    if flag == "on":
+        return True
+    if flag == "off":
+        return False
+    if flag != "auto":
+        raise ValueError(
+            f"hist_subtraction must be auto|on|off, got {flag!r}")
+    if platform is None:
+        platform = jax.default_backend()
+    return platform == "tpu"
+
+
+def level_histograms(
+    Xb: jax.Array,
+    g: jax.Array,
+    h: jax.Array,
+    node_index: jax.Array,      # int32 [R] level-local, -1 = frozen
+    n_level: int,
+    n_bins: int,
+    *,
+    hist_impl: str = "auto",
+    row_chunk: int = 32_768,
+    input_dtype=jnp.bfloat16,
+    allreduce=lambda x: x,
+    parent_hist: jax.Array | None = None,   # [n_level//2, F, B, 2], the
+    #   PREVIOUS level's post-allreduce histograms
+    parent_split: jax.Array | None = None,  # bool [n_level//2]: which
+    #   parents actually split (children of leaves must read zero mass)
+) -> jax.Array:
+    """One level's [n_level, F, B, 2] histograms (post-allreduce), with
+    the classic GBDT sibling-SUBTRACTION trick when parent state is
+    given: only LEFT children are built from rows (half the kernel work
+    AND half the allreduce payload), and each right child is recovered as
+    parent - left. Children of non-split parents are gated to exactly
+    zero — without the gate a frozen parent's phantom right child would
+    inherit the full parent mass and could "win" a split no training row
+    can reach (a predict-time divergence, since predict-time rows CAN
+    reach it).
+
+    Exactness: left-child sums are BITWISE identical to a direct full
+    build (a node's rows accumulate in the same tile order; absent rows
+    contribute exact +0.0 terms either way). Right-child sums differ
+    from a direct build by f32 rounding ULPs — the documented seam
+    behind cfg.hist_subtraction's platform gating."""
+    if parent_hist is None or n_level < 2:
+        with traced_scope("hist"):
+            hist = H.build_histograms(
+                Xb, g, h, node_index, n_level, n_bins,
+                impl=hist_impl, row_chunk=row_chunk,
+                input_dtype=input_dtype,
+            )
+        with traced_scope("allreduce"):
+            return allreduce(hist)
+    half = n_level // 2
+    with traced_scope("hist"):
+        # Rows sitting in LEFT children (even level-local index) keyed by
+        # parent slot; everyone else (right children, frozen) masks out.
+        is_left = (node_index >= 0) & (node_index % 2 == 0)
+        li = jnp.where(is_left, node_index // 2, -1).astype(jnp.int32)
+        hist_left = H.build_histograms(
+            Xb, g, h, li, half, n_bins,
+            impl=hist_impl, row_chunk=row_chunk, input_dtype=input_dtype,
+        )
+    with traced_scope("allreduce"):    # HALF a full level's payload
+        hist_left = allreduce(hist_left)
+    with traced_scope("hist:subtract"):
+        gate = parent_split.reshape(half, 1, 1, 1)
+        hist_right = jnp.where(gate, parent_hist - hist_left,
+                               jnp.float32(0.0))
+        # Interleave [half, {left,right}, F, B, 2] -> level order
+        # (left child = 2p, right child = 2p + 1).
+        hist = jnp.stack([hist_left, hist_right], axis=1)
+        return hist.reshape((n_level,) + hist_left.shape[1:])
 
 
 class TreeArrays(NamedTuple):
@@ -85,6 +182,10 @@ def grow_tree(
     #   holds NaN rows; splits learn a default direction for them.
     cat_features: tuple = (),    # GLOBAL feature indices with one-vs-rest
     #   ("bin == k goes left") categorical splits (cfg.cat_features).
+    hist_subtraction: bool = False,  # sibling-subtraction trick: levels
+    #   >= 1 build only LEFT-child histograms and derive right children as
+    #   parent - left (see level_histograms / resolve_hist_subtraction —
+    #   backends resolve cfg.hist_subtraction before tracing).
 ) -> TreeArrays:
     """Grow one complete-heap tree. Trace under jit (and shard_map if
     axis_name is set). Matches reference/numpy_trainer.grow_tree decisions.
@@ -132,128 +233,154 @@ def grow_tree(
         if cat_vec_g is not None:
             cat_vec = jax.lax.dynamic_slice_in_dim(cat_vec_g, f_lo, F)
 
+    # Sibling-subtraction carry: the previous level's post-allreduce
+    # histograms + its split decisions (level_histograms gates phantom
+    # children of frozen parents on these). None keeps every level a
+    # direct build — the bit-exact baseline path.
+    prev_hist = None
+    prev_split = None
+
     for depth in range(max_depth):         # unrolled: static 2^d nodes/level
         offset = (1 << depth) - 1
         n_level = 1 << depth
         node_index = jnp.where(frozen, -1, node_id - offset).astype(jnp.int32)
-        with traced_scope("hist"):
-            hist = H.build_histograms(
+        # One FUSED level round: hist -> [psum] -> (subtract) -> gain ->
+        # route, a single traced group with no host boundary and no HBM
+        # round-trip of intermediate state between stages (the gain
+        # epilogue consumes best_splits_impl directly — no nested pjit).
+        with traced_scope("fused_round"):
+            hist = level_histograms(
                 Xb, g, h, node_index, n_level, n_bins,
-                impl=hist_impl, row_chunk=row_chunk, input_dtype=input_dtype,
+                hist_impl=hist_impl, row_chunk=row_chunk,
+                input_dtype=input_dtype, allreduce=allreduce,
+                parent_hist=prev_hist, parent_split=prev_split,
             )
-        with traced_scope("allreduce"):    # the cross-partition allreduce
-            hist = allreduce(hist)
-        if feature_axis_name is None:
-            G, Hh = S.node_totals(hist)
-        else:
-            # Node totals from the row vectors, not the histogram: local
-            # histograms hold different COLUMNS per shard, so their bin sums
-            # agree only up to float add order — this form is bit-identical
-            # (and provably feature-axis-invariant) on every shard.
-            act = node_index >= 0
-            seg = jnp.clip(node_index, 0, n_level - 1)
-            G = allreduce(jax.ops.segment_sum(
-                jnp.where(act, g, 0.0), seg, num_segments=n_level))
-            Hh = allreduce(jax.ops.segment_sum(
-                jnp.where(act, h, 0.0), seg, num_segments=n_level))
-        with traced_scope("gain"):
-            gains, feats, bins, dls = S.best_splits(
-                hist, reg_lambda, min_child_weight, feature_mask,
-                missing_bin=missing_bin, cat_mask=cat_vec)
-            if feature_axis_name is not None:
-                # Combine per-shard winners: all_gather the (gain, feat,
-                # bin, direction) tuples (tiny), argmax over shards —
-                # first shard wins ties, preserving the global
-                # first-(feature,bin) tie-break rule.
-                feats = feats + f_lo
-                ga = jax.lax.all_gather(gains, feature_axis_name)
-                fa = jax.lax.all_gather(feats, feature_axis_name)
-                ba = jax.lax.all_gather(bins, feature_axis_name)
-                da = jax.lax.all_gather(dls, feature_axis_name)
-                w = jnp.argmax(ga, axis=0)                     # [n_level]
-                gains = jnp.take_along_axis(ga, w[None], axis=0)[0]
-                feats = jnp.take_along_axis(fa, w[None], axis=0)[0]
-                bins = jnp.take_along_axis(ba, w[None], axis=0)[0]
-                dls = jnp.take_along_axis(da, w[None], axis=0)[0]
-        # Guarded like the final level and the streamed twin: an EMPTY
-        # node at reg_lambda=0 would otherwise store -0/0 = NaN as its
-        # leaf value, which a predict-time row (different data) can reach.
-        value = jnp.where(Hh > 0, -G / (Hh + reg_lambda), 0.0)
-
-        do_split = (
-            (gains > min_split_gain) & jnp.isfinite(gains) & (Hh > 0)
-        )
-        sl = slice(offset, offset + n_level)
-        feature = feature.at[sl].set(jnp.where(do_split, feats, -1))
-        threshold_bin = threshold_bin.at[sl].set(jnp.where(do_split, bins, 0))
-        is_leaf = is_leaf.at[sl].set(~do_split)
-        leaf_value = leaf_value.at[sl].set(jnp.where(do_split, 0.0, value))
-        split_gain = split_gain.at[sl].set(
-            jnp.where(do_split, gains.astype(jnp.float32), 0.0))
-        default_left = default_left.at[sl].set(do_split & dls)
-
-        # Route rows through the new splits (dense node-id update). All
-        # per-row lookups are one-hot compare+reduce instead of gathers:
-        # TPU gathers (even from a 32-entry table) each cost ~10-20 ms at
-        # 1M rows, while the [R, n_level] masked reductions are a few ms
-        # total — and integer one-hot sums are EXACT, so routing is
-        # bit-identical to the gather formulation. The five per-node
-        # tables (feature, bin, cat-ness, direction, do_split) are packed
-        # into ONE int32 so a single masked reduction covers them:
-        # feat<<12 | bin<<3 | cat<<2 | default_left<<1 | split.
-        with traced_scope("route"):
-            idx_c = jnp.clip(node_id - offset, 0, n_level - 1)
-            noh = (idx_c[:, None]
-                   == jnp.arange(n_level, dtype=jnp.int32)[None, :])
-            if cat_vec_g is not None:
-                # Per-NODE cat-ness of the winning (global) feature. An
-                # n_level-sized gather from the replicated [F_global] table is
-                # fine — the gathers this file avoids are [R]-sized ones.
-                cat_n = jnp.take(cat_vec_g, feats, axis=0)
-            else:
-                cat_n = jnp.zeros(n_level, bool)
-            table = ((feats << 12) | (bins << 3)
-                     | (cat_n.astype(jnp.int32) << 2)
-                     | (dls.astype(jnp.int32) << 1)
-                     | do_split.astype(jnp.int32))
-            packed_r = jnp.sum(jnp.where(noh, table[None, :], 0), axis=1)
-            split_here = (packed_r & 1).astype(bool) & ~frozen
-            dl_r = ((packed_r >> 1) & 1).astype(bool)
-            cat_r = ((packed_r >> 2) & 1).astype(bool)
-            feat_r = packed_r >> 12
-            bin_r = (packed_r >> 3) & 0x1FF
             if feature_axis_name is None:
-                foh = (
-                    jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
-                    == feat_r[:, None]
-                )
-                fv = jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0), axis=1)
+                G, Hh = S.node_totals(hist)
             else:
-                # Winning columns live on exactly one feature shard: lanes only
-                # match on the owner (out-of-range local index matches
-                # nothing),
-                # everyone else contributes zero; psum broadcasts.
-                loc = feat_r - f_lo
-                foh = (
-                    jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
-                    == loc[:, None]
-                )
-                fv = jax.lax.psum(
-                    jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0), axis=1),
-                    feature_axis_name,
-                )
-            go_right = fv > bin_r
-            if cat_features:
-                # Categorical one-vs-rest: the matched category goes LEFT.
-                go_right = jnp.where(cat_r, fv != bin_r, go_right)
-            if missing_bin:
-                # NaN rows occupy the reserved top bin and follow the node's
-                # learned default direction.
-                go_right = jnp.where(fv == n_bins - 1, ~dl_r, go_right)
-            go_right = go_right.astype(jnp.int32)
-            node_id = jnp.where(split_here, 2 * node_id + 1 + go_right,
-                                node_id)
-            frozen = frozen | ~split_here
+                # Node totals from the row vectors, not the histogram:
+                # local histograms hold different COLUMNS per shard, so
+                # their bin sums agree only up to float add order — this
+                # form is bit-identical (and provably feature-axis-
+                # invariant) on every shard.
+                act = node_index >= 0
+                seg = jnp.clip(node_index, 0, n_level - 1)
+                G = allreduce(jax.ops.segment_sum(
+                    jnp.where(act, g, 0.0), seg, num_segments=n_level))
+                Hh = allreduce(jax.ops.segment_sum(
+                    jnp.where(act, h, 0.0), seg, num_segments=n_level))
+            with traced_scope("gain"):
+                gains, feats, bins, dls = S.best_splits_impl(
+                    hist, reg_lambda, min_child_weight, feature_mask,
+                    missing_bin=missing_bin, cat_mask=cat_vec)
+                if feature_axis_name is not None:
+                    # Combine per-shard winners: all_gather the (gain,
+                    # feat, bin, direction) tuples (tiny), argmax over
+                    # shards — first shard wins ties, preserving the
+                    # global first-(feature,bin) tie-break rule.
+                    feats = feats + f_lo
+                    ga = jax.lax.all_gather(gains, feature_axis_name)
+                    fa = jax.lax.all_gather(feats, feature_axis_name)
+                    ba = jax.lax.all_gather(bins, feature_axis_name)
+                    da = jax.lax.all_gather(dls, feature_axis_name)
+                    w = jnp.argmax(ga, axis=0)                 # [n_level]
+                    gains = jnp.take_along_axis(ga, w[None], axis=0)[0]
+                    feats = jnp.take_along_axis(fa, w[None], axis=0)[0]
+                    bins = jnp.take_along_axis(ba, w[None], axis=0)[0]
+                    dls = jnp.take_along_axis(da, w[None], axis=0)[0]
+            # Guarded like the final level and the streamed twin: an EMPTY
+            # node at reg_lambda=0 would otherwise store -0/0 = NaN as its
+            # leaf value, which a predict-time row (different data) can
+            # reach.
+            value = jnp.where(Hh > 0, -G / (Hh + reg_lambda), 0.0)
+
+            do_split = (
+                (gains > min_split_gain) & jnp.isfinite(gains) & (Hh > 0)
+            )
+            sl = slice(offset, offset + n_level)
+            feature = feature.at[sl].set(jnp.where(do_split, feats, -1))
+            threshold_bin = threshold_bin.at[sl].set(
+                jnp.where(do_split, bins, 0))
+            is_leaf = is_leaf.at[sl].set(~do_split)
+            leaf_value = leaf_value.at[sl].set(
+                jnp.where(do_split, 0.0, value))
+            split_gain = split_gain.at[sl].set(
+                jnp.where(do_split, gains.astype(jnp.float32), 0.0))
+            default_left = default_left.at[sl].set(do_split & dls)
+
+            # Route rows through the new splits (dense node-id update).
+            # All per-row lookups are one-hot compare+reduce instead of
+            # gathers: TPU gathers (even from a 32-entry table) each cost
+            # ~10-20 ms at 1M rows, while the [R, n_level] masked
+            # reductions are a few ms total — and integer one-hot sums
+            # are EXACT, so routing is bit-identical to the gather
+            # formulation. The five per-node tables (feature, bin,
+            # cat-ness, direction, do_split) are packed into ONE int32 so
+            # a single masked reduction covers them:
+            # feat<<12 | bin<<3 | cat<<2 | default_left<<1 | split.
+            with traced_scope("route"):
+                idx_c = jnp.clip(node_id - offset, 0, n_level - 1)
+                noh = (idx_c[:, None]
+                       == jnp.arange(n_level, dtype=jnp.int32)[None, :])
+                if cat_vec_g is not None:
+                    # Per-NODE cat-ness of the winning (global) feature.
+                    # An n_level-sized gather from the replicated
+                    # [F_global] table is fine — the gathers this file
+                    # avoids are [R]-sized ones.
+                    cat_n = jnp.take(cat_vec_g, feats, axis=0)
+                else:
+                    cat_n = jnp.zeros(n_level, bool)
+                table = ((feats << 12) | (bins << 3)
+                         | (cat_n.astype(jnp.int32) << 2)
+                         | (dls.astype(jnp.int32) << 1)
+                         | do_split.astype(jnp.int32))
+                packed_r = jnp.sum(jnp.where(noh, table[None, :], 0),
+                                   axis=1)
+                split_here = (packed_r & 1).astype(bool) & ~frozen
+                dl_r = ((packed_r >> 1) & 1).astype(bool)
+                cat_r = ((packed_r >> 2) & 1).astype(bool)
+                feat_r = packed_r >> 12
+                bin_r = (packed_r >> 3) & 0x1FF
+                if feature_axis_name is None:
+                    foh = (
+                        jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
+                        == feat_r[:, None]
+                    )
+                    fv = jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0),
+                                 axis=1)
+                else:
+                    # Winning columns live on exactly one feature shard:
+                    # lanes only match on the owner (out-of-range local
+                    # index matches nothing), everyone else contributes
+                    # zero; psum broadcasts.
+                    loc = feat_r - f_lo
+                    foh = (
+                        jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
+                        == loc[:, None]
+                    )
+                    fv = jax.lax.psum(
+                        jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0),
+                                axis=1),
+                        feature_axis_name,
+                    )
+                go_right = fv > bin_r
+                if cat_features:
+                    # Categorical one-vs-rest: the matched category goes
+                    # LEFT.
+                    go_right = jnp.where(cat_r, fv != bin_r, go_right)
+                if missing_bin:
+                    # NaN rows occupy the reserved top bin and follow the
+                    # node's learned default direction.
+                    go_right = jnp.where(fv == n_bins - 1, ~dl_r, go_right)
+                go_right = go_right.astype(jnp.int32)
+                node_id = jnp.where(split_here,
+                                    2 * node_id + 1 + go_right, node_id)
+                frozen = frozen | ~split_here
+
+        # Carry for the next level's sibling subtraction.
+        if hist_subtraction:
+            prev_hist = hist
+            prev_split = do_split
 
     # Final level: leaf values from per-terminal-node (G, H) aggregates —
     # via one-hot matmul (MXU, f32 HIGHEST) rather than segment_sum: the

@@ -1,31 +1,52 @@
-"""Pallas TPU kernel for HistogramBuilder — the hot loop, hand-tiled.
+"""Pallas TPU kernel for HistogramBuilder — VMEM-accumulating, hand-tiled.
 
 Why this kernel exists (measured on TPU v5e, 1M rows x 28 feat x 255 bins):
 the pure-XLA one-hot-matmul path materialises the [rows, F*B] bin one-hot in
 HBM — ~29 GB of write+read traffic per build — and runs HBM-bound at
-~26 M-rows/s with the MXU nearly idle (time is independent of node count).
-This kernel builds the one-hot TILE-BY-TILE IN VMEM, feeds it straight to the
-MXU, and never lets it touch HBM. The only HBM traffic is the binned input
-itself (R x F uint8) plus tiny per-row vectors — about 500x less.
+~26 M-rows/s with the MXU nearly idle. The first Pallas form (rounds 1-5)
+built the bin one-hot tile-by-tile in VMEM but still materialised the
+WEIGHTED NODE ONE-HOT `A [R, 2N]` (plus an int32 copy of the binned input)
+in an XLA prologue: ~250 MB of avoidable HBM write+read per build at the
+headline shape, re-streamed per feature slab when chunked — the roofline
+observatory's `ddt:hist` verdict stayed "hbm".
 
-Shape strategy per grid step (one tile of TILE_R rows):
-    A   [TILE_R, 2N]   bf16: node one-hot weighted by g (cols 0..N-1) and by
-                       h (cols N..2N-1) — built on the VPU from ni/g/h.
-    OH  [TILE_R, F*Bp] bf16: per-feature bin one-hot, Bp = 256-padded lanes
-                       per feature (2 MXU lane tiles), built on the VPU.
-    out [2N, F*Bp]     f32: += A^T @ OH — ONE dot_general per tile on the
-                       MXU, f32 accumulation via preferred_element_type.
-The output block is revisited by every grid step (index_map -> (0, 0)), so it
-lives in VMEM for the whole kernel and is zero-initialised at step 0 — the
-classic sequential-grid accumulation pattern.
+This rewrite streams only the RAW operands and synthesises everything else
+on-chip:
 
-VMEM budget at TILE_R=512, F=28, N<=32: OH 512x7168xbf16 = 7.3 MB,
-acc 64x7168xf32 = 1.8 MB, inputs < 0.1 MB — comfortably inside 16 MB.
+    inputs per grid step (one tile of TILE_R rows):
+      Xb  [TILE_R, F]  uint8  binned features (cast int32 in-VMEM — the
+                       only row-sized HBM read, 1 byte/feature/row)
+      g,h [1, TILE_R]  f32    gradient/hessian rows
+      ni  [1, TILE_R]  i32    level-local node index, -1 = frozen
+    on-chip per tile (VPU):
+      A   [TILE_R, 2N]   node one-hot weighted by g (cols 0..N-1) and by
+                         h (cols N..2N-1); ni = -1 matches no column, so
+                         frozen rows vanish without a masking prologue.
+      OH  [TILE_R, F*Bp] per-feature bin one-hot, Bp = padded lanes/bins.
+    accumulate (MXU):
+      acc [2N, F*Bp] f32 VMEM SCRATCH += A^T @ OH — ONE dot_general per
+      tile; the scratch lives across the whole row-tile grid loop and is
+      flushed to the output block (ONE HBM write per feature slab) at the
+      final grid step.
 
-Contract identical to ops/histogram.py: returns [n_nodes, F, n_bins, 2] f32;
-rows with node_index < 0 are masked out (done in the XLA prologue). Tests run
-this kernel in Pallas interpret mode on CPU (tests/test_hist_pallas.py);
-the real-chip path is exercised by bench.py.
+HBM traffic per build: R x F uint8 + 12 bytes/row of g/h/ni + the [N, F,
+B, 2] output — nothing else. No prologue materialisation, no per-slab
+re-stream of row-sized state (chunked slabs re-read only g/h/ni).
+
+Two kernel forms (dispatch on the padded bin width, sweep-9/10 measured):
+row-major (`_hist_kernel`, bins_pad >= 256) builds OH [T, F*Bp] with bins
+on LANES; the transposed form (`_hist_kernel_t`, bins_pad <= 128) builds
+OH [F*Bp, T] with bins on SUBLANES — x broadcasts along sublanes as cheap
+row replication, ~1.5x the row-major form at 64 bins. Since round 6 the
+64-bin layout is promoted to automatic dispatch: n_bins <= 64 pads to Bp
+= 64 sublanes (half the OH footprint and half the MXU columns of the old
+128-lane padding), which is what the bench's `value_64bin_optin` arm
+measures.
+
+Contract identical to ops/histogram.py: returns [n_nodes, F, n_bins, 2]
+f32. Tests run this kernel in Pallas interpret mode on CPU
+(tests/test_hist_pallas.py, tests/test_hist_fused.py); the real-chip path
+is exercised by bench.py.
 """
 
 from __future__ import annotations
@@ -37,14 +58,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ddt_tpu.telemetry.annotations import traced_scope
 from ddt_tpu.telemetry.costmodel import costed
 
 LANE = 128
 
 # VMEM working-set ceiling for auto-selection: the one-hot tile
-# [tile_r, F*Bp] + the revisited accumulator [2N, F*Bp] f32 + pipeline
-# buffers must fit ~16 MB/core. 12 MB leaves headroom for Mosaic's
-# double-buffered input windows.
+# [tile_r, F*Bp] + the scratch accumulator AND its HBM-flush output block
+# (both [2N, F*Bp] f32) + pipeline buffers must fit ~16 MB/core. 12 MB
+# leaves headroom for Mosaic's double-buffered input windows.
 _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 _DEFAULT_TILE_R = 512
 # The transposed kernel's default row tile: tiles 1024-2048 measure
@@ -63,9 +85,13 @@ def _default_tile_r(n_bins: int) -> int:
 
 
 def _bins_pad(n_bins: int) -> int:
-    """Padded one-hot lanes per feature. n_bins <= 128 pads to ONE lane
-    tile and routes to the TRANSPOSED kernel (see _hist_kernel_t);
-    wider bin counts pad to 256 for the row-major kernel."""
+    """Padded one-hot width per feature. n_bins <= 64 pads to 64 SUBLANES
+    (the promoted 64-bin layout — bins ride the transposed kernel's
+    sublane axis, where 64 is tile-aligned for both bf16 and f32);
+    n_bins <= 128 pads to one 128 tile and still routes transposed; wider
+    bin counts pad to 256 LANES for the row-major kernel."""
+    if n_bins <= 64:
+        return 64
     if n_bins <= LANE:
         return LANE
     return max(2 * LANE, ((n_bins + LANE - 1) // LANE) * LANE)
@@ -80,30 +106,49 @@ def pallas_fits(
 ) -> bool:
     """Whether the kernel's VMEM working set fits at this shape (the shape
     guard behind hist_impl='auto' — ops/histogram.resolve_hist_impl).
-    tile_r=None sizes for the tile the dispatcher will actually run."""
+    tile_r=None sizes for the tile the dispatcher will actually run.
+    input_bytes is the one-hot operand itemsize (2 bf16, 4 f32)."""
     if tile_r is None:
         tile_r = _default_tile_r(n_bins)
     fbp = n_features * _bins_pad(n_bins)
     oh_bytes = tile_r * fbp * input_bytes
-    acc_bytes = 2 * n_nodes * fbp * 4
+    # Scratch accumulator + the output block it flushes into: both live
+    # in VMEM for the whole kernel.
+    acc_bytes = 2 * (2 * n_nodes * fbp * 4)
     return oh_bytes + acc_bytes <= _VMEM_BUDGET_BYTES
 
 
-def _hist_kernel(xb_ref, a_ref, out_ref, *, n_feat: int, bins_pad: int,
-                 input_dtype):
-    """One row tile: out += A^T @ OH with OH built in VMEM.
+def _weighted_node_onehot(ni, g, h, n_nodes: int, input_dtype):
+    """A [T, 2N]: node one-hot weighted by g then h, built on the VPU.
+    ni = -1 (frozen / pad rows) matches no column — the masking prologue
+    the old kernel needed is free here."""
+    tile_r = ni.shape[0]
+    noh = ni[:, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (tile_r, n_nodes), 1)
+    zero = jnp.float32(0.0)
+    return jnp.concatenate(
+        [jnp.where(noh, g[:, None], zero), jnp.where(noh, h[:, None], zero)],
+        axis=1,
+    ).astype(input_dtype)                                 # [T, 2N]
 
-    xb_ref: [TILE_R, F] int32 (bin indices), a_ref: [TILE_R, 2N] bf16,
-    out_ref: [2N, F * bins_pad] f32 (revisited accumulator block).
-    """
+
+def _hist_kernel(xb_ref, g_ref, h_ref, ni_ref, out_ref, acc_ref, *,
+                 n_nodes: int, n_feat: int, bins_pad: int, input_dtype):
+    """One row tile, row-major form: acc += A^T @ OH, all built in VMEM.
+
+    xb_ref [TILE_R, F] uint8; g/h [1, TILE_R] f32; ni [1, TILE_R] i32;
+    acc_ref [2N, F*Bp] f32 VMEM scratch (lives across the grid);
+    out_ref same shape — written ONCE at the final grid step."""
     step = pl.program_id(0)
 
     @pl.when(step == 0)
     def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    x = xb_ref[:]                                         # [T, F] int32
+    x = xb_ref[:].astype(jnp.int32)                       # [T, F]
     tile_r = x.shape[0]
+    A = _weighted_node_onehot(ni_ref[0, :], g_ref[0, :], h_ref[0, :],
+                              n_nodes, input_dtype)
     bin_iota = jax.lax.broadcasted_iota(
         jnp.int32, (tile_r, bins_pad), 1
     )
@@ -115,46 +160,57 @@ def _hist_kernel(xb_ref, a_ref, out_ref, *, n_feat: int, bins_pad: int,
     ]
     oh = jnp.concatenate(slabs, axis=1)                   # [T, F*Bp]
 
-    out_ref[:] += jax.lax.dot_general(
-        a_ref[:], oh,
+    acc_ref[:] += jax.lax.dot_general(
+        A, oh,
         (((0,), (0,)), ((), ())),                         # contract rows
         preferred_element_type=jnp.float32,
     )
 
+    @pl.when(step == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]                           # ONE HBM write
 
-def _hist_kernel_t(xt_ref, a_ref, out_ref, *, n_feat: int, bins_pad: int,
-                   input_dtype):
-    """TRANSPOSED row tile (used when bins_pad == 128, i.e. n_bins <= 128):
-    out[F*Bp, 2N] += OH[F*Bp, T] @ A[T, 2N].
+
+def _hist_kernel_t(xt_ref, g_ref, h_ref, ni_ref, out_ref, acc_ref, *,
+                   n_nodes: int, n_feat: int, bins_pad: int, input_dtype):
+    """TRANSPOSED row tile (bins_pad <= 128, i.e. n_bins <= 128):
+    acc[F*Bp, 2N] += OH[F*Bp, T] @ A[T, 2N].
 
     Why a second form exists (experiments/hist_sweep9/10, measured v5e):
     the row-major kernel is bound by per-feature [T, 1] -> [T, Bp] LANE
     broadcasts (cost flat in Bp — shrinking bins bought nothing), while
     this form broadcasts x rows along SUBLANES ((bin_iota[Bp, 1] ==
     x[1, T])), which Mosaic executes as cheap row replication. At 64 bins
-    it measures ~72 Mrows/s vs ~48 row-major. At Bp = 256 the transposed
-    form loses its edge (more sublane tiles per slab), so the row-major
-    kernel keeps the 255-bin contract.
+    it measures ~72 Mrows/s vs ~48 row-major, and the promoted Bp = 64
+    sublane layout (n_bins <= 64) halves the OH footprint again. At
+    Bp = 256 the transposed form loses its edge (more sublane tiles per
+    slab), so the row-major kernel keeps the 255-bin contract.
     """
     step = pl.program_id(0)
 
     @pl.when(step == 0)
     def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    xt = xt_ref[:]                                        # [F, T]
+    xt = xt_ref[:].astype(jnp.int32)                      # [F, T]
     tile_r = xt.shape[1]
+    A = _weighted_node_onehot(ni_ref[0, :], g_ref[0, :], h_ref[0, :],
+                              n_nodes, input_dtype)
     bin_iota = jax.lax.broadcasted_iota(jnp.int32, (bins_pad, tile_r), 0)
     slabs = [
         (xt[f, :][None, :] == bin_iota).astype(input_dtype)   # [Bp, T]
         for f in range(n_feat)
     ]
     oh = jnp.concatenate(slabs, axis=0)                   # [F*Bp, T]
-    out_ref[:] += jax.lax.dot_general(
-        oh, a_ref[:],
+    acc_ref[:] += jax.lax.dot_general(
+        oh, A,
         (((1,), (0,)), ((), ())),                         # contract rows
         preferred_element_type=jnp.float32,
     )
+
+    @pl.when(step == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]                           # ONE HBM write
 
 
 def feature_chunks_for(n_nodes: int, n_features: int, n_bins: int,
@@ -192,10 +248,12 @@ def build_histograms_pallas(
     at full rate; float32 buys exact accumulation at reduced rate (same knob
     as the matmul path — cfg.matmul_input_dtype).
 
-    Shapes whose [2N, F*Bp] accumulator overflows the VMEM budget (deep
-    levels: n_nodes >= 64 at 255 bins) are feature-CHUNKED: one pallas_call
-    per column slab, outputs concatenated — exact (columns are independent)
-    and still ~2x the HBM-bound matmul fallback per slab.
+    Shapes whose VMEM working set overflows the budget (deep levels:
+    n_nodes >= 32 at 255 bins) are feature-CHUNKED: one pallas_call per
+    column slab, outputs concatenated — exact (columns are independent),
+    and since the rewrite a slab re-reads only its own Xb columns plus the
+    12 bytes/row of g/h/ni, so chunking stays far above the matmul
+    fallback.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -231,92 +289,105 @@ def _build_histograms_pallas(
     tile_r: int = _DEFAULT_TILE_R,
     interpret: bool = False,
     input_dtype=jnp.bfloat16,
-    n_chunks: int = 1,      # feature slabs (one pallas_call each); the
-                            # prologue below is shared across slabs
+    n_chunks: int = 1,      # feature slabs (one pallas_call each); slabs
+                            # share the streamed g/h/ni rows
 ) -> jax.Array:
     R, F = Xb.shape
     bins_pad = _bins_pad(n_bins)
 
-    # Prologue (XLA, fused & cheap): mask frozen rows, build the weighted
-    # node one-hot A, pad rows to a tile multiple (padded rows carry A=0).
-    active = node_index >= 0
-    idx = jnp.where(active, node_index, 0).astype(jnp.int32)
-    gz = jnp.where(active, g, 0.0).astype(jnp.float32)
-    hz = jnp.where(active, h, 0.0).astype(jnp.float32)
-    node_oh = jax.nn.one_hot(idx, n_nodes, dtype=jnp.float32)   # [R, N]
-    A = jnp.concatenate(
-        [node_oh * gz[:, None], node_oh * hz[:, None]], axis=1
-    ).astype(input_dtype)                                       # [R, 2N]
-    Xi = Xb.astype(jnp.int32)
-
+    # Stream prologue (XLA, cheap): pad rows to a tile multiple and fold
+    # the per-row vectors to [n_tiles, tile_r] blocks. Pad rows carry
+    # ni = -1, so they match no node column in-kernel — no weighted
+    # one-hot, no int32 input copy, nothing row-sized materialises.
     n_tiles = -(-R // tile_r)
     pad = n_tiles * tile_r - R
+    Xp = Xb
+    gz = g.astype(jnp.float32)
+    hz = h.astype(jnp.float32)
+    ni = node_index.astype(jnp.int32)
     if pad:
-        Xi = jnp.pad(Xi, ((0, pad), (0, 0)))
-        A = jnp.pad(A, ((0, pad), (0, 0)))
+        Xp = jnp.pad(Xp, ((0, pad), (0, 0)))
+        gz = jnp.pad(gz, (0, pad))
+        hz = jnp.pad(hz, (0, pad))
+        ni = jnp.pad(ni, (0, pad), constant_values=-1)
+    g2 = gz.reshape(n_tiles, tile_r)
+    h2 = hz.reshape(n_tiles, tile_r)
+    ni2 = ni.reshape(n_tiles, tile_r)
+
+    row_spec = pl.BlockSpec((1, tile_r), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
 
     def slab(Xs):
         Fs = Xs.shape[1]
         cost = pl.CostEstimate(
             flops=2 * 2 * n_nodes * Fs * bins_pad * n_tiles * tile_r,
-            bytes_accessed=R * Fs * 4 + R * 4 * n_nodes
+            bytes_accessed=R * Fs + R * 12
             + 2 * n_nodes * Fs * bins_pad * 4,
             transcendentals=0,
         )
         if bins_pad <= LANE:
             # Transposed kernel (n_bins <= 128): sublane-broadcast one-hot
             # build — ~1.5x the row-major form at 64 bins (sweep 10).
+            with traced_scope("hist:stream"):
+                out = pl.pallas_call(
+                    functools.partial(_hist_kernel_t, n_nodes=n_nodes,
+                                      n_feat=Fs, bins_pad=bins_pad,
+                                      input_dtype=input_dtype),
+                    grid=(n_tiles,),
+                    in_specs=[
+                        pl.BlockSpec((Fs, tile_r), lambda i: (0, i),
+                                     memory_space=pltpu.VMEM),
+                        row_spec, row_spec, row_spec,
+                    ],
+                    out_specs=pl.BlockSpec(
+                        (Fs * bins_pad, 2 * n_nodes), lambda i: (0, 0),
+                        memory_space=pltpu.VMEM,
+                    ),
+                    out_shape=jax.ShapeDtypeStruct(
+                        (Fs * bins_pad, 2 * n_nodes), jnp.float32),
+                    scratch_shapes=[
+                        pltpu.VMEM((Fs * bins_pad, 2 * n_nodes),
+                                   jnp.float32),
+                    ],
+                    cost_estimate=cost,
+                    interpret=interpret,
+                )(Xs.T, g2, h2, ni2)
+            with traced_scope("hist:flush"):
+                # [Fs*Bp, 2N] -> [N, Fs, B, 2]
+                out = out.reshape(Fs, bins_pad, 2, n_nodes)[:, :n_bins]
+                return out.transpose(3, 0, 1, 2)
+        with traced_scope("hist:stream"):
             out = pl.pallas_call(
-                functools.partial(_hist_kernel_t, n_feat=Fs,
+                functools.partial(_hist_kernel, n_nodes=n_nodes, n_feat=Fs,
                                   bins_pad=bins_pad,
                                   input_dtype=input_dtype),
                 grid=(n_tiles,),
                 in_specs=[
-                    pl.BlockSpec((Fs, tile_r), lambda i: (0, i),
-                                 memory_space=pltpu.VMEM),
-                    pl.BlockSpec((tile_r, 2 * n_nodes), lambda i: (i, 0),
-                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec(
+                        (tile_r, Fs), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM,
+                    ),
+                    row_spec, row_spec, row_spec,
                 ],
                 out_specs=pl.BlockSpec(
-                    (Fs * bins_pad, 2 * n_nodes), lambda i: (0, 0),
+                    (2 * n_nodes, Fs * bins_pad), lambda i: (0, 0),
                     memory_space=pltpu.VMEM,
                 ),
-                out_shape=jax.ShapeDtypeStruct(
-                    (Fs * bins_pad, 2 * n_nodes), jnp.float32),
+                out_shape=jax.ShapeDtypeStruct((2 * n_nodes, Fs * bins_pad),
+                                               jnp.float32),
+                scratch_shapes=[
+                    pltpu.VMEM((2 * n_nodes, Fs * bins_pad), jnp.float32),
+                ],
                 cost_estimate=cost,
                 interpret=interpret,
-            )(Xs.T, A)
-            # [Fs*Bp, 2N] -> [N, Fs, B, 2]
-            out = out.reshape(Fs, bins_pad, 2, n_nodes)[:, :n_bins]
-            return out.transpose(3, 0, 1, 2)
-        out = pl.pallas_call(
-            functools.partial(_hist_kernel, n_feat=Fs, bins_pad=bins_pad,
-                              input_dtype=input_dtype),
-            grid=(n_tiles,),
-            in_specs=[
-                pl.BlockSpec(
-                    (tile_r, Fs), lambda i: (i, 0), memory_space=pltpu.VMEM
-                ),
-                pl.BlockSpec(
-                    (tile_r, 2 * n_nodes), lambda i: (i, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-            ],
-            out_specs=pl.BlockSpec(
-                (2 * n_nodes, Fs * bins_pad), lambda i: (0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            out_shape=jax.ShapeDtypeStruct((2 * n_nodes, Fs * bins_pad),
-                                           jnp.float32),
-            cost_estimate=cost,
-            interpret=interpret,
-        )(Xs, A)
-        # [2N, Fs*Bp] -> [N, Fs, B, 2]
-        out = out.reshape(2, n_nodes, Fs, bins_pad)[..., :n_bins]
-        return out.transpose(1, 2, 3, 0)
+            )(Xs, g2, h2, ni2)
+        with traced_scope("hist:flush"):
+            # [2N, Fs*Bp] -> [N, Fs, B, 2]
+            out = out.reshape(2, n_nodes, Fs, bins_pad)[..., :n_bins]
+            return out.transpose(1, 2, 3, 0)
 
     if n_chunks == 1:
-        return slab(Xi)
+        return slab(Xp)
     fc = -(-F // n_chunks)
     return jnp.concatenate(
-        [slab(Xi[:, i:i + fc]) for i in range(0, F, fc)], axis=1)
+        [slab(Xp[:, i:i + fc]) for i in range(0, F, fc)], axis=1)

@@ -10,12 +10,13 @@ Xb [R, F], gradients g/h [R] float32 and a per-row level-local node index
 TPU realisation — XLA hates random-access scatter, so three interchangeable
 implementations (SURVEY.md §7 "hard parts (a)"):
 
-- "pallas": tiled VMEM kernel (ops/hist_pallas.py) that builds the bin
-  one-hot tile-by-tile in VMEM and feeds one dot_general per tile to the MXU
-  — nothing but Xb and the output ever touches HBM. The TPU default for
-  shapes whose working set fits VMEM (hist_pallas.pallas_fits); measured
-  ~2x the matmul path on v5e at the Higgs-1M shape (46-62 Mrows/s across
-  tile/row configs vs ~26).
+- "pallas": VMEM-accumulating tiled kernel (ops/hist_pallas.py): raw
+  g/h/node-index rows stream in tiles, the weighted node one-hot AND the
+  bin one-hot are synthesised on-chip, per-(feature-slab, node) bin
+  accumulators live in VMEM scratch across the row-tile grid, and each
+  slab performs exactly ONE HBM write — nothing but the uint8 Xb, 12
+  bytes/row of g/h/ni, and the output ever touches HBM. The TPU default
+  for shapes whose working set fits VMEM (hist_pallas.pallas_fits).
 - "matmul": one-hot outer-product accumulation on the MXU. Per feature f the
   histogram is A^T @ Bf where A [R, 2N] stacks node-one-hot weighted by g and
   by h, and Bf [R, B] is the bin one-hot. Chunked over rows with lax.scan so
@@ -217,11 +218,14 @@ def resolve_hist_impl(
     if n_nodes is not None and n_features is not None and n_bins is not None:
         from ddt_tpu.ops.hist_pallas import feature_chunks_for
 
-        # The kernel feature-chunks itself for deep levels, but every slab
-        # re-streams the [R, 2N] weighted node one-hot from HBM — past a
-        # few slabs that traffic exceeds the matmul path's, so cap k.
+        # The kernel feature-chunks itself for deep levels. Since the
+        # VMEM-streaming rewrite a slab re-reads only its own uint8
+        # columns plus 12 bytes/row of g/h/ni (the old form re-streamed
+        # the [R, 2N] weighted one-hot per slab, which capped k at 4), so
+        # chunking stays ahead of the matmul fallback until the slab
+        # count itself is pathological.
         k = feature_chunks_for(n_nodes, n_features, n_bins)
-        if k is None or k > 4:
+        if k is None or k > 8:
             return "matmul"
     return "pallas"
 

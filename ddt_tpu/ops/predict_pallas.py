@@ -216,6 +216,17 @@ def predict_effective_pallas(
         raise ValueError(
             f"padded tree count {Tpad} is not a multiple of "
             f"tree_chunk={tree_chunk}")
+    if not interpret and not predict_pallas_fits(
+            Tpad, tree_chunk, max_depth, F, C, tile_r):
+        # Compiled dispatch past the budget means a VMEM OOM or a
+        # pathological Mosaic trace on the chip — fail at the cause. The
+        # auto path (ops/predict.resolve_use_pallas) never gets here;
+        # this guards a forced predict_impl='pallas' at a monster shape.
+        # Interpret mode (CPU tests) has no VMEM to protect.
+        raise ValueError(
+            f"predict shape (trees_padded={Tpad}, tree_chunk={tree_chunk}, "
+            f"depth={max_depth}, F={F}, C={C}) exceeds the Pallas "
+            "VMEM/trace budget; use the one-hot path")
     n_tc = Tpad // tree_chunk
     n_int = (1 << max_depth) - 1
     n_leaves = 1 << max_depth

@@ -39,13 +39,8 @@ def node_totals(hist: jax.Array) -> tuple[jax.Array, jax.Array]:
     return hist[:, 0, :, 0].sum(axis=1), hist[:, 0, :, 1].sum(axis=1)
 
 
-@costed("gain", phase="gain")
-@functools.partial(
-    jax.jit, static_argnames=("reg_lambda", "min_child_weight",
-                              "missing_bin")
-)
 @op_scope("gain")
-def best_splits(
+def best_splits_impl(
     hist: jax.Array,            # float32 [n_nodes, F, B, 2]
     reg_lambda: float,
     min_child_weight: float,
@@ -205,3 +200,15 @@ def best_splits(
         (fb % B).astype(jnp.int32),
         best >= F * B,
     )
+
+
+#: The standalone jit entry (granular backend surface + host callers).
+#: `best_splits_impl` above is the raw traced body: the fused level round
+#: (ops/grow.py) calls it DIRECTLY so gain scoring inlines into the same
+#: XLA program as the histogram build and row routing — no nested pjit
+#: boundary between hist output and the gain epilogue.
+best_splits = costed("gain", phase="gain")(
+    functools.partial(
+        jax.jit,
+        static_argnames=("reg_lambda", "min_child_weight", "missing_bin"),
+    )(best_splits_impl))

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Tier-1-safe training-kernel smoke (make kernel-smoke): 2 boosting
+rounds through the FUSED path with the VMEM-streaming Pallas histogram
+kernel (interpret mode on CPU) and the sibling-subtraction trick forced
+on, checked three ways:
+
+1. fused-path parity — the granular per-tree Driver path must reproduce
+   the fused multi-round path's trees (structure bitwise, leaf values to
+   FMA tolerance) under the identical config;
+2. telemetry spans — the compiled grow program must carry the round-6
+   named scopes (ddt:fused_round, ddt:hist:subtract, and the kernel's
+   ddt:hist:{stream,flush}) so Perfetto captures stay attributable;
+3. run-log round trip — the telemetry run renders through `report` with
+   the expected phases present.
+
+Exit 0 iff all three hold. tests/test_hist_fused.py runs main()
+in-process (the telemetry/trace/profile smoke pattern).
+"""
+
+import functools
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddt_tpu import api
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.data.datasets import synthetic_binary
+    from ddt_tpu.data.quantizer import quantize
+    from ddt_tpu.driver import Driver
+    from ddt_tpu.ops import grow as grow_ops
+    from ddt_tpu.telemetry import report
+
+    X, y = synthetic_binary(1200, n_features=5, seed=19)
+    Xb, _ = quantize(X, n_bins=31, seed=19)
+    cfg = TrainConfig(n_trees=2, max_depth=3, n_bins=31, backend="tpu",
+                      hist_impl="pallas", hist_subtraction="on")
+
+    with tempfile.TemporaryDirectory(prefix="ddt_kernel_smoke_") as td:
+        log = os.path.join(td, "run.jsonl")
+        fused = api.train(Xb, y, cfg, binned=True, log_every=10**9,
+                          run_log=log).ensemble
+        gran = Driver(get_backend(cfg), cfg, log_every=10**9,
+                      profile=True).fit(Xb, y)
+        for field in ("feature", "threshold_bin", "is_leaf"):
+            if not np.array_equal(getattr(fused, field),
+                                  getattr(gran, field)):
+                print(f"kernel smoke: fused/granular {field} diverged",
+                      file=sys.stderr)
+                return 1
+        if not np.allclose(fused.leaf_value, gran.leaf_value,
+                           rtol=1e-5, atol=1e-6):
+            print("kernel smoke: fused/granular leaf values diverged",
+                  file=sys.stderr)
+            return 1
+
+        # Compiled-program span check on a tiny twin of the grow program.
+        rng = np.random.default_rng(0)
+        Xs = jnp.asarray(rng.integers(0, 31, size=(300, 5),
+                                      dtype=np.uint8))
+        gs = jnp.asarray(rng.standard_normal(300).astype(np.float32))
+        hs = jnp.asarray((rng.random(300) * 0.2 + 0.01).astype(np.float32))
+        txt = jax.jit(functools.partial(
+            grow_ops.grow_tree, max_depth=2, n_bins=31, reg_lambda=1.0,
+            min_child_weight=1e-3, min_split_gain=0.0,
+            hist_impl="pallas", hist_subtraction=True,
+        )).lower(Xs, gs, hs).compile().as_text()
+        spans = ["ddt:fused_round", "ddt:hist:subtract", "ddt:hist:stream",
+                 "ddt:hist:flush", "ddt:gain", "ddt:route"]
+        missing = [s for s in spans if s not in txt]
+        if missing:
+            print(f"kernel smoke: spans missing from the compiled grow "
+                  f"program: {missing}", file=sys.stderr)
+            return 1
+
+        events = report.read_events(log)      # validates every record
+        got = {e["event"] for e in events}
+        need = {"run_manifest", "round", "counters", "run_end"}
+        if not need <= got:
+            print(f"kernel smoke: missing events {need - got}",
+                  file=sys.stderr)
+            return 1
+        phases = {p["phase"] for e in events if e["event"] == "phase_timings"
+                  for p in e["phases"]}
+        if not {"grow_block", "fetch_tree"} <= phases:
+            print(f"kernel smoke: fused phases missing from the run log "
+                  f"(got {sorted(phases)})", file=sys.stderr)
+            return 1
+        print(json.dumps({"smoke": "kernel", "ok": True,
+                          "spans": spans, "phases": sorted(phases)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""pallas-vmem-guard negatives: the hist_pallas dispatch idiom — a
+VMEM-fits predicate in the dispatching function itself, in a direct
+caller, or two levels up the module-local call chain."""
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BUDGET = 12 * 1024 * 1024
+
+
+def _kernel(x_ref, o_ref, *, scale):
+    o_ref[:] = x_ref[:] * scale
+
+
+def my_shape_fits(rows, cols):
+    return rows * cols * 4 <= _BUDGET
+
+
+def guarded_inline(x, interpret=None):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not my_shape_fits(*x.shape):
+        raise ValueError("shape exceeds the VMEM budget")
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=2),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x)
+
+
+def _inner_kernel_call(x, interpret):
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=3),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x)
+
+
+def _mid_dispatch(x, interpret):
+    return _inner_kernel_call(x, interpret)
+
+
+def guarded_top_dispatcher(x, interpret=None):
+    # the guard sits two module-local call levels above the pallas_call
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not my_shape_fits(*x.shape):
+        raise ValueError("shape exceeds the VMEM budget")
+    return _mid_dispatch(x, interpret)
+
+
+def feature_chunks_for(rows, cols):
+    # chunk-count predicates count as guards too (the hist_pallas form)
+    for k in range(1, cols + 1):
+        if my_shape_fits(rows, -(-cols // k)):
+            return k
+    return None
+
+
+def guarded_by_chunking(x, interpret=None):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if feature_chunks_for(*x.shape) is None:
+        raise ValueError("no chunking fits the VMEM budget")
+    return _inner_kernel_call(x, interpret)
+
+
+class GuardedBackend:
+    """Method units: a guard in the method (or a caller) satisfies the
+    rule the same way it does for module-level functions."""
+
+    def dispatch(self, x, interpret=True):
+        if not my_shape_fits(*x.shape):
+            raise ValueError("shape exceeds the VMEM budget")
+        return pl.pallas_call(
+            functools.partial(_kernel, scale=6),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=interpret,
+        )(x)

@@ -1,0 +1,68 @@
+"""pallas-vmem-guard positives: pallas_call dispatch chains with no
+VMEM-fits predicate anywhere module-local — a direct dispatch, and a
+kernel wrapper whose only caller is also unguarded."""
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref, *, scale):
+    o_ref[:] = x_ref[:] * scale
+
+
+def unguarded_direct(x, interpret=None):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return pl.pallas_call(  # LINT: pallas-vmem-guard
+        functools.partial(_kernel, scale=2),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x)
+
+
+def _unguarded_inner(x, interpret):
+    return pl.pallas_call(  # LINT: pallas-vmem-guard
+        functools.partial(_kernel, scale=3),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x)
+
+
+def unguarded_dispatcher(x, interpret=None):
+    # calls the kernel wrapper but never consults a fits predicate
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _unguarded_inner(x, interpret)
+
+
+class UnguardedBackend:
+    """Methods are dispatch units too — a class cannot hide a site."""
+
+    def dispatch(self, x, interpret=True):
+        return pl.pallas_call(  # LINT: pallas-vmem-guard
+            functools.partial(_kernel, scale=5),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=interpret,
+        )(x)
+
+
+def other_shape_fits(rows, cols):
+    return rows * cols <= 1024
+
+
+class GuardedSibling:
+    """A SAME-NAMED guarded method in another class must not launder the
+    unguarded one above (units are class-qualified)."""
+
+    def dispatch(self, x, interpret=True):
+        if not other_shape_fits(*x.shape):
+            raise ValueError("over budget")
+        return pl.pallas_call(
+            functools.partial(_kernel, scale=7),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=interpret,
+        )(x)

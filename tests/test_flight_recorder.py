@@ -506,6 +506,34 @@ def test_benchwatch_one_sided_and_direction_aware(tmp_path):
         == ["e2e_train_s"]
 
 
+def test_benchwatch_schema_gates_redefined_metrics(tmp_path):
+    """A metric whose MEANING changed at a schema bump
+    (METRIC_MIN_SCHEMA) must not band against pre-bump history: the v2
+    e2e_implied_hist_mrows counts effective levels (~0.58x the v1
+    number at depth 6 with subtraction on), so a faster run would
+    otherwise flag as a regression. Same-schema banding still works."""
+    paths = [_bench_artifact(tmp_path, i + 1,
+                             e2e_implied_hist_mrows=50.0 + i)
+             for i in range(4)]                          # schema-1 history
+    # v2 current: ~0.6x the v1 median — semantics, not a regression.
+    cur = _bench_artifact(tmp_path, 5, bench_schema=2,
+                          e2e_implied_hist_mrows=30.0)
+    rep = benchwatch.run(paths, current_path=cur)
+    assert rep["ok"]
+    assert {"metric": "e2e_implied_hist_mrows", "history": 0} \
+        in rep["bench"]["skipped"]
+    # once schema-2 history accumulates, the band re-arms at the new
+    # meaning and a real regression inside it still trips.
+    paths2 = [_bench_artifact(tmp_path, 10 + i, bench_schema=2,
+                              e2e_implied_hist_mrows=30.0 + i)
+              for i in range(4)]
+    bad = _bench_artifact(tmp_path, 15, bench_schema=2,
+                          e2e_implied_hist_mrows=18.0)
+    rep = benchwatch.run(paths2, current_path=bad)
+    assert [r["metric"] for r in rep["bench"]["regressions"]] \
+        == ["e2e_implied_hist_mrows"]
+
+
 def test_benchwatch_skips_thin_history_never_guesses(tmp_path):
     paths = [_bench_artifact(tmp_path, 1, value=50.0,
                              predict_mrows_per_sec=2.7)]

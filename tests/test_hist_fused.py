@@ -1,0 +1,272 @@
+"""Training-megakernel parity: the VMEM-streaming Pallas histogram kernel
+vs the matmul and segment-sum paths, the sibling-subtraction trick, and
+the fused hist->gain->route level round.
+
+Bit-exactness methodology: histogram values are f32 sums whose BITS
+depend on accumulation order, so cross-implementation equality is only
+testable bitwise when every partial sum is exactly representable — the
+sweep therefore draws g/h from SMALL INTEGERS (sums stay << 2^24) and
+forces float32 kernel inputs, making pallas == matmul == segment a
+bit-for-bit assertion across tile orders (the same trick makes the
+subtraction assembly provably exact). Float-valued tolerance parity
+stays in tests/test_hist_pallas.py. Mirrors the sweep structure of
+tests/test_predict_pallas.py: bin widths x class counts x ragged
+row/feature remainders x reserved-missing-bin mass.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddt_tpu import api
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.data.datasets import synthetic_binary
+from ddt_tpu.data.quantizer import quantize
+from ddt_tpu.ops import grow as grow_ops
+from ddt_tpu.ops.hist_pallas import (
+    _bins_pad, build_histograms_pallas, pallas_fits)
+from ddt_tpu.ops.histogram import (
+    build_histograms_matmul, build_histograms_segment)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _int_case(R, F, B, N, C=1, seed=0, frozen_frac=0.2, missing_frac=0.0):
+    """Integer-valued g/h (exact in f32 under ANY summation order) +
+    binned data, with optional mass parked in the reserved top bin."""
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, B, size=(R, F), dtype=np.uint8)
+    if missing_frac:
+        Xb[rng.random((R, F)) < missing_frac] = B - 1   # reserved NaN bin
+    g = rng.integers(-8, 9, size=(R, C)).astype(np.float32)
+    h = rng.integers(0, 9, size=(R, C)).astype(np.float32)
+    ni = rng.integers(0, N, size=R).astype(np.int32)
+    ni[rng.random(R) < frozen_frac] = -1
+    if C == 1:
+        g, h = g[:, 0], h[:, 0]
+    return Xb, g, h, ni
+
+
+@pytest.mark.parametrize("B", [16, 64, 255])
+@pytest.mark.parametrize("C", [1, 3])
+@pytest.mark.parametrize("R,F,N,missing", [
+    (515, 5, 4, 0.0),       # ragged row remainder vs the 256 tile, odd F
+    (1024, 7, 32, 0.0),     # tile-aligned rows, widest depth-6 level
+    (700, 3, 8, 0.15),      # reserved-bin (missing) mass + row remainder
+])
+def test_kernel_bitexact_parity_sweep(B, C, R, F, N, missing):
+    """THE parity contract: with f32 inputs and integer-valued g/h the
+    VMEM-streaming kernel, the one-hot matmul path, and the segment-sum
+    path agree BIT-FOR-BIT — per class, at every bin width, through
+    ragged remainders and reserved-bin mass."""
+    Xb, g, h, ni = _int_case(R, F, B, N, C=C, seed=B + C,
+                             missing_frac=missing)
+    for c in range(C):
+        gc = g[:, c] if C > 1 else g
+        hc = h[:, c] if C > 1 else h
+        want = np.asarray(build_histograms_segment(Xb, gc, hc, ni, N, B))
+        mat = np.asarray(build_histograms_matmul(
+            Xb, gc, hc, ni, N, B, input_dtype=jnp.float32))
+        pal = np.asarray(build_histograms_pallas(
+            Xb, gc, hc, ni, N, B, tile_r=256, interpret=True,
+            input_dtype=jnp.float32))
+        np.testing.assert_array_equal(want, mat)
+        np.testing.assert_array_equal(want, pal)
+
+
+def test_subtraction_assembly_bitexact():
+    """level_histograms' sibling subtraction vs a direct full-level
+    build, bitwise (integer g/h): left children are the same sums, and
+    right = parent - left is exact when every sum is an integer."""
+    R, F, B = 2000, 4, 31
+    rng = np.random.default_rng(7)
+    Xb, g, h, _ = _int_case(R, F, B, 1, seed=7, frozen_frac=0.0)
+    # Parent level: 2 nodes, a few rows frozen before it.
+    ni_parent = rng.integers(0, 2, size=R).astype(np.int32)
+    ni_parent[rng.random(R) < 0.1] = -1
+    parent = grow_ops.level_histograms(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(ni_parent), 2, B, hist_impl="segment")
+    # Child level: parent 0 split (children 0/1), parent 1 froze.
+    go_right = Xb[:, 0] > 10
+    ni_child = np.where(ni_parent == 0, go_right.astype(np.int32), -1)
+    ni_child = ni_child.astype(np.int32)
+    parent_split = jnp.asarray([True, False])
+    got = np.asarray(grow_ops.level_histograms(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(ni_child), 4, B, hist_impl="segment",
+        parent_hist=parent, parent_split=parent_split))
+    want = np.asarray(grow_ops.level_histograms(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(ni_child), 4, B, hist_impl="segment"))
+    np.testing.assert_array_equal(got, want)
+    # The frozen parent's phantom children carry EXACTLY zero mass.
+    assert np.all(got[2:] == 0.0)
+
+
+def test_grow_subtraction_identical_structure():
+    """grow_tree with the trick on vs off: identical split decisions
+    (feature/threshold/leaf-ness/default-direction bitwise), leaf values
+    to f32 tolerance — the split-agreement-unchanged contract."""
+    import functools
+
+    rng = np.random.default_rng(0)
+    R = 4000
+    Xb = jnp.asarray(rng.integers(0, 31, size=(R, 8), dtype=np.uint8))
+    g = jnp.asarray(rng.standard_normal(R).astype(np.float32))
+    h = jnp.asarray((rng.random(R) * 0.25 + 0.01).astype(np.float32))
+    kw = dict(max_depth=4, n_bins=31, reg_lambda=1.0,
+              min_child_weight=1e-3, min_split_gain=0.0)
+    off = jax.jit(functools.partial(grow_ops.grow_tree,
+                                    hist_subtraction=False, **kw))(Xb, g, h)
+    on = jax.jit(functools.partial(grow_ops.grow_tree,
+                                   hist_subtraction=True, **kw))(Xb, g, h)
+    np.testing.assert_array_equal(np.asarray(off.feature),
+                                  np.asarray(on.feature))
+    np.testing.assert_array_equal(np.asarray(off.threshold_bin),
+                                  np.asarray(on.threshold_bin))
+    np.testing.assert_array_equal(np.asarray(off.is_leaf),
+                                  np.asarray(on.is_leaf))
+    np.testing.assert_array_equal(np.asarray(off.default_left),
+                                  np.asarray(on.default_left))
+    np.testing.assert_array_equal(np.asarray(off.leaf_of_row),
+                                  np.asarray(on.leaf_of_row))
+    np.testing.assert_allclose(np.asarray(off.leaf_value),
+                               np.asarray(on.leaf_value),
+                               rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("loss_kw", [
+    {},                                       # binary
+    {"loss": "softmax", "n_classes": 3},      # C = 3 trees per round
+    {"missing_policy": "learn"},              # reserved-bin routing
+])
+def test_fused_vs_granular_bitexact_with_subtraction(loss_kw):
+    """The Driver's fused multi-round path vs the granular per-tree path
+    with subtraction forced ON: both trace the same grow_tree program,
+    so tree STRUCTURE must match bitwise and leaf values to the same
+    FMA-contraction tolerance the two paths already had (the
+    fused == granular contract the subtraction trick must not widen)."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.driver import Driver
+
+    X, y = synthetic_binary(2500, n_features=6, seed=11)
+    if loss_kw.get("loss") == "softmax":
+        y = (y + (X[:, 0] > 0)).astype(np.int32)
+    Xb, _ = quantize(X, n_bins=31, seed=11)
+    cfg = TrainConfig(n_trees=4, max_depth=3, n_bins=31, backend="tpu",
+                      hist_subtraction="on", **loss_kw)
+    fused = api.train(Xb, y, cfg, binned=True, log_every=10**9).ensemble
+    be = get_backend(cfg)
+    gran = Driver(be, cfg, log_every=10**9, profile=True).fit(Xb, y)
+    np.testing.assert_array_equal(fused.feature, gran.feature)
+    np.testing.assert_array_equal(fused.threshold_bin, gran.threshold_bin)
+    np.testing.assert_array_equal(fused.is_leaf, gran.is_leaf)
+    np.testing.assert_allclose(fused.leaf_value, gran.leaf_value,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_subtraction_distributed_matches_single_device():
+    """4-partition row-sharded growth with subtraction ON vs single
+    device: the trick halves the allreduce payload (hist_left only), and
+    within one controller the psum'd left builds + replicated subtraction
+    must reproduce the single-device decisions bitwise (the ops/split.py
+    single-controller contract), leaf values to float tolerance."""
+    X, y = synthetic_binary(2400, n_features=5, seed=23)
+    Xb, _ = quantize(X, n_bins=31, seed=23)
+    kw = dict(n_trees=3, max_depth=3, n_bins=31, backend="tpu",
+              hist_subtraction="on")
+    one = api.train(Xb, y, TrainConfig(**kw), binned=True,
+                    log_every=10**9).ensemble
+    four = api.train(Xb, y, TrainConfig(n_partitions=4, **kw),
+                     binned=True, log_every=10**9).ensemble
+    np.testing.assert_array_equal(one.feature, four.feature)
+    np.testing.assert_array_equal(one.threshold_bin, four.threshold_bin)
+    np.testing.assert_array_equal(one.is_leaf, four.is_leaf)
+    np.testing.assert_allclose(one.leaf_value, four.leaf_value,
+                               rtol=1e-5, atol=1e-6)
+    # Column-sharded histogramming composes too: each feature shard
+    # subtracts within its own columns (the per-shard node totals come
+    # from row vectors, unchanged by the trick).
+    fp = api.train(Xb, y, TrainConfig(n_partitions=2,
+                                      feature_partitions=2, **kw),
+                   binned=True, log_every=10**9).ensemble
+    np.testing.assert_array_equal(one.feature, fp.feature)
+    np.testing.assert_array_equal(one.threshold_bin, fp.threshold_bin)
+    np.testing.assert_allclose(one.leaf_value, fp.leaf_value,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_resolve_hist_subtraction():
+    assert grow_ops.resolve_hist_subtraction("on") is True
+    assert grow_ops.resolve_hist_subtraction("off") is False
+    # auto follows the platform: off everywhere but a real TPU chip.
+    assert grow_ops.resolve_hist_subtraction("auto", platform="cpu") is False
+    assert grow_ops.resolve_hist_subtraction("auto", platform="tpu") is True
+    with pytest.raises(ValueError, match="hist_subtraction"):
+        grow_ops.resolve_hist_subtraction("maybe")
+    with pytest.raises(ValueError, match="hist_subtraction"):
+        TrainConfig(hist_subtraction="sometimes")
+
+
+def test_bins_pad_64_promotion():
+    """The 64-bin layout is automatic dispatch now: n_bins <= 64 pads to
+    64 SUBLANES (transposed kernel), not the old 128-lane tile — half
+    the one-hot footprint, and the VMEM budget math must agree."""
+    assert _bins_pad(16) == 64
+    assert _bins_pad(64) == 64
+    assert _bins_pad(65) == 128
+    assert _bins_pad(128) == 128
+    assert _bins_pad(129) == 256
+    assert _bins_pad(255) == 256
+    # The halved padding admits shapes the 128-lane layout would have
+    # chunked: budget scales linearly in bins_pad.
+    assert pallas_fits(64, 28, 64)
+    # and the headline 255-bin shape still fits single-slab at N=32.
+    assert pallas_fits(32, 28, 255)
+
+
+def test_fused_round_scopes_in_compiled_program():
+    """The new sub-spans are HLO metadata on the compiled grow program:
+    ddt:fused_round wraps each level, ddt:hist:subtract the sibling
+    assembly, ddt:hist:{stream,flush} the Pallas kernel's accumulation
+    and its one HBM flush (named scopes survive into the compiled
+    executable's op metadata, not the StableHLO text)."""
+    import functools
+
+    rng = np.random.default_rng(0)
+    Xb = jnp.asarray(rng.integers(0, 15, size=(300, 3), dtype=np.uint8))
+    g = jnp.asarray(rng.standard_normal(300).astype(np.float32))
+    h = jnp.asarray((rng.random(300) * 0.25 + 0.01).astype(np.float32))
+    kw = dict(max_depth=2, n_bins=15, reg_lambda=1.0,
+              min_child_weight=1e-3, min_split_gain=0.0)
+    txt = jax.jit(functools.partial(
+        grow_ops.grow_tree, hist_subtraction=True, hist_impl="segment",
+        **kw)).lower(Xb, g, h).compile().as_text()
+    for scope in ("ddt:fused_round", "ddt:hist", "ddt:hist:subtract",
+                  "ddt:gain", "ddt:route", "ddt:leaf"):
+        assert scope in txt, scope
+    # The kernel sub-spans ride the pallas dispatcher.
+    fn = jax.jit(functools.partial(
+        build_histograms_pallas, n_nodes=2, n_bins=15, tile_r=256,
+        interpret=True))
+    ktxt = fn.lower(Xb, g, h, jnp.zeros(300, jnp.int32)).compile().as_text()
+    assert "ddt:hist:stream" in ktxt
+    assert "ddt:hist:flush" in ktxt
+
+
+def test_kernel_smoke_script():
+    """scripts/kernel_smoke.py (make kernel-smoke) stays green — the
+    2-round interpret-mode smoke is tier-1-reachable through here, the
+    same pattern as the telemetry/trace/profile smokes."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "kernel_smoke", os.path.join(REPO, "scripts", "kernel_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0

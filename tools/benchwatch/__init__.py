@@ -52,6 +52,13 @@ METRICS: dict[str, str] = {
     "hist_one_dispatch_mrows_per_sec_min": "higher",
     "value_64bin_optin": "higher",
     "ab_ratio_64bin": "higher",
+    # hist_fused_roofline_hbm_util is context-only (NOT banded) for the
+    # same reason as hist_roofline_hbm_util below: lowering the fused
+    # round's bytes-accessed is the design direction, so a drop is an
+    # improvement and a "higher" band would invert the gate.
+    "hist_fused_mrows_per_sec": "higher",
+    "hist_fused_ab_ratio": "higher",
+    "hist_fused_roofline_flops_util": "higher",
     "e2e_train_s": "lower",
     "e2e_ms_per_tree": "lower",
     "e2e_implied_hist_mrows": "higher",
@@ -64,13 +71,29 @@ METRICS: dict[str, str] = {
     # Roofline utilization stamps (cost observatory): achieved/peak
     # fractions from XLA's cost model at the measured wallclock — losing
     # utilization is a regression even when absolute throughput drift
-    # hides it inside the tunnel bands.
+    # hides it inside the tunnel bands. hist_roofline_hbm_util is
+    # deliberately NOT banded since bench schema v2: the VMEM-streaming
+    # histogram kernel LOWERS bytes-accessed by design (the hist verdict
+    # flipping hbm -> compute is the kernel campaign's goal), so a drop
+    # against pre-rewrite history is the fix landing, not a regression;
+    # flops_util stays the banded hist signal.
     "hist_roofline_flops_util": "higher",
-    "hist_roofline_hbm_util": "higher",
     "predict_roofline_flops_util": "higher",
     "predict_roofline_hbm_util": "higher",
     "split_agreement": "higher",
     "auc_delta": "lower",
+}
+
+#: metric -> minimum bench_schema whose artifacts are comparable. When a
+#: metric's MEANING changes (not just its value), bench.py bumps
+#: BENCH_SCHEMA and the entry here keeps older artifacts out of that
+#: metric's band — banding a redefined quantity against pre-redefinition
+#: history would flag the redefinition itself as a regression (and hide
+#: real ones behind the semantic shift). Metrics absent here band across
+#: every schema. v2: e2e_implied_hist_mrows counts EFFECTIVE levels
+#: (1 + (depth-1)/2) when the sibling-subtraction trick is active.
+METRIC_MIN_SCHEMA: dict[str, int] = {
+    "e2e_implied_hist_mrows": 2,
 }
 
 MAD_K = 3.0          # band half-width in MADs...
@@ -107,8 +130,10 @@ def load_artifact(path: str) -> dict:
     if order is None:
         m = re.search(r"r(\d+)", os.path.basename(path))
         order = int(m.group(1)) if m else 0
+    schema = rec.get("bench_schema")
     return {"path": path, "kind": kind, "order": int(order),
             "metrics": metrics, "facts": facts,
+            "schema": int(schema) if isinstance(schema, int) else 1,
             "run_id": rec.get("run_id"), "git_rev": rec.get("git_rev")}
 
 
@@ -134,8 +159,10 @@ def check(history: list[dict], current: dict,
     regressions carry metric, direction, current, median, tolerance."""
     regressions, checked, skipped = [], [], []
     for name, cur in sorted(current["metrics"].items()):
+        min_schema = METRIC_MIN_SCHEMA.get(name, 0)
         vals = [h["metrics"][name] for h in history
-                if name in h["metrics"]]
+                if name in h["metrics"]
+                and h.get("schema", 1) >= min_schema]
         if len(vals) < min_history:
             skipped.append({"metric": name, "history": len(vals)})
             continue

@@ -491,6 +491,111 @@ class PallasInterpretChecker(Checker):
 
 
 # --------------------------------------------------------------------- #
+# 7b. pallas-vmem-guard
+# --------------------------------------------------------------------- #
+class PallasVmemGuardChecker(Checker):
+    """Every `pl.pallas_call` dispatch site must sit behind a VMEM-fits
+    predicate — a call whose name matches `*fits*` / `*chunks_for*`
+    (the hist_pallas.pallas_fits / feature_chunks_for /
+    predict_pallas.predict_pallas_fits idiom) — in the dispatching
+    function itself or in a module-local (transitive) caller. A Pallas
+    kernel pins its whole working set in VMEM: an unguarded dispatch at
+    a shape past the ~16 MB/core budget dies as a Mosaic allocation
+    failure (or a silent multi-minute pathological compile) ON THE CHIP
+    ONLY — the CPU interpret-mode tests never see it, so the guard is
+    the one thing standing between a new config knob and a fleet crash.
+    Dispatch units are module-level functions, class METHODS, and
+    module-scope code (no pallas_call site can hide by where it sits);
+    cross-module dispatchers don't count: the module that owns the
+    kernel must own (or call) its own budget predicate, so the guard and
+    the kernel's VMEM layout can never drift apart in separate files."""
+
+    rule = "pallas-vmem-guard"
+    path_scope = (r"^ddt_tpu/",)
+    _GUARD_RE = re.compile(r"fits|chunks_for")
+
+    def _units(self):
+        """(qualname, node) dispatch units: module-level functions,
+        CLASS METHODS (qualified `Class.method` so same-named methods in
+        different classes keep distinct guard status), and a `<module>`
+        pseudo-unit for module-scope statements — no pallas_call site
+        can hide from the scan by where it sits. Nested defs stay part
+        of their enclosing unit (they dispatch under its entry point).
+        Call EDGES still resolve on the bare last name (`self.m()` and
+        `obj.m()` are indistinguishable statically), conservatively
+        linking every same-named unit."""
+        defs = (ast.FunctionDef, ast.AsyncFunctionDef)
+        for node in ast.iter_child_nodes(self.ctx.tree):
+            if isinstance(node, defs):
+                yield node.name, node
+            elif isinstance(node, ast.ClassDef):
+                for m in ast.iter_child_nodes(node):
+                    if isinstance(m, defs):
+                        yield f"{node.name}.{m.name}", m
+        # Module scope: everything outside the units above.
+        mod = ast.Module(
+            body=[n for n in self.ctx.tree.body
+                  if not isinstance(n, defs + (ast.ClassDef,))],
+            type_ignores=[])
+        yield "<module>", mod
+
+    def run(self) -> list[Finding]:
+        calls: dict[str, set[str]] = {}       # qual -> called last-names
+        guarded: set[str] = set()             # quals with a fits call
+        dispatches: dict[str, list[ast.AST]] = {}
+        by_bare: dict[str, list[str]] = {}    # bare name -> quals
+        for qual, fn in self._units():
+            by_bare.setdefault(qual.split(".")[-1], []).append(qual)
+            called: set[str] = set()
+            for n in ast.walk(fn):               # incl. nested defs: they
+                if not isinstance(n, ast.Call):  # dispatch under the
+                    continue                     # enclosing entry point
+                d = callgraph.dotted(n.func)
+                if d is None:
+                    continue
+                last = d.split(".")[-1]
+                called.add(last)
+                if last == "pallas_call":
+                    dispatches.setdefault(qual, []).append(n)
+                if self._GUARD_RE.search(last):
+                    guarded.add(qual)
+            calls[qual] = called
+
+        # Reverse reachability: the dispatching unit plus every
+        # module-local transitive caller (a called bare name links every
+        # unit carrying it).
+        callers: dict[str, set[str]] = {q: set() for q in calls}
+        for src, called in calls.items():
+            for c in called:
+                for target in by_bare.get(c, ()):
+                    callers[target].add(src)
+
+        for qual, sites in dispatches.items():
+            seen, stack = {qual}, [qual]
+            ok = False
+            while stack and not ok:
+                cur = stack.pop()
+                if cur in guarded:
+                    ok = True
+                    break
+                for up in callers.get(cur, ()):
+                    if up not in seen:
+                        seen.add(up)
+                        stack.append(up)
+            if ok:
+                continue
+            for site in sites:
+                self.report(site, (
+                    f"`pallas_call` in '{qual}' has no VMEM-fits guard on "
+                    "its module-local dispatch chain — gate the dispatch "
+                    "behind a budget predicate (the hist_pallas."
+                    "pallas_fits / feature_chunks_for pattern) so "
+                    "over-budget shapes fail at the cause instead of as "
+                    "an on-chip Mosaic VMEM allocation failure"))
+        return self.findings
+
+
+# --------------------------------------------------------------------- #
 # 8. named-scope
 # --------------------------------------------------------------------- #
 class NamedScopeChecker(Checker):
@@ -599,6 +704,7 @@ AST_CHECKERS = [
     BroadExceptChecker,
     NoPrintChecker,
     PallasInterpretChecker,
+    PallasVmemGuardChecker,
     NamedScopeChecker,
     RawPhaseTimingChecker,
 ]
