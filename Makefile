@@ -47,6 +47,14 @@ profile-smoke:
 kernel-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/kernel_smoke.py
 
+# Chaos smoke (docs/ROBUSTNESS.md): small CPU run under a multi-fault
+# plan — torn checkpoint write (digest-detected, history fallback),
+# injected stream-read IOErrors (retry seam), injected straggler
+# (watchdog detection) — asserting the recovered ensemble is
+# BIT-IDENTICAL to an undisturbed run and the run log tells the story.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py
+
 # Bench regression sentinel (docs/OBSERVABILITY.md): band every metric
 # of the newest BENCH_r*/MULTICHIP_r* artifact against the history
 # (median ± max(3*MAD, 20%)); exit 1 on an adverse excursion. Point a
@@ -58,4 +66,4 @@ native:
 	$(MAKE) -C ddt_tpu/native
 
 .PHONY: lint lint-baseline tsan-audit test report trace-smoke \
-	profile-smoke kernel-smoke benchwatch native
+	profile-smoke kernel-smoke chaos-smoke benchwatch native

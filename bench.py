@@ -65,6 +65,17 @@ def _git_rev() -> str | None:
     rev = out.stdout.strip()
     return rev if out.returncode == 0 and rev else None
 
+
+def _injected_faults_active() -> bool:
+    """True when a chaos-harness fault plan is active in this process
+    (robustness/faultplan.py) — stamped into the artifact so benchwatch
+    keeps chaos numbers out of bench history."""
+    try:
+        from ddt_tpu.robustness import faultplan
+    except ImportError:
+        return False
+    return faultplan.active_plan() is not None
+
 # Perf-regression floors (SURVEY.md §4). Histogram: RATCHETED for the
 # VMEM-streaming kernel rewrite (training-megakernel round): the old
 # kernel measured 40-64 Mrows/s/chip across tunnel bands and its ~250
@@ -309,6 +320,10 @@ def main() -> None:
         "run_id": uuid.uuid4().hex[:12],
         "bench_schema": BENCH_SCHEMA,
         "git_rev": _git_rev(),
+        # Chaos stamp (docs/ROBUSTNESS.md): True when a fault-injection
+        # plan was active during this bench — benchwatch excludes such
+        # artifacts from bench history (recovery tests, not perf data).
+        "injected_faults": _injected_faults_active(),
         "value": round(value, 2),
         "unit": "Mrows/s/chip",
         "vs_baseline": round(value / baseline, 2),

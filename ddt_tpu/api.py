@@ -19,6 +19,7 @@ from ddt_tpu.config import TrainConfig
 from ddt_tpu.data.quantizer import BinMapper, fit_bin_mapper
 from ddt_tpu.driver import Driver
 from ddt_tpu.models.tree import TreeEnsemble
+from ddt_tpu.utils.atomic import atomic_savez
 
 log = logging.getLogger("ddt_tpu.api")
 
@@ -60,7 +61,11 @@ def save_model(path, ens: TreeEnsemble, mapper: BinMapper | None = None,
                encoder=None) -> None:
     """Write one .npz holding the ensemble and, when given, the BinMapper
     and CategoricalEncoder fitted at training time. The file remains loadable
-    by plain `TreeEnsemble.load` (extra keys are ignored there)."""
+    by plain `TreeEnsemble.load` (extra keys are ignored there).
+
+    Written tmp-then-os.replace (the atomic-artifact-write contract,
+    docs/ROBUSTNESS.md): a process killed mid-save leaves the previous
+    model intact, never a torn npz a serving loader would choke on."""
     d = ens.to_dict()
     if mapper is not None:
         # Reuse the classes' own save() dicts under a key prefix so any
@@ -69,7 +74,7 @@ def save_model(path, ens: TreeEnsemble, mapper: BinMapper | None = None,
         d.update({f"mapper_{k}": v for k, v in mapper.save().items()})
     if encoder is not None:
         d.update({f"cat_{k}": v for k, v in encoder.save().items()})
-    np.savez_compressed(path, **d)
+    atomic_savez(path, compressed=True, **d)
 
 
 def load_model(path) -> ModelBundle:

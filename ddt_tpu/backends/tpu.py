@@ -33,6 +33,7 @@ fpga/tpu selection [BASELINE].
 from __future__ import annotations
 
 import functools
+import logging
 import os
 from typing import Any, NamedTuple
 
@@ -49,9 +50,13 @@ from ddt_tpu.ops import histogram as hist_ops
 from ddt_tpu.ops import predict as predict_ops
 from ddt_tpu.ops import split as split_ops
 from ddt_tpu.parallel import mesh as mesh_lib
+from ddt_tpu.robustness import emit_fault, faultplan
 from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry.annotations import phase_span
 from ddt_tpu.telemetry.costmodel import costed
+from ddt_tpu.utils import retry as retry_lib
+
+log = logging.getLogger("ddt_tpu.backends.tpu")
 
 P = jax.sharding.PartitionSpec
 
@@ -205,6 +210,9 @@ class TPUDevice(DeviceBackend):
         self._row_axes = (
             (HAXIS, AXIS) if self.host_partitions > 1 else AXIS)
         self._input_dtype = jnp.dtype(cfg.matmul_input_dtype)
+        # Sticky position on the histogram OOM-degradation ladder
+        # (build_histograms below): 0 = the configured impl.
+        self._hist_degrade = 0
 
     # ------------------------------------------------------------------ #
     # sharding helpers
@@ -289,7 +297,16 @@ class TPUDevice(DeviceBackend):
     # ------------------------------------------------------------------ #
 
     @functools.cached_property
-    def _hist_fn(self):
+    def _hist_fns(self) -> dict:
+        # (impl, row_chunk) -> dispatcher; one entry per degrade-ladder
+        # step actually reached (almost always just the first).
+        return {}
+
+    def _hist_fn_for(self, impl: str, row_chunk: int):
+        key = (impl, row_chunk)
+        fn = self._hist_fns.get(key)
+        if fn is not None:
+            return fn
         cfg = self.cfg
 
         if self.feature_partitions > 1:
@@ -299,6 +316,7 @@ class TPUDevice(DeviceBackend):
                     "only; feature_partitions > 1 is handled inside "
                     "grow_tree (the Driver path)"
                 )
+            self._hist_fns[key] = unsupported
             return unsupported
 
         rax = self._row_axes
@@ -308,7 +326,8 @@ class TPUDevice(DeviceBackend):
             # shape (pallas only when its VMEM working set fits).
             out = hist_ops.build_histograms(
                 Xb, g, h, node_index, n_nodes, cfg.n_bins,
-                impl=cfg.hist_impl, input_dtype=self._input_dtype,
+                impl=impl, row_chunk=row_chunk,
+                input_dtype=self._input_dtype,
             )
             if self.distributed:
                 # The fabric-allreduce analog; over (hosts, rows) XLA phases
@@ -325,8 +344,38 @@ class TPUDevice(DeviceBackend):
                     out_specs=P(),
                 )
                 return f(Xb, g, h, node_index)
+            self._hist_fns[key] = sharded
             return sharded
+        self._hist_fns[key] = hist
         return hist
+
+    # Graceful-degradation ladder for the granular/streamed histogram
+    # surface (docs/ROBUSTNESS.md): a RESOURCE_EXHAUSTED from the
+    # resolved impl (the Pallas VMEM kernel pins its working set; a
+    # config past the budget predicate's model can still OOM on a busy
+    # chip) steps DOWN — matmul at the default row chunk, matmul at a
+    # small row chunk (a quarter of the one-hot working set), finally
+    # the scatter path — instead of discarding the run. The step is
+    # STICKY per backend instance (the same shape would OOM again) and
+    # each step emits a fault event + the hist_oom_degrades counter.
+    _HIST_DEGRADE_ROW_CHUNK = 8192
+
+    @functools.cached_property
+    def _hist_ladder(self) -> list:
+        default_rc = 32_768
+        ladder = [(self.cfg.hist_impl, default_rc)]
+        for step in (("matmul", default_rc),
+                     ("matmul", self._HIST_DEGRADE_ROW_CHUNK),
+                     ("segment", default_rc)):
+            # Membership (not just last-entry) dedup: hist_impl=
+            # "segment" must yield [segment, matmul, matmul@8k], never
+            # re-climb to a hungrier impl only to re-try the one that
+            # just OOM'd. (segment IS the floor for scatter-friendly
+            # platforms, but matmul's bounded row chunks are the only
+            # lower-VMEM option left when scatter itself blew up.)
+            if step not in ladder:
+                ladder.append(step)
+        return ladder
 
     def build_histograms(self, data, g, h, node_index, n_nodes):
         g = g if isinstance(g, jax.Array) else self._put_rows(np.asarray(g))
@@ -335,7 +384,26 @@ class TPUDevice(DeviceBackend):
             node_index = self._put_rows(
                 self._pad_rows_index(np.asarray(node_index))
             )
-        return self._hist_fn(data, g, h, node_index, n_nodes=n_nodes)
+        while True:
+            impl, row_chunk = self._hist_ladder[self._hist_degrade]
+            try:
+                faultplan.inject("hist.build")
+                return self._hist_fn_for(impl, row_chunk)(
+                    data, g, h, node_index, n_nodes=n_nodes)
+            except Exception as e:
+                if not faultplan.is_resource_exhausted(e) \
+                        or self._hist_degrade + 1 >= len(self._hist_ladder):
+                    raise
+                self._hist_degrade += 1
+                nxt, nxt_rc = self._hist_ladder[self._hist_degrade]
+                tele_counters.record_hist_oom_degrade()
+                emit_fault("hist_oom_degrade", from_impl=impl,
+                           to_impl=nxt, row_chunk=nxt_rc)
+                log.warning(
+                    "histogram build RESOURCE_EXHAUSTED under impl=%s "
+                    "(row_chunk=%d); degrading to impl=%s (row_chunk=%d) "
+                    "for the rest of this process: %s",
+                    impl, row_chunk, nxt, nxt_rc, str(e)[:200])
 
     def _pad_rows_index(self, idx: np.ndarray) -> np.ndarray:
         """Pad a node-index vector with -1 (frozen) so pad rows are inert."""
@@ -499,6 +567,60 @@ class TPUDevice(DeviceBackend):
         barrier on the handle, so it runs only on mesh runs WITH a run
         log attached)."""
         return mesh_lib.shard_ready_times(handle)
+
+    # Compiled callables and caches that close over self.mesh — every
+    # entry must be dropped when the mesh changes (rotate_row_partitions)
+    # or a stale program would keep placing shards on the old devices.
+    _MESH_BOUND_CACHES = (
+        "_hist_fns", "_grow_fn", "_grow_masked_fn", "_grad_fn",
+        "_rounds_fns", "_rounds_masked_fns", "_rounds_eval_fns",
+        "_eval_fns", "_stream_cache", "_apply_fn", "_row_mask_fn",
+        "_loss_fn", "_predict_cache",
+    )
+
+    def rotate_row_partitions(self) -> bool:
+        """Static row re-partitioning, rotation form (the straggler
+        watchdog's action — docs/ROBUSTNESS.md): rebuild the mesh with
+        the device order rotated by one, so each row shard moves to the
+        next physical device. Shard CONTENTS are untouched — same global
+        padded row layout, same psum structure — so the trained model is
+        unchanged by construction; what moves is which device does which
+        shard's work (the right response to a slow device; a no-op for
+        pure data skew). Costs a recompile of every mesh-bound program
+        plus the caller's reshard of live handles (reshard_rows) — why
+        the Driver only triggers it at checkpoint boundaries. Returns
+        False (and does nothing) on single-device backends and
+        multi-process meshes (rotating a pod's global device list needs
+        every process to agree; that is ROADMAP item 3's elastic
+        rework)."""
+        if not self.distributed or jax.process_count() > 1:
+            return False
+        devs = list(self.mesh.devices.flat)
+        rotated = devs[1:] + devs[:1]
+        # Mesh(ndarray) — NOT jax.make_mesh: make_mesh routes through
+        # mesh_utils.create_device_mesh, whose TPU branch rebuilds the
+        # order from physical torus coordinates of the device SET and
+        # silently discards the rotation (the CPU branch preserves it,
+        # which is why only a chip run would have noticed). The explicit
+        # ndarray constructor keeps the caller's order everywhere.
+        self.mesh = jax.sharding.Mesh(
+            np.asarray(rotated, dtype=object).reshape(
+                self.mesh.devices.shape),
+            self.mesh.axis_names)
+        for attr in self._MESH_BOUND_CACHES:
+            self.__dict__.pop(attr, None)
+        log.info("rotated row partitions: shard 0 now on device %s",
+                 rotated[0].id)
+        return True
+
+    def reshard_rows(self, handle, extra_dims: int = 0):
+        """Move a live row-sharded handle onto the CURRENT mesh (after
+        rotate_row_partitions) — a device-to-device copy, values
+        untouched."""
+        if handle is None or not self.distributed:
+            return handle
+        return jax.device_put(
+            handle, self._sharding(self._row_axes, *([None] * extra_dims)))
 
     # ------------------------------------------------------------------ #
     # fused multi-round training: a whole block of boosting rounds in ONE
@@ -883,7 +1005,16 @@ class TPUDevice(DeviceBackend):
         return f
 
     def fetch_tree(self, handle) -> HostTree:
-        packed = np.asarray(handle)                      # ONE fetch
+        def _fetch():
+            # The per-tree D2H round-trip is the Driver's one recurring
+            # host<->device transfer — through a remote-attached chip it
+            # is also the seam a tunnel reset tears first, so it retries
+            # transient runtime faults (UNAVAILABLE/DEADLINE_EXCEEDED)
+            # with backoff; the chaos harness injects here.
+            faultplan.inject("fetch_tree")
+            return np.asarray(handle)                    # ONE fetch
+
+        packed = retry_lib.retry_call(_fetch, seam="fetch_tree")
         tele_counters.record_d2h(packed.nbytes)          # run-log counter
         return HostTree(
             feature=packed[0].astype(np.int32),

@@ -541,6 +541,20 @@ def main(argv: list[str] | None = None) -> int:
     tp.add_argument("--checkpoint-dir", default=None)
     tp.add_argument("--checkpoint-every", type=_positive_int, default=25,
                     help="write a checkpoint every K boosting rounds (>= 1)")
+    tp.add_argument("--fault-plan", default=None,
+                    help="JSON fault-injection plan (the chaos harness, "
+                         "docs/ROBUSTNESS.md): fires named faults at the "
+                         "real seams — torn checkpoint write, stream-read "
+                         "IOError, multihost-init timeout, histogram OOM, "
+                         "straggler delay — deterministically, so recovery "
+                         "is a tested property; no plan = zero overhead")
+    tp.add_argument("--straggler-repartition", action="store_true",
+                    help="act on the straggler watchdog: rotate row-shard "
+                         "-> device assignment at the next checkpoint "
+                         "boundary when one device persistently straggles "
+                         "(needs --run-log on a multi-partition run; "
+                         "models are unchanged by construction — "
+                         "docs/ROBUSTNESS.md)")
     tp.add_argument("--valid-frac", type=float, default=0.0,
                     help="hold out this fraction as a validation set")
     tp.add_argument("--metric", default=None,
@@ -626,6 +640,17 @@ def main(argv: list[str] | None = None) -> int:
 
     args = ap.parse_args(argv)
 
+    if args.cmd == "train" and getattr(args, "fault_plan", None):
+        # Arm the chaos plan process-wide BEFORE multihost bootstrap so
+        # the multihost.init seam is injectable; the trainers see it
+        # already active and leave it alone (docs/ROBUSTNESS.md).
+        from ddt_tpu.robustness import faultplan
+
+        try:
+            faultplan.activate(faultplan.load_plan(args.fault_plan))
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"--fault-plan: {e}") from e
+
     if args.cmd == "train" and (
             args.multihost_coordinator is not None
             or args.multihost_processes is not None):
@@ -691,6 +716,8 @@ def main(argv: list[str] | None = None) -> int:
             missing_policy=args.missing,
             cat_features=cat_features,
             fused_block_rounds=args.fused_block_rounds,
+            fault_plan=args.fault_plan,
+            straggler_repartition=args.straggler_repartition,
         )
         if file_cfg is not None:
             cfg = cfg.replace(**file_cfg)

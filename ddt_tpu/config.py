@@ -106,6 +106,26 @@ class TrainConfig:
     # the systolic array at full rate, float32 forces exact accumulation.
     matmul_input_dtype: str = "bfloat16"
 
+    # --- robustness (docs/ROBUSTNESS.md) ---
+    # Path to a JSON fault-injection plan (robustness/faultplan.py); the
+    # chaos harness. None (the default) compiles every injection seam to
+    # a single module-global read — the telemetry disabled-path bar.
+    fault_plan: Optional[str] = None
+    # Act on the straggler watchdog: when the flight recorder's
+    # per-round partition attribution shows one device persistently past
+    # the skew threshold, rotate the row-shard -> device assignment at
+    # the next checkpoint boundary (shard contents untouched — the model
+    # is unchanged by construction). Detection events are always emitted
+    # on telemetry mesh runs; this flag gates the ACTION, and it forces
+    # the granular Driver path (repartitioning needs round-boundary
+    # control a fused block does not yield).
+    straggler_repartition: bool = False
+    # Watchdog trip point: a device whose per-round phase total exceeds
+    # the MEDIAN OF THE OTHER lanes by this factor is a straggler
+    # candidate (excluding the candidate keeps the default meaningful
+    # even on a 2-lane mesh — robustness/watchdog.py).
+    straggler_skew_threshold: float = 2.0
+
     def __post_init__(self) -> None:
         if self.loss not in LOSSES:
             raise ValueError(f"loss must be one of {LOSSES}, got {self.loss!r}")
@@ -148,6 +168,12 @@ class TrainConfig:
         if self.missing_policy == "learn" and self.n_bins < 3:
             raise ValueError(
                 "missing_policy='learn' reserves the top bin; n_bins >= 3"
+            )
+        if self.straggler_skew_threshold <= 1.0:
+            raise ValueError(
+                "straggler_skew_threshold must be > 1.0 (1.0 is a "
+                f"perfectly balanced mesh), got "
+                f"{self.straggler_skew_threshold}"
             )
         # Normalize unconditionally: a list (even an empty one) must
         # become a tuple or the backend cache key is unhashable.

@@ -27,12 +27,23 @@ import re
 
 import numpy as np
 
+from ddt_tpu.utils.atomic import atomic_savez
+
 CHUNK_PREFIX = "chunk_"
 _CHUNK_RE = re.compile(re.escape(CHUNK_PREFIX) + r"(\d+)\.npz$")
 
 
 def _chunk_path(out_dir: str, c: int) -> str:
     return os.path.join(out_dir, f"{CHUNK_PREFIX}{c:05d}.npz")
+
+
+def _atomic_savez(path: str, **arrays) -> None:
+    """Shard writes are tmp-then-os.replace (utils/atomic.py): a writer
+    killed mid-shard leaves no torn chunk_*.npz for a later training run
+    to choke on — the reader's canonical-name regex (_CHUNK_RE,
+    $-anchored) never matches the .tmp.npz name, so a partial write is
+    invisible to chunk_files."""
+    atomic_savez(path, **arrays)
 
 
 def _purge_stale(out_dir: str, n_chunks: int) -> None:
@@ -87,8 +98,8 @@ def shard_arrays(
     paths = []
     for c in range(n_chunks):
         p = _chunk_path(out_dir, c)
-        np.savez(p, X=X[bounds[c]:bounds[c + 1]],
-                 y=y[bounds[c]:bounds[c + 1]])
+        _atomic_savez(p, X=X[bounds[c]:bounds[c + 1]],
+                      y=y[bounds[c]:bounds[c + 1]])
         paths.append(p)
     _purge_stale(out_dir, n_chunks)
     return paths
@@ -135,7 +146,7 @@ def shard_stress_chunks(
         Xc, yc = stress_binned_chunk(
             c, chunk_rows, n_features=n_features, seed=seed,
             n_bins=n_bins)
-        np.savez(_chunk_path(out_dir, c), X=Xc, y=yc)
+        _atomic_savez(_chunk_path(out_dir, c), X=Xc, y=yc)
         del Xc, yc
     _purge_stale(out_dir, n_chunks)
     return chunk_rows
@@ -181,7 +192,7 @@ def write_binned_cache(
     os.makedirs(cache_dir, exist_ok=True)
     for c in range(n_chunks):
         X, y = raw_chunk_fn(c)
-        np.savez(_chunk_path(cache_dir, c),
-                 X=mapper.transform(np.asarray(X, np.float32)), y=y)
+        _atomic_savez(_chunk_path(cache_dir, c),
+                      X=mapper.transform(np.asarray(X, np.float32)), y=y)
     _purge_stale(cache_dir, n_chunks)
     return directory_chunks(cache_dir)

@@ -70,6 +70,7 @@ from ddt_tpu.backends.base import DeviceBackend
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble, empty_ensemble
 from ddt_tpu.reference.numpy_trainer import base_score
+from ddt_tpu.robustness import faultplan, set_fault_sink
 from ddt_tpu.telemetry import costmodel
 from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry.annotations import phase_ctx
@@ -221,7 +222,22 @@ class Driver:
         from a path string — is closed on every exit, success or mid-run
         exception such as the NaN-eval ValueError, so repeated failing
         fits cannot leak file handles. fit_streaming carries the same
-        shim.)"""
+        shim. The same shim scopes the robustness state: the fault-event
+        sink points at this run's log for the duration, and a
+        cfg.fault_plan chaos plan is activated here — unless one is
+        already active process-wide, e.g. the CLI armed it before
+        multihost bootstrap — and deactivated on every exit.)"""
+        # Load the plan BEFORE touching any process-global state: a bad
+        # plan file must fail clean, not leak the sink or collectors.
+        plan = None
+        if self.cfg.fault_plan and faultplan.active_plan() is None:
+            plan = faultplan.load_plan(self.cfg.fault_plan)
+        prev_sink = set_fault_sink(self.run_log)
+        plan_prev = None
+        plan_armed = False
+        if plan is not None:
+            plan_prev = faultplan.activate(plan)
+            plan_armed = True
         try:
             return self._fit(
                 Xb, y, eval_set=eval_set, eval_metric=eval_metric,
@@ -235,6 +251,9 @@ class Driver:
             costmodel.deactivate(self._cost)
             if self._window is not None:
                 self._window.close()
+            if plan_armed:
+                faultplan.deactivate(plan_prev)
+            set_fault_sink(prev_sink)
             if self._own_run_log and self.run_log is not None:
                 self.run_log.close()
 
@@ -334,7 +353,8 @@ class Driver:
         if self.checkpoint_dir is not None:
             from ddt_tpu.utils.checkpoint import try_resume
 
-            start_round = try_resume(self.checkpoint_dir, ens, cfg)
+            start_round = try_resume(self.checkpoint_dir, ens, cfg,
+                                     run_log=self.run_log)
             if start_round > 0:
                 # Reconstitute boosting state by rescoring the partial
                 # ensemble with fit's own per-round accumulation order, so
@@ -446,6 +466,18 @@ class Driver:
         # recorder (no probes, no syncs — the PR-2 invariant).
         self._part_rec = part_rec = PartitionRecorder(
             self.run_log, self.backend, bytes_per_round=coll_bytes_round)
+        # Straggler watchdog (robustness/watchdog.py): consumes the
+        # recorder's per-round lanes, so it exists exactly when the
+        # recorder does — detection events always, the repartition
+        # ACTION only behind cfg.straggler_repartition (which also
+        # forces the granular path below: the rotation needs a round
+        # boundary a fused block does not yield).
+        self._watchdog = None
+        if part_rec.active:
+            from ddt_tpu.robustness.watchdog import StragglerWatchdog
+
+            self._watchdog = StragglerWatchdog(
+                threshold=cfg.straggler_skew_threshold)
 
         def _store(handle, slot):
             with ph("fetch_tree"):
@@ -499,6 +531,7 @@ class Driver:
             getattr(self.backend, "grow_rounds", None) is not None
             and (eval_set is None or fused_eval)
             and not self.profile
+            and not cfg.straggler_repartition
             and (not colsample or fused_masked)
         ):
             eval_state = None
@@ -601,7 +634,7 @@ class Driver:
             self._recorder.record(
                 rnd, dt * 1e3, val_score,
                 lambda: self.backend.loss_value(pred, y_dev))
-            part_rec.flush_round(rnd)
+            self._observe_straggler(rnd, part_rec.flush_round(rnd))
             if self._window is not None:      # xprof window: stop edge
                 self._window.round_end(rnd)
 
@@ -641,6 +674,22 @@ class Driver:
                     pending = None
                 checkpoint.maybe_save(self.checkpoint_dir, ens, cfg,
                                       rnd + 1)
+            if self.checkpoint_every >= 1 \
+                    and (rnd + 1) % self.checkpoint_every == 0 \
+                    and self._wants_repartition():
+                # The watchdog's action fires only on the checkpoint
+                # CADENCE (with or without a directory): the rotation
+                # recompiles every mesh-bound program, so it must be
+                # paid at a boundary, never mid-stride. The pending
+                # fetch is flushed first — its handle belongs to the
+                # pre-rotation mesh.
+                if pending is not None:
+                    _store(*pending)
+                    pending = None
+                (data, y_dev, pred, val_data_dev, val_y_dev,
+                 val_pred_dev) = self._repartition(
+                    rnd, data, y_dev, pred, val_data_dev, val_y_dev,
+                    val_pred_dev, C)
 
         if pending is not None:                # flush the fetch pipeline
             _store(*pending)
@@ -650,6 +699,59 @@ class Driver:
                               completed_rounds)
         self._finish_run(t_fit0, completed_rounds, counters_start)
         return ens
+
+    def _observe_straggler(self, rnd: int, parts: "dict | None") -> None:
+        """One round's flushed partition lanes -> the watchdog (shared
+        feed: robustness.watchdog.feed_watchdog — warning + fault
+        event). No-op when either side is absent."""
+        if self._watchdog is None:
+            return
+        from ddt_tpu.robustness.watchdog import feed_watchdog
+
+        feed_watchdog(self._watchdog, self.run_log, rnd, parts, log)
+
+    def _wants_repartition(self) -> bool:
+        return (self._watchdog is not None
+                and self._watchdog.pending_repartition
+                and self.cfg.straggler_repartition
+                and getattr(self.backend, "feature_partitions", 1) == 1
+                and getattr(self.backend, "rotate_row_partitions", None)
+                is not None)
+
+    def _repartition(self, rnd: int, data, y_dev, pred,
+                     val_data, val_y, val_pred, C: int) -> tuple:
+        """The watchdog's action: rotate the row-shard -> device
+        assignment (backend.rotate_row_partitions — shard contents and
+        therefore the model are untouched) and move every live handle
+        onto the new mesh. Runs at checkpoint boundaries only; emits a
+        `repartition` fault event so the run log shows when lanes
+        moved."""
+        be = self.backend
+        if not be.rotate_row_partitions():
+            # Nothing to rotate (single device / multi-process mesh):
+            # acknowledge so the watchdog does not re-request every
+            # boundary.
+            self._watchdog.repartition_done()
+            return data, y_dev, pred, val_data, val_y, val_pred
+        extra = 1 if C > 1 else 0
+        data = be.reshard_rows(data, extra_dims=1)
+        y_dev = type(y_dev)(be.reshard_rows(y_dev.y),
+                            be.reshard_rows(y_dev.valid))
+        pred = be.reshard_rows(pred, extra_dims=extra)
+        if val_data is not None:
+            val_data = be.reshard_rows(val_data, extra_dims=1)
+        if val_y is not None:
+            val_y = type(val_y)(be.reshard_rows(val_y.y),
+                                be.reshard_rows(val_y.valid))
+        if val_pred is not None:
+            val_pred = be.reshard_rows(val_pred, extra_dims=extra)
+        log.warning("repartitioned at round %d: rotated row shards off "
+                    "the straggling device", rnd + 1)
+        if self.run_log is not None:
+            self.run_log.emit("fault", kind="repartition", round=rnd + 1,
+                              rotation=1)
+        self._watchdog.repartition_done()
+        return data, y_dev, pred, val_data, val_y, val_pred
 
     def _fit_fused(self, data, y_dev, pred, ens: TreeEnsemble,
                    start_round: int, C: int,
@@ -735,7 +837,11 @@ class Driver:
                 # trace now holds every dispatch of rounds [rnd, rnd+K).
                 self._window.round_end(rnd + K - 1)
             if part_rec is not None:
-                part_rec.flush_round(rnd, n_rounds=K)
+                # Watchdog feed on the fused path too — detection only
+                # (the repartition action needs the granular loop, which
+                # cfg.straggler_repartition forces).
+                self._observe_straggler(
+                    rnd, part_rec.flush_round(rnd, n_rounds=K))
             tele_counters.record_d2h(trees.nbytes + losses.nbytes)
             if coll_bytes_round:
                 tele_counters.record_collective(coll_bytes_round * K)

@@ -22,6 +22,8 @@ import hashlib
 
 import numpy as np
 
+from ddt_tpu.utils.atomic import atomic_savez
+
 
 @dataclasses.dataclass
 class TreeEnsemble:
@@ -386,7 +388,9 @@ class TreeEnsemble:
         )
 
     def save(self, path: str) -> None:
-        np.savez_compressed(path, **self.to_dict())
+        # tmp-then-replace (the atomic-artifact-write contract): a kill
+        # mid-save never leaves a torn model file behind.
+        atomic_savez(path, compressed=True, **self.to_dict())
 
     @staticmethod
     def load(path: str) -> "TreeEnsemble":

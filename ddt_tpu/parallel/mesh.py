@@ -171,7 +171,13 @@ def initialize_multihost(
     no-op (preemptible-restart loops re-run their whole entry point); a
     repeat call with DIFFERENT arguments raises — jax.distributed cannot
     re-wire a live coordinator, and silently keeping the old topology
-    would train on the wrong mesh."""
+    would train on the wrong mesh.
+
+    Transient bootstrap faults (a coordinator that is still coming up, a
+    DCN blip — the classic pod bring-up race) are RETRIED with backoff
+    before the hard failure below: one slow peer must not abort an
+    N-host launch (utils/retry.py, seam "multihost.init"; the chaos
+    harness injects its timeout at the same seam)."""
     global _init_args
     kwargs = {}
     if coordinator_address is not None:
@@ -190,8 +196,21 @@ def initialize_multihost(
             f"re-initialise with {kwargs} — restart the process to change "
             "the distributed topology"
         )
-    try:
+    from ddt_tpu.robustness import faultplan
+    from ddt_tpu.utils import retry
+
+    def _attempt() -> None:
+        faultplan.inject("multihost.init")
         jax.distributed.initialize(**kwargs)
+
+    try:
+        retry.retry_call(
+            _attempt, seam="multihost.init",
+            # Bootstrap waits are long: few, slow attempts with a pod-
+            # bring-up-sized deadline (vs the default I/O policy's 30 s).
+            policy=retry.RetryPolicy(attempts=3, base_s=2.0,
+                                     multiplier=2.0, jitter=0.5,
+                                     deadline_s=120.0))
     except Exception as e:
         raise RuntimeError(
             f"jax.distributed.initialize({kwargs}) failed — check that the "

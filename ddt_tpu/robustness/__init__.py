@@ -1,0 +1,53 @@
+"""Robustness substrate: fault injection, retry seams, self-healing hooks.
+
+The reference system runs tree construction across a cluster where node
+loss and fabric hiccups are routine (SURVEY.md §5 "Failure detection /
+elastic recovery"); at the north-star scale a transient fault must cost
+a retry, not a run. This package holds the pieces that make recovery a
+TESTED property:
+
+- `faultplan` — a seeded, config-driven fault-injection plan
+  (`cfg.fault_plan` / `--fault-plan`) that fires named faults at the
+  real seams (torn checkpoint write, stream-chunk IOError, multihost
+  bootstrap timeout, histogram RESOURCE_EXHAUSTED, straggler delay),
+  compiled to a single module-global read when no plan is active.
+- `watchdog` — the straggler watchdog consuming the flight recorder's
+  per-round partition attribution.
+- the process-global FAULT SINK below: deep seams (retry loops, the
+  checkpoint fallback, the histogram degrade ladder) emit schema'd
+  `fault` events into the active run log without threading a handle
+  through every layer. Trainers set it for the duration of a fit; with
+  no sink attached emission is one global read and a return.
+
+The retry/backoff engine itself lives in `ddt_tpu.utils.retry` (it is
+a utility with no robustness-package dependencies beyond this sink).
+Docs: docs/ROBUSTNESS.md.
+"""
+
+from __future__ import annotations
+
+# The active fault sink: a telemetry.RunLog (or None). Process-global on
+# purpose — same ownership discipline as faultplan's active plan: the
+# trainer's fit shim sets it, restores the previous value in `finally`.
+_SINK = None
+
+
+def set_fault_sink(run_log) -> "object | None":
+    """Install `run_log` (may be None) as the fault-event sink; returns
+    the previous sink so callers can restore it (the activate/deactivate
+    pairing every trainer shim uses)."""
+    global _SINK
+    prev = _SINK
+    _SINK = run_log
+    return prev
+
+
+def emit_fault(kind: str, **fields) -> None:
+    """Emit a `fault` run-log event through the active sink (no-op when
+    none is attached). The event schema requires only `kind`; seams add
+    extras (seam, attempt, round, device, ...) — the catalog is the
+    fault-kind table in docs/OBSERVABILITY.md."""
+    sink = _SINK
+    if sink is None:
+        return
+    sink.emit("fault", kind=kind, **fields)

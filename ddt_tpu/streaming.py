@@ -384,16 +384,41 @@ def fit_streaming(
     """Train a GBDT over streamed chunks — see _fit_streaming_impl
     directly below for the full contract (validation, checkpointing,
     device streaming, sampling, telemetry). This wrapper owns exactly
-    one concern: run-scoped telemetry state built HERE — a run log
-    coerced from a path string, the cost-capture collector, a still-open
-    xprof window — is torn down on every exit, success or mid-run
-    exception (the Driver has the same shim on fit), so repeated failing
-    fits cannot leak file handles or bill capture work to later runs."""
+    one concern: run-scoped state built HERE — a run log coerced from a
+    path string, the cost-capture collector, a still-open xprof window,
+    the robustness fault sink, a cfg.fault_plan chaos plan — is torn
+    down on every exit, success or mid-run exception (the Driver has
+    the same shim on fit), so repeated failing fits cannot leak file
+    handles or bill capture work to later runs.
+
+    The chunk sources are additionally wrapped in the stream-read retry
+    seam (utils/retry.retrying_chunk_fn): every read — training, value
+    and label-only alike, on both the host and device loops — retries
+    transient I/O faults with jittered backoff, each failed attempt
+    emitting a schema'd `fault` event. Chunk sources are pure by
+    contract, so a retried re-read changes nothing."""
+    from ddt_tpu.robustness import faultplan, set_fault_sink
+    from ddt_tpu.utils import retry as retry_lib
+
+    # Load the plan BEFORE touching any process-global state: a bad plan
+    # file must fail clean, not leak the sink or the cost collector.
+    plan = None
+    if cfg.fault_plan and faultplan.active_plan() is None:
+        plan = faultplan.load_plan(cfg.fault_plan)
     own_run_log = isinstance(run_log, str)
     run_log = RunLog.coerce(run_log)
     # Device-truth cost capture (telemetry/costmodel.py): telemetry runs
     # only; torn down below even when the fit dies mid-round.
     cost = costmodel.activate() if run_log is not None else None
+    prev_sink = set_fault_sink(run_log)
+    plan_prev = None
+    plan_armed = False
+    if plan is not None:
+        plan_prev = faultplan.activate(plan)
+        plan_armed = True
+    chunk_fn = retry_lib.retrying_chunk_fn(chunk_fn)
+    if valid_chunk_fn is not None:
+        valid_chunk_fn = retry_lib.retrying_chunk_fn(valid_chunk_fn)
     try:
         return _fit_streaming_impl(
             chunk_fn, n_chunks, cfg, backend=backend,
@@ -409,6 +434,9 @@ def fit_streaming(
         costmodel.deactivate(cost)
         if profiler_window is not None:
             profiler_window.close()
+        if plan_armed:
+            faultplan.deactivate(plan_prev)
+        set_fault_sink(prev_sink)
         if own_run_log and run_log is not None:
             run_log.close()
 
@@ -597,6 +625,17 @@ def _fit_streaming_impl(
             C * n_chunks * tele_counters.hist_allreduce_bytes(
                 cfg.max_depth, int(F), cfg.n_bins)
             if getattr(backend, "distributed", False) else 0))
+    # Straggler watchdog (robustness/watchdog.py) — DETECTION only on
+    # the streaming path (fault events per trip; repartitioning a
+    # streamed run means re-cutting chunk->host assignment, which is
+    # ROADMAP item 3's elastic rework). Exists exactly when the
+    # recorder is active.
+    watchdog = None
+    if part_rec.active:
+        from ddt_tpu.robustness.watchdog import StragglerWatchdog
+
+        watchdog = StragglerWatchdog(
+            threshold=cfg.straggler_skew_threshold)
 
     def _finish(e: TreeEnsemble) -> TreeEnsemble:
         """Telemetry epilogue — every fit_streaming return funnels
@@ -622,7 +661,8 @@ def _fit_streaming_impl(
                 f"checkpoint_every must be >= 1, got {checkpoint_every}")
         from ddt_tpu.utils.checkpoint import try_resume
 
-        start_round = try_resume(checkpoint_dir, ens, cfg)
+        start_round = try_resume(checkpoint_dir, ens, cfg,
+                                 run_log=run_log)
         if start_round > 0:
             log.info("streaming: resumed from checkpoint at round %d",
                      start_round)
@@ -650,7 +690,7 @@ def _fit_streaming_impl(
             checkpoint_every=checkpoint_every, ev=ev,
             device_chunk_cache=device_chunk_cache,
             ph=ph, run_log=run_log, part_rec=part_rec,
-            window=profiler_window))
+            window=profiler_window, watchdog=watchdog))
 
     # The ONE optional O(R·C) structure: per-chunk cached raw scores (4C
     # bytes/row). cache_preds=False recomputes scores from the partial
@@ -878,6 +918,7 @@ def _fit_streaming_device(
     run_log: "RunLog | None" = None,
     part_rec: "PartitionRecorder | None" = None,
     window=None,
+    watchdog=None,
 ) -> TreeEnsemble:
     """Device streaming loop: see fit_streaming. Per tree it makes
     max_depth histogram passes + 1 leaf pass (+ 1 pred-update pass between
@@ -1085,7 +1126,14 @@ def _fit_streaming_device(
                     ev)
         if window is not None:                # xprof window: stop edge
             window.round_end(rnd)
-        part_rec.flush_round(rnd)
+        if watchdog is not None:
+            from ddt_tpu.robustness.watchdog import feed_watchdog
+
+            feed_watchdog(watchdog, run_log, rnd,
+                          part_rec.flush_round(rnd), log,
+                          prefix="streaming: ")
+        else:
+            part_rec.flush_round(rnd)
         if stop:
             log.info(
                 "streaming: early stop at round %d (best %s=%.6f at "

@@ -62,6 +62,14 @@ _c = {
     # live models than the LRU holds) or the model is being rebuilt
     # between calls.
     "compiled_ensemble_cache_hits": 0,
+    # Robustness substrate (docs/ROBUSTNESS.md): failed attempts the
+    # retry seams recovered from (utils/retry.py — each also emits a
+    # `fault` event with the seam) and histogram OOM degradations (the
+    # backend stepped down the hist-impl ladder after RESOURCE_EXHAUSTED
+    # — backends/tpu.py). Nonzero values in a "healthy" run's counters
+    # line are the signal the infrastructure is limping.
+    "fault_retries": 0,
+    "hist_oom_degrades": 0,
 }
 _listener_installed = False
 # When truthy, the compile listener drops events: the cost observatory's
@@ -122,6 +130,14 @@ def record_collective(nbytes: int) -> None:
 
 def record_compiled_ensemble_hit() -> None:
     _c["compiled_ensemble_cache_hits"] += 1
+
+
+def record_fault_retry() -> None:
+    _c["fault_retries"] += 1
+
+
+def record_hist_oom_degrade() -> None:
+    _c["hist_oom_degrades"] += 1
 
 
 def snapshot() -> dict:

@@ -58,7 +58,14 @@ EVENT_FIELDS: dict[str, set] = {
     "partition_skew": {"phases"},
     # The early-stopping decision, when one fires.
     "early_stop": {"round", "best_round", "best_score", "metric"},
-    # Fault/recovery events (today: checkpoint resume after a death).
+    # Fault/recovery events. Kinds (extras per kind; the catalog table
+    # lives in docs/OBSERVABILITY.md): checkpoint_resume,
+    # checkpoint_corrupt, checkpoint_fallback, checkpoint_unrecoverable
+    # (utils/checkpoint.py); retry / retry_exhausted / retry_deadline
+    # (utils/retry.py, with seam + attempt); injected (the chaos
+    # harness, robustness/faultplan.py, with site); hist_oom_degrade
+    # (backends/tpu.py); straggler_detected / repartition
+    # (robustness/watchdog.py via the trainers).
     "fault": {"kind"},
     # Device-counter deltas over the run (telemetry.counters).
     "counters": {"jit_compiles", "h2d_bytes", "d2h_bytes",
@@ -304,13 +311,29 @@ class PartitionRecorder:
             self._round.setdefault(dev, {})
             self._round[dev][phase] = self._round[dev].get(phase, 0.0) + ms
 
-    def flush_round(self, rnd: int, n_rounds: int = 1) -> None:
+    def flush_round(self, rnd: int, n_rounds: int = 1) -> "dict | None":
         """Emit the round's partition_phases event (rnd is 0-based here;
         the event carries the 1-based round like every other record).
         `n_rounds` > 1 on the fused path: the event covers a whole
-        block."""
+        block. Returns the flushed {device: {phase: ms}} dict — the
+        straggler watchdog's per-round feed (robustness/watchdog.py) —
+        or None when inactive/empty."""
         if not self.active or not self._round:
-            return
+            return None
+        # Chaos-harness straggler seam (robustness/faultplan.py): an
+        # active plan may inflate one lane's observed time — a
+        # DETERMINISTIC straggler (no real sleeping) that flows into the
+        # event stream, the skew summary, and the watchdog exactly like
+        # a slow device would. One module-global read per device when no
+        # plan is active.
+        from ddt_tpu.robustness import faultplan
+
+        for dev in self._round:
+            extra = faultplan.perturb_ms("straggler", device=int(dev),
+                                         round=rnd + 1)
+            if extra:
+                self._round[dev]["straggler_injected"] = (
+                    self._round[dev].get("straggler_injected", 0.0) + extra)
         parts = []
         for dev in sorted(self._round):
             phases = {k: round(v, 3) for k, v in self._round[dev].items()}
@@ -323,7 +346,8 @@ class PartitionRecorder:
                 tot[k] = tot.get(k, 0.0) + v
         self.run_log.emit("partition_phases", round=rnd + 1,
                           rounds=n_rounds, partitions=parts)
-        self._round = {}
+        flushed, self._round = self._round, {}
+        return flushed
 
     def emit_skew(self) -> None:
         """End-of-run partition_skew event (finish_run_log calls this

@@ -328,6 +328,13 @@ def render(summary: dict) -> str:
         hits = c.get("compiled_ensemble_cache_hits")
         if hits is not None:
             out.append(f"predict: compiled_ensemble_cache_hits={hits}")
+        # Robustness health pair (docs/ROBUSTNESS.md): nonzero means the
+        # run limped through faults — say so even when it finished green.
+        retries = c.get("fault_retries") or 0
+        degrades = c.get("hist_oom_degrades") or 0
+        if retries or degrades:
+            out.append(f"robustness: fault_retries={retries}  "
+                       f"hist_oom_degrades={degrades}")
 
     if summary["slowest_rounds"]:
         slow = ", ".join(f"#{r['round']} ({r['ms_per_round']:.1f} ms)"

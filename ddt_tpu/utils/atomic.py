@@ -1,0 +1,37 @@
+"""Atomic npz artifact writes (docs/ROBUSTNESS.md atomic-artifact-write).
+
+THE shared tmp-then-`os.replace` dance for every persistent-artifact
+writer (model save, checkpoint ensemble, chunk/cache shards) — one home,
+so a future hardening (fsync-before-replace, say) lands once. ddtlint's
+`atomic-artifact-write` rule enforces the pattern; this helper is how
+the artifact-owning modules comply."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def atomic_savez(path, *, compressed: bool = False, **arrays) -> str:
+    """np.savez[_compressed] via a tmp-suffixed sibling + os.replace, so
+    a process killed mid-save leaves the previous artifact intact —
+    never a torn npz at the canonical name. Mirrors np.savez's
+    suffixing (a bare path gains .npz) so the final name matches what a
+    direct call produced. Returns the final path; a failed write
+    removes its tmp sibling before re-raising."""
+    final = str(path)
+    if not final.endswith(".npz"):
+        final += ".npz"
+    tmp = final + ".tmp.npz"
+    save = np.savez_compressed if compressed else np.savez
+    try:
+        save(tmp, **arrays)
+        os.replace(tmp, final)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    return final

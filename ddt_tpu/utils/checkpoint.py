@@ -2,28 +2,74 @@
 
 A GBDT ensemble is tiny (KBs–MBs of node arrays), so checkpointing is simply:
 after every K boosting rounds, atomically write the partial ensemble + a
-cursor (completed rounds, config fingerprint). Resume = load node arrays into
-the pre-allocated ensemble, rescore the partial ensemble to rebuild the
-boosting state (Driver does that part), and continue the loop. Exactly
-restartable because training is deterministic given the binned data
-(SURVEY.md §5 "checkpoint/resume"); the fault-injection test kills a training
-process mid-run and verifies the resumed ensemble matches an uninterrupted
-one (tests/test_checkpoint.py).
-"""
+cursor (completed rounds, config fingerprint, ensemble content digest).
+Resume = load node arrays into the pre-allocated ensemble, rescore the
+partial ensemble to rebuild the boosting state (Driver does that part), and
+continue the loop. Exactly restartable because training is deterministic
+given the binned data (SURVEY.md §5 "checkpoint/resume"); the fault-injection
+test kills a training process mid-run and verifies the resumed ensemble
+matches an uninterrupted one (tests/test_faultinject.py).
+
+Hardening (docs/ROBUSTNESS.md):
+
+- **Pair atomicity via digest.** ensemble.npz and cursor.json are two
+  separate os.replace's; a crash BETWEEN them leaves a new ensemble beside a
+  stale cursor. The cursor therefore carries the sha256 of the ensemble file
+  it describes — resume validates the pair and a mismatch is a detected torn
+  write, never a silently skewed resume.
+- **Keep-last-k history.** After each top-level pair lands, it is hard-linked
+  (copy fallback) into `ckpt-<round>/`; the newest `keep_last` rounds are
+  retained. A torn or corrupt top-level pair falls back to the newest VALID
+  history pair instead of crashing. (Links share inodes: a torn REWRITE —
+  always a new file via os.replace — never touches history, while in-place
+  bit rot on the latest pair also hits the history entry sharing its inode;
+  the fallback then recovers one save older, which digest validation finds
+  on its own.)
+- **Corruption = no checkpoint, not a crash.** A truncated cursor.json, an
+  unreadable npz, or a digest mismatch logs a warning, emits a `fault` event
+  (kind checkpoint_corrupt / checkpoint_fallback), and resumes from the best
+  surviving pair — or returns 0 (fresh start) when nothing survives.
+  An INCOMPATIBLE-but-valid checkpoint still raises: that is a user error
+  (wrong directory), and resuming it would corrupt the run silently.
+- **Retry seams.** The artifact writes/reads retry transient I/O faults with
+  backoff (utils/retry.py seams ckpt.save / ckpt.load), and the chaos
+  harness's injection sites (ckpt.save.write, ckpt.save.between, ckpt.load —
+  robustness/faultplan.py) sit at the real failure points.
+
+The resumed == uninterrupted bit-identity contract is unchanged: the cursor
+and node-array semantics are exactly the pre-hardening ones, old cursors
+without a digest remain resumable, and history retention never rewrites the
+top-level pair the existing tests poll."""
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import logging
 import os
-
-import numpy as np
+import re
+import shutil
+import zipfile
 
 from ddt_tpu.config import TrainConfig
 from ddt_tpu.models.tree import TreeEnsemble
+from ddt_tpu.robustness import emit_fault, faultplan
+from ddt_tpu.utils import retry
+from ddt_tpu.utils.atomic import atomic_savez
+
+log = logging.getLogger("ddt_tpu.checkpoint")
 
 CKPT_FILE = "ensemble.npz"
 CURSOR_FILE = "cursor.json"
+HISTORY_PREFIX = "ckpt-"
+_HISTORY_RE = re.compile(re.escape(HISTORY_PREFIX) + r"(\d+)$")
+#: retained `ckpt-<round>` history pairs (beyond the top-level pair)
+KEEP_LAST = 3
+
+#: cursor fields resume can trust only when present (old checkpoints
+#: predate them and stay resumable): ensemble_digest (pair validation).
+CURSOR_SCHEMA = 2
 
 
 def _cfg_fingerprint(cfg: TrainConfig) -> dict:
@@ -32,9 +78,12 @@ def _cfg_fingerprint(cfg: TrainConfig) -> dict:
     # System knobs may legitimately differ across resume (e.g. resume on a
     # different partition count — distribution never changes results), and
     # n_trees may grow (resuming to train further is the point of resuming).
+    # The robustness knobs are system knobs too: a run that crashed UNDER a
+    # fault plan must resume WITHOUT one.
     for k in ("n_trees", "n_partitions", "feature_partitions",
               "host_partitions", "hist_impl", "backend",
-              "matmul_input_dtype"):
+              "matmul_input_dtype", "fault_plan", "straggler_repartition",
+              "straggler_skew_threshold"):
         d.pop(k, None)
     # JSON round-trips tuples as lists; normalize so a saved fingerprint
     # compares equal to a freshly computed one.
@@ -42,23 +91,96 @@ def _cfg_fingerprint(cfg: TrainConfig) -> dict:
     return d
 
 
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _history_dirs(ckpt_dir: str) -> list[tuple[int, str]]:
+    """(round, path) of every ckpt-<round> history dir, newest first."""
+    out = []
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    for name in names:
+        m = _HISTORY_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+    """Hard-link (same-filesystem free) with a copy fallback (EXDEV,
+    filesystems without links). os.replace'ing the source later leaves
+    the linked inode untouched — which is exactly why history retention
+    costs no second serialization."""
+    if os.path.exists(dst):
+        os.remove(dst)
+    try:
+        os.link(src, dst)
+    except OSError:
+        shutil.copy2(src, dst)
+
+
+def _retain_history(ckpt_dir: str, completed_rounds: int,
+                    keep_last: int) -> None:
+    """Link the just-landed top-level pair into ckpt-<round>/ and prune
+    older history past `keep_last`. Best-effort by design: a failure
+    here must never fail the save that already landed."""
+    hist = os.path.join(ckpt_dir, f"{HISTORY_PREFIX}{completed_rounds:06d}")
+    try:
+        os.makedirs(hist, exist_ok=True)
+        _link_or_copy(os.path.join(ckpt_dir, CKPT_FILE),
+                      os.path.join(hist, CKPT_FILE))
+        _link_or_copy(os.path.join(ckpt_dir, CURSOR_FILE),
+                      os.path.join(hist, CURSOR_FILE))
+    except OSError as e:
+        log.warning("checkpoint history retention failed for round %d: %s",
+                    completed_rounds, e)
+        return
+    for _, path in _history_dirs(ckpt_dir)[keep_last:]:
+        shutil.rmtree(path, ignore_errors=True)
+
+
 def save_checkpoint(
-    ckpt_dir: str, ens: TreeEnsemble, cfg: TrainConfig, completed_rounds: int
+    ckpt_dir: str, ens: TreeEnsemble, cfg: TrainConfig,
+    completed_rounds: int, keep_last: int = KEEP_LAST,
 ) -> None:
-    """Atomically persist the ensemble + cursor after `completed_rounds`."""
+    """Atomically persist the ensemble + cursor after `completed_rounds`,
+    then retain the pair in the keep-last-k history."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    tmp = os.path.join(ckpt_dir, CKPT_FILE + ".tmp.npz")
     final = os.path.join(ckpt_dir, CKPT_FILE)
-    np.savez_compressed(tmp, **ens.to_dict())
-    os.replace(tmp, final)
+
+    def _write_ensemble() -> str:
+        faultplan.inject("ckpt.save.write", round=completed_rounds)
+        atomic_savez(final, compressed=True, **ens.to_dict())
+        return _sha256_file(final)
+
+    digest = retry.retry_call(_write_ensemble, seam="ckpt.save")
+    # The pair-atomicity gap under test: a crash HERE leaves ensemble.npz
+    # one save ahead of cursor.json — the digest below is how resume
+    # detects it (tests/test_robustness.py, scripts/chaos_smoke.py).
+    faultplan.inject("ckpt.save.between", round=completed_rounds)
     cur = {
         "completed_rounds": int(completed_rounds),
         "config": _cfg_fingerprint(cfg),
+        "ensemble_digest": digest,
+        "ckpt_schema": CURSOR_SCHEMA,
     }
-    tmp_c = os.path.join(ckpt_dir, CURSOR_FILE + ".tmp")
-    with open(tmp_c, "w") as f:
-        json.dump(cur, f)
-    os.replace(tmp_c, os.path.join(ckpt_dir, CURSOR_FILE))
+
+    def _write_cursor() -> None:
+        tmp_c = os.path.join(ckpt_dir, CURSOR_FILE + ".tmp")
+        with open(tmp_c, "w") as f:
+            json.dump(cur, f)
+        os.replace(tmp_c, os.path.join(ckpt_dir, CURSOR_FILE))
+
+    retry.retry_call(_write_cursor, seam="ckpt.save")
+    _retain_history(ckpt_dir, completed_rounds, keep_last)
 
 
 def maybe_save(
@@ -79,39 +201,120 @@ def maybe_save(
     save_checkpoint(ckpt_dir, ens, cfg, completed_rounds)
 
 
-def try_resume(ckpt_dir: str, ens: TreeEnsemble, cfg: TrainConfig) -> int:
+def _read_pair(d: str) -> "dict | str | None":
+    """Load the (cursor, ensemble) pair in directory `d`.
+
+    Returns the loaded {"rounds", "cur", "saved"} dict when the pair is
+    present AND internally consistent; a string REASON when something is
+    there but torn/corrupt (truncated JSON, unreadable npz, digest
+    mismatch); None when the pair is simply absent."""
+    cursor_path = os.path.join(d, CURSOR_FILE)
+    ckpt_path = os.path.join(d, CKPT_FILE)
+    have_cursor = os.path.exists(cursor_path)
+    have_ckpt = os.path.exists(ckpt_path)
+    if not have_cursor and not have_ckpt:
+        return None
+    if not have_cursor:
+        return f"{CKPT_FILE} present but {CURSOR_FILE} missing"
+    if not have_ckpt:
+        return f"{CURSOR_FILE} present but {CKPT_FILE} missing"
+
+    def _read_cursor():
+        faultplan.inject("ckpt.load")
+        with open(cursor_path) as f:
+            return json.load(f)
+
+    try:
+        cur = retry.retry_call(_read_cursor, seam="ckpt.load")
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+        return f"{CURSOR_FILE} unreadable: {type(e).__name__}: {e}"
+    if not isinstance(cur, dict) or "completed_rounds" not in cur \
+            or "config" not in cur:
+        return f"{CURSOR_FILE} malformed (missing required fields)"
+    digest = cur.get("ensemble_digest")
+    if digest is not None:
+        try:
+            actual = _sha256_file(ckpt_path)
+        except OSError as e:
+            return f"{CKPT_FILE} unreadable: {e}"
+        if actual != digest:
+            return (f"{CKPT_FILE} does not match the cursor's digest "
+                    "(torn checkpoint write)")
+    try:
+        saved = retry.retry_call(TreeEnsemble.load, ckpt_path,
+                                 seam="ckpt.load")
+    except (OSError, ValueError, KeyError, EOFError,
+            zipfile.BadZipFile) as e:
+        return f"{CKPT_FILE} unreadable: {type(e).__name__}: {e}"
+    return {"rounds": int(cur["completed_rounds"]), "cur": cur,
+            "saved": saved}
+
+
+def try_resume(ckpt_dir: str, ens: TreeEnsemble, cfg: TrainConfig,
+               run_log=None) -> int:
     """Load a checkpoint into `ens` (in place). Returns completed rounds
-    (0 = nothing to resume). Raises if the checkpoint's config is
-    incompatible — resuming a different run would corrupt it silently."""
-    cursor_path = os.path.join(ckpt_dir, CURSOR_FILE)
-    ckpt_path = os.path.join(ckpt_dir, CKPT_FILE)
-    if not (os.path.exists(cursor_path) and os.path.exists(ckpt_path)):
-        return 0
-    with open(cursor_path) as f:
-        cur = json.load(f)
-    # Fingerprint fields added over time default to their empty value so
-    # checkpoints written before a field existed stay resumable.
-    cur["config"].setdefault("cat_features", [])
-    if cur["config"] != _cfg_fingerprint(cfg):
-        raise ValueError(
-            f"checkpoint at {ckpt_dir} was written by an incompatible config; "
-            "refusing to resume. Delete the directory to start fresh."
-        )
-    saved = TreeEnsemble.load(ckpt_path)
-    rounds = int(cur["completed_rounds"])
-    if rounds > cfg.n_trees:
-        raise ValueError(
-            f"checkpoint at {ckpt_dir} has {rounds} completed rounds but "
-            f"cfg.n_trees={cfg.n_trees}; raise n_trees to resume (a finished "
-            "checkpoint cannot be shrunk in place)."
-        )
-    C = cfg.n_classes if cfg.loss == "softmax" else 1
-    k = rounds * C
-    ens.feature[:k] = saved.feature[:k]
-    ens.threshold_bin[:k] = saved.threshold_bin[:k]
-    ens.threshold_raw[:k] = saved.threshold_raw[:k]
-    ens.is_leaf[:k] = saved.is_leaf[:k]
-    ens.leaf_value[:k] = saved.leaf_value[:k]
-    ens.split_gain[:k] = saved.split_gain[:k]
-    ens.default_left[:k] = saved.default_left[:k]
-    return rounds
+    (0 = nothing to resume). Raises if a VALID checkpoint's config is
+    incompatible — resuming a different run would corrupt it silently.
+
+    Torn/corrupt artifacts never raise: the top-level pair is validated
+    (cursor parse, ensemble digest, npz load) and on failure resume
+    FALLS BACK to the newest valid `ckpt-<round>` history pair, emitting
+    `fault` events (checkpoint_corrupt per bad candidate,
+    checkpoint_fallback on recovery) into `run_log` (and the process
+    fault sink); with no survivor it returns 0 with a warning — a
+    damaged checkpoint directory costs recomputation, not the run."""
+    def _fault(kind: str, **fields) -> None:
+        if run_log is not None:
+            run_log.emit("fault", kind=kind, **fields)
+        else:
+            emit_fault(kind, **fields)
+
+    candidates = [("latest", ckpt_dir)] + [
+        (f"{HISTORY_PREFIX}{r:06d}", p) for r, p in _history_dirs(ckpt_dir)]
+    saw_corrupt = False
+    for label, d in candidates:
+        res = _read_pair(d)
+        if res is None:
+            continue
+        if isinstance(res, str):
+            log.warning("checkpoint %s (%s): %s — trying older history",
+                        label, d, res)
+            _fault("checkpoint_corrupt", candidate=label, reason=res)
+            saw_corrupt = True
+            continue
+        cur, saved, rounds = res["cur"], res["saved"], res["rounds"]
+        # Fingerprint fields added over time default to their empty value
+        # so checkpoints written before a field existed stay resumable.
+        cur["config"].setdefault("cat_features", [])
+        if cur["config"] != _cfg_fingerprint(cfg):
+            raise ValueError(
+                f"checkpoint at {ckpt_dir} was written by an incompatible "
+                "config; refusing to resume. Delete the directory to start "
+                "fresh."
+            )
+        if rounds > cfg.n_trees:
+            raise ValueError(
+                f"checkpoint at {ckpt_dir} has {rounds} completed rounds "
+                f"but cfg.n_trees={cfg.n_trees}; raise n_trees to resume "
+                "(a finished checkpoint cannot be shrunk in place)."
+            )
+        if saw_corrupt:
+            log.warning("checkpoint fallback: resuming from %s at round %d",
+                        label, rounds)
+            _fault("checkpoint_fallback", candidate=label, round=rounds)
+        C = cfg.n_classes if cfg.loss == "softmax" else 1
+        k = rounds * C
+        ens.feature[:k] = saved.feature[:k]
+        ens.threshold_bin[:k] = saved.threshold_bin[:k]
+        ens.threshold_raw[:k] = saved.threshold_raw[:k]
+        ens.is_leaf[:k] = saved.is_leaf[:k]
+        ens.leaf_value[:k] = saved.leaf_value[:k]
+        ens.split_gain[:k] = saved.split_gain[:k]
+        ens.default_left[:k] = saved.default_left[:k]
+        return rounds
+    if saw_corrupt:
+        log.warning(
+            "no valid checkpoint survives in %s (all candidates torn or "
+            "corrupt); starting fresh", ckpt_dir)
+        _fault("checkpoint_unrecoverable")
+    return 0

@@ -1,0 +1,153 @@
+"""Chaos smoke (`make chaos-smoke`): a small CPU run under a multi-fault
+plan asserting BIT-EXACT recovery (docs/ROBUSTNESS.md).
+
+Three arms, all in one process, all on the CPU platform:
+
+1. **Torn checkpoint write** — a streamed training run dies (injected
+   crash between the checkpoint pair's two os.replace calls, leaving
+   ensemble.npz one save ahead of cursor.json); the restarted run
+   detects the torn pair via the cursor digest, falls back to the last
+   good checkpoint, and finishes.
+2. **Stream-read IOError** — the restarted run ALSO suffers injected
+   chunk-read faults, absorbed by the retry/backoff seam.
+3. **Injected straggler** — a 2-partition in-memory run with a run log
+   gets one lane's observed times inflated; the watchdog must detect it
+   (fault events in the log) while the trained model stays untouched.
+
+The verdict for every arm is the same: the final ensemble is
+bit-identical to an undisturbed run, and the run log tells the whole
+fault story (injected / retry / checkpoint_fallback /
+checkpoint_resume / straggler_detected events). Exit 0 = all hold.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from ddt_tpu import api  # noqa: E402
+from ddt_tpu.config import TrainConfig  # noqa: E402
+from ddt_tpu.robustness import faultplan  # noqa: E402
+from ddt_tpu.streaming import fit_streaming  # noqa: E402
+from ddt_tpu.telemetry.events import RunLog  # noqa: E402
+
+
+def _dataset(rows=4000, features=7, n_bins=29, seed=11):
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, n_bins, size=(rows, features), dtype=np.uint8)
+    y = (Xb[:, 0] + rng.integers(0, 6, size=rows) > 18).astype(np.float32)
+    return Xb, y
+
+
+def _chunk_fn(Xb, y, n_chunks):
+    bounds = np.linspace(0, len(y), n_chunks + 1).astype(np.int64)
+
+    def f(c):
+        return Xb[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
+
+    return f
+
+
+def _assert_same(a, b, label):
+    for field in ("feature", "threshold_bin", "is_leaf", "leaf_value",
+                  "split_gain"):
+        np.testing.assert_array_equal(getattr(a, field), getattr(b, field),
+                                      err_msg=f"{label}: {field} differs")
+
+
+def main() -> int:
+    n_chunks = 4
+    Xb, y = _dataset()
+    cfg = TrainConfig(n_trees=8, max_depth=3, n_bins=29, backend="tpu",
+                      seed=3)
+    chunk_fn = _chunk_fn(Xb, y, n_chunks)
+    out = {"cmd": "chaos_smoke"}
+
+    with tempfile.TemporaryDirectory() as td:
+        # Undisturbed reference run (own checkpoint dir, never faulted).
+        ens_clean = fit_streaming(
+            chunk_fn, n_chunks, cfg, checkpoint_dir=os.path.join(td, "ck0"),
+            checkpoint_every=2)
+
+        # Arm 1: torn checkpoint write at round 4 — training dies with
+        # the simulated crash AFTER ensemble.npz landed but BEFORE
+        # cursor.json, exactly the pair-atomicity gap.
+        ck = os.path.join(td, "ck1")
+        torn = {"faults": [{"site": "ckpt.save.between", "round": 4}]}
+        died = False
+        prev = faultplan.activate(faultplan.load_plan(torn))
+        try:
+            fit_streaming(chunk_fn, n_chunks, cfg, checkpoint_dir=ck,
+                          checkpoint_every=2)
+        except faultplan.InjectedCrash:
+            died = True
+        finally:
+            faultplan.deactivate(prev)
+        assert died, "torn-checkpoint injection never fired"
+        out["torn_ckpt_crashed"] = True
+
+        # Arm 2: restart from the torn directory UNDER stream-read
+        # faults, with a run log. The retry seam absorbs the IOErrors;
+        # resume must fall back past the torn pair and finish.
+        rl = RunLog()          # ring-only: assertions read events directly
+        chaos = {"faults": [
+            {"site": "stream.chunk_read", "chunk": 1, "times": 1},
+            {"site": "stream.chunk_read", "chunk": 2, "times": 1},
+        ]}
+        prev = faultplan.activate(faultplan.load_plan(chaos))
+        try:
+            ens_chaos = fit_streaming(chunk_fn, n_chunks, cfg,
+                                      checkpoint_dir=ck,
+                                      checkpoint_every=2, run_log=rl)
+        finally:
+            faultplan.deactivate(prev)
+        _assert_same(ens_clean, ens_chaos, "torn-ckpt + stream-read")
+        kinds = [e["kind"] for e in rl.events("fault")]
+        for want in ("checkpoint_corrupt", "checkpoint_fallback",
+                     "checkpoint_resume", "injected", "retry"):
+            assert want in kinds, f"missing fault kind {want!r}: {kinds}"
+        out["recovered_bit_exact"] = True
+        out["fault_kinds"] = sorted(set(kinds))
+
+    # Arm 3: injected straggler on a 2-partition in-memory run — the
+    # watchdog must DETECT (events, at the default threshold: the
+    # watchdog's skew excludes the candidate lane from the median, so
+    # 2.0 is reachable even on two lanes), the model must not move.
+    cfg2 = TrainConfig(n_trees=6, max_depth=3, n_bins=29, backend="tpu",
+                       n_partitions=2, seed=3)
+    res_ref = api.train(Xb, y, cfg2, binned=True)
+    rl2 = RunLog()
+    strag = {"faults": [{"site": "straggler", "device": 1,
+                         "delay_ms": 600000.0, "rounds": [1, 6],
+                         "times": 6}]}
+    prev = faultplan.activate(faultplan.load_plan(strag))
+    try:
+        res_strag = api.train(Xb, y, cfg2, binned=True, run_log=rl2)
+    finally:
+        faultplan.deactivate(prev)
+    _assert_same(res_ref.ensemble, res_strag.ensemble, "straggler")
+    kinds2 = [e["kind"] for e in rl2.events("fault")]
+    assert "straggler_detected" in kinds2, kinds2
+    out["straggler_detected"] = True
+
+    out["ok"] = True
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,45 @@
+"""atomic-artifact-write negative fixture: the compliant patterns —
+tmp-then-os.replace, reads, appends, tempfile-derived targets."""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+
+def save_model(path, arrays):
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, path)
+
+
+def write_cursor(path, cur):
+    tmp_c = path + ".tmp"
+    with open(tmp_c, "w") as f:
+        json.dump(cur, f)
+    os.replace(tmp_c, path)
+
+
+def read_cursor(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def read_bytes(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def append_log(path, line):
+    # Append-only run logs are line-granular by design, not artifact
+    # overwrites — the crash story is a torn final line, tolerated at
+    # read time.
+    with open(path, "a") as f:
+        f.write(line)
+
+
+def scratch_dump(arrays):
+    with tempfile.NamedTemporaryFile(suffix=".npz") as tmp_f:
+        np.savez(tmp_f.name, **arrays)
+        return tmp_f.name

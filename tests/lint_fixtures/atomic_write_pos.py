@@ -1,0 +1,24 @@
+"""atomic-artifact-write positive fixture: persistent artifacts written
+directly to their final paths (torn on a mid-write kill)."""
+
+import json
+
+import numpy as np
+
+
+def save_model(path, arrays):
+    np.savez_compressed(path, **arrays)  # LINT: atomic-artifact-write
+
+
+def save_scores(path, scores):
+    np.save(path, scores)  # LINT: atomic-artifact-write
+
+
+def write_cursor(path, cur):
+    with open(path, "w") as f:  # LINT: atomic-artifact-write
+        json.dump(cur, f)
+
+
+def write_manifest(path, text):
+    with open(path, mode="w") as f:  # LINT: atomic-artifact-write
+        f.write(text)

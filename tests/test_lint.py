@@ -66,6 +66,8 @@ CASES = [
      "pallas_vmem_neg.py", "ddt_tpu/ops/fixture_mod.py"),
     ("named-scope", "named_scope_pos.py", "named_scope_neg.py",
      "ddt_tpu/ops/fixture_mod.py"),
+    ("atomic-artifact-write", "atomic_write_pos.py", "atomic_write_neg.py",
+     "ddt_tpu/models/fixture_mod.py"),
     ("raw-phase-timing", "raw_timing_pos.py", "raw_timing_neg.py",
      "ddt_tpu/ops/fixture_mod.py"),
 ]

@@ -131,9 +131,25 @@ def load_artifact(path: str) -> dict:
         m = re.search(r"r(\d+)", os.path.basename(path))
         order = int(m.group(1)) if m else 0
     schema = rec.get("bench_schema")
+    # Chaos-run exclusion (docs/ROBUSTNESS.md): bench.py stamps
+    # injected_faults when a fault-injection plan was active, and an
+    # attached run log's injected `fault` events count too — numbers
+    # measured under injected faults are recovery tests, not
+    # performance history, and banding against them would widen (or
+    # poison) every band.
+    injected = bool(rec.get("injected_faults")) or (
+        isinstance(raw, dict) and bool(raw.get("injected_faults")))
+    if not injected:
+        run_events = rec.get("run_log_events") or (
+            raw.get("run_log_events") if isinstance(raw, dict) else None)
+        if isinstance(run_events, list):
+            injected = any(
+                isinstance(e, dict) and e.get("event") == "fault"
+                and e.get("kind") == "injected" for e in run_events)
     return {"path": path, "kind": kind, "order": int(order),
             "metrics": metrics, "facts": facts,
             "schema": int(schema) if isinstance(schema, int) else 1,
+            "injected_faults": injected,
             "run_id": rec.get("run_id"), "git_rev": rec.get("git_rev")}
 
 
@@ -222,10 +238,27 @@ def run(paths: list[str], current_path: str | None = None,
                 "(no bench metrics, no multichip facts) — schema drift "
                 "or a torn write; nothing was checked")
             return report
-    bench = sorted((a for a in arts if a["kind"] == "bench"),
+    # Injected-fault artifacts (chaos runs) never enter bench history,
+    # and a chaos artifact under test is excluded rather than banded —
+    # its numbers measure recovery, not performance.
+    excluded = [a["path"] for a in arts
+                if a["kind"] == "bench" and a.get("injected_faults")]
+    if excluded:
+        report["excluded_injected"] = excluded
+    bench = sorted((a for a in arts if a["kind"] == "bench"
+                    and not a.get("injected_faults")),
                    key=lambda a: a["order"])
     if cur_art is not None and cur_art["kind"] == "bench":
-        history, current = bench, cur_art
+        if cur_art.get("injected_faults"):
+            report["excluded_injected"] = (
+                report.get("excluded_injected", []) + [cur_art["path"]])
+            report["bench"] = {
+                "skipped_injected": "current artifact carries "
+                                    "injected-fault events; not banded"}
+            current = None
+            history = bench
+        else:
+            history, current = bench, cur_art
     elif bench:
         history, current = bench[:-1], bench[-1]
     else:

@@ -49,7 +49,13 @@ def main(argv: list[str] | None = None) -> int:
     else:
         if report.get("error"):
             print(f"benchwatch: ERROR {report['error']}")
+        for p in report.get("excluded_injected", []):
+            print(f"benchwatch: excluded {p} (injected-fault chaos run; "
+                  "not performance history)")
         b = report.get("bench")
+        if b and b.get("skipped_injected"):
+            print(f"benchwatch: {b['skipped_injected']}")
+            b = None
         if b:
             print(f"benchwatch: {b['current_path']} vs {b['n_history']} "
                   f"history artifact(s): {len(b['checked'])} in band, "

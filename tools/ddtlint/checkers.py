@@ -658,7 +658,82 @@ class NamedScopeChecker(Checker):
 
 
 # --------------------------------------------------------------------- #
-# 9. raw-phase-timing
+# 9. atomic-artifact-write
+# --------------------------------------------------------------------- #
+class AtomicArtifactWriteChecker(Checker):
+    """Persistent artifacts (checkpoints, model files, chunk caches)
+    must be written tmp-then-`os.replace` — a direct
+    `np.savez(final, ...)` / `open(final, "w")` killed mid-write leaves
+    a TORN artifact at the canonical name, which a later resume/load
+    then chokes on (the checkpoint-hardening bug class,
+    docs/ROBUSTNESS.md). Scoped to the artifact-owning modules
+    (utils/checkpoint.py, api.py, models/, data/chunks.py); a write is
+    compliant when its path expression is tmp-like — a name/attribute/
+    literal containing "tmp", or anything tempfile-derived — because
+    the tmp-name-then-replace dance is exactly the pattern the rule
+    exists to enforce. Read modes and append modes are exempt (appends
+    are logs, not artifact overwrites; the run log's crash story is
+    line-granularity by design)."""
+
+    rule = "atomic-artifact-write"
+    path_scope = (r"^ddt_tpu/utils/checkpoint\.py$", r"^ddt_tpu/api\.py$",
+                  r"^ddt_tpu/models/", r"^ddt_tpu/data/chunks\.py$")
+    _WRITERS = {"np.save", "np.savez", "np.savez_compressed",
+                "numpy.save", "numpy.savez", "numpy.savez_compressed"}
+
+    def visit_Call(self, node: ast.Call):
+        d = callgraph.dotted(node.func)
+        if d in self._WRITERS and node.args \
+                and not self._tmp_like(node.args[0]):
+            self.report(node, (
+                f"`{d}(...)` writes a persistent artifact directly to its "
+                "final path — a kill mid-write leaves a torn file there; "
+                "write to a tmp-suffixed sibling and `os.replace` it "
+                "(docs/ROBUSTNESS.md atomic-artifact-write)"))
+        elif d == "open" and node.args:
+            mode = self._mode(node)
+            if mode is not None and ("w" in mode or "x" in mode) \
+                    and not self._tmp_like(node.args[0]):
+                self.report(node, (
+                    f"`open(..., {mode!r})` truncates a persistent "
+                    "artifact in place — a kill mid-write leaves a torn "
+                    "file at the final path; write a tmp-suffixed sibling "
+                    "and `os.replace` it (docs/ROBUSTNESS.md "
+                    "atomic-artifact-write)"))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _mode(node: ast.Call) -> str | None:
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            return node.args[1].value
+        for k in node.keywords:
+            if k.arg == "mode" and isinstance(k.value, ast.Constant) \
+                    and isinstance(k.value.value, str):
+                return k.value.value
+        return None
+
+    @staticmethod
+    def _tmp_like(e: ast.AST) -> bool:
+        for n in ast.walk(e):
+            if isinstance(n, ast.Name) and "tmp" in n.id.lower():
+                return True
+            if isinstance(n, ast.Attribute) and "tmp" in n.attr.lower():
+                return True
+            if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                    and "tmp" in n.value.lower():
+                return True
+            if isinstance(n, ast.Call):
+                d = callgraph.dotted(n.func)
+                if d is not None and (
+                        d.startswith("tempfile.")
+                        or "temp" in d.split(".")[-1].lower()):
+                    return True
+        return False
+
+
+# --------------------------------------------------------------------- #
+# 10. raw-phase-timing
 # --------------------------------------------------------------------- #
 class RawPhaseTimingChecker(Checker):
     """Raw host clocks (`time.time()` / `time.perf_counter()` /
@@ -706,6 +781,7 @@ AST_CHECKERS = [
     PallasInterpretChecker,
     PallasVmemGuardChecker,
     NamedScopeChecker,
+    AtomicArtifactWriteChecker,
     RawPhaseTimingChecker,
 ]
 
