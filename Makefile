@@ -55,6 +55,14 @@ kernel-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py
 
+# Serving-tier smoke (docs/SERVING.md): tiny model behind the HTTP
+# front end on CPU — 100 concurrent requests with a mid-flight hot
+# swap (zero failures, old-or-new responses only), admission
+# coalescing witnessed, serve_latency SLO event lands in the run log
+# and renders through `cli report`.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py
+
 # Bench regression sentinel (docs/OBSERVABILITY.md): band every metric
 # of the newest BENCH_r*/MULTICHIP_r* artifact against the history
 # (median ± max(3*MAD, 20%)); exit 1 on an adverse excursion. Point a
@@ -66,4 +74,4 @@ native:
 	$(MAKE) -C ddt_tpu/native
 
 .PHONY: lint lint-baseline tsan-audit test report trace-smoke \
-	profile-smoke kernel-smoke chaos-smoke benchwatch native
+	profile-smoke kernel-smoke chaos-smoke serve-smoke benchwatch native

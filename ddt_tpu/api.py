@@ -227,6 +227,38 @@ def _row_mesh_backend(n_partitions: int) -> DeviceBackend:
     return be
 
 
+def validate_mapper_model(mapper: BinMapper, ens: TreeEnsemble) -> None:
+    """The mapper-vs-model scoring contract, ONE home (api.predict per
+    call, ServableModel once per model version): the NaN policy must
+    match and the model's categorical columns must have been
+    identity-binned by this mapper — both failures silently corrupt
+    every affected row otherwise. The categorical edge scan is memoized
+    on the mapper (BinMapper.non_identity_columns), so repeat calls are
+    O(1) — the "binning prologue rebuilt per call even on cache hit"
+    fix (ISSUE 8 satellite)."""
+    if mapper.missing_bin != ens.missing_bin:
+        # A policy mismatch silently misroutes every NaN row (the
+        # reserved bin vs bin 0); same guard as train-time.
+        raise ValueError(
+            f"mapper.missing_bin={mapper.missing_bin} but the "
+            f"ensemble was trained with missing_bin="
+            f"{ens.missing_bin}; use the training-time mapper "
+            "(api.load_model returns it)"
+        )
+    if ens.has_cat_splits:
+        # Same loud-failure contract as missing_bin: the model's
+        # categorical columns must have been identity-binned by
+        # this mapper or every "bin == k" comparison is garbage.
+        not_identity = mapper.non_identity_columns(ens.cat_features)
+        if not_identity:
+            raise ValueError(
+                f"the ensemble splits features {not_identity} "
+                "categorically but this BinMapper did not "
+                "identity-bin them; use the training-time mapper "
+                "(api.load_model returns it)"
+            )
+
+
 def predict(
     ens: "TreeEnsemble | ModelBundle",
     X: np.ndarray,
@@ -263,27 +295,7 @@ def predict(
     X = np.asarray(X)
     if not binned:
         if mapper is not None:
-            if mapper.missing_bin != ens.missing_bin:
-                # A policy mismatch silently misroutes every NaN row (the
-                # reserved bin vs bin 0); same guard as train-time.
-                raise ValueError(
-                    f"mapper.missing_bin={mapper.missing_bin} but the "
-                    f"ensemble was trained with missing_bin="
-                    f"{ens.missing_bin}; use the training-time mapper "
-                    "(api.load_model returns it)"
-                )
-            if ens.has_cat_splits:
-                # Same loud-failure contract as missing_bin: the model's
-                # categorical columns must have been identity-binned by
-                # this mapper or every "bin == k" comparison is garbage.
-                not_identity = mapper.non_identity_columns(ens.cat_features)
-                if not_identity:
-                    raise ValueError(
-                        f"the ensemble splits features {not_identity} "
-                        "categorically but this BinMapper did not "
-                        "identity-bin them; use the training-time mapper "
-                        "(api.load_model returns it)"
-                    )
+            validate_mapper_model(mapper, ens)
             X = mapper.transform(X)
             binned = True
         elif not ens.has_raw_thresholds:
@@ -301,10 +313,15 @@ def predict(
         out = backend.predict_raw(ens, X)
         if raw:
             return out
-        from ddt_tpu.ops.predict import predict_proba
-        import jax.numpy as jnp
+        # Probability transform on HOST numpy (formula-identical to
+        # TreeEnsemble.predict): the old device predict_proba round-trip
+        # re-uploaded the fetched [R]-sized scores and dispatched a
+        # sigmoid per call — pure prologue cost on every served request,
+        # visible as a ddt:predict:upload share drop in `report` now
+        # that it is gone (ISSUE 8 satellite).
+        from ddt_tpu.utils.metrics import predict_proba_np
 
-        return np.asarray(predict_proba(jnp.asarray(out), ens.loss))
+        return predict_proba_np(out, ens.loss)
     return ens.predict_raw(X, binned=binned) if raw else ens.predict(
         X, binned=binned
     )

@@ -160,9 +160,14 @@ class DeviceBackend(abc.ABC):
     # ------------------------------------------------------------------ #
 
     @abc.abstractmethod
-    def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
+    def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray,
+                    compiled=None) -> np.ndarray:
         """Batch ensemble scoring on binned data (TreeEnsemble.predict path,
-        [BASELINE]): raw margins [R] or [R, C], on host."""
+        [BASELINE]): raw margins [R] or [R, C], on host. `compiled` is an
+        optional models/tree.CompiledEnsemble already built for THIS ens
+        (the serving tier holds one per model version); backends that
+        keep device-resident scoring caches use it to skip the per-call
+        content hash, others may ignore it."""
 
     # ------------------------------------------------------------------ #
 

@@ -174,7 +174,11 @@ class CPUDevice(DeviceBackend):
 
     # ------------------------------------------------------------------ #
 
-    def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
+    def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray,
+                    compiled=None) -> np.ndarray:
+        # `compiled` is accepted for interface parity (the serving tier
+        # passes it unconditionally); the CPU traversal reads the
+        # ensemble heap directly, so there is nothing to seed.
         if self._native_traverse is None:
             return ens.predict_raw(Xb, binned=True)
         # C++ batch traversal (the CPU twin of the device gather+compare

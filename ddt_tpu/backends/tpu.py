@@ -1247,10 +1247,15 @@ class TPUDevice(DeviceBackend):
     # a handful of live model versions.
     PREDICT_CACHE_MAX = 4
 
-    def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray) -> np.ndarray:
+    def predict_raw(self, ens: TreeEnsemble, Xb: np.ndarray,
+                    compiled=None) -> np.ndarray:
+        """Score binned rows. `compiled` (a models/tree.CompiledEnsemble
+        already built for THIS ens) skips the per-call content hash —
+        the serving tier holds one per model version, so a micro-batch
+        request pays upload + dispatch only (docs/SERVING.md)."""
         R = Xb.shape[0]
         chunk = self.PREDICT_ROW_CHUNK * max(1, self.row_shards)
-        fn, ens_dev = self._predict_fn(ens)     # compiled-ensemble cache
+        fn, ens_dev = self._predict_fn(ens, compiled=compiled)
         if isinstance(Xb, jax.Array) and (R <= chunk or self.distributed):
             # Device-resident input is only special-cased on the
             # single-chip big-batch loop below (where it skips the bulk
@@ -1307,11 +1312,54 @@ class TPUDevice(DeviceBackend):
     @property
     def _use_pallas(self) -> "bool | None":
         """cfg.predict_impl as predict_raw_effective's use_pallas value
-        (None = auto-dispatch; ops/predict.resolve_use_pallas)."""
+        (None = auto-dispatch; ops/predict.resolve_use_pallas). "lut"
+        resolves here to the f32 auto value — it is the FALLBACK the
+        quantized dispatch in _predict_fn degrades to when the LUT
+        kernel's VMEM budget refuses the shape."""
         return {"auto": None, "pallas": True,
-                "onehot": False}[self.cfg.predict_impl]
+                "onehot": False, "lut": None}[self.cfg.predict_impl]
 
-    def _predict_fn(self, ens: TreeEnsemble):
+    def _lut_fn(self, ce, n_features: int):
+        """(jitted LUT scoring fn, device operand tuple) for one model
+        version, or None when the shape exceeds the kernel's budget
+        (predict_lut_fits — the pallas-vmem-guard contract; the caller
+        falls back to the f32 path). Tables quantize on host once per
+        model version; the error bound rides on the tables
+        (docs/SERVING.md "Quantized serving")."""
+        from ddt_tpu.ops import predict_lut
+
+        # ce.quantize() memoizes: when the serving tier already
+        # quantized this model version at publish (for its error-bound
+        # reporting), this is a dict hit, not a second O(model) pass.
+        tables = ce.quantize()
+        if not predict_lut.predict_lut_fits(
+                tables.n_trees_padded, tables.tree_chunk,
+                tables.max_depth, n_features, tables.n_classes_out):
+            return None
+        host_ops = predict_lut.lut_device_operands(tables)
+        with phase_span("predict:upload"):
+            dev_ops = tuple(self._put(a, self._sharding())
+                            for a in host_ops)
+        static = dict(
+            max_depth=tables.max_depth,
+            learning_rate=tables.learning_rate,
+            base=tables.base_score, n_classes=tables.n_classes_out,
+            tree_chunk=tables.tree_chunk,
+            n_trees_padded=tables.n_trees_padded,
+            missing_bin_value=tables.missing_bin_value,
+            use_missing=tables.eff_dl is not None,
+            use_cat=tables.eff_cat is not None,
+            use_scale=tables.leaf_scale is not None,
+        )
+
+        def lut0(*args):
+            *ops, Xc = args
+            return predict_lut.predict_effective_lut_ops(
+                tuple(ops), Xc, **static)
+
+        return jax.jit(lut0), dev_ops
+
+    def _predict_fn(self, ens: TreeEnsemble, compiled=None):
         """(jittable scoring fn, device-resident compiled-ensemble arrays).
 
         The pushed-down/padded scoring layout (models/tree.
@@ -1320,38 +1368,59 @@ class TPUDevice(DeviceBackend):
         in-place trainer mutation can never serve stale trees, and a hit
         skips pushdown AND re-upload entirely (the resident-vs-total
         bench gap showed ~27% of predict wall time there). Hits feed the
-        run log's `compiled_ensemble_cache_hits` counter."""
-        token = ens.cache_token()
+        run log's `compiled_ensemble_cache_hits` counter.
+
+        `compiled` (a CompiledEnsemble snapshot the caller already
+        built) keys the cache on its `token` directly — no per-call
+        full-array hash — and seeds a miss without rebuilding the
+        layout. The serving tier's request path rides this.
+
+        With cfg.predict_impl="lut" the cached entry is the int8
+        quantized path (ops/predict_lut.py): tables quantize + upload
+        once per model version; shapes past the LUT kernel's VMEM
+        budget fall back to the f32 path (predict_lut_fits)."""
+        token = compiled.token if compiled is not None \
+            else ens.cache_token()
         hit = self._predict_cache.pop(token, None)
         if hit is not None:
             self._predict_cache[token] = hit     # most-recently-used
             tele_counters.record_compiled_ensemble_hit()
             return hit
-        ce = ens.compile(tree_chunk=64)
-        with phase_span("predict:upload"):
-            ens_dev = tuple(self._put(a, self._sharding())
-                            for a in ce.arrays())
-        use_missing = ce.eff_dl is not None
-        use_cat = ce.eff_cat is not None
-        use_pallas = self._use_pallas
+        ce = compiled if compiled is not None else ens.compile(
+            tree_chunk=64)
+        lut = (self._lut_fn(ce, ens.n_features)
+               if self.cfg.predict_impl == "lut" else None)
+        if lut is not None:
+            fn0, ens_dev = lut
+        else:
+            if self.cfg.predict_impl == "lut":
+                log.warning(
+                    "predict_impl='lut': shape exceeds the LUT kernel's "
+                    "VMEM budget; falling back to the f32 path")
+            with phase_span("predict:upload"):
+                ens_dev = tuple(self._put(a, self._sharding())
+                                for a in ce.arrays())
+            use_missing = ce.eff_dl is not None
+            use_cat = ce.eff_cat is not None
+            use_pallas = self._use_pallas
 
-        def fn0(ef, et, bv, coh, *rest):
-            *opt, Xc = rest
-            opt = list(opt)
-            dl = opt.pop(0) if use_missing else None
-            cn = opt.pop(0) if use_cat else None
-            return predict_ops.predict_raw_effective(
-                ef, et, bv, coh, Xc,
-                max_depth=ce.max_depth,
-                learning_rate=ce.learning_rate,
-                base=ce.base_score,
-                n_classes=ce.n_classes_out,
-                tree_chunk=ce.tree_chunk,
-                eff_dl=dl,
-                missing_bin_value=ce.missing_bin_value,
-                eff_cat=cn,
-                use_pallas=use_pallas,
-            )
+            def fn0(ef, et, bv, coh, *rest):
+                *opt, Xc = rest
+                opt = list(opt)
+                dl = opt.pop(0) if use_missing else None
+                cn = opt.pop(0) if use_cat else None
+                return predict_ops.predict_raw_effective(
+                    ef, et, bv, coh, Xc,
+                    max_depth=ce.max_depth,
+                    learning_rate=ce.learning_rate,
+                    base=ce.base_score,
+                    n_classes=ce.n_classes_out,
+                    tree_chunk=ce.tree_chunk,
+                    eff_dl=dl,
+                    missing_bin_value=ce.missing_bin_value,
+                    eff_cat=cn,
+                    use_pallas=use_pallas,
+                )
 
         fn = fn0
         n_rep = len(ens_dev)

@@ -565,6 +565,13 @@ def main(argv: list[str] | None = None) -> int:
     pp = sub.add_parser("predict", help="score a batch with a saved ensemble")
     _add_common(pp)
     pp.add_argument("--model", required=True)
+    pp.add_argument("--quantized", action="store_true",
+                    help="score through the int8 TreeLUT fast path "
+                         "(cfg.predict_impl='lut': int8 thresholds + "
+                         "fp16 leaf tables, ~4x less HBM traffic per "
+                         "request; leaf values within the tables' "
+                         "documented max-abs-error bound of f32 — "
+                         "docs/SERVING.md)")
     pp.add_argument("--partitions", type=int, default=1,
                     help="row-shard scoring over this many chips "
                          "(parallel.mesh row mesh; trees replicate, each "
@@ -578,10 +585,40 @@ def main(argv: list[str] | None = None) -> int:
                          "config 4 at beyond-RAM scale); overrides "
                          "--dataset/--data")
 
+    sv = sub.add_parser(
+        "serve",
+        help="persistent low-latency scoring server (docs/SERVING.md): "
+             "device-resident compiled model, admission-batched request "
+             "coalescing, zero-downtime hot swap, serve_latency SLO "
+             "telemetry")
+    sv.add_argument("--model", required=True,
+                    help="model artifact to serve (api.save_model .npz); "
+                         "hot-swap later via POST /swap")
+    sv.add_argument("--backend", choices=BACKENDS, default="tpu")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8199,
+                    help="HTTP port (0 = ephemeral; printed on stdout)")
+    sv.add_argument("--max-wait-ms", type=float, default=1.0,
+                    help="admission window: how long a request may wait "
+                         "for company before its micro-batch dispatches "
+                         "(the latency/throughput knob)")
+    sv.add_argument("--max-batch", type=_positive_int, default=256,
+                    help="largest micro-batch (rows); batches pad to a "
+                         "fixed power-of-two bucket ladder up to this, "
+                         "so load never retraces")
+    sv.add_argument("--quantized", action="store_true",
+                    help="serve through the int8 TreeLUT fast path "
+                         "(ops/predict_lut.py)")
+    sv.add_argument("--raw", action="store_true",
+                    help="return raw margins instead of probabilities")
+    sv.add_argument("--run-log", default=None,
+                    help="JSONL run log for serve_latency SLO events "
+                         "(render with `report` — docs/OBSERVABILITY.md)")
+
     bp = sub.add_parser("bench", help="kernel/e2e benchmarks (JSON lines)")
     _add_common(bp)
     bp.add_argument("--kernel", default="histogram",
-                    choices=["histogram", "train", "predict"])
+                    choices=["histogram", "train", "predict", "serve"])
     bp.add_argument("--features", type=int, default=28)
     bp.add_argument("--trees", type=int, default=100)
     bp.add_argument("--depth", type=int, default=6)
@@ -789,7 +826,8 @@ def main(argv: list[str] | None = None) -> int:
                                    n_features=ens.n_features)
         cfg = TrainConfig(backend=args.backend, loss=ens.loss,
                           n_classes=max(ens.n_classes, 2),
-                          n_partitions=max(1, args.partitions))
+                          n_partitions=max(1, args.partitions),
+                          predict_impl="lut" if args.quantized else "auto")
         t0 = time.perf_counter()
         if bundle.mapper is not None:
             # Training-time binning, loaded from the artifact — NEVER refit
@@ -811,6 +849,29 @@ def main(argv: list[str] | None = None) -> int:
             "trees": ens.n_trees, "wallclock_s": round(dt, 3),
             "rows_per_sec": round(len(X) / dt, 1),
         }))
+        return 0
+
+    if args.cmd == "serve":
+        from ddt_tpu.serve.engine import ServeEngine
+        from ddt_tpu.serve.http import serve_forever
+
+        bundle = api.load_model(args.model)
+        cfg = TrainConfig(
+            backend=args.backend, loss=bundle.ensemble.loss,
+            n_classes=max(bundle.ensemble.n_classes, 2),
+            predict_impl="lut" if args.quantized else "auto")
+        engine = ServeEngine(
+            bundle, cfg, max_wait_ms=args.max_wait_ms,
+            max_batch=args.max_batch, quantize=args.quantized,
+            raw=args.raw, run_log=args.run_log)
+        print(json.dumps({
+            "cmd": "serve", "model": args.model,
+            "model_token": engine.model_token,
+            "quantized": args.quantized, "host": args.host,
+            "port": args.port, "max_wait_ms": args.max_wait_ms,
+            "max_batch": args.max_batch,
+        }), flush=True)
+        serve_forever(engine, host=args.host, port=args.port)
         return 0
 
     if args.cmd == "report":

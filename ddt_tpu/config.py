@@ -86,7 +86,13 @@ class TrainConfig:
     # real TPU backs the computation and the shape fits its VMEM budget,
     # falling back to the one-hot compare+reduce path; "pallas"/"onehot"
     # force one side (pallas off-TPU runs the interpreter — tests only).
-    predict_impl: str = "auto"  # auto | pallas | onehot
+    # "lut" is the TreeLUT-style int8 quantized traversal
+    # (ops/predict_lut.py — the low-latency serving opt-in, `--quantized`
+    # on the CLI): int8 thresholds + fp16 leaf tables, ~4x less HBM
+    # traffic per request, leaf values within the tables' documented
+    # max-abs-error bound of f32; auto-falls back to the f32 path when
+    # the shape exceeds the kernel's VMEM budget (predict_lut_fits).
+    predict_impl: str = "auto"  # auto | pallas | onehot | lut
     seed: int = 0
     # Cap on boosting rounds per fused device dispatch (Driver._fit_fused).
     # One block already amortizes dispatch latency to nothing, so bigger
@@ -155,9 +161,9 @@ class TrainConfig:
                 f"hist_subtraction must be auto|on|off, got "
                 f"{self.hist_subtraction!r}"
             )
-        if self.predict_impl not in ("auto", "pallas", "onehot"):
+        if self.predict_impl not in ("auto", "pallas", "onehot", "lut"):
             raise ValueError(
-                f"predict_impl must be auto|pallas|onehot, got "
+                f"predict_impl must be auto|pallas|onehot|lut, got "
                 f"{self.predict_impl!r}"
             )
         if self.missing_policy not in ("zero", "learn"):

@@ -58,9 +58,20 @@ class BinMapper:
         permuted by transform). Checks the edges themselves rather than the
         recorded `cat_features` metadata, so mappers saved before that field
         existed — or hand-built ones — are judged by the invariant that
-        actually matters."""
-        bad = sorted(int(f) for f in features
-                     if not 0 <= int(f) < self.n_features)
+        actually matters.
+
+        Memoized per feature tuple: api.predict runs this check on EVERY
+        call (scoring correctness must not depend on call history), but
+        the edge scan is O(cat_features x bins) against edges that never
+        mutate after fit — paying it once per (mapper, feature-set) keeps
+        the serving request path's prologue flat (ISSUE 8 satellite).
+        Mutating `edges` in place after fit voids the memo (and every
+        other consistency property of a fitted mapper)."""
+        key = tuple(sorted(int(f) for f in features))
+        cache = self.__dict__.setdefault("_non_identity_memo", {})
+        if key in cache:
+            return list(cache[key])
+        bad = sorted(f for f in key if not 0 <= f < self.n_features)
         if bad:
             raise ValueError(
                 f"cat_features indices {bad} out of range for "
@@ -68,10 +79,12 @@ class BinMapper:
             )
         nv = self.n_value_bins
         want = np.arange(nv - 1, dtype=np.float32)
-        return sorted(
-            int(f) for f in features
-            if not np.array_equal(self.edges[int(f), : nv - 1], want)
+        out = sorted(
+            f for f in key
+            if not np.array_equal(self.edges[f, : nv - 1], want)
         )
+        cache[key] = tuple(out)
+        return out
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         """Bin a float matrix [rows, n_features] -> uint8 [rows, n_features]."""

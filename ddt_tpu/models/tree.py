@@ -500,6 +500,31 @@ class CompiledEnsemble:
             out.append(self.eff_cat)
         return tuple(out)
 
+    def quantize(self, leaf_dtype: str = "float16"):
+        """TreeLUT-style int8/fp16 scoring tables (ops/predict_lut.
+        QuantizedTables): int8 recentred thresholds (EXACT — bin ids are
+        integers in [0, 255]), fp16 or int8+per-tree-scale leaf tables,
+        and a computed `max_abs_err` bound on |lut - f32| (the rounding
+        contract documented in ops/predict_lut.py). The low-latency
+        serving opt-in (cfg.predict_impl="lut" / `cli predict
+        --quantized` / ServeEngine(quantize=True)). Lazy import keeps
+        this module jax-free for hosts that never score quantized.
+
+        Memoized per leaf_dtype (this instance is immutable — frozen
+        snapshot of one model version): the serving tier quantizes at
+        publish for its error-bound reporting and the backend quantizes
+        again on first LUT dispatch — one O(model) host pass, shared."""
+        memo = self.__dict__.get("_quant_memo")
+        if memo is None:
+            memo = {}
+            object.__setattr__(self, "_quant_memo", memo)
+        if leaf_dtype not in memo:
+            from ddt_tpu.ops.predict_lut import quantize_compiled
+
+            memo[leaf_dtype] = quantize_compiled(
+                self, leaf_dtype=leaf_dtype)
+        return memo[leaf_dtype]
+
     @staticmethod
     def build(ens: TreeEnsemble, tree_chunk: int = 64
               ) -> "CompiledEnsemble":

@@ -1,0 +1,23 @@
+"""Low-latency serving tier (docs/SERVING.md).
+
+`ServeEngine` holds device-resident compiled models behind an
+admission-batching request queue (`MicroBatcher`): concurrent small
+requests coalesce into micro-batches that ride a fixed set of
+pre-traced bucket shapes, models hot-swap atomically keyed on the
+content-digest cache token, and per-request latency lands in the run
+log as the schema-v4 `serve_latency` event. The int8 TreeLUT fast path
+(ops/predict_lut.py) is the `quantize=True` opt-in. The HTTP front end
+(`ddt_tpu.serve.http`, `cli serve`) is a thin stdlib adapter over the
+same engine the tests and bench drive in-process.
+"""
+
+from ddt_tpu.serve.batcher import (MicroBatcher, PendingRequest,
+                                   ShuttingDown)
+from ddt_tpu.serve.engine import (ServableModel, ServeEngine, ServeStats,
+                                  bucket_for, default_buckets, proba_np)
+
+__all__ = [
+    "MicroBatcher", "PendingRequest", "ShuttingDown",
+    "ServableModel", "ServeEngine", "ServeStats",
+    "bucket_for", "default_buckets", "proba_np",
+]

@@ -1,0 +1,181 @@
+"""Admission batching: coalesce concurrent small requests into micro-batches.
+
+The serving tier's queueing half (docs/SERVING.md). Single-row requests
+each paying a full dispatch would serialise the device behind per-call
+latency; instead, submitters enqueue and a single dispatcher thread
+admits work in micro-batches:
+
+- a batch CLOSES when either (a) `max_wait_ms` has elapsed since its
+  OLDEST admitted request (the latency budget a request can pay waiting
+  for company — default ~1 ms), or (b) the batch reaches `max_batch`
+  rows (the largest pre-traced bucket);
+- the dispatcher never sleeps: it parks on a Condition and wakes on
+  submit, so an idle server burns nothing and a lone request under no
+  load waits only the max-wait admission window;
+- requests are never split across batches and never reordered within
+  one — each remembers its row span, so the dispatcher's response
+  scatter is positional and a request's rows can neither drop nor
+  duplicate (tests/test_serve.py drives this with concurrent
+  submitters).
+
+HOT-LOOP MODULE (the ddtlint serve-blocking-io rule): no `time.sleep`,
+no synchronous file I/O anywhere in here — a blocked dispatcher thread
+stalls EVERY in-flight request's latency, not just its own.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+
+class ShuttingDown(RuntimeError):
+    """Raised to waiters whose request cannot be served because the
+    batcher is closing."""
+
+
+class PendingRequest:
+    """One submitted request: rows in, scores (or an exception) out.
+
+    `result()` blocks the SUBMITTER only; the dispatcher thread signals
+    the event after the scatter. Latency accounting: `t_submit` is
+    stamped at enqueue, the engine stamps completion — the span covers
+    queue wait + admission window + dispatch, which is what a caller
+    experiences. `model_token` is stamped by the dispatcher with the
+    content digest of the model that actually scored this request —
+    reading the engine's current token around submit/result instead is
+    a race against hot swap (a swap landing in between attributes the
+    response to the wrong version; scripts/serve_smoke.py catches it)."""
+
+    __slots__ = ("rows", "n", "t_submit", "model_token", "_event",
+                 "_result", "_error")
+
+    def __init__(self, rows, n: int):
+        self.rows = rows
+        self.n = n
+        self.t_submit = time.perf_counter()
+        self.model_token = None
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def set_result(self, scores) -> None:
+        self._result = scores
+        self._event.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request timed out")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class MicroBatcher:
+    """The admission queue + dispatcher thread.
+
+    `dispatch(batch: list[PendingRequest], queue_depth: int)` is called
+    on the dispatcher thread with the admitted batch (total rows <=
+    max_batch unless a single over-sized request exceeds it alone —
+    those dispatch solo) and the queue depth observed at close time
+    (the engine's backlog telemetry). The dispatch callable OWNS
+    result/error delivery for every request it receives; if it raises,
+    the batcher fails the batch's requests with the exception so no
+    submitter hangs."""
+
+    def __init__(self, dispatch, max_wait_ms: float = 1.0,
+                 max_batch: int = 256):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_wait_ms < 0:
+            raise ValueError(
+                f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        self._dispatch = dispatch
+        self.max_wait_s = max_wait_ms / 1e3
+        self.max_batch = int(max_batch)
+        self._q: collections.deque[PendingRequest] = collections.deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name="ddt-serve-batcher", daemon=True)
+        self._thread.start()
+
+    def submit(self, rows, n: int) -> PendingRequest:
+        """Enqueue one request (`rows` is the request's row block, `n`
+        its row count). Returns immediately; wait on the PendingRequest."""
+        req = PendingRequest(rows, n)
+        with self._cv:
+            if self._closed:
+                raise ShuttingDown("serve batcher is shut down")
+            self._q.append(req)
+            self._cv.notify_all()
+        return req
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop admitting, drain what is queued, join the dispatcher."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout)
+
+    # ------------------------------------------------------------------ #
+    # dispatcher thread
+    # ------------------------------------------------------------------ #
+
+    def _admit_locked(self) -> "tuple[list[PendingRequest], int]":
+        """Pop the next micro-batch (called with the lock held, queue
+        non-empty). Requests are admitted FIFO until the row budget is
+        hit; an over-budget FIRST request dispatches alone (large
+        requests degrade to solo batches rather than erroring)."""
+        batch: list[PendingRequest] = []
+        rows = 0
+        while self._q:
+            nxt = self._q[0]
+            if batch and rows + nxt.n > self.max_batch:
+                break
+            batch.append(self._q.popleft())
+            rows += nxt.n
+            if rows >= self.max_batch:
+                break
+        return batch, len(self._q)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if not self._q:
+                    return                       # closed and drained
+                # Admission window: wait for company until the OLDEST
+                # queued request's budget expires or the row budget
+                # fills. cv.wait(timeout) parks the thread — no
+                # sleep-polling (the serve-blocking-io contract).
+                deadline = self._q[0].t_submit + self.max_wait_s
+                while (not self._closed
+                       and sum(r.n for r in self._q) < self.max_batch):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                    if not self._q:              # spurious wake post-drain
+                        break
+                if not self._q:
+                    continue
+                batch, depth = self._admit_locked()
+            try:
+                self._dispatch(batch, depth)
+            # The dispatcher thread must survive any scoring failure:
+            # deliver it to the batch's waiters and keep serving — dying
+            # here would hang every future submitter.
+            except Exception as e:  # ddtlint: disable=broad-except
+                for req in batch:
+                    if not req.done():
+                        req.set_error(e)

@@ -1,0 +1,171 @@
+"""Stdlib HTTP front end for the ServeEngine (`cli serve`).
+
+Deliberately dependency-free (http.server + json): the serving tier's
+value is the engine (admission batching, device-resident models,
+hot-swap, SLO telemetry) — the transport is a thin adapter any real
+deployment would replace (gRPC, a sidecar, an in-process embedding).
+Threading model: ThreadingHTTPServer gives one thread per connection;
+each handler thread is a SUBMITTER into the engine's admission queue,
+so concurrent HTTP requests coalesce into micro-batches exactly like
+library callers (scripts/serve_smoke.py drives 100 of them).
+
+Endpoints (all JSON):
+
+- POST /predict   {"rows": [[...], ...], "binned": false}
+                  -> {"scores": [...], "model": token}
+- POST /swap      {"model": "/path/to/model.npz"}
+                  -> {"old": token, "new": token}   (zero-downtime)
+- GET  /healthz   -> engine.health() (+ all-time latency snapshot)
+- GET  /stats     -> current-window latency summary; "?emit=1" also
+                  emits it as a run-log `serve_latency` event and
+                  resets the window
+- POST /shutdown  -> drains and stops the server
+
+File I/O note: model loading (api.load_model) happens HERE, on the
+swap/boot path — never in the engine or batcher hot-loop modules (the
+ddtlint serve-blocking-io rule).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ddt_tpu.serve.batcher import ShuttingDown
+
+log = logging.getLogger("ddt_tpu.serve.http")
+
+
+def _make_handler(engine, server_box: dict):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):   # route through logging
+            log.debug("%s " + fmt, self.address_string(), *args)
+
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n) if n else b"{}"
+            return json.loads(raw or b"{}")
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                return self._send(200, engine.health())
+            if self.path.startswith("/stats"):
+                emit = "emit=1" in self.path
+                if emit:
+                    out = engine.emit_latency(reset=True) or {
+                        "requests": 0}
+                else:
+                    out = engine.stats.window_summary(reset=False)
+                return self._send(200, out)
+            return self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            try:
+                if self.path == "/predict":
+                    req = self._body()
+                    rows = np.asarray(req["rows"])
+                    if req.get("binned"):
+                        # astype(uint8) would silently WRAP out-of-range
+                        # ids (300 -> 44) and truncate floats — fail the
+                        # contract violation loudly like every other
+                        # malformed input in this handler.
+                        if rows.dtype.kind not in "iu" or (
+                                rows.size and (int(rows.min()) < 0
+                                               or int(rows.max()) > 255)):
+                            raise ValueError(
+                                "binned rows must be integer bin ids "
+                                "in 0..255")
+                        rows = rows.astype(np.uint8)
+                    # The dispatcher stamps the token of the model that
+                    # ACTUALLY scored the batch — reading engine.
+                    # model_token here instead races the hot swap and
+                    # mis-attributes responses that straddle it.
+                    pending = engine.predict_async(rows)
+                    scores = pending.result(30.0)
+                    return self._send(200, {
+                        "scores": np.asarray(scores).tolist(),
+                        "model": pending.model_token})
+                if self.path == "/swap":
+                    from ddt_tpu import api
+
+                    req = self._body()
+                    bundle = api.load_model(req["model"])
+                    return self._send(200, engine.swap(bundle))
+                if self.path == "/shutdown":
+                    self._send(200, {"ok": True})
+                    threading.Thread(
+                        target=server_box["server"].shutdown,
+                        daemon=True).start()
+                    return None
+                return self._send(404, {"error": f"no route {self.path}"})
+            # The handler IS the error boundary: every failure must
+            # become a JSON response on the open connection, never an
+            # unwound handler (= connection reset with no body). Order
+            # matters: TimeoutError is an OSError subclass.
+            except TimeoutError as e:
+                return self._send(504, {"error": f"{type(e).__name__}: "
+                                                 f"{e}"})
+            except ShuttingDown as e:
+                return self._send(503, {"error": f"{type(e).__name__}: "
+                                                 f"{e}"})
+            except (KeyError, ValueError, TypeError, OSError) as e:
+                return self._send(400, {"error": f"{type(e).__name__}: "
+                                                 f"{e}"})
+            # Dispatch-delivered failures (a scoring error re-raised by
+            # result()) can be anything; surfaced as 500, re-raising
+            # would just tear the connection down bodyless.
+            except Exception as e:  # ddtlint: disable=broad-except
+                return self._send(500, {"error": f"{type(e).__name__}: "
+                                                 f"{e}"})
+
+    return Handler
+
+
+def serve_forever(engine, host: str = "127.0.0.1", port: int = 8199,
+                  ready_event: "threading.Event | None" = None) -> int:
+    """Run the HTTP front end until /shutdown (or KeyboardInterrupt);
+    returns the BOUND port (pass port=0 for an ephemeral one — the
+    smoke test does). `ready_event` is set once the socket listens."""
+    box: dict = {}
+
+    class _Server(ThreadingHTTPServer):
+        # The default socketserver backlog (5) resets connections under
+        # exactly the burst concurrency admission batching exists for —
+        # a 100-way storm must QUEUE at the socket, not fail
+        # (scripts/serve_smoke.py drives this).
+        request_queue_size = 128
+        daemon_threads = True
+
+    server = _Server((host, port), _make_handler(engine, box))
+    box["server"] = server
+    bound = server.server_address[1]
+    # Published BEFORE ready_event fires so a launcher thread can learn
+    # an ephemeral (port=0) binding without racing serve_forever's
+    # blocking loop (scripts/serve_smoke.py).
+    engine.http_port = bound
+    log.info("serving on %s:%d (model %s)", host, bound,
+             engine.model_token[:12])
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        server.serve_forever(poll_interval=0.05)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        engine.close()
+    return bound

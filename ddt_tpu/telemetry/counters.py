@@ -70,6 +70,15 @@ _c = {
     # line are the signal the infrastructure is limping.
     "fault_retries": 0,
     "hist_oom_degrades": 0,
+    # Serving tier (ddt_tpu/serve/, schema v4): requests completed,
+    # micro-batches dispatched, and zero-downtime hot swaps. The ratio
+    # requests/batches is the process-lifetime mean coalesce width — a
+    # serving process whose ratio sits at ~1.0 under load has lost
+    # admission batching (per-window quantiles live in the
+    # serve_latency events, not here: quantiles are not monotonic).
+    "serve_requests": 0,
+    "serve_batches": 0,
+    "serve_hot_swaps": 0,
 }
 _listener_installed = False
 # When truthy, the compile listener drops events: the cost observatory's
@@ -138,6 +147,18 @@ def record_fault_retry() -> None:
 
 def record_hist_oom_degrade() -> None:
     _c["hist_oom_degrades"] += 1
+
+
+def record_serve_requests(n: int) -> None:
+    _c["serve_requests"] += int(n)
+
+
+def record_serve_batch() -> None:
+    _c["serve_batches"] += 1
+
+
+def record_serve_hot_swap() -> None:
+    _c["serve_hot_swaps"] += 1
 
 
 def snapshot() -> dict:

@@ -27,10 +27,13 @@ import time
 # (XLA compiled-executable cost/memory analysis per jit entry point —
 # telemetry/costmodel.py) and the manifest's optional `xprof_dir` /
 # `xprof_rounds` extras (telemetry/profiler.py capture windows).
-# v1/v2 logs remain readable (no required field of an existing event
-# ever changed — the back-compat contract tests/test_observatory.py
-# pins).
-SCHEMA_VERSION = 3
+# v4 (the low-latency serving tier): adds the `serve_latency` event
+# (per-window request latency quantiles + admission-batching counters
+# from ServeEngine — ddt_tpu/serve/engine.py) and the `hot_swap` fault
+# kind. v1-v3 logs remain readable (no required field of an existing
+# event ever changed — the back-compat contract tests/test_observatory.
+# py and tests/test_serve.py pin).
+SCHEMA_VERSION = 4
 
 #: event type -> REQUIRED payload fields (extras are allowed and common:
 #: e.g. `round` records carry `valid_<metric>` keys named by the run's
@@ -77,6 +80,13 @@ EVENT_FIELDS: dict[str, set] = {
     # platform, arg/output/temp HBM bytes from memory_analysis(),
     # signature. Emitted in the run epilogue, one per (op, signature).
     "cost_analysis": {"op", "flops", "bytes_accessed"},
+    # Serving-tier SLO window (schema v4, ddt_tpu/serve/engine.py): one
+    # per emitted latency window — per-request latency quantiles
+    # (p50/p99; extras p999_ms, max_ms), admission-batching shape
+    # (batches, coalesce_mean/max, queue_depth_max), window_s, and the
+    # served model's content-digest token. Consumed by `report`'s
+    # serving section and banded (via the bench stamps) by benchwatch.
+    "serve_latency": {"requests", "p50_ms", "p99_ms"},
     # Last record of a completed run.
     "run_end": {"completed_rounds", "wallclock_s"},
 }

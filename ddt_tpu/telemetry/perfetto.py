@@ -41,6 +41,7 @@ import json
 #: the export as instants so a trace viewer can read the cost model next
 #: to the lanes).
 _INSTANT_EVENTS = ("early_stop", "fault", "run_end", "phase_timings",
+                   "serve_latency",
                    "counters", "partition_skew", "cost_analysis")
 
 

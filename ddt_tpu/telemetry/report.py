@@ -189,6 +189,11 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
             {k: v for k, v in e.items()
              if k not in ("event", "schema", "t", "seq")}
             for e in cost_ev],
+        # Serving tier (schema v4): SLO windows from ServeEngine.
+        # emit_latency — None on pre-v4 / non-serving logs so older
+        # summaries render exactly as before.
+        "serving": _serving_summary(
+            [e for e in events if e["event"] == "serve_latency"]),
     }
     # Roofline join (telemetry/costmodel.py): only when the log carries
     # cost_analysis events — pre-v3 logs render exactly as before.
@@ -201,6 +206,34 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
             counters=summary["counters"],
             wallclock_s=summary["wallclock_s"])
     return summary
+
+
+def _serving_summary(serve_ev: list[dict]) -> dict | None:
+    """Reduce a run's serve_latency windows for the report: totals
+    across windows, the LAST window's quantiles (current behavior), and
+    the WORST p99/p999 seen in any window (tail attribution wants the
+    worst window, not the most recent one)."""
+    if not serve_ev:
+        return None
+    last = serve_ev[-1]
+    return {
+        "windows": len(serve_ev),
+        "requests": sum(e["requests"] for e in serve_ev),
+        "batches": sum(e.get("batches", 0) for e in serve_ev),
+        "p50_ms": last["p50_ms"],
+        "p99_ms": last["p99_ms"],
+        "p999_ms": last.get("p999_ms"),
+        "worst_p99_ms": max(e["p99_ms"] for e in serve_ev),
+        "worst_p999_ms": max((e.get("p999_ms") or 0.0)
+                             for e in serve_ev) or None,
+        "coalesce_mean": last.get("coalesce_mean"),
+        "coalesce_max": max((e.get("coalesce_max") or 0)
+                            for e in serve_ev),
+        "queue_depth_max": max((e.get("queue_depth_max") or 0)
+                               for e in serve_ev),
+        "model_tokens": sorted({e["model_token"][:12] for e in serve_ev
+                                if e.get("model_token")}),
+    }
 
 
 def _fmt_bytes(n) -> str:
@@ -283,6 +316,24 @@ def render(summary: dict) -> str:
                 f"  {p['phase']:<14} max {p['ms_max']:>9.1f} ms "
                 f"@{where:<8} median "
                 f"{p['ms_median']:>9.1f} ms  skew {skew}")
+
+    if summary.get("serving"):
+        s = summary["serving"]
+        out.append(
+            f"serving: {s['requests']} requests in {s['windows']} "
+            f"window(s), {s['batches']} micro-batches  "
+            f"(coalesce max {s['coalesce_max']}, "
+            f"queue depth max {s['queue_depth_max']})")
+        p999 = (f"  p999={s['p999_ms']:.3f} ms"
+                if s.get("p999_ms") is not None else "")
+        worst = (f"  worst-window p99={s['worst_p99_ms']:.3f} ms"
+                 if s.get("worst_p99_ms") is not None else "")
+        out.append(
+            f"  latency: p50={s['p50_ms']:.3f} ms  "
+            f"p99={s['p99_ms']:.3f} ms{p999}{worst}")
+        if s.get("model_tokens"):
+            out.append("  models served: "
+                       + ", ".join(s["model_tokens"]))
 
     curve = summary["metric_curve"]
     if curve:

@@ -16,13 +16,33 @@ import numpy as np
 
 
 def _sigmoid(z: np.ndarray) -> np.ndarray:
-    return 1.0 / (1.0 + np.exp(-z))
+    # exp(-z) overflows to inf for strongly negative margins; the result
+    # (1/inf = 0.0) is exactly right, so suppress the warning rather
+    # than switch to a "stable" two-branch form whose ULP differences
+    # would break the formula-identity contract with TreeEnsemble.
+    # predict (models/tree.py inlines this same expression).
+    with np.errstate(over="ignore"):
+        return 1.0 / (1.0 + np.exp(-z))
 
 
 def _softmax(z: np.ndarray) -> np.ndarray:
     z = z - z.max(axis=1, keepdims=True)
     e = np.exp(z)
     return e / e.sum(axis=1, keepdims=True)
+
+
+def predict_proba_np(raw: np.ndarray, loss: str) -> np.ndarray:
+    """Raw margins -> probabilities on HOST numpy, formula-identical to
+    TreeEnsemble.predict — the ONE home api.predict and the serving
+    tier share. Exists so scoring paths never round-trip an [R]-sized
+    score vector back to the device just for a sigmoid (the per-call
+    predict prologue fix, ISSUE 8)."""
+    raw = np.asarray(raw)
+    if loss == "logloss":
+        return _sigmoid(raw)
+    if loss == "softmax":
+        return _softmax(raw)
+    return raw
 
 
 def auc(y_true: np.ndarray, score: np.ndarray) -> float:

@@ -1,0 +1,175 @@
+"""Serve smoke (`make serve-smoke`): the serving tier end to end on CPU
+(docs/SERVING.md).
+
+One process, five assertions:
+
+1. a tiny model trains, saves, and comes up behind the HTTP front end
+   (ephemeral port) with every bucket shape pre-traced;
+2. 100 CONCURRENT single-row HTTP requests (plus a few multi-row ones)
+   all succeed and BIT-match the offline `api.predict` answer for
+   whichever model version each was served by;
+3. a hot swap to a second model fires MID-FLIGHT: zero failed requests,
+   and every response is attributable to exactly the old or the new
+   model (the response carries the serving token) — never a mix;
+4. the admission batcher actually coalesced (width > 1 across the
+   storm — the deterministic >= 8 witness lives in tests/test_serve.py
+   behind a barrier; under real HTTP concurrency width depends on the
+   box, so the smoke asserts coalescing happened, not a number);
+5. the `serve_latency` SLO event lands in the run log and renders
+   through `cli report`'s serving section.
+
+Exit 0 = all hold.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from ddt_tpu import api  # noqa: E402
+from ddt_tpu.config import TrainConfig  # noqa: E402
+from ddt_tpu.data import datasets  # noqa: E402
+from ddt_tpu.serve.engine import ServeEngine  # noqa: E402
+from ddt_tpu.serve.http import serve_forever  # noqa: E402
+from ddt_tpu.telemetry import report as tele_report  # noqa: E402
+
+
+def _post(port: int, path: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _get(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:
+    X, y = datasets.synthetic_binary(4000, seed=3)
+    kw = dict(n_trees=6, max_depth=3, n_bins=31, backend="tpu",
+              log_every=10**9)
+    res_a = api.train(X, y, **kw)
+    # A genuinely different model version (seed alone changes nothing
+    # without bagging): halving the learning rate moves every leaf.
+    res_b = api.train(X, y, learning_rate=0.05, **kw)
+    cfg = TrainConfig(backend="tpu", n_bins=31)
+    want = {}   # serving token -> offline reference scores
+    out = {"cmd": "serve_smoke"}
+
+    with tempfile.TemporaryDirectory() as td:
+        model_b = os.path.join(td, "model_b.npz")
+        res_b.save(model_b)
+        run_log = os.path.join(td, "serve.jsonl")
+
+        bundle_a = api.ModelBundle(ensemble=res_a.ensemble,
+                                   mapper=res_a.mapper)
+        engine = ServeEngine(bundle_a, cfg, max_wait_ms=2.0,
+                             max_batch=64, run_log=run_log)
+        for res in (res_a, res_b):
+            tok = res.ensemble.compile().token
+            want[tok] = np.asarray(api.predict(
+                res.ensemble, X, mapper=res.mapper, cfg=cfg))
+
+        ready = threading.Event()
+        th = threading.Thread(
+            target=serve_forever, args=(engine,),
+            kwargs=dict(port=0, ready_event=ready), daemon=True)
+        th.start()
+        assert ready.wait(60), "server never came up"
+        port = engine.http_port      # published before ready fires
+
+        health = _get(port, "/healthz")
+        assert health["ok"] and health["model_token"] == \
+            res_a.ensemble.compile().token
+        out["buckets"] = health["buckets"]
+
+        # --- the storm: 100 concurrent single-row requests, a hot swap
+        # injected from a parallel thread mid-flight, plus batch rows.
+        n = 100
+        errs = []
+        served = [None] * n
+        barrier = threading.Barrier(n + 1)
+
+        def worker(i):
+            barrier.wait()
+            try:
+                r = _post(port, "/predict",
+                          {"rows": [X[i].tolist()]})
+                served[i] = (r["model"], r["scores"][0])
+            except Exception as e:       # noqa: BLE001 — smoke verdict
+                errs.append((i, repr(e)))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+
+        def swapper():
+            barrier.wait()
+            _post(port, "/swap", {"model": model_b})
+
+        sw = threading.Thread(target=swapper)
+        sw.start()
+        for t in threads:
+            t.join(60)
+        sw.join(60)
+        assert not errs, f"failed requests during hot swap: {errs[:5]}"
+
+        # Every response matches the offline answer of the model that
+        # served it — old or new, never a mix.
+        seen_tokens = set()
+        for i, (tok, score) in enumerate(served):
+            assert tok in want, f"response {i} served by unknown {tok}"
+            seen_tokens.add(tok)
+            np.testing.assert_allclose(score, want[tok][i], rtol=1e-5,
+                                       atol=1e-6)
+        out["hot_swap_zero_failures"] = True
+        out["tokens_seen"] = len(seen_tokens)
+
+        # Post-swap requests must score with model B.
+        r = _post(port, "/predict", {"rows": X[:5].tolist()})
+        tok_b = res_b.ensemble.compile().token
+        assert r["model"] == tok_b
+        np.testing.assert_allclose(r["scores"], want[tok_b][:5],
+                                   rtol=1e-5, atol=1e-6)
+
+        stats = _get(port, "/stats?emit=1")
+        assert stats["requests"] > 0
+        out["coalesce_max"] = stats["coalesce_max"]
+        assert stats["coalesce_max"] > 1, (
+            "no coalescing under a 100-way concurrent storm: "
+            f"{stats}")
+        _post(port, "/shutdown", {})
+        th.join(30)
+
+        # --- the run log: serve_latency landed, report renders it.
+        events = tele_report.read_events(run_log)
+        sl = [e for e in events if e["event"] == "serve_latency"]
+        assert sl, "no serve_latency event in the run log"
+        summary = tele_report.summarize(events)
+        assert summary["serving"]["requests"] >= n
+        rendered = tele_report.render(summary)
+        assert "serving:" in rendered and "latency:" in rendered
+        out["serve_latency_events"] = len(sl)
+        out["p99_ms"] = sl[-1]["p99_ms"]
+
+    out["ok"] = True
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

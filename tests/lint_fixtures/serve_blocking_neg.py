@@ -1,0 +1,32 @@
+"""serve-blocking-io negative fixture: the compliant hot-loop idioms —
+Condition/Event parking with timeouts, in-memory numpy work, and model
+objects handed in ready (no file I/O)."""
+import collections
+import threading
+import time
+
+import numpy as np
+
+
+class Batcher:
+    def __init__(self, dispatch):
+        self._q = collections.deque()
+        self._cv = threading.Condition()
+        self._dispatch = dispatch
+
+    def loop(self, max_wait_s):
+        with self._cv:
+            while not self._q:
+                self._cv.wait()                 # park, don't poll
+            deadline = time.perf_counter() + max_wait_s
+            remaining = deadline - time.perf_counter()
+            if remaining > 0:
+                self._cv.wait(remaining)        # admission window
+            batch = list(self._q)
+            self._q.clear()
+        rows = np.concatenate([b.rows for b in batch])
+        self._dispatch(rows)
+
+
+def wait_result(event: threading.Event, timeout):
+    return event.wait(timeout)

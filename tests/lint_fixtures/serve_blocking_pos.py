@@ -1,0 +1,21 @@
+"""serve-blocking-io positive fixture: blocking host I/O on the serving
+tier's shared dispatcher thread (scanned as ddt_tpu/serve/engine.py)."""
+import json
+import time
+
+import numpy as np
+
+
+def dispatcher_loop(queue, path):
+    while queue:
+        time.sleep(0.001)                      # LINT: serve-blocking-io
+        batch = queue.pop()
+        with open(path) as f:                  # LINT: serve-blocking-io
+            cfg = json.load(f)                 # LINT: serve-blocking-io
+        tables = np.load(path + ".npz")        # LINT: serve-blocking-io
+        batch.score(cfg, tables)
+
+
+def reload_model(model_path):
+    blob = model_path.read_bytes()             # LINT: serve-blocking-io
+    return blob

@@ -70,6 +70,8 @@ CASES = [
      "ddt_tpu/models/fixture_mod.py"),
     ("raw-phase-timing", "raw_timing_pos.py", "raw_timing_neg.py",
      "ddt_tpu/ops/fixture_mod.py"),
+    ("serve-blocking-io", "serve_blocking_pos.py", "serve_blocking_neg.py",
+     "ddt_tpu/serve/engine.py"),
 ]
 
 
@@ -91,6 +93,19 @@ def test_checker_silent_on_clean_code(rule, _pos, neg, path):
     got = _flagged_lines(neg, path, rule)
     assert got == set(), f"{rule}: false positives at lines {sorted(got)} " \
                          f"in {neg}"
+
+
+def test_serve_blocking_io_exempts_transport_and_other_layers():
+    """The rule is scoped to the serving HOT-LOOP modules only: the
+    same blocking source must not be flagged in the HTTP transport
+    layer (its blocking is the caller's thread), the cli, or non-serve
+    library code (which other rules govern)."""
+    src = _fixture_src("serve_blocking_pos.py")
+    for path in ("ddt_tpu/serve/http.py", "ddt_tpu/cli.py",
+                 "ddt_tpu/streaming.py", "scripts/serve_smoke.py"):
+        findings = runner.run_on_source(path, src,
+                                        rules={"serve-blocking-io"})
+        assert findings == [], (path, [f.render() for f in findings])
 
 
 def test_no_print_exempts_cli_and_non_library_paths():

@@ -1,0 +1,218 @@
+"""int8 TreeLUT quantized traversal (ops/predict_lut.py): the rounding
+contract, pinned.
+
+Three properties, across n_classes {1, 3} x missing-value routing x
+categorical one-vs-rest splits (the full feature matrix of the scoring
+path):
+
+1. ERROR CONTRACT: |lut - f32| <= QuantizedTables.max_abs_err for both
+   leaf dtypes (fp16 and int8+scale) — the bound is COMPUTED per model
+   at quantize time, so this asserts the documented contract, not a
+   tolerance pulled from the air.
+2. PARITY: jitted LUT == jitted f32 one-hot path fed the DEQUANTIZED
+   tables, BITWISE — descent is exact (int8 thresholds lose nothing on
+   integer bins) and the kernel mirrors the one-hot accumulation
+   term-for-term, so the only difference between LUT and f32 is the
+   single leaf-rounding step. (Both sides run under jit: the production
+   dispatch always does, and XLA's fusion choices — e.g. an FMA in the
+   base + lr*acc epilogue — differ between eager and jitted programs.)
+3. DISPATCH: cfg.predict_impl="lut" routes the backend's predict cache
+   through the quantized tables (within the bound of the f32 backend),
+   and shapes past the kernel's VMEM budget refuse/fall back per the
+   pallas-vmem-guard contract.
+
+All kernels run in Pallas interpret mode on the CPU suite (the same
+fallback pattern as tests/test_predict_pallas.py); shapes stay tiny.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.models.tree import empty_ensemble
+from ddt_tpu.ops import predict as predict_ops
+from ddt_tpu.ops import predict_lut
+
+
+def _rand_ens(seed=0, trees=12, depth=3, features=7, bins=31,
+              loss="logloss", n_classes=2, missing=False, cat=()):
+    rng = np.random.default_rng(seed)
+    n_nodes = 2 ** (depth + 1) - 1
+    ens = empty_ensemble(
+        trees, depth, features, 0.1, 0.25, loss, n_classes=n_classes,
+        missing_bin=missing, n_bins=bins, cat_features=tuple(cat))
+    ens.feature[:] = rng.integers(0, features, size=(trees, n_nodes))
+    # Missing models reserve the top bin; thresholds stay in value bins.
+    ens.threshold_bin[:] = rng.integers(
+        0, bins - (2 if missing else 1), size=(trees, n_nodes))
+    ens.is_leaf[:] = rng.random((trees, n_nodes)) < 0.25
+    ens.leaf_value[:] = rng.standard_normal(
+        (trees, n_nodes)).astype(np.float32)
+    if missing:
+        ens.default_left[:] = rng.random((trees, n_nodes)) < 0.5
+    return ens
+
+
+def _rows(ens, rows=50, bins=31, missing=False, seed=1):
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, bins - (1 if missing else 0),
+                      size=(rows, ens.n_features)).astype(np.uint8)
+    if missing:
+        # A healthy share of rows carry the reserved NaN bin.
+        mask = rng.random(Xb.shape) < 0.2
+        Xb[mask] = bins - 1
+    return Xb
+
+
+VARIANTS = [
+    pytest.param(dict(), id="binary"),
+    pytest.param(dict(loss="softmax", n_classes=3, trees=12),
+                 id="softmax3"),
+    pytest.param(dict(missing=True), id="missing"),
+    pytest.param(dict(cat=(1, 4)), id="categorical"),
+    pytest.param(dict(loss="softmax", n_classes=3, cat=(0, 2),
+                      trees=9), id="softmax3-categorical"),
+]
+
+
+def _f32_reference(ce, Xb, use_dequantized=None):
+    """Jitted one-hot scores, on the original or dequantized tables."""
+    if use_dequantized is None:
+        arrays = [jnp.asarray(a) for a in ce.arrays()]
+        eff_feat, eff_thr, bot_val, cls_oh, *rest = arrays
+    else:
+        thr_d, val_d = use_dequantized.dequantized()
+        eff_feat = jnp.asarray(use_dequantized.eff_feat)
+        eff_thr = jnp.asarray(thr_d)
+        bot_val = jnp.asarray(val_d)
+        cls_oh = jnp.asarray(use_dequantized.cls_oh)
+        rest = []
+        if use_dequantized.eff_dl is not None:
+            rest.append(jnp.asarray(use_dequantized.eff_dl))
+        if use_dequantized.eff_cat is not None:
+            rest.append(jnp.asarray(use_dequantized.eff_cat))
+    kw = {}
+    opt = list(rest)
+    if ce.eff_dl is not None:
+        kw["eff_dl"] = opt.pop(0)
+    if ce.eff_cat is not None:
+        kw["eff_cat"] = opt.pop(0)
+    return np.asarray(predict_ops.predict_raw_effective(
+        eff_feat, eff_thr, bot_val, cls_oh, jnp.asarray(Xb),
+        max_depth=ce.max_depth, learning_rate=ce.learning_rate,
+        base=ce.base_score, n_classes=ce.n_classes_out,
+        tree_chunk=ce.tree_chunk,
+        missing_bin_value=ce.missing_bin_value, use_pallas=False, **kw))
+
+
+def _lut_scores(tables, Xb):
+    fn = jax.jit(lambda X: predict_lut.predict_effective_lut(tables, X))
+    return np.asarray(fn(jnp.asarray(Xb)))
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+@pytest.mark.parametrize("leaf_dtype", ["float16", "int8"])
+def test_error_contract_within_computed_bound(variant, leaf_dtype):
+    """Property 1: the documented max-abs-error bound holds for every
+    variant and both leaf dtypes (plus f32-accumulation slack)."""
+    missing = variant.get("missing", False)
+    ens = _rand_ens(**variant)
+    Xb = _rows(ens, bins=31, missing=missing)
+    ce = ens.compile(tree_chunk=8)
+    tables = ce.quantize(leaf_dtype=leaf_dtype)
+    got = _lut_scores(tables, Xb)
+    want = _f32_reference(ce, Xb)
+    err = float(np.abs(got - want).max())
+    assert err <= tables.max_abs_err * (1 + 1e-5) + 1e-6, \
+        (err, tables.max_abs_err)
+    # The bound is meaningful, not vacuous: int8 leaves genuinely
+    # round, so SOME error exists at these random leaf values.
+    if leaf_dtype == "int8":
+        assert tables.max_abs_err > 0
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+def test_lut_bitexact_to_dequantized_reference(variant):
+    """Property 2: the LUT kernel is bit-exact to the f32 one-hot path
+    fed the dequantized tables — descent identical, accumulation
+    mirrored (both jitted; see module doc)."""
+    missing = variant.get("missing", False)
+    ens = _rand_ens(**variant)
+    Xb = _rows(ens, bins=31, missing=missing)
+    ce = ens.compile(tree_chunk=8)
+    tables = ce.quantize()
+    got = _lut_scores(tables, Xb)
+    ref = _f32_reference(ce, Xb, use_dequantized=tables)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_threshold_quantization_is_exact():
+    """Contract 1 in ops/predict_lut.py: int8 recentring loses nothing
+    on integer bins — with exactly-representable leaf values the whole
+    LUT output equals f32 BITWISE (leaf CHOICE must be identical, and
+    values in 1/256 steps are exact in fp16)."""
+    ens = _rand_ens(seed=7)
+    rng = np.random.default_rng(7)
+    ens.leaf_value[:] = (rng.integers(-256, 257, ens.leaf_value.shape)
+                         / 256.0).astype(np.float32)
+    Xb = _rows(ens)
+    ce = ens.compile(tree_chunk=8)
+    tables = ce.quantize()
+    assert tables.max_abs_err == 0.0
+    np.testing.assert_array_equal(_lut_scores(tables, Xb),
+                                  _f32_reference(ce, Xb))
+
+
+def test_quantize_rejects_unknown_leaf_dtype():
+    ens = _rand_ens()
+    with pytest.raises(ValueError, match="leaf_dtype"):
+        ens.compile().quantize(leaf_dtype="int4")
+
+
+def test_fits_guard_refuses_monster_shapes():
+    """predict_lut_fits is the vmem-guard: a shape whose trace/VMEM
+    budget explodes must return False, and a forced COMPILED dispatch
+    at it must raise at the cause (interpret mode has no VMEM to
+    protect and stays callable for tests)."""
+    assert predict_lut.predict_lut_fits(64, 64, 3, 7, 1)
+    assert not predict_lut.predict_lut_fits(131072, 64, 10, 4096, 1)
+    ens = _rand_ens()
+    tables = ens.compile(tree_chunk=8).quantize()
+    with pytest.raises(ValueError, match="VMEM"):
+        predict_lut.predict_effective_lut(
+            tables, _rows(ens), tile_r=10**6, interpret=False)
+
+
+def test_backend_lut_dispatch_and_cache():
+    """Property 3: a predict_impl='lut' backend scores through the
+    quantized tables (within the bound of the f32 backend's answer),
+    hits its compiled cache on repeat calls, and predict_raw(compiled=)
+    accepts a prebuilt CompiledEnsemble (the serving request path)."""
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.telemetry import counters as tele_counters
+
+    ens = _rand_ens(trees=8)
+    Xb = _rows(ens, rows=33)
+    be_f32 = get_backend(TrainConfig(backend="tpu", n_bins=31))
+    be_lut = get_backend(TrainConfig(backend="tpu", n_bins=31,
+                                     predict_impl="lut"))
+    want = be_f32.predict_raw(ens, Xb)
+    got = be_lut.predict_raw(ens, Xb)
+    bound = ens.compile().quantize().max_abs_err
+    assert float(np.abs(got - want).max()) <= bound * (1 + 1e-5) + 1e-6
+
+    c0 = tele_counters.snapshot()
+    ce = ens.compile(tree_chunk=64)
+    got2 = be_lut.predict_raw(ens, Xb, compiled=ce)
+    np.testing.assert_array_equal(got, got2)
+    assert tele_counters.delta(c0)["compiled_ensemble_cache_hits"] >= 1
+
+
+def test_lut_empty_batch():
+    ens = _rand_ens()
+    tables = ens.compile(tree_chunk=8).quantize()
+    out = predict_lut.predict_effective_lut(
+        tables, np.zeros((0, ens.n_features), np.uint8))
+    assert np.asarray(out).shape == (0,)

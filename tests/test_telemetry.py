@@ -69,6 +69,13 @@ def test_runlog_emits_and_round_trips_every_event_type(tmp_path):
             phases=[{"phase": "grow", "ms_max": 2.0, "ms_median": 1.75,
                      "skew": 1.143, "max_device": 1}],
             n_partitions=2),
+        # Schema v4 (low-latency serving tier): one SLO window from
+        # ServeEngine.emit_latency.
+        "serve_latency": dict(requests=100, p50_ms=0.8, p99_ms=2.5,
+                              p999_ms=4.0, max_ms=4.2, batches=13,
+                              coalesce_mean=7.7, coalesce_max=16,
+                              queue_depth_max=3, window_s=1.0,
+                              model_token="cafe" * 10),
         "run_end": dict(completed_rounds=2, wallclock_s=0.1),
     }
     assert set(payloads) == set(EVENT_FIELDS)   # exhaustive by contract

@@ -82,6 +82,29 @@ METRICS: dict[str, str] = {
     "predict_roofline_hbm_util": "higher",
     "split_agreement": "higher",
     "auc_delta": "lower",
+    # Serving tier (ISSUE 8): LATENCY IS LOWER-IS-BETTER — the first
+    # metrics in this table whose regression direction is a rise in
+    # milliseconds, stamped from bench_serve_latency's headline QPS
+    # point. serve_cold_over_p99 (the acceptance ratio) and the
+    # coalesce width band higher: losing either means the admission
+    # batcher degenerated even if absolute latency drift hides it.
+    # serve_cold_predict_ms is context only (NOT banded): it measures
+    # first-call compile cost, which jax version bumps legitimately
+    # move in either direction.
+    "serve_p50_ms": "lower",
+    "serve_p99_ms": "lower",
+    "serve_p999_ms": "lower",
+    "serve_cold_over_p99": "higher",
+    "serve_coalesce_mean": "higher",
+    "serve_coalesce_max": "higher",
+    # Quantized LUT arm (chip artifacts): throughput and the paired
+    # ratio band higher; the witnessed max-abs-error bands LOWER — a
+    # quantizer change that widens real error past its documented bound
+    # already asserts in-bench, but a creeping (still-in-bound) rise is
+    # exactly what a band catches.
+    "predict_lut_mrows_per_sec": "higher",
+    "predict_lut_ab_ratio": "higher",
+    "predict_lut_max_abs_err": "lower",
 }
 
 #: metric -> minimum bench_schema whose artifacts are comparable. When a

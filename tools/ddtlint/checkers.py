@@ -771,6 +771,51 @@ class RawPhaseTimingChecker(Checker):
         self.generic_visit(node)
 
 
+# --------------------------------------------------------------------- #
+# 11. serve-blocking-io
+# --------------------------------------------------------------------- #
+class ServeBlockingIOChecker(Checker):
+    """Blocking host I/O in the serving tier's HOT-LOOP modules
+    (ddt_tpu/serve/batcher.py + engine.py): the admission batcher's
+    dispatcher thread is shared by EVERY in-flight request — one
+    `time.sleep` poll or synchronous file read there adds its wall time
+    to the whole queue's tail latency, invisibly (the p999 the SLO
+    counters exist to expose). Flagged: `time.sleep` (park on a
+    Condition/Event with a timeout instead — the batcher's admission
+    window does exactly that), `open(...)` in any mode, `np.load` /
+    `json.load`, and Path `.read_text`/`.read_bytes` (model files load
+    in the cli/http layer and arrive as ready ModelBundles —
+    docs/SERVING.md "Hot swap"). The transport layer (serve/http.py)
+    and everything outside ddt_tpu/serve/ are out of scope: their
+    blocking is the caller's thread, not the dispatcher's."""
+
+    rule = "serve-blocking-io"
+    path_scope = (r"^ddt_tpu/serve/batcher\.py$",
+                  r"^ddt_tpu/serve/engine\.py$")
+    _BLOCKING_CALLS = {"time.sleep", "open", "np.load", "numpy.load",
+                       "json.load"}
+    _READ_ATTRS = {"read_text", "read_bytes"}
+
+    def visit_Call(self, node: ast.Call):
+        d = callgraph.dotted(node.func)
+        if d in self._BLOCKING_CALLS:
+            self.report(node, (
+                f"`{d}(...)` in a serving hot-loop module blocks the "
+                "shared dispatcher thread and taxes every in-flight "
+                "request's tail latency — park on a Condition/Event "
+                "timeout, or move the I/O to the cli/http layer "
+                "(docs/SERVING.md; ddtlint serve-blocking-io)"))
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in self._READ_ATTRS:
+            self.report(node, (
+                f"`.{node.func.attr}()` in a serving hot-loop module is "
+                "a synchronous file read on the shared dispatcher "
+                "thread — load artifacts in the cli/http layer and hand "
+                "the engine ready objects (docs/SERVING.md; ddtlint "
+                "serve-blocking-io)"))
+        self.generic_visit(node)
+
+
 AST_CHECKERS = [
     TracedBranchChecker,
     HostSyncChecker,
@@ -783,6 +828,7 @@ AST_CHECKERS = [
     NamedScopeChecker,
     AtomicArtifactWriteChecker,
     RawPhaseTimingChecker,
+    ServeBlockingIOChecker,
 ]
 
 
