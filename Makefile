@@ -63,6 +63,14 @@ chaos-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py
 
+# Registry smoke (docs/REGISTRY.md): train -> CLI push -> COLD-process
+# restore through the zero-retrace AOT loader -> serve -> bit-match vs
+# the exporting process, with the jit_compiles counter witnessing zero
+# compiles during serving; the run log's registry section renders the
+# push/load provenance via `cli report`.
+registry-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/registry_smoke.py
+
 # Bench regression sentinel (docs/OBSERVABILITY.md): band every metric
 # of the newest BENCH_r*/MULTICHIP_r* artifact against the history
 # (median ± max(3*MAD, 20%)); exit 1 on an adverse excursion. Point a
@@ -74,4 +82,5 @@ native:
 	$(MAKE) -C ddt_tpu/native
 
 .PHONY: lint lint-baseline tsan-audit test report trace-smoke \
-	profile-smoke kernel-smoke chaos-smoke serve-smoke benchwatch native
+	profile-smoke kernel-smoke chaos-smoke serve-smoke registry-smoke \
+	benchwatch native

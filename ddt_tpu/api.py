@@ -36,12 +36,19 @@ class TrainResult:
     # numeric/pre-encoded matrix); a caller who encoded categorical columns
     # sets this so save() produces a complete artifact.
     encoder: "object | None" = None
+    # Provenance stamped into saved artifacts' embedded manifests: the
+    # telemetry run_id (present when the run had a run log or capture
+    # window — the registry's cross-reference to the training run) and
+    # the training config (fingerprinted, never embedded whole).
+    run_id: str | None = None
+    cfg: TrainConfig | None = None
 
     def save(self, path: str) -> None:
         """Persist the model artifact: ensemble + bin mapper + categorical
-        encoder if one was attached (see the `encoder` field)."""
+        encoder if one was attached (see the `encoder` field), manifest
+        embedded (docs/REGISTRY.md)."""
         save_model(path, self.ensemble, mapper=self.mapper,
-                   encoder=self.encoder)
+                   encoder=self.encoder, run_id=self.run_id, cfg=self.cfg)
 
 
 @dataclasses.dataclass
@@ -55,17 +62,30 @@ class ModelBundle:
     ensemble: TreeEnsemble
     mapper: BinMapper | None = None
     encoder: "object | None" = None   # data.categorical.CategoricalEncoder
+    # Embedded manifest (schema version, content digest, run_id, git
+    # rev — registry/manifest.py), digest-VERIFIED by load_model; None
+    # for legacy manifest-less files, which stay loadable.
+    manifest: dict | None = None
 
 
 def save_model(path, ens: TreeEnsemble, mapper: BinMapper | None = None,
-               encoder=None) -> None:
+               encoder=None, *, run_id: str | None = None,
+               cfg: TrainConfig | None = None) -> None:
     """Write one .npz holding the ensemble and, when given, the BinMapper
     and CategoricalEncoder fitted at training time. The file remains loadable
     by plain `TreeEnsemble.load` (extra keys are ignored there).
 
+    An embedded manifest (registry/manifest.py: schema version, content
+    digest over every payload array, the training `run_id`, a config
+    fingerprint, git rev) rides under the `manifest_json` key —
+    load_model verifies the digest so a torn or bit-rotted artifact is
+    rejected loudly instead of serving silently wrong trees.
+
     Written tmp-then-os.replace (the atomic-artifact-write contract,
     docs/ROBUSTNESS.md): a process killed mid-save leaves the previous
     model intact, never a torn npz a serving loader would choke on."""
+    from ddt_tpu.registry import manifest as manifest_mod
+
     d = ens.to_dict()
     if mapper is not None:
         # Reuse the classes' own save() dicts under a key prefix so any
@@ -74,14 +94,31 @@ def save_model(path, ens: TreeEnsemble, mapper: BinMapper | None = None,
         d.update({f"mapper_{k}": v for k, v in mapper.save().items()})
     if encoder is not None:
         d.update({f"cat_{k}": v for k, v in encoder.save().items()})
-    atomic_savez(path, compressed=True, **d)
+    manifest_mod.embed_npz_manifest(
+        d, kind="model_bundle", run_id=run_id,
+        config_fingerprint=(
+            manifest_mod.config_fingerprint_digest(cfg)
+            if cfg is not None else None))
+    # deterministic: model artifacts are content-addressed by the
+    # registry — identical models must produce identical bytes.
+    atomic_savez(path, compressed=True, deterministic=True, **d)
 
 
-def load_model(path) -> ModelBundle:
+def load_model(path, *, verify: bool = True) -> ModelBundle:
     """Load a model artifact written by save_model (or a bare
-    TreeEnsemble.save file — mapper/encoder come back None then)."""
+    TreeEnsemble.save file — mapper/encoder come back None then). When
+    the file carries an embedded manifest, its content digest is
+    verified — a mismatch raises registry.IntegrityError (a ValueError)
+    rather than returning silently corrupt trees; manifest-less legacy
+    files load exactly as before. `verify=False` skips the digest pass
+    for callers that already proved the file bytes (the registry loader
+    restores behind an artifact-level sha256)."""
+    from ddt_tpu.registry import manifest as manifest_mod
+
     with np.load(path) as z:
         d = dict(z)
+    manifest = manifest_mod.read_npz_manifest(d, verify=verify,
+                                              source=str(path))
     ens = TreeEnsemble.from_dict(d)
     mapper = None
     if "mapper_edges" in d:
@@ -95,7 +132,8 @@ def load_model(path) -> ModelBundle:
         encoder = CategoricalEncoder.load(
             {k[len("cat_"):]: v for k, v in d.items()
              if k.startswith("cat_")})
-    return ModelBundle(ensemble=ens, mapper=mapper, encoder=encoder)
+    return ModelBundle(ensemble=ens, mapper=mapper, encoder=encoder,
+                       manifest=manifest)
 
 
 def train(
@@ -205,6 +243,7 @@ def train(
     return TrainResult(
         ensemble=ens, mapper=mapper, history=driver.history,
         best_round=driver.best_round, best_score=driver.best_score,
+        run_id=getattr(driver, "run_id", None), cfg=cfg,
     )
 
 

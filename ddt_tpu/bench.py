@@ -818,6 +818,77 @@ def bench_predict_lut_ab(
     }
 
 
+def bench_registry_cold_load(
+    backend: str = "tpu",
+    features: int = 16,
+    bins: int = 63,
+    trees: int = 100,
+    depth: int = 5,
+    max_batch: int = 64,
+    quantize: bool = False,
+    seed: int = 0,
+) -> dict:
+    """Cold-start-to-serving latency: restore-from-registry (AOT
+    deserialize + per-bucket XLA compile + warm) vs the full in-process
+    ServableModel build (validate + compile layout + TRACE every bucket
+    + compile + warm) — the prologue the registry's export boundary
+    exists to amortize (ISSUE 9). Both arms start from cleared jax
+    caches so each pays its honest cold path; the AOT arm additionally
+    witnesses bit-identical scores against the in-process build."""
+    import shutil
+    import tempfile
+
+    import jax as _jax
+
+    from ddt_tpu import api
+    from ddt_tpu.backends import get_backend as _get_backend
+    from ddt_tpu.registry.loader import load_servable, push_servable
+    from ddt_tpu.serve.engine import ServableModel, default_buckets
+
+    _be, Xb, ens = _predict_setup(4 * max_batch, features, bins, trees,
+                                  depth, seed, backend=backend)
+    del _be
+    bundle = api.ModelBundle(ensemble=ens, mapper=None)
+    root = tempfile.mkdtemp(prefix="ddt_reg_bench_")
+    try:
+        push_servable(root, bundle, name="bench", max_batch=max_batch,
+                      quantize=quantize)
+        cold_cfg = TrainConfig(backend=backend, n_bins=bins,
+                               predict_impl="lut" if quantize else "auto")
+
+        _jax.clear_caches()
+        t0 = time.perf_counter()
+        rebuild = ServableModel(
+            bundle, _get_backend(cold_cfg, use_cache=False),
+            quantize=quantize, buckets=default_buckets(max_batch))
+        rebuild.warmup()
+        rebuild_ms = (time.perf_counter() - t0) * 1e3
+        want = rebuild.score_binned(Xb[:max_batch])
+
+        _jax.clear_caches()
+        t0 = time.perf_counter()
+        report = load_servable(root, "bench", quantize=quantize)
+        report.model.warmup()
+        aot_ms = (time.perf_counter() - t0) * 1e3
+        got = report.model.score_binned(Xb[:max_batch])
+        if report.mode.startswith("aot") and not np.array_equal(want, got):
+            raise AssertionError(
+                "registry-restored scores diverge from the in-process "
+                "build — the bit-exactness contract broke")
+        return {
+            "kernel": "registry_cold_load", "backend": backend,
+            "trees": trees, "depth": depth, "features": features,
+            "max_batch": max_batch, "quantized": bool(quantize),
+            "mode": report.mode,
+            "registry_rebuild_cold_ms": round(rebuild_ms, 3),
+            "registry_aot_cold_ms": round(aot_ms, 3),
+            "registry_aot_speedup": round(rebuild_ms / aot_ms, 3)
+            if aot_ms > 0 else None,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_bench(kernel: str = "histogram", **kw) -> dict:
     if kernel == "histogram":
         keys = ("backend", "rows", "features", "bins", "iters",
@@ -835,4 +906,8 @@ def run_bench(kernel: str = "histogram", **kw) -> dict:
         keys = ("backend", "rows", "features", "bins", "trees", "depth",
                 "seed")
         return bench_serve_latency(**{k: kw[k] for k in keys if k in kw})
+    if kernel == "registry":
+        keys = ("backend", "features", "bins", "trees", "depth", "seed")
+        return bench_registry_cold_load(
+            **{k: kw[k] for k in keys if k in kw})
     raise ValueError(f"unknown bench kernel {kernel!r}")

@@ -208,9 +208,16 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
         tmp_cache = tempfile.mkdtemp(prefix="ddt_binned_")
         cache_root = tmp_cache
     window = _capture_window(args)
+    # Coerce the run log HERE so the run_id fit_streaming derives (and
+    # binds on the instance) survives for the saved model's manifest —
+    # the same provenance stamp the in-memory train path writes.
+    from ddt_tpu.telemetry.events import RunLog
+
+    run_log = RunLog.coerce(args.run_log)
     try:
         ens, history, mapper, rows, n_chunks, chunk_rows_max = \
-            _stream_fit(args, X, y, cfg, cache_root, window)
+            _stream_fit(args, X, y, cfg, cache_root, window,
+                        run_log=run_log)
     except NotImplementedError as e:   # e.g. feature-parallel streaming
         raise SystemExit(str(e)) from e
     finally:
@@ -218,12 +225,15 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
         # mid-way through writing the (potentially huge) binned cache.
         if tmp_cache is not None:
             shutil.rmtree(tmp_cache, ignore_errors=True)
+        if run_log is not None:
+            run_log.close()
     dt = time.perf_counter() - t0
     if mapper is not None:
         from ddt_tpu.reference.numpy_trainer import _fill_raw_thresholds
 
         _fill_raw_thresholds(ens, mapper)
-    api.save_model(args.out, ens, mapper=mapper, encoder=encoder)
+    api.save_model(args.out, ens, mapper=mapper, encoder=encoder,
+                   run_id=run_log.run_id if run_log else None, cfg=cfg)
     out = {
         "cmd": "train", "backend": args.backend, "rows": rows,
         "trees": ens.n_trees, "depth": cfg.max_depth,
@@ -250,7 +260,7 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
     return 0
 
 
-def _stream_fit(args, X, y, cfg, cache_root, window=None):
+def _stream_fit(args, X, y, cfg, cache_root, window=None, run_log=None):
     """Chunk-source construction + fit_streaming for _train_streaming
     (separated so its caller's finally-cleanup wraps the WHOLE cache
     lifecycle). Returns (ens, history, mapper, rows, n_chunks,
@@ -396,7 +406,7 @@ def _stream_fit(args, X, y, cfg, cache_root, window=None):
                         early_stopping_rounds=args.early_stop,
                         history=history,
                         device_chunk_cache=dev_cache,
-                        run_log=args.run_log,
+                        run_log=run_log,
                         profile=args.profile,
                         profiler_window=window)
     return ens, history, mapper, rows, n_chunks, chunk_rows_max
@@ -592,8 +602,16 @@ def main(argv: list[str] | None = None) -> int:
              "coalescing, zero-downtime hot swap, serve_latency SLO "
              "telemetry")
     sv.add_argument("--model", required=True,
-                    help="model artifact to serve (api.save_model .npz); "
-                         "hot-swap later via POST /swap")
+                    help="model artifact to serve: an api.save_model "
+                         ".npz path, or — with --registry — a registry "
+                         "reference (name, name@version, name@tag, or "
+                         "digest); hot-swap later via POST /swap")
+    sv.add_argument("--registry", default=None,
+                    help="registry root directory (docs/REGISTRY.md): "
+                         "resolve --model and /swap bodies as registry "
+                         "references and serve through the zero-retrace "
+                         "AOT loader — the model is deserialized, never "
+                         "re-traced")
     sv.add_argument("--backend", choices=BACKENDS, default="tpu")
     sv.add_argument("--host", default="127.0.0.1")
     sv.add_argument("--port", type=int, default=8199,
@@ -615,10 +633,50 @@ def main(argv: list[str] | None = None) -> int:
                     help="JSONL run log for serve_latency SLO events "
                          "(render with `report` — docs/OBSERVABILITY.md)")
 
+    rg = sub.add_parser(
+        "registry",
+        help="digest-addressed model registry (docs/REGISTRY.md): AOT-"
+             "export servable artifacts, version them by name, restore "
+             "them anywhere with zero retracing")
+    rg.add_argument("--registry", required=True,
+                    help="registry root directory (created on first push)")
+    rgsub = rg.add_subparsers(dest="registry_cmd", required=True)
+    rpu = rgsub.add_parser(
+        "push", help="AOT-export a model artifact and publish it")
+    rpu.add_argument("--model", required=True,
+                     help="api.save_model .npz to export")
+    rpu.add_argument("--name", default=None,
+                     help="version the artifact under this name "
+                          "(omit for an anonymous digest-only push)")
+    rpu.add_argument("--tag", default=None,
+                     help="also point this tag at the pushed version")
+    rpu.add_argument("--max-batch", type=_positive_int, default=256,
+                     help="largest serving micro-batch: the exported "
+                          "pad-to-bucket ladder covers powers of two up "
+                          "to this (must match the serving engine's)")
+    rpu.add_argument("--quantize", action="store_true",
+                     help="also export the int8 TreeLUT variant and "
+                          "carry the quantized tables in the artifact")
+    rpu.add_argument("--run-log", default=None,
+                     help="append an `artifact` push event to this "
+                          "JSONL run log (renders in `report`)")
+    rls = rgsub.add_parser("list", help="inventory: names, versions, tags")
+    rls.add_argument("--name", default=None,
+                     help="limit to one model name")
+    rls.add_argument("--json", action="store_true")
+    rgt = rgsub.add_parser(
+        "get", help="resolve + integrity-check a reference, print its "
+                    "manifest")
+    rgt.add_argument("ref", help="digest | name | name@version | name@tag")
+    rtg = rgsub.add_parser("tag", help="point a tag at a version")
+    rtg.add_argument("ref", help="name@version (or name for latest)")
+    rtg.add_argument("tag", help="tag to set (non-numeric)")
+
     bp = sub.add_parser("bench", help="kernel/e2e benchmarks (JSON lines)")
     _add_common(bp)
     bp.add_argument("--kernel", default="histogram",
-                    choices=["histogram", "train", "predict", "serve"])
+                    choices=["histogram", "train", "predict", "serve",
+                             "registry"])
     bp.add_argument("--features", type=int, default=28)
     bp.add_argument("--trees", type=int, default=100)
     bp.add_argument("--depth", type=int, default=6)
@@ -786,9 +844,11 @@ def main(argv: list[str] | None = None) -> int:
         dt = time.perf_counter() - t0
         # Persist the COMPLETE artifact: ensemble + training-time BinMapper
         # (+ CategoricalEncoder) so predict never refits preprocessing on
-        # scoring data (round-1 verdict, Weak #2).
+        # scoring data (round-1 verdict, Weak #2). The embedded manifest
+        # carries the telemetry run_id + config fingerprint — the
+        # provenance chain registry artifacts inherit (docs/REGISTRY.md).
         api.save_model(args.out, res.ensemble, mapper=res.mapper,
-                       encoder=encoder)
+                       encoder=encoder, run_id=res.run_id, cfg=cfg)
         out = {
             "cmd": "train", "backend": args.backend, "rows": len(y),
             "trees": res.ensemble.n_trees, "depth": cfg.max_depth,
@@ -855,24 +915,115 @@ def main(argv: list[str] | None = None) -> int:
         from ddt_tpu.serve.engine import ServeEngine
         from ddt_tpu.serve.http import serve_forever
 
-        bundle = api.load_model(args.model)
-        cfg = TrainConfig(
-            backend=args.backend, loss=bundle.ensemble.loss,
-            n_classes=max(bundle.ensemble.n_classes, 2),
-            predict_impl="lut" if args.quantized else "auto")
-        engine = ServeEngine(
-            bundle, cfg, max_wait_ms=args.max_wait_ms,
-            max_batch=args.max_batch, quantize=args.quantized,
-            raw=args.raw, run_log=args.run_log)
+        mode = "file"
+        digest = None
+        if args.registry is not None and not os.path.exists(args.model):
+            # Registry serving: restore through the zero-retrace loader
+            # — the artifact's AOT programs deserialize here, the model
+            # is never re-traced in this process, and the engine's
+            # bucket ladder is the ARTIFACT's (the shapes that were
+            # exported are exactly the shapes that serve).
+            from ddt_tpu.registry import RegistryError
+            from ddt_tpu.registry import loader as reg_loader
+            from ddt_tpu.telemetry.events import RunLog
+
+            # ONE RunLog for the whole serve lifetime: the loader's
+            # boot-time artifact event and the engine's serving events
+            # share the handle and the per-log monotonic seq (merge's
+            # tie-break invariant); the engine closes it at shutdown.
+            run_log = RunLog.coerce(args.run_log)
+            try:
+                report = reg_loader.load_servable(
+                    args.registry, args.model, quantize=args.quantized,
+                    raw=args.raw, backend=args.backend,
+                    run_log=run_log)
+            except (RegistryError, ValueError, OSError) as e:
+                raise SystemExit(f"serve --registry: {e}") from e
+            servable = report.model
+            mode, digest = report.mode, report.digest
+            cfg = TrainConfig(
+                backend=args.backend, loss=servable.ens.loss,
+                n_classes=max(servable.ens.n_classes, 2),
+                predict_impl="lut" if args.quantized else "auto")
+            engine = ServeEngine(
+                servable, cfg, max_wait_ms=args.max_wait_ms,
+                max_batch=servable.buckets[-1], quantize=args.quantized,
+                raw=args.raw, run_log=run_log)
+        else:
+            bundle = api.load_model(args.model)
+            cfg = TrainConfig(
+                backend=args.backend, loss=bundle.ensemble.loss,
+                n_classes=max(bundle.ensemble.n_classes, 2),
+                predict_impl="lut" if args.quantized else "auto")
+            engine = ServeEngine(
+                bundle, cfg, max_wait_ms=args.max_wait_ms,
+                max_batch=args.max_batch, quantize=args.quantized,
+                raw=args.raw, run_log=args.run_log)
+        engine.registry_root = args.registry
         print(json.dumps({
             "cmd": "serve", "model": args.model,
             "model_token": engine.model_token,
             "quantized": args.quantized, "host": args.host,
             "port": args.port, "max_wait_ms": args.max_wait_ms,
-            "max_batch": args.max_batch,
+            "max_batch": engine.buckets[-1],
+            "registry": args.registry, "mode": mode,
+            "artifact_digest": digest,
         }), flush=True)
         serve_forever(engine, host=args.host, port=args.port)
         return 0
+
+    if args.cmd == "registry":
+        from ddt_tpu.registry import IntegrityError, Registry, RegistryError
+
+        reg = Registry(args.registry)
+        try:
+            if args.registry_cmd == "push":
+                from ddt_tpu.registry.loader import push_servable
+
+                bundle = api.load_model(args.model)
+                out = push_servable(
+                    reg, bundle, name=args.name, tag=args.tag,
+                    max_batch=args.max_batch, quantize=args.quantize,
+                    run_log=args.run_log)
+                print(json.dumps({"cmd": "registry_push",
+                                  "model": args.model, **out}))
+                return 0
+            if args.registry_cmd == "list":
+                inv = reg.list(name=args.name)
+                if args.json:
+                    print(json.dumps(inv))
+                else:
+                    for name, idx in sorted(inv["names"].items()):
+                        tags = {t: v for t, v in idx["tags"].items()}
+                        for v in idx["versions"]:
+                            vt = [t for t, tv in tags.items()
+                                  if tv == v["version"]]
+                            print(f"{name}@{v['version']}  {v['digest']}"
+                                  + (f"  run_id={v['run_id']}"
+                                     if v.get("run_id") else "")
+                                  + ("  quantized" if v.get("quantized")
+                                     else "")
+                                  + (f"  [{', '.join(vt)}]" if vt else ""))
+                    for d in inv["anonymous"]:
+                        print(f"(anonymous)  {d}")
+                return 0
+            if args.registry_cmd == "get":
+                art_dir, man, digest = reg.get(args.ref)
+                print(json.dumps({
+                    "cmd": "registry_get", "ref": args.ref,
+                    "digest": digest, "path": art_dir,
+                    "manifest": {k: v for k, v in man.items()
+                                 if k != "files"},
+                    "n_files": len(man["files"]),
+                }))
+                return 0
+            if args.registry_cmd == "tag":
+                print(json.dumps({"cmd": "registry_tag",
+                                  **reg.tag(args.ref, args.tag)}))
+                return 0
+        except (RegistryError, IntegrityError, OSError) as e:
+            raise SystemExit(f"registry {args.registry_cmd}: {e}") from e
+        return 2  # pragma: no cover
 
     if args.cmd == "report":
         from ddt_tpu.telemetry import merge as tele_merge

@@ -310,6 +310,10 @@ class Driver:
             run_id = derive_run_id(
                 trainer="driver", rows=int(R), features=int(F),
                 **dataclasses.asdict(cfg))
+        # Exposed for artifact provenance: api.train stamps it into the
+        # TrainResult so saved models' embedded manifests (and registry
+        # artifacts) cross-reference this run's log (docs/REGISTRY.md).
+        self.run_id = run_id
         if self._window is not None:
             self._window.bind(run_id)
         if self.run_log is not None:

@@ -1,0 +1,4 @@
+"""AOT model export (jax.export / StableHLO) — see export/aot.py and
+docs/REGISTRY.md. Import the submodule lazily (`from ddt_tpu.export
+import aot`): it needs jax, and the registry's pure-metadata paths
+(list/tag/manifest reads) must work without it."""

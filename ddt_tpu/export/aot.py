@@ -1,0 +1,331 @@
+"""AOT export of the compiled predict function (jax.export / StableHLO).
+
+The Julia→TPU full-compilation work (arXiv:1810.09868) showed that the
+right deployment boundary for accelerator ML is the WHOLE lowered
+program, not source that re-traces at the destination. This module is
+that boundary for a trained ensemble: per pad-to-bucket batch shape,
+the scoring function is lowered once (in the exporting process), the
+StableHLO serialized, and the bytes shipped inside the registry
+artifact. A cold serving process deserializes and compiles each bucket
+at load time — it never re-traces the model, which the `jit_compiles`
+counter witnesses (`make registry-smoke`).
+
+Two variants per artifact (docs/REGISTRY.md "Artifact layout"):
+
+- **f32** — `predict_raw_effective` over the CompiledEnsemble's
+  pushed-down arrays with `use_pallas=False`: the one-hot path is pure
+  StableHLO (no platform custom calls), so a single export lowers for
+  BOTH cpu and tpu (`platforms=("cpu","tpu")`) and the same blob serves
+  on chip or host. Bit-exact to the in-process path by the repo's
+  standing parity contracts (pallas == one-hot, tests/test_predict_*).
+- **lut** — the TreeLUT int8 fast path (ops/predict_lut.py,
+  arXiv:2501.01511). The kernel is a Pallas call, so the export is
+  platform-specific (interpret-mode lowering on host, the real kernel
+  on chip); the manifest records `lut_platforms` and the loader falls
+  back to rebuilding the LUT path from the carried tables when the
+  serving platform differs. The quantized tables THEMSELVES also ride
+  in the artifact (`lut_tables.npz`) so the int8 representation — and
+  its computed `max_abs_err` bound — survives export verbatim.
+
+The exported functions take `(*operands, X)` where the operands are
+exactly `CompiledEnsemble.arrays()` / `lut_device_operands(tables)` —
+the loader rebuilds those host-side from model.npz (deterministic;
+guarded by the manifest's `model_token`) and keeps them device-resident
+across requests, so the blobs stay small (program only, no weights).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddt_tpu.ops import predict as predict_ops
+from ddt_tpu.ops import predict_lut
+from ddt_tpu.registry import manifest as manifest_mod
+
+log = logging.getLogger("ddt_tpu.export")
+
+MODEL_FILE = "model.npz"
+LUT_TABLES_FILE = "lut_tables.npz"
+AOT_DIR = "aot"
+F32_BLOB = "predict_f32_b{bucket:05d}.bin"
+LUT_BLOB = "predict_lut_b{bucket:05d}.bin"
+#: platforms one f32 export covers when multi-platform lowering works
+#: (pure StableHLO — no custom calls — so lowering for the absent
+#: platform needs no hardware).
+F32_PLATFORMS = ("cpu", "tpu")
+
+
+def f32_predict_fn(ce):
+    """The f32 scoring closure over a CompiledEnsemble's static facts —
+    the SAME computation TPUDevice._predict_fn jits (one-hot form), so
+    an exported call is bit-identical to the exporting process's serve
+    path at the same shape."""
+    use_missing = ce.eff_dl is not None
+    use_cat = ce.eff_cat is not None
+
+    def fn(ef, et, bv, coh, *rest):
+        *opt, Xc = rest
+        opt = list(opt)
+        dl = opt.pop(0) if use_missing else None
+        cn = opt.pop(0) if use_cat else None
+        return predict_ops.predict_raw_effective(
+            ef, et, bv, coh, Xc,
+            max_depth=ce.max_depth, learning_rate=ce.learning_rate,
+            base=ce.base_score, n_classes=ce.n_classes_out,
+            tree_chunk=ce.tree_chunk, eff_dl=dl,
+            missing_bin_value=ce.missing_bin_value, eff_cat=cn,
+            use_pallas=False,
+        )
+
+    return fn
+
+
+def lut_predict_fn(tables):
+    """The LUT scoring closure (ops/predict_lut.py) over one model's
+    quantized tables; `interpret` pinned at EXPORT time — the lowered
+    program is platform-specific either way, which the manifest's
+    `lut_platforms` records."""
+    interpret = jax.default_backend() != "tpu"
+    static = dict(
+        max_depth=tables.max_depth, learning_rate=tables.learning_rate,
+        base=tables.base_score, n_classes=tables.n_classes_out,
+        tree_chunk=tables.tree_chunk,
+        n_trees_padded=tables.n_trees_padded,
+        missing_bin_value=tables.missing_bin_value,
+        use_missing=tables.eff_dl is not None,
+        use_cat=tables.eff_cat is not None,
+        use_scale=tables.leaf_scale is not None,
+        interpret=interpret,
+    )
+
+    def fn(*args):
+        *ops, Xc = args
+        return predict_lut.predict_effective_lut_ops(
+            tuple(ops), Xc, **static)
+
+    return fn
+
+
+def _shape_args(operands, bucket: int, n_features: int) -> list:
+    args = [jax.ShapeDtypeStruct(np.asarray(a).shape,
+                                 np.asarray(a).dtype) for a in operands]
+    args.append(jax.ShapeDtypeStruct((bucket, n_features), jnp.uint8))
+    return args
+
+
+def export_bucket(fn, operands, bucket: int, n_features: int,
+                  platforms: tuple | None = None) -> tuple[bytes, tuple]:
+    """(serialized StableHLO, platforms actually lowered for) of one
+    scoring closure at one bucket shape. Multi-platform lowering is
+    best-effort: when it fails (a platform this jax build cannot lower
+    for), the export degrades to the current platform and the caller
+    records the narrower coverage in the manifest.
+
+    Lowered WITHOUT caller-traceback location metadata
+    (jax_traceback_in_locations_limit=0 for the duration): MLIR
+    locations embed the EXPORTING call stack's file:line, so the same
+    model exported from two different call sites would serialize to
+    different bytes — breaking the registry's content addressing (push
+    idempotence). The op-level debug payload a serving process never
+    reads is exactly the nondeterminism we strip."""
+    from jax import export as jax_export
+
+    args = _shape_args(operands, bucket, n_features)
+    prev = jax.config.jax_traceback_in_locations_limit
+    jax.config.update("jax_traceback_in_locations_limit", 0)
+    try:
+        if platforms is not None:
+            try:
+                exp = jax_export.export(jax.jit(fn),
+                                        platforms=tuple(platforms))(*args)
+                return bytes(exp.serialize()), tuple(exp.platforms)
+            except Exception as e:  # ddtlint: disable=broad-except
+                # Lowering for a foreign platform is an optional
+                # capability (older jax, exotic backends) — fall back to
+                # the platform we are actually on rather than failing
+                # the export.
+                log.warning("multi-platform export for %s failed "
+                            "(%s: %s); exporting for %s only", platforms,
+                            type(e).__name__, e, jax.default_backend())
+        exp = jax_export.export(jax.jit(fn))(*args)
+        return bytes(exp.serialize()), tuple(exp.platforms)
+    finally:
+        jax.config.update("jax_traceback_in_locations_limit", prev)
+
+
+def deserialize_blob(blob: bytes):
+    """Serialized StableHLO -> a callable Exported (the loader jits
+    `.call` so each bucket compiles exactly once, at load time)."""
+    from jax import export as jax_export
+
+    return jax_export.deserialize(bytearray(blob))
+
+
+# --------------------------------------------------------------------- #
+# QuantizedTables npz round trip (the carried int8 representation)
+# --------------------------------------------------------------------- #
+
+_TABLE_SCALARS = ("token", "tree_chunk", "max_depth", "n_classes_out",
+                  "learning_rate", "base_score", "loss",
+                  "missing_bin_value", "leaf_dtype", "max_abs_err")
+_TABLE_ARRAYS = ("eff_feat", "thr_i8", "leaf_q", "leaf_scale", "cls_oh",
+                 "eff_dl", "eff_cat")
+
+
+def tables_to_arrays(tables) -> dict:
+    """QuantizedTables -> npz-ready dict (None optionals become empty
+    arrays; scalars ride as 0-d numpy)."""
+    d = {}
+    for k in _TABLE_SCALARS:
+        v = getattr(tables, k)
+        d[k] = np.bytes_(v.encode()) if isinstance(v, str) else np.asarray(v)
+    for k in _TABLE_ARRAYS:
+        v = getattr(tables, k)
+        d[k] = np.zeros(0, np.int8) if v is None else np.asarray(v)
+    return d
+
+
+def tables_from_arrays(d: dict):
+    """Inverse of tables_to_arrays (empty optionals back to None)."""
+    kw = {}
+    for k in _TABLE_SCALARS:
+        v = d[k]
+        if np.asarray(v).dtype.kind == "S":
+            kw[k] = bytes(np.asarray(v).item()).decode()
+        elif k in ("learning_rate", "base_score", "max_abs_err"):
+            kw[k] = float(v)
+        else:
+            kw[k] = int(v)
+    for k in _TABLE_ARRAYS:
+        a = np.asarray(d[k])
+        kw[k] = None if a.size == 0 and k != "cls_oh" else a
+    return predict_lut.QuantizedTables(**kw)
+
+
+# --------------------------------------------------------------------- #
+# staging a full servable artifact
+# --------------------------------------------------------------------- #
+
+@dataclasses.dataclass
+class StagedArtifact:
+    stage_dir: str
+    manifest: dict
+    digest: str          # full sha256 of the manifest bytes
+
+
+def stage_servable(
+    stage_dir: str,
+    bundle,                       # api.ModelBundle (or TrainResult-like)
+    *,
+    buckets: tuple,
+    quantize: bool = False,
+    raw: bool = False,
+    tree_chunk: int = 64,
+    run_id: str | None = None,
+) -> StagedArtifact:
+    """Build a complete servable artifact in `stage_dir` (the registry's
+    staging area): model.npz, per-bucket AOT blobs (f32 always, lut when
+    `quantize` and the kernel admits the shape), lut_tables.npz, and
+    the finalized manifest.json. Returns the staged paths + digest;
+    `Registry.push(stage_dir, …)` publishes it atomically."""
+    from ddt_tpu import api
+
+    ens = bundle.ensemble
+    buckets = tuple(sorted({int(b) for b in buckets}))
+    if not buckets or buckets[0] < 1:
+        raise ValueError(f"buckets must be positive ints, got {buckets}")
+    emb = getattr(bundle, "manifest", None) or {}
+    if run_id is None:
+        run_id = emb.get("run_id")
+
+    os.makedirs(os.path.join(stage_dir, AOT_DIR), exist_ok=True)
+    api.save_model(os.path.join(stage_dir, MODEL_FILE), ens,
+                   mapper=bundle.mapper,
+                   encoder=getattr(bundle, "encoder", None),
+                   run_id=run_id)
+
+    ce = ens.compile(tree_chunk=tree_chunk)
+    fn = f32_predict_fn(ce)
+    operands = ce.arrays()
+    F = int(ens.n_features)
+    # Manifest coverage is the INTERSECTION across buckets: lowering is
+    # per-call best-effort, and a platform the manifest claims must hold
+    # for every blob the loader will deserialize.
+    platforms: tuple | None = None
+    for b in buckets:
+        blob, covered = export_bucket(fn, operands, b, F,
+                                      platforms=F32_PLATFORMS)
+        platforms = covered if platforms is None else tuple(
+            p for p in platforms if p in covered)
+        with open(os.path.join(stage_dir, AOT_DIR,
+                               F32_BLOB.format(bucket=b)), "wb") as f:
+            f.write(blob)
+    platforms = platforms or ()
+
+    quantized_meta = None
+    lut_platforms: tuple | None = None
+    if quantize:
+        tables = ce.quantize()
+        quantized_meta = {"leaf_dtype": tables.leaf_dtype,
+                          "max_abs_err": tables.max_abs_err}
+        # The int8 representation itself rides in the artifact — the
+        # TreeLUT fast path survives export even where the lowered
+        # kernel blob cannot follow (foreign serving platform).
+        from ddt_tpu.utils.atomic import atomic_savez
+
+        atomic_savez(os.path.join(stage_dir, LUT_TABLES_FILE),
+                     compressed=True, deterministic=True,
+                     **tables_to_arrays(tables))
+        on_tpu = jax.default_backend() == "tpu"
+        if not on_tpu or predict_lut.predict_lut_fits(
+                tables.n_trees_padded, tables.tree_chunk,
+                tables.max_depth, F, tables.n_classes_out):
+            lfn = lut_predict_fn(tables)
+            lops = predict_lut.lut_device_operands(tables)
+            for b in buckets:
+                blob, covered = export_bucket(lfn, lops, b, F)
+                lut_platforms = covered if lut_platforms is None \
+                    else tuple(p for p in lut_platforms if p in covered)
+                with open(os.path.join(
+                        stage_dir, AOT_DIR,
+                        LUT_BLOB.format(bucket=b)), "wb") as f:
+                    f.write(blob)
+        else:
+            log.warning(
+                "LUT shape exceeds the kernel's VMEM budget; artifact "
+                "carries quantized tables but no lut AOT blobs")
+
+    # No timestamps: the manifest bytes ARE the artifact digest, and
+    # re-exporting the same model must reproduce the same address
+    # (push idempotence). pushed_at lives in the registry name index.
+    meta = {
+        "kind": "servable",
+        "model_token": ce.token,
+        "loss": ens.loss,
+        "n_classes": int(ens.n_classes),
+        "n_features": F,
+        "n_trees": int(ens.n_trees),
+        "max_depth": int(ens.max_depth),
+        "tree_chunk": int(tree_chunk),
+        "buckets": list(buckets),
+        "raw": bool(raw),
+        "has_mapper": bundle.mapper is not None,
+        "has_encoder": getattr(bundle, "encoder", None) is not None,
+        "platforms": list(platforms),
+        "lut_platforms": list(lut_platforms or ()),
+        "quantized": quantized_meta,
+        "run_id": run_id,
+        "config_fingerprint": emb.get("config_fingerprint"),
+        "git_rev": manifest_mod.git_rev(),
+        "jax_version": jax.__version__,
+        "export_host_platform": jax.default_backend(),
+    }
+    digest = manifest_mod.write_artifact_manifest(stage_dir, meta)
+    return StagedArtifact(stage_dir=stage_dir,
+                          manifest={**meta}, digest=digest)

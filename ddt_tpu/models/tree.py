@@ -389,8 +389,16 @@ class TreeEnsemble:
 
     def save(self, path: str) -> None:
         # tmp-then-replace (the atomic-artifact-write contract): a kill
-        # mid-save never leaves a torn model file behind.
-        atomic_savez(path, compressed=True, **self.to_dict())
+        # mid-save never leaves a torn model file behind. The embedded
+        # manifest (schema version, content digest, git rev —
+        # registry/manifest.py) makes the bare-ensemble artifact
+        # self-describing too; `load` ignores the extra key, and
+        # api.load_model digest-verifies it (docs/REGISTRY.md).
+        from ddt_tpu.registry import manifest as manifest_mod
+
+        d = self.to_dict()
+        manifest_mod.embed_npz_manifest(d, kind="tree_ensemble")
+        atomic_savez(path, compressed=True, deterministic=True, **d)
 
     @staticmethod
     def load(path: str) -> "TreeEnsemble":
@@ -524,6 +532,19 @@ class CompiledEnsemble:
             memo[leaf_dtype] = quantize_compiled(
                 self, leaf_dtype=leaf_dtype)
         return memo[leaf_dtype]
+
+    def seed_quantized(self, tables) -> None:
+        """Install pre-built tables as this instance's quantization:
+        `quantize(leaf_dtype=tables.leaf_dtype)` — including the
+        backend's first LUT dispatch — returns them verbatim instead of
+        re-deriving. The registry loader seeds the artifact's CARRIED
+        lut_tables.npz here so the exported int8 representation is what
+        serves, even across version skew in the quantization routine."""
+        memo = self.__dict__.get("_quant_memo")
+        if memo is None:
+            memo = {}
+            object.__setattr__(self, "_quant_memo", memo)
+        memo[tables.leaf_dtype] = tables
 
     @staticmethod
     def build(ens: TreeEnsemble, tree_chunk: int = 64

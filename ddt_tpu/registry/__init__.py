@@ -1,0 +1,10 @@
+"""Digest-addressed model registry (docs/REGISTRY.md).
+
+Submodules: `manifest` (provenance + integrity schemas, stdlib-only),
+`store` (the on-disk object/version store), `loader` (the zero-retrace
+servable restore — imports jax; keep it lazy from transport/CLI code).
+Only the jax-free pieces are re-exported here so `registry list`-style
+metadata work never pays a jax import."""
+
+from ddt_tpu.registry.manifest import IntegrityError  # noqa: F401
+from ddt_tpu.registry.store import Registry, RegistryError  # noqa: F401

@@ -67,10 +67,24 @@ class ServableModel:
     tables + device upload + one traced dispatch per bucket) is paid
     here, off the request path; `score()` is transform + pad + dispatch.
     Instances are immutable once built — the engine swaps whole
-    references."""
+    references.
+
+    Subclass seam: `_invoke(Xb)` is the one device-dispatch point the
+    pad/chunk/probability logic funnels through — the registry's
+    AOT-restored model (ddt_tpu/registry/loader.py) overrides ONLY it,
+    scoring through deserialized StableHLO instead of the backend's
+    traced path, and inherits every shape contract here verbatim."""
+
+    #: short registry digest when this model came from an artifact
+    #: (stamped into serve_latency / hot_swap events); None for models
+    #: published straight from a file or bundle.
+    artifact_digest: "str | None" = None
+    #: True when scoring rides deserialized AOT blobs (zero retrace).
+    aot: bool = False
 
     def __init__(self, bundle, backend, *, quantize: bool = False,
-                 buckets: tuple[int, ...] = (1,), raw: bool = False):
+                 buckets: tuple[int, ...] = (1,), raw: bool = False,
+                 tables=None):
         from ddt_tpu.api import validate_mapper_model
 
         self.ens = bundle.ensemble
@@ -89,8 +103,19 @@ class ServableModel:
         if quantize:
             # Error contract rides on the tables (ops/predict_lut.py);
             # recorded here so /healthz and the smoke test can surface
-            # the served bound.
-            self.tables = self.compiled.quantize()
+            # the served bound. Pre-built `tables` (the registry's
+            # carried lut_tables.npz, token-pinned by the loader) take
+            # precedence over re-quantizing: the exported int8
+            # representation is what serves, even across version skew.
+            if tables is not None:
+                # Seed the compiled model's memo so the backend's LUT
+                # dispatch consumes THESE tables, not a re-derivation —
+                # keyed by THEIR leaf_dtype, not the default's.
+                self.compiled.seed_quantized(tables)
+                self.tables = self.compiled.quantize(
+                    leaf_dtype=tables.leaf_dtype)
+            else:
+                self.tables = self.compiled.quantize()
             self.max_abs_err = self.tables.max_abs_err
         else:
             self.tables = None
@@ -127,9 +152,14 @@ class ServableModel:
         if n < b:
             Xb = np.concatenate(
                 [Xb, np.zeros((b - n, Xb.shape[1]), np.uint8)])
-        out = self.backend.predict_raw(self.ens, Xb,
-                                       compiled=self.compiled)[:n]
+        out = self._invoke(Xb)[:n]
         return out if self.raw else proba_np(out, self.ens.loss)
+
+    def _invoke(self, Xb: np.ndarray) -> np.ndarray:
+        """One raw-score dispatch at an exact bucket shape (see the
+        class doc's subclass seam)."""
+        return self.backend.predict_raw(self.ens, Xb,
+                                        compiled=self.compiled)
 
     def warmup(self) -> None:
         """Trace every bucket shape BEFORE the model is published — a
@@ -265,6 +295,10 @@ class ServeEngine:
         self.raw = bool(raw)
         self.stats = ServeStats()
         self.run_log = RunLog.coerce(run_log)
+        # Registry root for reference-based hot swaps (`cli serve
+        # --registry` sets it; the HTTP layer resolves refs — this
+        # module never does file I/O, the serve-blocking-io contract).
+        self.registry_root: "str | None" = None
         self._swap_lock = threading.Lock()
         self._model = self._build(bundle)
         self._batcher = MicroBatcher(self._dispatch,
@@ -276,6 +310,15 @@ class ServeEngine:
     # ------------------------------------------------------------------ #
 
     def _build(self, bundle) -> ServableModel:
+        if isinstance(bundle, ServableModel):
+            # A prebuilt model (the registry loader's AOT restore, or a
+            # caller-constructed ServableModel): publish as-is — its
+            # prologue was paid where it was built. Warm-up is repeated
+            # here because it is the PUBLISH-side guarantee that no
+            # live request ever pays a compile; on an already-warm
+            # model it is a handful of cached dispatches.
+            bundle.warmup()
+            return bundle
         m = ServableModel(bundle, self.backend, quantize=self.quantize,
                           buckets=self.buckets, raw=self.raw)
         m.warmup()
@@ -293,11 +336,18 @@ class ServeEngine:
         with self._swap_lock:               # serialize concurrent swaps
             new = self._build(bundle)
             old = self._model.token
+            old_digest = self._model.artifact_digest
             self._model = new               # atomic reference publish
         tele_counters.record_serve_hot_swap()
         if self.run_log is not None:
+            # Registry provenance rides on the event: which ARTIFACT
+            # (not just which content token) is serving before/after —
+            # the digest is how an operator joins a swap to `registry
+            # list` and to the training run's own log (docs/REGISTRY.md).
             self.run_log.emit("fault", kind="hot_swap", old=old,
-                              new=new.token)
+                              new=new.token,
+                              old_artifact=old_digest,
+                              new_artifact=new.artifact_digest)
         log.info("hot-swapped model %s -> %s", old[:12], new.token[:12])
         return {"old": old, "new": new.token}
 
@@ -389,7 +439,10 @@ class ServeEngine:
         summary = self.stats.window_summary(reset=reset)
         if summary["requests"] == 0:
             return None
-        summary["model_token"] = self.model_token
+        m = self._model
+        summary["model_token"] = m.token
+        if m.artifact_digest is not None:
+            summary["artifact_digest"] = m.artifact_digest
         if self.run_log is not None:
             self.run_log.emit("serve_latency", **summary)
         return summary
@@ -402,6 +455,8 @@ class ServeEngine:
             "quantized": m.quantized,
             "lut_max_abs_err": m.max_abs_err,
             "buckets": list(self.buckets),
+            "artifact_digest": m.artifact_digest,
+            "aot": m.aot,
             **self.stats.snapshot(),
         }
 

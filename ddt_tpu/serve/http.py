@@ -13,7 +13,12 @@ Endpoints (all JSON):
 
 - POST /predict   {"rows": [[...], ...], "binned": false}
                   -> {"scores": [...], "model": token}
-- POST /swap      {"model": "/path/to/model.npz"}
+- POST /swap      {"model": "/path/to/model.npz"} — or a REGISTRY
+                  reference {"model": "name@version" | "name@tag" |
+                  "<digest>"} when the server was started with
+                  `cli serve --registry` (docs/REGISTRY.md): the
+                  artifact restores through the zero-retrace loader,
+                  digest-verified, off the request path.
                   -> {"old": token, "new": token}   (zero-downtime)
 - GET  /healthz   -> engine.health() (+ all-time latency snapshot)
 - GET  /stats     -> current-window latency summary; "?emit=1" also
@@ -38,6 +43,38 @@ import numpy as np
 from ddt_tpu.serve.batcher import ShuttingDown
 
 log = logging.getLogger("ddt_tpu.serve.http")
+
+
+def _swap(engine, ref: str) -> dict:
+    """Resolve a /swap target — an artifact path on disk, or (when the
+    engine carries a registry root) a registry reference — build + warm
+    the new model on THIS handler thread, and publish it. An existing
+    file always wins; anything else needs `--registry`, so a mistyped
+    path fails loudly instead of being treated as a model name."""
+    import os
+
+    if os.path.exists(ref):
+        from ddt_tpu import api
+
+        return engine.swap(api.load_model(ref))
+    registry_root = getattr(engine, "registry_root", None)
+    if registry_root is None:
+        raise ValueError(
+            f"{ref!r} is not a file, and this server was started "
+            "without --registry so registry references cannot resolve")
+    from ddt_tpu.registry import loader as reg_loader
+
+    # The engine's serving mode wins: a quantized server stays
+    # quantized (missing LUT export -> loud 400), an f32 server serves
+    # the f32 variant even from a quantized artifact.
+    report = reg_loader.load_servable(
+        registry_root, ref, quantize=engine.quantize,
+        raw=engine.raw, backend=engine.backend,
+        run_log=engine.run_log)
+    out = engine.swap(report.model)
+    out["artifact_digest"] = report.digest
+    out["mode"] = report.mode
+    return out
 
 
 def _make_handler(engine, server_box: dict):
@@ -100,11 +137,8 @@ def _make_handler(engine, server_box: dict):
                         "scores": np.asarray(scores).tolist(),
                         "model": pending.model_token})
                 if self.path == "/swap":
-                    from ddt_tpu import api
-
                     req = self._body()
-                    bundle = api.load_model(req["model"])
-                    return self._send(200, engine.swap(bundle))
+                    return self._send(200, _swap(engine, req["model"]))
                 if self.path == "/shutdown":
                     self._send(200, {"ok": True})
                     threading.Thread(

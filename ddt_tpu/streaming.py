@@ -602,6 +602,7 @@ def _fit_streaming_impl(
     if profiler_window is not None:
         profiler_window.bind(run_id)
     if run_log is not None:
+        run_log.run_id = run_id
         run_log.emit(
             "run_manifest",
             trainer=trainer_name,

@@ -33,7 +33,13 @@ import time
 # kind. v1-v3 logs remain readable (no required field of an existing
 # event ever changed — the back-compat contract tests/test_observatory.
 # py and tests/test_serve.py pin).
-SCHEMA_VERSION = 4
+# v5 (the AOT export + model registry): adds the `artifact` event
+# (registry push/load/serve-publish records carrying the content
+# digest, name@version, and the training run_id — ddt_tpu/registry/),
+# plus the optional `artifact_digest` extra on serve_latency and the
+# `old_artifact`/`new_artifact` extras on hot_swap faults. v1-v4 logs
+# remain readable (tests/test_registry.py pins the v4 round trip).
+SCHEMA_VERSION = 5
 
 #: event type -> REQUIRED payload fields (extras are allowed and common:
 #: e.g. `round` records carry `valid_<metric>` keys named by the run's
@@ -80,6 +86,13 @@ EVENT_FIELDS: dict[str, set] = {
     # platform, arg/output/temp HBM bytes from memory_analysis(),
     # signature. Emitted in the run epilogue, one per (op, signature).
     "cost_analysis": {"op", "flops", "bytes_accessed"},
+    # Registry provenance (schema v5, ddt_tpu/registry/): one per
+    # artifact lifecycle step — action in {push, load}, digest = the
+    # 16-hex content address. Extras: name, version, kind, the training
+    # run_id (the cross-reference `report`'s registry section joins on),
+    # model_token, and mode (the loader's restore ladder: aot-f32 /
+    # aot-lut / tables-fallback / rebuild).
+    "artifact": {"action", "digest"},
     # Serving-tier SLO window (schema v4, ddt_tpu/serve/engine.py): one
     # per emitted latency window — per-request latency quantiles
     # (p50/p99; extras p999_ms, max_ms), admission-batching shape
@@ -135,6 +148,10 @@ class RunLog:
         self.ring: collections.deque = collections.deque(maxlen=ring_size)
         self._fh = None
         self._seq = 0
+        # Bound by the trainer that derives it (Driver, fit_streaming) —
+        # callers that only hold the log (the CLI's streaming save path)
+        # read the run's identity here; survives close().
+        self.run_id: str | None = None
 
     @classmethod
     def coerce(cls, run_log) -> "RunLog | None":

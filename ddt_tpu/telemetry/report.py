@@ -194,6 +194,12 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
         # summaries render exactly as before.
         "serving": _serving_summary(
             [e for e in events if e["event"] == "serve_latency"]),
+        # Registry provenance (schema v5): artifact push/load events,
+        # each cross-referenced against THIS run's id when they carry
+        # one — None on pre-v5 logs.
+        "registry": _registry_summary(
+            [e for e in events if e["event"] == "artifact"],
+            manifest.get("run_id")),
     }
     # Roofline join (telemetry/costmodel.py): only when the log carries
     # cost_analysis events — pre-v3 logs render exactly as before.
@@ -233,6 +239,37 @@ def _serving_summary(serve_ev: list[dict]) -> dict | None:
                                for e in serve_ev),
         "model_tokens": sorted({e["model_token"][:12] for e in serve_ev
                                 if e.get("model_token")}),
+    }
+
+
+def _registry_summary(artifact_ev: list[dict],
+                      log_run_id) -> dict | None:
+    """Reduce a run's artifact events for the report: one record per
+    event (they are rare — lifecycle steps, not request traffic), with
+    `same_run` marking artifacts whose embedded training run_id matches
+    this log's own manifest — the provenance join the registry exists
+    to provide (train --run-log L; registry push; report --log L shows
+    the push against its own run)."""
+    if not artifact_ev:
+        return None
+    events = []
+    for e in artifact_ev:
+        rec = {
+            "action": e["action"],
+            "digest": e["digest"],
+            "name": e.get("name"),
+            "version": e.get("version"),
+            "run_id": e.get("run_id"),
+            "mode": e.get("mode"),
+            "same_run": (e.get("run_id") is not None
+                         and e.get("run_id") == log_run_id),
+        }
+        events.append(rec)
+    return {
+        "events": events,
+        "pushes": sum(1 for e in events if e["action"] == "push"),
+        "loads": sum(1 for e in events if e["action"] == "load"),
+        "digests": sorted({e["digest"] for e in events if e["digest"]}),
     }
 
 
@@ -334,6 +371,24 @@ def render(summary: dict) -> str:
         if s.get("model_tokens"):
             out.append("  models served: "
                        + ", ".join(s["model_tokens"]))
+
+    if summary.get("registry"):
+        r = summary["registry"]
+        out.append(
+            f"registry: {r['pushes']} push(es), {r['loads']} load(s) "
+            f"across {len(r['digests'])} artifact(s)")
+        for e in r["events"]:
+            where = (f"{e['name']}@{e['version']}"
+                     if e.get("name") and e.get("version") else "")
+            bits = [b for b in (
+                where,
+                e["digest"],
+                f"mode={e['mode']}" if e.get("mode") else "",
+                f"run_id={e['run_id']}" + (
+                    " (this run)" if e["same_run"] else "")
+                if e.get("run_id") else "",
+            ) if b]
+            out.append(f"  {e['action']:<5} " + "  ".join(bits))
 
     curve = summary["metric_curve"]
     if curve:

@@ -1,0 +1,191 @@
+"""Registry smoke (`make registry-smoke`): compile once, serve anywhere
+— the ISSUE 9 acceptance witness, end to end on CPU (docs/REGISTRY.md).
+
+Flow, across two REAL processes:
+
+1. (this process) a tiny model trains with a run log, saves with its
+   embedded manifest, and is pushed through the real CLI
+   (`registry push`) — the artifact event lands in the same run log;
+2. offline reference scores for a fixed request set are computed with
+   in-process `api.predict`;
+3. a COLD python process (fresh interpreter, empty jax caches) restores
+   the artifact through the zero-retrace loader, publishes it in a
+   ServeEngine, and serves every bucket shape plus an oversize request:
+   - every score BIT-matches the exporting process's reference,
+   - the jit_compiles counter moves ZERO during serving (all compiles
+     happened at load/warmup — the counter delta is emitted into the
+     run log as the witness the acceptance criteria name),
+   - the restore mode is aot-* (the witness is not vacuous);
+4. (back here) `cli report` renders the run log: the registry section
+   shows the push + load cross-referenced to THIS run's run_id, and
+   the serve_latency window carries the artifact digest.
+
+Exit 0 = all hold.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MAX_BATCH = 16
+REQUEST_SIZES = (1, 2, 3, 8, MAX_BATCH, 3 * MAX_BATCH + 5)
+
+
+def cold_serve(root: str, ref: str, io_path: str, run_log: str) -> int:
+    """The cold-process half: restore -> publish -> serve -> witness.
+    Runs in a FRESH interpreter (no training ever happened here; the
+    only route to a scoring program is the artifact's AOT blobs)."""
+    import numpy as np
+
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.registry.loader import load_servable
+    from ddt_tpu.serve.engine import ServeEngine
+    from ddt_tpu.telemetry import counters as tc
+    from ddt_tpu.telemetry.events import RunLog
+
+    tc.install_jax_listener()
+    with np.load(io_path) as z:
+        X = np.asarray(z["X"])
+        want = np.asarray(z["want"])
+    rl = RunLog(run_log)
+    report = load_servable(root, ref, quantize=False, run_log=rl)
+    assert report.mode == "aot-f32", (
+        f"restore fell back to {report.mode}; the zero-retrace witness "
+        "would be vacuous")
+    before_publish = tc.snapshot()["jit_compiles"]
+    cfg = TrainConfig(backend="tpu",
+                      loss=report.model.ens.loss)
+    engine = ServeEngine(report.model, cfg, max_wait_ms=2.0,
+                        max_batch=MAX_BATCH, run_log=rl)
+    warm_compiles = tc.snapshot()["jit_compiles"]
+    serving_start = tc.snapshot()
+    got = []
+    try:
+        for n in REQUEST_SIZES:
+            got.append(np.asarray(engine.predict(X[:n])))
+        # The counters event IS the run-log witness: jit_compiles over
+        # the serving window, exactly zero when every bucket shape was
+        # pre-traced at export and compiled once at load.
+        delta = tc.delta(serving_start)
+        rl.emit("counters", **delta,
+                device_peak_bytes=tc.device_peak_bytes(),
+                host_peak_rss_bytes=tc.host_peak_rss_bytes())
+        engine.emit_latency(reset=True)
+    finally:
+        engine.close()
+    off = 0
+    for n, g in zip(REQUEST_SIZES, got):
+        w = want[off:off + n]
+        assert np.array_equal(w, g), (
+            f"cold-process scores diverge from the exporting process at "
+            f"request size {n}")
+        off += n
+    out = {
+        "ok": True,
+        "digest": report.digest,
+        "mode": report.mode,
+        "compiles_at_load": warm_compiles,
+        "compiles_serving": delta["jit_compiles"],
+        "requests": len(REQUEST_SIZES),     # engine counts requests,
+        "rows": int(sum(REQUEST_SIZES)),    # not rows
+    }
+    assert warm_compiles > before_publish or warm_compiles > 0, \
+        "compile counter never moved — the witness is not counting"
+    assert delta["jit_compiles"] == 0, (
+        f"{delta['jit_compiles']} jit compiles DURING serving — the "
+        "zero-retrace contract broke")
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def main() -> int:
+    import numpy as np
+
+    from ddt_tpu import api
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.data import datasets
+    from ddt_tpu.telemetry import report as tele_report
+
+    out = {"cmd": "registry_smoke"}
+    with tempfile.TemporaryDirectory() as td:
+        run_log = os.path.join(td, "run.jsonl")
+        model = os.path.join(td, "model.npz")
+        root = os.path.join(td, "registry")
+        io_path = os.path.join(td, "io.npz")
+
+        # 1. train (with a run log: the manifest's run_id is the
+        # provenance key everything downstream joins on) + save.
+        X, y = datasets.synthetic_binary(3000, seed=11)
+        res = api.train(X, y, n_trees=6, max_depth=3, n_bins=31,
+                        backend="tpu", log_every=10**9, run_log=run_log)
+        assert res.run_id, "training with a run log must derive a run_id"
+        res.save(model)
+
+        # 2. push through the REAL CLI, artifact event into the same log.
+        proc = subprocess.run(
+            [sys.executable, "-m", "ddt_tpu.cli", "registry",
+             "--registry", root, "push", "--model", model,
+             "--name", "smoke", "--max-batch", str(MAX_BATCH),
+             "--run-log", run_log],
+            cwd=REPO, capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        push = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert push["version"] == 1
+        out["digest"] = push["digest"]
+
+        # 3. offline reference scores for the cold process to bit-match.
+        cfg = TrainConfig(backend="tpu", n_bins=31)
+        rows = np.concatenate([X[:n] for n in REQUEST_SIZES])
+        want = api.predict(res.ensemble, rows, mapper=res.mapper, cfg=cfg)
+        np.savez(io_path, X=X[:max(REQUEST_SIZES)], want=want)
+
+        # 4. the cold process: fresh interpreter, registry-only restore.
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cold", root,
+             "smoke@1", io_path, run_log],
+            cwd=REPO, capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        cold = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert cold["ok"] and cold["compiles_serving"] == 0
+        assert cold["digest"] == push["digest"]
+        out.update({k: cold[k] for k in
+                    ("mode", "compiles_at_load", "compiles_serving",
+                     "requests")})
+
+        # 5. the run log tells the whole story through `cli report`.
+        events = tele_report.read_events(run_log)
+        summary = tele_report.summarize(events)
+        reg = summary["registry"]
+        assert reg and reg["pushes"] == 1 and reg["loads"] == 1
+        push_ev = next(e for e in reg["events"] if e["action"] == "push")
+        assert push_ev["same_run"], (
+            "the pushed artifact's run_id did not join back to this "
+            "run's manifest")
+        assert reg["digests"] == [push["digest"]]
+        sl = summary["serving"]
+        assert sl and sl["requests"] == cold["requests"]
+        witness = [e for e in events
+                   if e["event"] == "counters"][-1]["jit_compiles"]
+        assert witness == 0, witness
+        rendered = tele_report.render(summary)
+        assert "registry:" in rendered and push["digest"] in rendered
+        out["report_lines"] = len(rendered.splitlines())
+
+    out["ok"] = True
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--cold":
+        sys.exit(cold_serve(*sys.argv[2:6]))
+    sys.exit(main())

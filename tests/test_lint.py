@@ -108,6 +108,25 @@ def test_serve_blocking_io_exempts_transport_and_other_layers():
         assert findings == [], (path, [f.render() for f in findings])
 
 
+def test_atomic_artifact_write_covers_registry():
+    """ISSUE 9: the registry is an artifact-owning module — the SAME
+    violating source that fires under models/ must fire under
+    ddt_tpu/registry/, while the export staging layer stays exempt
+    (its writes land in a staging dir published by one atomic dir
+    rename — see the checker doc)."""
+    src = _fixture_src("atomic_write_pos.py")
+    want = _marker_lines(src, "atomic-artifact-write")
+    got = _flagged_lines("atomic_write_pos.py",
+                         "ddt_tpu/registry/store.py",
+                         "atomic-artifact-write")
+    assert got == want, (sorted(got), sorted(want))
+    for exempt in ("ddt_tpu/export/aot.py", "scripts/registry_smoke.py"):
+        findings = runner.run_on_source(
+            exempt, src, rules={"atomic-artifact-write"})
+        assert findings == [], (exempt,
+                                [f.render() for f in findings])
+
+
 def test_no_print_exempts_cli_and_non_library_paths():
     """The rule is scoped to LIBRARY code: the same print-bearing source
     must not be flagged when it lives in the CLI (stdout is its

@@ -1,0 +1,579 @@
+"""AOT export + digest-addressed model registry (ISSUE 9).
+
+Covers the acceptance surface on the CPU tier: export→load→predict
+bit-exactness against in-process `api.predict` (f32 and quantized),
+zero retracing on the pre-traced bucket shapes (jit_compiles witness),
+registry push atomicity under concurrent writers, corrupt/torn
+artifact rejection, legacy manifest-less back-compat, reference-based
+hot swap, and the schema-v5 `artifact` event → `report` registry
+section round trip. The cold-PROCESS restore is scripts/
+registry_smoke.py's job; everything here runs in-process.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ddt_tpu import api, cli
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.data import datasets
+from ddt_tpu.models.tree import TreeEnsemble
+from ddt_tpu.registry import IntegrityError, Registry, RegistryError
+from ddt_tpu.registry import manifest as manifest_mod
+from ddt_tpu.registry.loader import (RestoredModel, load_servable,
+                                     push_servable)
+from ddt_tpu.serve.engine import ServeEngine
+from ddt_tpu.serve.http import _swap
+from ddt_tpu.telemetry import counters as tele_counters
+from ddt_tpu.telemetry import report as tele_report
+from ddt_tpu.telemetry.events import RunLog
+
+MAX_BATCH = 16          # bucket ladder (1, 2, 4, 8, 16): small, fast AOT
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """One small model + reference config, shared module-wide (training
+    and AOT export are the slow parts)."""
+    X, y = datasets.synthetic_binary(2500, seed=7)
+    res = api.train(X, y, n_trees=6, max_depth=3, n_bins=31,
+                    backend="tpu", log_every=10**9)
+    cfg = TrainConfig(backend="tpu", n_bins=31)
+    cfg_lut = cfg.replace(predict_impl="lut")
+    return dict(X=X, res=res, cfg=cfg, cfg_lut=cfg_lut)
+
+
+@pytest.fixture(scope="module")
+def pushed(trained, tmp_path_factory):
+    """The model exported (f32 + quantized variants) and pushed once."""
+    root = str(tmp_path_factory.mktemp("registry"))
+    bundle = api.ModelBundle(ensemble=trained["res"].ensemble,
+                             mapper=trained["res"].mapper)
+    out = push_servable(root, bundle, name="higgs", max_batch=MAX_BATCH,
+                        quantize=True)
+    return dict(root=root, **out)
+
+
+def _bundle(trained):
+    return api.ModelBundle(ensemble=trained["res"].ensemble,
+                           mapper=trained["res"].mapper)
+
+
+# --------------------------------------------------------------------- #
+# embedded npz manifests (satellite 1)
+# --------------------------------------------------------------------- #
+def test_save_model_embeds_verified_manifest(trained, tmp_path):
+    p = str(tmp_path / "m.npz")
+    api.save_model(p, trained["res"].ensemble, mapper=trained["res"].mapper,
+                   run_id="deadbeef1234", cfg=trained["cfg"])
+    b = api.load_model(p)
+    man = b.manifest
+    assert man is not None
+    assert man["manifest_schema"] == manifest_mod.MANIFEST_SCHEMA
+    assert man["kind"] == "model_bundle"
+    assert man["run_id"] == "deadbeef1234"
+    assert man["config_fingerprint"]
+    assert len(man["digest"]) == 64
+    # The digest covers the payload: same arrays -> same digest.
+    with np.load(p) as z:
+        d = dict(z)
+    assert manifest_mod.arrays_digest(d) == man["digest"]
+
+
+def test_save_model_bytes_are_deterministic(trained, tmp_path):
+    """Content addressing rides on this: the same model saved twice
+    produces IDENTICAL file bytes (zip member timestamps stripped —
+    utils/atomic.atomic_savez deterministic mode), so re-pushing reuses
+    the digest and version instead of minting a new artifact."""
+    import hashlib
+    import time
+
+    a, b = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    api.save_model(a, trained["res"].ensemble, mapper=trained["res"].mapper)
+    time.sleep(0.01)
+    api.save_model(b, trained["res"].ensemble, mapper=trained["res"].mapper)
+    with open(a, "rb") as fa, open(b, "rb") as fb:
+        assert hashlib.sha256(fa.read()).digest() \
+            == hashlib.sha256(fb.read()).digest()
+
+
+def test_load_model_rejects_tampered_payload(trained, tmp_path):
+    p = str(tmp_path / "m.npz")
+    api.save_model(p, trained["res"].ensemble, mapper=trained["res"].mapper)
+    with np.load(p) as z:
+        d = dict(z)
+    d["leaf_value"] = np.array(d["leaf_value"])
+    d["leaf_value"][0, 0] += 1.0          # one flipped leaf
+    np.savez_compressed(str(tmp_path / "evil"), **d)
+    with pytest.raises(IntegrityError, match="digest mismatch"):
+        api.load_model(str(tmp_path / "evil.npz"))
+
+
+def test_legacy_manifestless_npz_still_loads(trained, tmp_path):
+    """Files written before manifests existed carry no manifest_json
+    key and must keep loading (and serving) exactly as before."""
+    p = str(tmp_path / "legacy.npz")
+    d = trained["res"].ensemble.to_dict()
+    d.update({f"mapper_{k}": v
+              for k, v in trained["res"].mapper.save().items()})
+    np.savez_compressed(p, **d)           # the pre-manifest writer
+    b = api.load_model(p)
+    assert b.manifest is None
+    want = api.predict(trained["res"].ensemble, trained["X"][:8],
+                       mapper=trained["res"].mapper, cfg=trained["cfg"])
+    got = api.predict(b, trained["X"][:8], cfg=trained["cfg"])
+    assert np.array_equal(want, got)
+
+
+def test_tree_ensemble_save_carries_manifest(trained, tmp_path):
+    p = str(tmp_path / "ens.npz")
+    trained["res"].ensemble.save(p)
+    # Plain load ignores the manifest key; api.load_model verifies it.
+    ens = TreeEnsemble.load(p)
+    assert ens.n_trees == trained["res"].ensemble.n_trees
+    b = api.load_model(p)
+    assert b.manifest["kind"] == "tree_ensemble"
+    assert b.mapper is None
+
+
+# --------------------------------------------------------------------- #
+# store: push/resolve/list/tag, atomicity, corruption
+# --------------------------------------------------------------------- #
+def _fake_stage(reg: Registry, payload: bytes, kind: str = "servable"
+                ) -> str:
+    """A tiny hand-built artifact (no jax, no export) for store-level
+    tests — content varies with `payload` so digests differ."""
+    stage = reg.stage()
+    with open(os.path.join(stage, "blob.bin"), "wb") as f:
+        f.write(payload)
+    manifest_mod.write_artifact_manifest(stage, {"kind": kind})
+    return stage
+
+
+def test_push_resolve_list_tag_roundtrip(tmp_path):
+    reg = Registry(str(tmp_path / "reg"))
+    d1 = reg.push(_fake_stage(reg, b"one"), "m")
+    d2 = reg.push(_fake_stage(reg, b"two"), "m")
+    assert (d1["version"], d2["version"]) == (1, 2)
+    assert d1["digest"] != d2["digest"]
+    # Every reference form resolves to the same object.
+    for ref in (d1["digest"], d1["digest"][:10], "m@1"):
+        assert reg.resolve(ref) == d1["digest"]
+    for ref in ("m", "m@latest", "m@2"):
+        assert reg.resolve(ref) == d2["digest"]
+    tag = reg.tag("m@1", "prod")
+    assert tag["version"] == 1
+    assert reg.resolve("m@prod") == d1["digest"]
+    inv = reg.list()
+    assert [v["version"] for v in inv["names"]["m"]["versions"]] == [1, 2]
+    assert inv["names"]["m"]["tags"] == {"prod": 1}
+    assert inv["anonymous"] == []
+    # Unknown refs fail loudly with the known inventory in hand.
+    with pytest.raises(RegistryError):
+        reg.resolve("m@3")
+    with pytest.raises(RegistryError):
+        reg.resolve("nosuch")
+    with pytest.raises(RegistryError):
+        reg.tag("m@1", "7")               # numeric tags are reserved
+
+
+def test_push_same_content_is_idempotent(tmp_path):
+    reg = Registry(str(tmp_path / "reg"))
+    a = reg.push(_fake_stage(reg, b"same"), "m")
+    b = reg.push(_fake_stage(reg, b"same"), "m")
+    assert a == b                          # same digest, same version 1
+    assert len(reg.list()["names"]["m"]["versions"]) == 1
+
+
+def test_concurrent_pushers_get_dense_unique_versions(tmp_path):
+    """The push-atomicity acceptance item: racing writers (distinct
+    contents AND a duplicated content) never tear the store — versions
+    come out dense and unique, every object integrity-checks."""
+    reg = Registry(str(tmp_path / "reg"))
+    n_distinct, errs, results = 12, [], []
+    payloads = [f"model-{i}".encode() for i in range(n_distinct)]
+    payloads += [b"model-0"] * 3          # same-content race too
+    stages = [_fake_stage(reg, p) for p in payloads]
+    barrier = threading.Barrier(len(stages))
+
+    def push(stage):
+        try:
+            barrier.wait(timeout=30)
+            results.append(reg.push(stage, "m"))
+        # Worker-thread boundary: every failure must surface in errs,
+        # never die silently on the thread.
+        except Exception as e:  # ddtlint: disable=broad-except
+            errs.append(e)
+
+    threads = [threading.Thread(target=push, args=(s,)) for s in stages]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    versions = sorted(v["version"]
+                      for v in reg.list()["names"]["m"]["versions"])
+    assert versions == list(range(1, n_distinct + 1))
+    dup = [r for r in results if r["digest"] == reg.resolve("m@1")]
+    for r in results:                      # every pusher got a version
+        assert r["version"] in versions
+    assert len({r["digest"] for r in results}) == n_distinct
+    assert len(dup) >= 1
+    for v in versions:                     # every object verifies
+        reg.get(f"m@{v}")
+    # No staging litter became visible as an object.
+    assert len(reg.list()["anonymous"]) == 0
+
+
+def test_corrupt_and_torn_artifacts_are_rejected(tmp_path):
+    reg = Registry(str(tmp_path / "reg"))
+    d = reg.push(_fake_stage(reg, b"payload"), "m")
+    obj = reg.object_dir(d["digest"])
+    # 1. flipped byte in a listed file
+    with open(os.path.join(obj, "blob.bin"), "r+b") as f:
+        f.write(b"X")
+    with pytest.raises(IntegrityError, match="sha256 mismatch"):
+        reg.get("m@1")
+    with open(os.path.join(obj, "blob.bin"), "wb") as f:
+        f.write(b"payload")
+    reg.get("m@1")                         # restored -> verifies again
+    # 2. unlisted foreign file hiding in the object
+    with open(os.path.join(obj, "extra.bin"), "wb") as f:
+        f.write(b"sneaky")
+    with pytest.raises(IntegrityError, match="drifted"):
+        reg.get("m@1")
+    os.remove(os.path.join(obj, "extra.bin"))
+    # 3. manifest rewritten in place (digest no longer matches address)
+    man_path = os.path.join(obj, manifest_mod.MANIFEST_FILE)
+    with open(man_path, encoding="utf-8") as f:
+        man = json.load(f)
+    man["kind"] = "tampered"
+    with open(man_path + ".t", "w", encoding="utf-8") as f:
+        json.dump(man, f, sort_keys=True)
+    os.replace(man_path + ".t", man_path)
+    with pytest.raises(IntegrityError, match="addressed"):
+        reg.get("m@1")
+    # 4. truncated manifest = unreadable artifact
+    with open(man_path, "w", encoding="utf-8") as f:
+        f.write('{"artifact_schema": 1, "files"')
+    with pytest.raises(IntegrityError, match="not valid JSON"):
+        reg.get("m@1")
+
+
+def test_staging_litter_is_invisible(tmp_path):
+    reg = Registry(str(tmp_path / "reg"))
+    reg.push(_fake_stage(reg, b"x"), "m")
+    # A crashed pusher's leftover staging dir must never surface.
+    dead = reg.stage()
+    with open(os.path.join(dead, "half-written"), "wb") as f:
+        f.write(b"torn")
+    inv = reg.list()
+    assert set(inv["names"]) == {"m"}
+    assert inv["anonymous"] == []
+
+
+def test_bad_names_rejected(tmp_path):
+    reg = Registry(str(tmp_path / "reg"))
+    for bad in ("", "a@b", "a/b", ".hidden"):
+        with pytest.raises(RegistryError):
+            reg.push(_fake_stage(reg, b"y"), bad)
+
+
+# --------------------------------------------------------------------- #
+# export -> load -> predict bit-exactness (acceptance)
+# --------------------------------------------------------------------- #
+def test_f32_restore_bitexact_vs_api_predict(trained, pushed):
+    rep = load_servable(pushed["root"], "higgs@1", quantize=False)
+    assert rep.mode == "aot-f32"
+    m = rep.model
+    assert isinstance(m, RestoredModel) and m.aot
+    assert m.artifact_digest == pushed["digest"]
+    m.warmup()
+    X = trained["X"]
+    # Sweep request sizes across buckets INCLUDING an over-sized one
+    # (beyond the exported cap -> largest-bucket chunking).
+    for n in (1, 3, 8, MAX_BATCH, 5 * MAX_BATCH + 3):
+        want = api.predict(trained["res"].ensemble, X[:n],
+                           mapper=trained["res"].mapper,
+                           cfg=trained["cfg"])
+        got = m.score_binned(trained["res"].mapper.transform(X[:n]))
+        assert np.array_equal(np.asarray(want), np.asarray(got)), n
+
+
+def test_lut_restore_bitexact_and_bounded(trained, pushed):
+    rep = load_servable(pushed["root"], pushed["digest"])  # follows artifact
+    assert rep.mode == "aot-lut"
+    m = rep.model
+    assert m.quantized and m.max_abs_err > 0
+    m.warmup()
+    X = trained["X"]
+    for n in (1, 7, MAX_BATCH):
+        want = api.predict(trained["res"].ensemble, X[:n],
+                           mapper=trained["res"].mapper,
+                           cfg=trained["cfg_lut"])
+        got = m.score_binned(trained["res"].mapper.transform(X[:n]))
+        assert np.array_equal(np.asarray(want), np.asarray(got)), n
+
+
+def test_restore_rejects_model_blob_swap(trained, pushed, tmp_path):
+    """model.npz and the AOT programs must agree: an object whose model
+    file was swapped for a DIFFERENT (valid, digest-consistent at the
+    npz level) model fails the manifest token pin, not silently serves
+    the wrong trees with the old programs' shapes."""
+    root2 = str(tmp_path / "reg2")
+    src = Registry(pushed["root"]).object_dir(pushed["digest"])
+    dst = Registry(root2).object_dir(pushed["digest"])
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    shutil.copytree(src, dst)
+    other = api.train(trained["X"][:, :],
+                      (trained["X"][:, 0] < 0).astype(np.float32),
+                      n_trees=6, max_depth=3, n_bins=31, backend="tpu",
+                      log_every=10**9)
+    api.save_model(os.path.join(dst, "model.npz"), other.ensemble,
+                   mapper=other.mapper)
+    # File-level integrity catches it first (sha256 of model.npz).
+    with pytest.raises(IntegrityError):
+        load_servable(root2, pushed["digest"])
+
+
+def test_zero_retrace_on_pretraced_buckets(trained, pushed):
+    """The acceptance witness, in-process form: after warmup, scoring
+    every exported bucket shape (and oversize chunked requests) causes
+    ZERO further XLA compiles — the jit_compiles counter the smoke
+    asserts from a genuinely cold process."""
+    rep = load_servable(pushed["root"], "higgs", quantize=False)
+    m = rep.model
+    m.warmup()
+    Xb = trained["res"].mapper.transform(trained["X"])
+    tele_counters.install_jax_listener()
+    before = tele_counters.snapshot()["jit_compiles"]
+    for n in (1, 2, 3, 4, 8, 15, MAX_BATCH, 3 * MAX_BATCH):
+        m.score_binned(Xb[:n])
+    assert tele_counters.snapshot()["jit_compiles"] - before == 0
+
+
+# --------------------------------------------------------------------- #
+# engine integration: publish, digest stamping, swap by reference
+# --------------------------------------------------------------------- #
+def test_engine_serves_restored_model_and_stamps_digest(trained, pushed):
+    rep = load_servable(pushed["root"], "higgs@1", quantize=False)
+    rl = RunLog()
+    eng = ServeEngine(rep.model, trained["cfg"], max_wait_ms=5.0,
+                      max_batch=MAX_BATCH, run_log=rl)
+    try:
+        X = trained["X"]
+        got = eng.predict(X[:5])
+        want = api.predict(trained["res"].ensemble, X[:5],
+                           mapper=trained["res"].mapper,
+                           cfg=trained["cfg"])
+        assert np.array_equal(np.asarray(want), np.asarray(got))
+        out = eng.emit_latency(reset=True)
+        assert out["artifact_digest"] == pushed["digest"]
+        ev = rl.events("serve_latency")[-1]
+        assert ev["artifact_digest"] == pushed["digest"]
+        assert eng.health()["artifact_digest"] == pushed["digest"]
+        assert eng.health()["aot"] is True
+    finally:
+        eng.close()
+
+
+def test_swap_by_registry_reference(trained, pushed):
+    """The HTTP /swap body path: a file path still works, and with a
+    registry root a name@version reference restores + swaps — the
+    hot_swap fault event carries both artifact digests."""
+    rl = RunLog()
+    eng = ServeEngine(_bundle(trained), trained["cfg"], max_wait_ms=5.0,
+                      max_batch=MAX_BATCH, run_log=rl)
+    try:
+        with pytest.raises(ValueError, match="without --registry"):
+            _swap(eng, "higgs@1")
+        eng.registry_root = pushed["root"]
+        out = _swap(eng, "higgs@1")
+        assert out["artifact_digest"] == pushed["digest"]
+        assert out["mode"] == "aot-f32"
+        assert eng.model_token == trained["res"].ensemble.cache_token()
+        ev = [e for e in rl.events("fault") if e["kind"] == "hot_swap"][-1]
+        assert ev["new_artifact"] == pushed["digest"]
+        assert ev["old_artifact"] is None
+        # Scores after the swap come from the restored AOT model.
+        got = eng.predict(trained["X"][:3])
+        want = api.predict(trained["res"].ensemble, trained["X"][:3],
+                           mapper=trained["res"].mapper,
+                           cfg=trained["cfg"])
+        assert np.array_equal(np.asarray(want), np.asarray(got))
+        with pytest.raises(RegistryError):
+            _swap(eng, "higgs@99")
+    finally:
+        eng.close()
+
+
+def test_fallback_rebuild_on_foreign_platform(trained, pushed,
+                                              monkeypatch):
+    """The CPU-fallback ladder: when no AOT blob covers the serving
+    platform, the loader rebuilds in-process from model.npz — same
+    artifact, same answers, honestly reported as a rebuild."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "neverland")
+    rep = load_servable(pushed["root"], "higgs@1", quantize=False,
+                        cfg=trained["cfg"])
+    assert rep.mode == "rebuild"
+    assert not rep.model.aot
+    assert rep.model.artifact_digest == pushed["digest"]
+    monkeypatch.undo()
+    rep.model.warmup()
+    got = rep.model.score_binned(
+        trained["res"].mapper.transform(trained["X"][:6]))
+    want = api.predict(trained["res"].ensemble, trained["X"][:6],
+                       mapper=trained["res"].mapper, cfg=trained["cfg"])
+    assert np.array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_tables_fallback_serves_carried_tables(trained, pushed,
+                                               monkeypatch):
+    """quantize=True on a platform no LUT blob covers: the loader still
+    serves the CARRIED lut_tables.npz (token-pinned, memo-seeded into
+    the compiled model so the backend's dispatch consumes it), never a
+    re-quantization — the manifest's error bound keeps describing what
+    actually serves."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "neverland")
+    rep = load_servable(pushed["root"], "higgs@1", quantize=True,
+                        cfg=trained["cfg_lut"])
+    assert rep.mode == "tables-fallback"
+    assert not rep.model.aot
+    monkeypatch.undo()
+    assert rep.model.tables.token == rep.manifest["model_token"]
+    assert rep.model.max_abs_err == \
+        rep.manifest["quantized"]["max_abs_err"]
+    # The seeded memo IS the dispatch source: quantize() returns the
+    # carried object itself, so the backend cannot re-derive.
+    assert rep.model.compiled.quantize() is rep.model.tables
+    rep.model.warmup()
+    got = rep.model.score_binned(
+        trained["res"].mapper.transform(trained["X"][:6]))
+    want = api.predict(trained["res"].ensemble, trained["X"][:6],
+                       mapper=trained["res"].mapper,
+                       cfg=trained["cfg_lut"])
+    assert np.array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_stage_sweeps_stale_crash_litter(tmp_path):
+    """A SIGKILLed pusher's stage never runs its cleanup; the next
+    stage() reclaims it once it ages past the sweep threshold — without
+    touching a fresh (possibly live) concurrent stage."""
+    from ddt_tpu.registry import store as store_mod
+
+    reg = Registry(str(tmp_path / "reg"))
+    stale = reg.stage()
+    old = time.time() - 2 * store_mod._STAGE_SWEEP_AGE_S
+    os.utime(stale, (old, old))
+    fresh = reg.stage()
+    reg.stage()
+    assert not os.path.isdir(stale)
+    assert os.path.isdir(fresh)
+
+
+def test_quantized_restore_without_lut_export_refused(trained, tmp_path):
+    root = str(tmp_path / "reg")
+    push_servable(root, _bundle(trained), name="f32only",
+                  max_batch=8, quantize=False)
+    with pytest.raises(ValueError, match="without the quantized"):
+        load_servable(root, "f32only", quantize=True)
+
+
+# --------------------------------------------------------------------- #
+# telemetry: artifact events, report registry section, back-compat
+# --------------------------------------------------------------------- #
+def test_artifact_events_flow_into_report(trained, tmp_path):
+    root = str(tmp_path / "reg")
+    log_path = str(tmp_path / "run.jsonl")
+    with RunLog(log_path) as rl:
+        rl.emit("run_manifest", trainer="driver", backend="tpu",
+                loss="logloss", n_trees=6, max_depth=3, rows=100,
+                features=8, run_id="feedface0001")
+        out = push_servable(root, _bundle(trained), name="m",
+                            max_batch=8, run_id="feedface0001",
+                            run_log=rl)
+        load_servable(root, "m@1", quantize=False, run_log=rl)
+        rl.emit("run_end", completed_rounds=6, wallclock_s=0.1)
+    events = tele_report.read_events(log_path)
+    summary = tele_report.summarize(events)
+    r = summary["registry"]
+    assert r["pushes"] == 1 and r["loads"] == 1
+    assert r["digests"] == [out["digest"]]
+    push_ev = next(e for e in r["events"] if e["action"] == "push")
+    assert push_ev["name"] == "m" and push_ev["version"] == 1
+    assert push_ev["same_run"] is True     # run_id joins to the manifest
+    load_ev = next(e for e in r["events"] if e["action"] == "load")
+    assert load_ev["mode"] == "aot-f32"
+    text = tele_report.render(summary)
+    assert "registry: 1 push(es), 1 load(s)" in text
+    assert out["digest"] in text
+    assert "(this run)" in text
+
+
+def test_v4_logs_still_parse(tmp_path):
+    """Back-compat: a pre-registry (schema v4) log reads through report
+    with registry=None — no required field changed."""
+    p = str(tmp_path / "v4.jsonl")
+    recs = [
+        {"event": "run_manifest", "schema": 4, "t": 1.0, "seq": 0,
+         "trainer": "driver", "backend": "tpu", "loss": "logloss",
+         "n_trees": 2, "max_depth": 3, "rows": 10, "features": 4},
+        {"event": "serve_latency", "schema": 4, "t": 2.0, "seq": 1,
+         "requests": 5, "p50_ms": 1.0, "p99_ms": 2.0,
+         "model_token": "abc123"},
+        {"event": "run_end", "schema": 4, "t": 3.0, "seq": 2,
+         "completed_rounds": 2, "wallclock_s": 0.5},
+    ]
+    with open(p, "w", encoding="utf-8") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    summary = tele_report.summarize(tele_report.read_events(p))
+    assert summary["registry"] is None
+    assert summary["serving"]["requests"] == 5
+    tele_report.render(summary)
+
+
+# --------------------------------------------------------------------- #
+# CLI round trip
+# --------------------------------------------------------------------- #
+def test_cli_registry_workflow(trained, tmp_path, capsys):
+    model = str(tmp_path / "model.npz")
+    root = str(tmp_path / "reg")
+    api.save_model(model, trained["res"].ensemble,
+                   mapper=trained["res"].mapper, cfg=trained["cfg"])
+    assert cli.main(["registry", "--registry", root, "push",
+                     "--model", model, "--name", "cli-model",
+                     "--max-batch", "8"]) == 0
+    push = json.loads(capsys.readouterr().out)
+    assert push["version"] == 1 and len(push["digest"]) == 16
+    assert cli.main(["registry", "--registry", root, "tag",
+                     "cli-model@1", "prod"]) == 0
+    capsys.readouterr()
+    assert cli.main(["registry", "--registry", root, "list",
+                     "--json"]) == 0
+    inv = json.loads(capsys.readouterr().out)
+    assert inv["names"]["cli-model"]["tags"] == {"prod": 1}
+    assert cli.main(["registry", "--registry", root, "get",
+                     "cli-model@prod"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got["digest"] == push["digest"]
+    assert got["manifest"]["kind"] == "servable"
+    assert got["manifest"]["buckets"] == [1, 2, 4, 8]
+    # Idempotent re-push: same content, same version.
+    assert cli.main(["registry", "--registry", root, "push",
+                     "--model", model, "--name", "cli-model",
+                     "--max-batch", "8"]) == 0
+    assert json.loads(capsys.readouterr().out)["version"] == 1
+    # Unknown reference exits cleanly with the CLI's message, not a
+    # traceback.
+    with pytest.raises(SystemExit, match="registry get"):
+        cli.main(["registry", "--registry", root, "get", "ghost@9"])

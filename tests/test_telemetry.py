@@ -69,6 +69,11 @@ def test_runlog_emits_and_round_trips_every_event_type(tmp_path):
             phases=[{"phase": "grow", "ms_max": 2.0, "ms_median": 1.75,
                      "skew": 1.143, "max_device": 1}],
             n_partitions=2),
+        # Schema v5 (AOT export + model registry): one artifact
+        # lifecycle step (registry push / loader restore).
+        "artifact": dict(action="push", digest="a1b2c3d4e5f60718",
+                         name="higgs", version=3, kind="servable",
+                         run_id="58226c4d64f0", mode=None),
         # Schema v4 (low-latency serving tier): one SLO window from
         # ServeEngine.emit_latency.
         "serve_latency": dict(requests=100, p50_ms=0.8, p99_ms=2.5,

@@ -667,17 +667,24 @@ class AtomicArtifactWriteChecker(Checker):
     a TORN artifact at the canonical name, which a later resume/load
     then chokes on (the checkpoint-hardening bug class,
     docs/ROBUSTNESS.md). Scoped to the artifact-owning modules
-    (utils/checkpoint.py, api.py, models/, data/chunks.py); a write is
-    compliant when its path expression is tmp-like — a name/attribute/
-    literal containing "tmp", or anything tempfile-derived — because
-    the tmp-name-then-replace dance is exactly the pattern the rule
-    exists to enforce. Read modes and append modes are exempt (appends
-    are logs, not artifact overwrites; the run log's crash story is
-    line-granularity by design)."""
+    (utils/checkpoint.py, api.py, models/, data/chunks.py, and — since
+    the model registry (ISSUE 9) — ddt_tpu/registry/, whose manifests
+    and name indexes are exactly the small-JSON-beside-big-npz pair the
+    checkpoint hardening story is about); a write is compliant when its
+    path expression is tmp-like — a name/attribute/literal containing
+    "tmp", or anything tempfile-derived — because the
+    tmp-name-then-replace dance is exactly the pattern the rule exists
+    to enforce. Read modes and append modes are exempt (appends are
+    logs, not artifact overwrites; the run log's crash story is
+    line-granularity by design). ddt_tpu/export/ stays OUT of scope by
+    design: its writers only ever target a registry STAGING directory,
+    which publishes wholesale via one atomic os.rename
+    (registry/store.py) — the directory is the tmp sibling."""
 
     rule = "atomic-artifact-write"
     path_scope = (r"^ddt_tpu/utils/checkpoint\.py$", r"^ddt_tpu/api\.py$",
-                  r"^ddt_tpu/models/", r"^ddt_tpu/data/chunks\.py$")
+                  r"^ddt_tpu/models/", r"^ddt_tpu/data/chunks\.py$",
+                  r"^ddt_tpu/registry/")
     _WRITERS = {"np.save", "np.savez", "np.savez_compressed",
                 "numpy.save", "numpy.savez", "numpy.savez_compressed"}
 
