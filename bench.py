@@ -199,6 +199,16 @@ AB64_RATIO_FLOOR = 1.05
 # that silently fell out of the dispatch measures ~1.0; 1.05 separates
 # the two from any tunnel band (both arms of a pair share the band).
 HIST_FUSED_AB_FLOOR = 1.05
+# Split-comms paired ratio (ISSUE 10, chip only): reduce-scatter split
+# finding cuts per-level collective bytes >= 2x (the payload_ratio stamp
+# is deterministic math and asserted in tests; at the Higgs shape over 8
+# shards it is ~3.5x) and must never cost wallclock — ratio ~1.0 on a
+# single-host mesh (localhost "wire"), > 1.0 once a real ICI/DCN fabric
+# carries the histograms. ENCODED-BUT-UNWITNESSED: no post-landing chip
+# artifact exists yet (rounds 7+ ran CPU-only); re-calibrate against the
+# first two chip artifacts per docs/PERF.md "Histogram comms"
+# (Re-calibration status), ratcheting UP if the fabric win is real.
+HIST_COMMS_AB_FLOOR = 1.0
 # Cross-platform training parity (experiments/chip_parity.py): 2-4/155
 # split flips from MXU f32 summation order straddling bf16 gain-rounding
 # ties; quality-equivalent. Wider divergence means a real kernel bug.
@@ -311,6 +321,19 @@ def main() -> None:
         fab = bench_hist_fused_ab(rows=rows, features=features, bins=bins,
                                   depth=depth)
 
+    # Split-comms paired A/B (ISSUE 10): allreduce vs reduce_scatter
+    # split finding on the pod mesh. Real chip only in the headline run
+    # (the CPU multi-device twin lives in tier-1 as
+    # tests/test_comms.py::test_bench_hist_comms_ab_smoke); the
+    # deterministic payload ratio is stamped either way via the counter
+    # model.
+    cab = None
+    if on_tpu and len(jax.devices()) > 1:
+        from ddt_tpu.bench import bench_hist_comms_ab
+
+        cab = bench_hist_comms_ab(rows=rows, features=features, bins=bins,
+                                  depth=depth)
+
     # Scoring config: device-resident (floored) + total (context) +
     # compute-only (floored, band-stable), one shared
     # dataset/ensemble/warm-up.
@@ -394,6 +417,16 @@ def main() -> None:
             fab.get("hist_fused_roofline_flops_util") if fab else None,
         "hist_fused_roofline_hbm_util":
             fab.get("hist_fused_roofline_hbm_util") if fab else None,
+        # Split-comms A/B (ISSUE 10): paired wallclock ratio (chip pod
+        # mesh only) + the deterministic per-tree payload ratio from the
+        # corrected hist_allreduce_bytes model — >= 2x is the acceptance
+        # bar, witnessed in-process by tests/test_comms.py.
+        "hist_comms_ab_ratio":
+            round(cab["ratio_allreduce_over_rs"], 3) if cab else None,
+        "hist_comms_payload_ratio":
+            cab["payload_ratio"] if cab else None,
+        "hist_comms_rs_mrows_per_sec":
+            round(cab["mrows_rs"], 2) if cab else None,
         "predict_mrows_per_sec": round(pr["mrows_per_sec"], 2),
         "predict_total_s": round(pr_total["wallclock_s"], 2),
         "predict_compute_mrows_per_sec": round(pr_comp["mrows_per_sec"], 2),
@@ -525,6 +558,13 @@ def main() -> None:
             f"{fab['ratio_on_over_off']:.3f} < {HIST_FUSED_AB_FLOOR} "
             "(the sibling-subtraction trick fell out of the level loop — "
             "ops/grow.level_histograms; docs/PERF.md Training kernel)")
+    if cab is not None \
+            and cab["ratio_allreduce_over_rs"] < HIST_COMMS_AB_FLOOR:
+        fails.append(
+            f"split-comms paired ratio "
+            f"{cab['ratio_allreduce_over_rs']:.3f} < {HIST_COMMS_AB_FLOOR} "
+            "(reduce-scatter split finding costs wallclock on a real "
+            "fabric — parallel/comms.py; docs/PERF.md Histogram comms)")
     if lab is not None \
             and lab["ratio_lut_over_f32"] < PREDICT_LUT_AB_FLOOR:
         fails.append(

@@ -51,6 +51,13 @@ _JIT_FIELDS = (
     "reg_lambda", "min_child_weight", "min_split_gain",
     "hist_impl", "predict_impl", "matmul_input_dtype", "missing_policy",
     "cat_features", "subsample",
+    # Trace-shaping comms + kernel-phasing knobs: the resolved collective
+    # mode/dtype/slab count and the sibling-subtraction flag all bake
+    # into the compiled grow/stream programs — a cached instance reused
+    # across them would train with the wrong collectives (the A/B benches
+    # and the comms parity tests flip exactly these).
+    "hist_subtraction", "split_comms", "hist_comms_dtype",
+    "hist_comms_slabs",
 )
 
 

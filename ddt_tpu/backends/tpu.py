@@ -49,6 +49,7 @@ from ddt_tpu.ops import grow as grow_ops
 from ddt_tpu.ops import histogram as hist_ops
 from ddt_tpu.ops import predict as predict_ops
 from ddt_tpu.ops import split as split_ops
+from ddt_tpu.parallel import comms as comms_lib
 from ddt_tpu.parallel import mesh as mesh_lib
 from ddt_tpu.robustness import emit_fault, faultplan
 from ddt_tpu.telemetry import counters as tele_counters
@@ -71,12 +72,11 @@ HAXIS = "hosts"  # cross-slice DCN axis (SURVEY.md §5 "Distributed comm
 def _axis_allreduce(axis):
     """Collective-or-identity reducer over `axis` (None = single shard):
     (x, op) with op in sum|min|max — the ONE home of the psum/pmin/pmax
-    dispatch the metric twins and loss reductions share."""
+    dispatch the metric twins and loss reductions share (collectives
+    themselves spelled in parallel/comms.py, the one-home module)."""
     def allreduce(x, op="sum"):
-        if axis is None:
-            return x
-        return {"sum": jax.lax.psum, "min": jax.lax.pmin,
-                "max": jax.lax.pmax}[op](x, axis)
+        return {"sum": comms_lib.psum, "min": comms_lib.pmin,
+                "max": comms_lib.pmax}[op](x, axis)
 
     return allreduce
 
@@ -210,9 +210,49 @@ class TPUDevice(DeviceBackend):
         self._row_axes = (
             (HAXIS, AXIS) if self.host_partitions > 1 else AXIS)
         self._input_dtype = jnp.dtype(cfg.matmul_input_dtype)
+        # Split-finding comms, resolved ONCE at backend construction so
+        # a forced-but-impossible combination (reduce_scatter on a
+        # feature-sharded mesh) fails here, not mid-trace, and every
+        # program this backend builds — fused, granular, streamed — and
+        # the telemetry payload model all read the same answer.
+        self.split_comms = comms_lib.resolve_split_comms(
+            cfg.split_comms, distributed=self.distributed,
+            feature_partitions=self.feature_partitions)
+        # Host-FETCH histogram surfaces (the granular build_histograms
+        # and the streamed hist ops) return the table to the host; under
+        # reduce_scatter that output is row-sharded, which a
+        # multi-process mesh cannot np.asarray (shards span other
+        # processes' devices). Those surfaces therefore fall back to
+        # allreduce on multi-process meshes — the fused in-trace path
+        # keeps the scatter (its histograms never leave the program).
+        self.stream_hist_comms = (
+            self.split_comms if jax.process_count() == 1 else "allreduce")
+        self.comms_slabs = comms_lib.resolve_comms_slabs(
+            cfg.hist_comms_slabs, distributed=self.distributed)
         # Sticky position on the histogram OOM-degradation ladder
         # (build_histograms below): 0 = the configured impl.
         self._hist_degrade = 0
+
+    def collective_bytes_per_tree(self, n_features: int,
+                                  streamed: bool = False) -> int:
+        """Effective per-tree histogram-collective payload estimate for
+        THIS backend's resolved comms configuration (mode, wire dtype,
+        sibling subtraction) — the one home the Driver and the streaming
+        trainers record into `hist_allreduce_bytes` (telemetry.counters
+        documents the model). `streamed=True` reads the host-fetch
+        surfaces' mode (stream_hist_comms — allreduce on multi-process
+        meshes). Zero on single-device backends."""
+        if not self.distributed:
+            return 0
+        from ddt_tpu.ops.grow import resolve_hist_subtraction
+
+        return tele_counters.hist_allreduce_bytes(
+            self.cfg.max_depth, n_features, self.cfg.n_bins,
+            partitions=self.row_shards,
+            mode=self.stream_hist_comms if streamed else self.split_comms,
+            comms_dtype=self.cfg.hist_comms_dtype,
+            subtraction=resolve_hist_subtraction(self.cfg.hist_subtraction),
+        )
 
     # ------------------------------------------------------------------ #
     # sharding helpers
@@ -320,6 +360,7 @@ class TPUDevice(DeviceBackend):
             return unsupported
 
         rax = self._row_axes
+        rs = self.stream_hist_comms == "reduce_scatter"
 
         def hist(Xb, g, h, node_index, *, n_nodes):
             # impl resolution happens inside build_histograms with the full
@@ -330,20 +371,34 @@ class TPUDevice(DeviceBackend):
                 input_dtype=self._input_dtype,
             )
             if self.distributed:
-                # The fabric-allreduce analog; over (hosts, rows) XLA phases
-                # it ICI-reduce first, then the cross-slice DCN hop.
-                out = jax.lax.psum(out, rax)
+                # The fabric-allreduce analog (parallel/comms.py); over
+                # (hosts, rows) XLA phases it ICI-reduce first, then the
+                # cross-slice DCN hop. Under split_comms=reduce_scatter
+                # each shard keeps only its merged F/P slab on device —
+                # the host reassembles the full table from the sharded
+                # output at D2H time, so the WIRE pays the scatter cost
+                # while the caller contract is unchanged.
+                if rs:
+                    out = comms_lib.pad_to_multiple(out, 1, self.row_shards)
+                out = comms_lib.hist_reduce(
+                    out, rax,
+                    mode="reduce_scatter" if rs else "allreduce",
+                    comms_dtype=cfg.hist_comms_dtype, scatter_dim=1)
             return out
 
         if self.distributed:
             def sharded(Xb, g, h, node_index, *, n_nodes):
+                out_specs = P(None, rax) if rs else P()
                 f = mesh_lib.shard_map(
                     functools.partial(hist, n_nodes=n_nodes),
                     mesh=self.mesh,
                     in_specs=(P(rax, None), P(rax), P(rax), P(rax)),
-                    out_specs=P(),
+                    out_specs=out_specs,
                 )
-                return f(Xb, g, h, node_index)
+                out = f(Xb, g, h, node_index)
+                if rs and out.shape[1] != Xb.shape[1]:
+                    out = out[:, :Xb.shape[1]]   # drop scatter pad columns
+                return out
             self._hist_fns[key] = sharded
             return sharded
         self._hist_fns[key] = hist
@@ -491,6 +546,9 @@ class TPUDevice(DeviceBackend):
                 missing_bin=cfg.missing_policy == "learn",
                 cat_features=cfg.cat_features,
                 hist_subtraction=subtract,
+                split_comms=self.split_comms,
+                hist_comms_dtype=cfg.hist_comms_dtype,
+                comms_slabs=self.comms_slabs,
             )
             delta = grow_ops.tree_predict_delta(tree, cfg.learning_rate)
             # Pack the tiny node arrays into ONE f32 array so the host
@@ -526,8 +584,11 @@ class TPUDevice(DeviceBackend):
                 # replicated row vectors with identical programs on every
                 # shard; routing values ride a psum).
                 # The static VMA checker cannot see through the gathered
-                # argmax, so it is disabled for this path only.
-                check_vma=faxis is None,
+                # argmax, so it is disabled for that path — and for
+                # reduce-scatter split finding, whose winner combine is
+                # the same gathered-argmax shape over the row axes.
+                check_vma=(faxis is None
+                           and self.split_comms != "reduce_scatter"),
             )
         # Cost observatory registration: on telemetry runs the first call
         # per shape pulls XLA's cost/memory analysis for the whole
@@ -800,6 +861,9 @@ class TPUDevice(DeviceBackend):
                         missing_bin=missing,
                         cat_features=cfg.cat_features,
                         hist_subtraction=subtract,
+                        split_comms=self.split_comms,
+                        hist_comms_dtype=cfg.hist_comms_dtype,
+                        comms_slabs=self.comms_slabs,
                     )
                     delta = grow_ops.tree_predict_delta(
                         tree, cfg.learning_rate)
@@ -889,8 +953,10 @@ class TPUDevice(DeviceBackend):
                 out_specs=out_specs,
                 # Same rationale as _build_grow_fn: tree outputs are
                 # replicated bit-identically by construction; the static
-                # VMA checker cannot see through the gathered argmax.
-                check_vma=faxis is None,
+                # VMA checker cannot see through the gathered argmax
+                # (feature-parallel OR reduce-scatter winner combine).
+                check_vma=(faxis is None
+                           and self.split_comms != "reduce_scatter"),
             )
         # Both block-reassigned prediction buffers are donated (the Driver
         # rebinds pred AND val_pred from the return every block).
@@ -966,7 +1032,7 @@ class TPUDevice(DeviceBackend):
                 # state itself would fail on a multi-host mesh (spans
                 # non-addressable devices).
                 gathered = (
-                    jax.lax.all_gather(pred, rax, axis=0, tiled=True)
+                    comms_lib.all_gather(pred, rax, axis=0, tiled=True)
                     if self.distributed else pred
                 )
                 return pred, gathered
@@ -1062,8 +1128,9 @@ class TPUDevice(DeviceBackend):
     def _stream_cache(self) -> dict:
         return {}
 
-    def _stream_fn(self, kind: str, depth: int, class_idx: int):
-        key = (kind, depth, class_idx)
+    def _stream_fn(self, kind: str, depth: int, class_idx: int,
+                   left: bool = False):
+        key = (kind, depth, class_idx, left)
         fn = self._stream_cache.get(key)
         if fn is not None:
             return fn
@@ -1071,6 +1138,8 @@ class TPUDevice(DeviceBackend):
         from ddt_tpu.ops import stream as stream_ops
 
         cfg = self.cfg
+        comms_mode = self.stream_hist_comms
+        comms_dtype = cfg.hist_comms_dtype
         if self.feature_partitions > 1:
             raise NotImplementedError(
                 "streaming with feature_partitions > 1 is not wired; "
@@ -1103,6 +1172,8 @@ class TPUDevice(DeviceBackend):
                     input_dtype=self._input_dtype, axis_name=axis,
                     missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
                     row_keep=row_keep_for(Xb, *bag) if bag else None,
+                    comms_mode=comms_mode, comms_dtype=comms_dtype,
+                    build_left=left,
                 )
         elif kind == "leaf":
             def f(Xb, pred, y, valid, feat, thr, leaf, dl, *bag):
@@ -1139,12 +1210,20 @@ class TPUDevice(DeviceBackend):
                     input_dtype=self._input_dtype, axis_name=axis,
                     missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
                     row_keep=row_keep_for(Xb, *bag) if bag else None,
+                    comms_mode=comms_mode, comms_dtype=comms_dtype,
                 )
         else:  # pragma: no cover
             raise ValueError(kind)
 
         if self.distributed:
             rax = self._row_axes
+            # Under split_comms=reduce_scatter the streamed histogram
+            # outputs come back F-sharded over the row axes (the wire
+            # moved one slab per shard); the trainers slice the scatter
+            # pad columns off after fetch.
+            hist_spec = (P(None, rax)
+                         if self.stream_hist_comms == "reduce_scatter"
+                         else P())
             bag_specs = (P(), P(), P()) if bagged else ()
             pred_spec = P(rax, None) if softmax else P(rax)
             if kind == "update":
@@ -1154,7 +1233,11 @@ class TPUDevice(DeviceBackend):
             elif kind == "roundstart":
                 in_specs = (P(rax, None), pred_spec, P(rax), P(rax)) + \
                     (P(),) * (5 * depth) + bag_specs
-                out_specs = (pred_spec, P())
+                out_specs = (pred_spec, hist_spec)
+            elif kind == "hist":
+                in_specs = (P(rax, None), pred_spec, P(rax), P(rax),
+                            P(), P(), P(), P()) + bag_specs
+                out_specs = hist_spec
             else:
                 in_specs = (P(rax, None), pred_spec, P(rax), P(rax),
                             P(), P(), P(), P()) + bag_specs
@@ -1187,14 +1270,21 @@ class TPUDevice(DeviceBackend):
 
     def stream_level_hist(self, data, pred, y: "LabelHandle", tree,
                           depth: int, class_idx: int = 0,
-                          rnd: int = 0, row_start: int = 0):
+                          rnd: int = 0, row_start: int = 0,
+                          build_left: bool = False):
         """Partial histogram [2^depth, F, B, 2] for one uploaded chunk
-        (device handle; includes the cross-shard psum). `tree` is the
-        partial tree's host arrays (feature, threshold_bin, is_leaf,
-        default_left). `rnd`/`row_start` feed the counter-based bagging
-        mask when cfg.subsample < 1 (ignored otherwise)."""
+        (device handle; includes the cross-shard collective — psum, or
+        the F/P reduce-scatter under split_comms=reduce_scatter, where
+        the handle comes back F-sharded with zero pad columns the caller
+        slices off). `tree` is the partial tree's host arrays (feature,
+        threshold_bin, is_leaf, default_left). `rnd`/`row_start` feed
+        the counter-based bagging mask when cfg.subsample < 1 (ignored
+        otherwise). `build_left=True` is the streamed sibling-
+        subtraction half-build: [2^(depth-1), F, B, 2] LEFT children
+        keyed by parent slot (streaming._assemble_subtracted_level
+        recovers the right children)."""
         feat, thr, leaf, dl = tree
-        return self._stream_fn("hist", depth, class_idx)(
+        return self._stream_fn("hist", depth, class_idx, left=build_left)(
             data, pred, y.y, y.valid, feat, thr, leaf, dl,
             *self._bag_args(rnd, row_start))
 

@@ -299,6 +299,100 @@ def bench_hist_fused_ab(
     return out
 
 
+def bench_hist_comms_ab(
+    rows: int = 1_000_000,
+    features: int = 28,
+    bins: int = 255,
+    depth: int = 6,
+    iters: int = 4,
+    reps: int = 8,
+    seed: int = 0,
+    host_partitions: int | None = None,
+    n_partitions: int | None = None,
+) -> dict:
+    """PAIRED split-comms A/B on the pod mesh: the whole per-tree fused
+    level loop with split_comms="allreduce" vs "reduce_scatter", same
+    data, same mesh (docs/PERF.md "Histogram comms"). Default mesh is
+    the pod shape — hosts x rows over every visible device (2 x N/2 when
+    >= 4 devices, so the collective crosses the mesh's slow outer axis)
+    — which is the CPU multi-device harness in tier-1 and the real
+    ICI+DCN fabric on a chip image.
+
+    Same statistic as bench_hist_fused_ab: per-rep PAIRED ratio with the
+    arm order alternating every rep, median-of-ratios as the A/B
+    evidence (ratio_allreduce_over_rs > 1 means reduce-scatter wins),
+    min-of-reps per-arm timing as the headline. The deterministic
+    per-level payload ratio (telemetry.counters.hist_allreduce_bytes,
+    both modes) is stamped alongside — wallclock on a one-host virtual
+    mesh moves little (localhost "wire"), the payload model is the
+    invariant, and the chip floor (HIST_COMMS_AB_FLOOR) guards the
+    wallclock side where a real fabric exists."""
+    import jax
+
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.telemetry import counters as tele_counters
+    from ddt_tpu.utils.device import device_sync as sync
+
+    n_dev = len(jax.devices())
+    if host_partitions is None or n_partitions is None:
+        if n_dev >= 4:
+            host_partitions, n_partitions = 2, n_dev // 2
+        else:
+            host_partitions, n_partitions = 1, max(1, n_dev)
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, bins, size=(rows, features), dtype=np.uint8)
+    g = rng.standard_normal(rows).astype(np.float32)
+    h = (rng.random(rows) + 0.5).astype(np.float32)
+
+    arms = {}
+    for mode in ("allreduce", "reduce_scatter"):
+        cfg = TrainConfig(
+            backend="tpu", n_bins=bins, max_depth=depth,
+            host_partitions=host_partitions, n_partitions=n_partitions,
+            split_comms=mode, seed=seed,
+        )
+        be = get_backend(cfg)
+        data = be.upload(Xb)
+        gd = be._put_rows(g)
+        hd = be._put_rows(h)
+        fn = be._grow_fn
+        sync(fn(data, gd, hd)[0])       # compile + first run
+        arms[mode] = (fn, data, gd, hd, be)
+
+    def bout(mode):
+        fn, data, gd, hd, _ = arms[mode]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            packed, _delta = fn(data, gd, hd)
+        sync(packed)
+        return (time.perf_counter() - t0) / iters
+
+    # ratio = dt_allreduce / dt_rs: > 1 means reduce-scatter wins
+    # (_paired_ab_reps returns dt_key_a / dt_key_b per rep).
+    dts, ratios = _paired_ab_reps(bout, "allreduce", "reduce_scatter",
+                                  reps)
+    dt_rs = min(dts["reduce_scatter"])
+    dt_ar = min(dts["allreduce"])
+    P = arms["allreduce"][4].row_shards
+    bytes_ar = tele_counters.hist_allreduce_bytes(depth, features, bins,
+                                                  partitions=P)
+    bytes_rs = tele_counters.hist_allreduce_bytes(
+        depth, features, bins, partitions=P, mode="reduce_scatter")
+    return {
+        "kernel": "hist_comms_ab",
+        "rows": rows, "features": features, "bins": bins, "depth": depth,
+        "iters": iters, "reps": reps,
+        "host_partitions": host_partitions, "n_partitions": n_partitions,
+        "mrows_rs": rows * depth / dt_rs / 1e6,
+        "mrows_allreduce": rows * depth / dt_ar / 1e6,
+        "ratio_allreduce_over_rs": float(np.median(ratios)),
+        "payload_bytes_allreduce": bytes_ar,
+        "payload_bytes_rs": bytes_rs,
+        "payload_ratio": round(bytes_ar / bytes_rs, 3),
+    }
+
+
 def bench_histogram_one_dispatch(
     rows: int = 1_000_000,
     features: int = 28,
@@ -910,4 +1004,7 @@ def run_bench(kernel: str = "histogram", **kw) -> dict:
         keys = ("backend", "features", "bins", "trees", "depth", "seed")
         return bench_registry_cold_load(
             **{k: kw[k] for k in keys if k in kw})
+    if kernel == "hist_comms":
+        keys = ("rows", "features", "bins", "depth", "iters", "seed")
+        return bench_hist_comms_ab(**{k: kw[k] for k in keys if k in kw})
     raise ValueError(f"unknown bench kernel {kernel!r}")

@@ -521,6 +521,23 @@ def main(argv: list[str] | None = None) -> int:
                          "(left children built, right = parent - left); "
                          "auto = on only on a real TPU chip "
                          "(TrainConfig.hist_subtraction)")
+    tp.add_argument("--split-comms", default="auto",
+                    choices=["auto", "allreduce", "reduce_scatter"],
+                    help="split-finding collective (parallel/comms.py): "
+                         "reduce_scatter merges one F/P feature slab per "
+                         "row shard and all_gathers the tiny winner "
+                         "tuples; auto = reduce_scatter when a row mesh "
+                         "is live (TrainConfig.split_comms)")
+    tp.add_argument("--hist-comms-dtype", default="f32",
+                    choices=["f32", "bf16", "int32_fixed"],
+                    help="histogram collective wire dtype (opt-in): bf16 "
+                         "halves payload bytes; int32_fixed makes the "
+                         "N-partition merge bit-stable via an integer "
+                         "reduction (TrainConfig.hist_comms_dtype)")
+    tp.add_argument("--hist-comms-slabs", type=int, default=0,
+                    help="feature slabs for the pipelined build+collective "
+                         "overlap; 0 = auto (pipelined on a real TPU "
+                         "mesh), 1 = off (TrainConfig.hist_comms_slabs)")
     tp.add_argument("--stream-chunks", type=int, default=0,
                     help="train via the streaming path (BASELINE config 5) "
                          "with the dataset split into this many chunks: "
@@ -676,7 +693,7 @@ def main(argv: list[str] | None = None) -> int:
     _add_common(bp)
     bp.add_argument("--kernel", default="histogram",
                     choices=["histogram", "train", "predict", "serve",
-                             "registry"])
+                             "registry", "hist_comms"])
     bp.add_argument("--features", type=int, default=28)
     bp.add_argument("--trees", type=int, default=100)
     bp.add_argument("--depth", type=int, default=6)
@@ -808,6 +825,9 @@ def main(argv: list[str] | None = None) -> int:
             colsample_bytree=args.colsample_bytree,
             hist_impl=args.hist_impl, seed=args.seed,
             hist_subtraction=args.hist_subtraction,
+            split_comms=args.split_comms,
+            hist_comms_dtype=args.hist_comms_dtype,
+            hist_comms_slabs=args.hist_comms_slabs,
             missing_policy=args.missing,
             cat_features=cat_features,
             fused_block_rounds=args.fused_block_rounds,

@@ -81,6 +81,39 @@ class TrainConfig:
     # suites' streamed == in-memory BITWISE contracts would; "on"/"off"
     # force either side (tests use "on" with interpret-mode kernels).
     hist_subtraction: str = "auto"  # auto | on | off
+    # Split-finding collective (parallel/comms.py, docs/PERF.md
+    # "Histogram comms"). "allreduce": the classic full-histogram psum —
+    # every device receives every feature's bins and runs the same
+    # argmax. "reduce_scatter": each of the P row shards merges only its
+    # F/P feature slab, finds its slab's best splits locally, and the
+    # tiny per-shard winner tuples are all_gathered — per-level
+    # collective payload drops from O(F·B) to O(F·B/P) + O(P·nodes).
+    # "auto" picks reduce_scatter exactly when a row mesh is live (and
+    # the feature axis is not separately sharded); trees are
+    # structure-identical either way (comms.combine_shard_winners
+    # reproduces the single-device argmax tie-break exactly).
+    split_comms: str = "auto"   # auto | allreduce | reduce_scatter
+    # Wire dtype of the histogram collective (parallel/comms.py
+    # hist_reduce; NEVER on by default): "bf16" halves payload bytes at
+    # ~2^-9 relative rounding per partial; "int32_fixed" reduces on a
+    # shared fixed-point grid with an INTEGER sum — order-independent,
+    # so N-partition merges are bit-stable where f32 psum order was not.
+    # Both carry a computed error bound (comms.comms_error_bound) held
+    # by the split-agreement contract tests.
+    hist_comms_dtype: str = "f32"   # f32 | bf16 | int32_fixed
+    # Slab-pipelined comms overlap: split each level's histogram
+    # build + collective into N feature slabs so slab k+1's histogram
+    # kernels dispatch while slab k's collective is still on the wire
+    # (XLA's async collectives hide DCN latency behind VPU work).
+    # f32/bf16 collectives are elementwise, so slab phasing is
+    # bit-identical to the monolithic form by construction (tested);
+    # int32_fixed computes its fixed-point scale per collective, so
+    # each SLAB quantizes on its own (tighter) grid — deterministic,
+    # within the same error bound, but the slab count is part of that
+    # mode's numerics (split agreement still holds; tested). 0 =
+    # auto: pipelined only on a real TPU mesh (where a wire exists to
+    # hide); 1 = off; N >= 2 forces N slabs (tests).
+    hist_comms_slabs: int = 0   # 0 = auto | 1 = off | N slabs
     # Batch-scoring traversal implementation (ops/predict.py dispatch):
     # "auto" takes the Pallas VMEM traversal kernel on binned data when a
     # real TPU backs the computation and the shape fits its VMEM budget,
@@ -160,6 +193,21 @@ class TrainConfig:
             raise ValueError(
                 f"hist_subtraction must be auto|on|off, got "
                 f"{self.hist_subtraction!r}"
+            )
+        if self.split_comms not in ("auto", "allreduce", "reduce_scatter"):
+            raise ValueError(
+                f"split_comms must be auto|allreduce|reduce_scatter, got "
+                f"{self.split_comms!r}"
+            )
+        if self.hist_comms_dtype not in ("f32", "bf16", "int32_fixed"):
+            raise ValueError(
+                f"hist_comms_dtype must be f32|bf16|int32_fixed, got "
+                f"{self.hist_comms_dtype!r}"
+            )
+        if self.hist_comms_slabs < 0:
+            raise ValueError(
+                f"hist_comms_slabs must be >= 0 (0 = auto), got "
+                f"{self.hist_comms_slabs}"
             )
         if self.predict_impl not in ("auto", "pallas", "onehot", "lut"):
             raise ValueError(

@@ -75,8 +75,8 @@ from ddt_tpu.telemetry import costmodel
 from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry.annotations import phase_ctx
 from ddt_tpu.telemetry.events import (
-    PartitionRecorder, RoundRecorder, RunLog, derive_run_id,
-    emit_early_stop, finish_run_log)
+    PartitionRecorder, RoundRecorder, RunLog, comms_manifest_fields,
+    derive_run_id, emit_early_stop, finish_run_log)
 from ddt_tpu.utils import checkpoint
 from ddt_tpu.utils.profiling import PhaseTimer
 
@@ -335,6 +335,10 @@ class Driver:
                 # construction.
                 run_id=run_id,
                 host=int(getattr(self.backend, "host_index", 0)),
+                # ISSUE-10 extras (schema extras, no version bump): the
+                # RESOLVED split-finding comms config — report renders
+                # the per-mode comms line from these.
+                **comms_manifest_fields(self.backend),
                 # v3 extras: the xprof cross-reference — a flight-recorder
                 # lane and a profiler session join on run_id through
                 # these (telemetry/profiler.py).
@@ -461,8 +465,10 @@ class Driver:
         # wire. Zero on single-device runs.
         coll_bytes_round = 0
         if getattr(self.backend, "distributed", False):
-            coll_bytes_round = C * tele_counters.hist_allreduce_bytes(
-                cfg.max_depth, F, cfg.n_bins)
+            # EFFECTIVE payload for the resolved comms config (mode,
+            # wire dtype, subtraction) — backends/tpu.py
+            # collective_bytes_per_tree is the one home.
+            coll_bytes_round = C * self.backend.collective_bytes_per_tree(F)
         # Per-partition attribution (the distributed flight recorder):
         # active only on mesh runs WITH a run log — it probes per-device
         # shard completion, which is a barrier on the observed handle.

@@ -51,6 +51,7 @@ import jax.numpy as jnp
 
 from ddt_tpu.ops import histogram as H
 from ddt_tpu.ops import split as S
+from ddt_tpu.parallel import comms
 from ddt_tpu.parallel import mesh as mesh_lib
 from ddt_tpu.telemetry.annotations import traced_scope
 
@@ -85,6 +86,22 @@ def resolve_hist_subtraction(flag: str, platform: str | None = None) -> bool:
     return platform == "tpu"
 
 
+def _slab_widths(F: int, slabs: int, row_shards: int) -> list[int]:
+    """Feature-slab widths for the slab-pipelined build+reduce loop.
+
+    Slab boundaries align to the row-shard count (each non-final slab's
+    width is a multiple of `row_shards`) so that under reduce_scatter
+    every padded local column id lands >= F — the one-line validity test
+    the gain mask relies on (`col < F`). Returns [F] when pipelining is
+    off or the shape is too narrow to split."""
+    if slabs <= 1:
+        return [F]
+    fc = -(-F // (slabs * row_shards)) * row_shards
+    if fc <= 0 or fc >= F:
+        return [F]
+    return [min(fc, F - i) for i in range(0, F, fc)]
+
+
 def level_histograms(
     Xb: jax.Array,
     g: jax.Array,
@@ -97,47 +114,75 @@ def level_histograms(
     row_chunk: int = 32_768,
     input_dtype=jnp.bfloat16,
     allreduce=lambda x: x,
-    parent_hist: jax.Array | None = None,   # [n_level//2, F, B, 2], the
-    #   PREVIOUS level's post-allreduce histograms
+    comms_slabs: int = 1,
+    row_shards: int = 1,
+    parent_hist: jax.Array | None = None,   # [n_level//2, F(_loc), B, 2],
+    #   the PREVIOUS level's post-collective histograms (the local slab
+    #   under reduce_scatter — the carry and the reduce share a layout)
     parent_split: jax.Array | None = None,  # bool [n_level//2]: which
     #   parents actually split (children of leaves must read zero mass)
 ) -> jax.Array:
-    """One level's [n_level, F, B, 2] histograms (post-allreduce), with
-    the classic GBDT sibling-SUBTRACTION trick when parent state is
-    given: only LEFT children are built from rows (half the kernel work
-    AND half the allreduce payload), and each right child is recovered as
+    """One level's [n_level, F, B, 2] histograms (post-collective; the
+    merged F/row_shards slab under reduce_scatter), with the classic GBDT
+    sibling-SUBTRACTION trick when parent state is given: only LEFT
+    children are built from rows (half the kernel work AND half the
+    collective payload), and each right child is recovered as
     parent - left. Children of non-split parents are gated to exactly
     zero — without the gate a frozen parent's phantom right child would
     inherit the full parent mass and could "win" a split no training row
     can reach (a predict-time divergence, since predict-time rows CAN
     reach it).
 
+    `allreduce` is the histogram collective (comms.hist_reduce bound by
+    the caller: psum or reduce-scatter, optionally compressed). With
+    `comms_slabs` > 1 the build+collective is SLAB-PIPELINED: the
+    feature axis splits into row-shard-aligned slabs (_slab_widths), and
+    slab k+1's histogram kernels are dispatched before slab k's
+    collective completes — inside one traced program, XLA's async
+    collectives then hide the wire latency behind VPU work. f32/bf16
+    collectives are elementwise reductions, so the phasing is
+    bit-identical to the monolithic form by construction; int32_fixed
+    derives its fixed-point scale per collective, so each slab
+    quantizes on its own (tighter) grid — deterministic and inside the
+    same error bound, but not bitwise vs slabs=1.
+
     Exactness: left-child sums are BITWISE identical to a direct full
     build (a node's rows accumulate in the same tile order; absent rows
     contribute exact +0.0 terms either way). Right-child sums differ
     from a direct build by f32 rounding ULPs — the documented seam
     behind cfg.hist_subtraction's platform gating."""
+    F = Xb.shape[1]
+    widths = _slab_widths(F, comms_slabs, row_shards)
+
+    def build_reduced(ni, n_nodes):
+        """Per-slab histogram build, each slab's collective issued as
+        soon as its build is traced (the overlap phasing)."""
+        outs = []
+        lo = 0
+        for w in widths:
+            with traced_scope("hist"):
+                hs = H.build_histograms(
+                    Xb[:, lo:lo + w] if len(widths) > 1 else Xb,
+                    g, h, ni, n_nodes, n_bins,
+                    impl=hist_impl, row_chunk=row_chunk,
+                    input_dtype=input_dtype,
+                )
+            with traced_scope("allreduce"):
+                outs.append(allreduce(hs))
+            lo += w
+        if len(outs) == 1:
+            return outs[0]
+        return jnp.concatenate(outs, axis=1)
+
     if parent_hist is None or n_level < 2:
-        with traced_scope("hist"):
-            hist = H.build_histograms(
-                Xb, g, h, node_index, n_level, n_bins,
-                impl=hist_impl, row_chunk=row_chunk,
-                input_dtype=input_dtype,
-            )
-        with traced_scope("allreduce"):
-            return allreduce(hist)
+        return build_reduced(node_index, n_level)
     half = n_level // 2
-    with traced_scope("hist"):
-        # Rows sitting in LEFT children (even level-local index) keyed by
-        # parent slot; everyone else (right children, frozen) masks out.
-        is_left = (node_index >= 0) & (node_index % 2 == 0)
-        li = jnp.where(is_left, node_index // 2, -1).astype(jnp.int32)
-        hist_left = H.build_histograms(
-            Xb, g, h, li, half, n_bins,
-            impl=hist_impl, row_chunk=row_chunk, input_dtype=input_dtype,
-        )
-    with traced_scope("allreduce"):    # HALF a full level's payload
-        hist_left = allreduce(hist_left)
+    # Rows sitting in LEFT children (even level-local index) keyed by
+    # parent slot; everyone else (right children, frozen) masks out.
+    # HALF a full level's collective payload.
+    is_left = (node_index >= 0) & (node_index % 2 == 0)
+    li = jnp.where(is_left, node_index // 2, -1).astype(jnp.int32)
+    hist_left = build_reduced(li, half)
     with traced_scope("hist:subtract"):
         gate = parent_split.reshape(half, 1, 1, 1)
         hist_right = jnp.where(gate, parent_hist - hist_left,
@@ -186,6 +231,20 @@ def grow_tree(
     #   >= 1 build only LEFT-child histograms and derive right children as
     #   parent - left (see level_histograms / resolve_hist_subtraction —
     #   backends resolve cfg.hist_subtraction before tracing).
+    split_comms: str = "allreduce",  # RESOLVED collective for split
+    #   finding ("allreduce" | "reduce_scatter" — backends resolve
+    #   cfg.split_comms via comms.resolve_split_comms): reduce_scatter
+    #   hands each row shard one merged F/P feature slab, split finding
+    #   runs on the slab, and the tiny per-shard winner tuples are
+    #   combined by GLOBAL flattened candidate index
+    #   (comms.combine_shard_winners) — same trees, O(F·B/P) payload.
+    hist_comms_dtype: str = "f32",   # wire dtype of the histogram
+    #   collective (comms.hist_reduce): f32 | bf16 | int32_fixed.
+    comms_slabs: int = 1,            # RESOLVED slab-pipelining factor
+    #   (comms.resolve_comms_slabs): the level's build+collective splits
+    #   into this many feature slabs so slab k+1's kernels overlap slab
+    #   k's wire time. 1 = monolithic; f32/bf16 phasing is bit-identical
+    #   either way (int32_fixed: see level_histograms).
 ) -> TreeArrays:
     """Grow one complete-heap tree. Trace under jit (and shard_map if
     axis_name is set). Matches reference/numpy_trainer.grow_tree decisions.
@@ -218,8 +277,42 @@ def grow_tree(
     node_id = jnp.zeros((R,), jnp.int32)   # heap slot per row
     frozen = jnp.zeros((R,), bool)
 
+    # Split-finding comms (parallel/comms.py; docs/PERF.md "Histogram
+    # comms"): `allreduce` is the exact psum for the small aggregates
+    # (node totals, leaf sums, routing values); the HISTOGRAM collective
+    # is hist_collective — psum or reduce_scatter over the row axes,
+    # optionally compressed on the wire.
+    rs = split_comms == "reduce_scatter" and axis_name is not None
+    assert not (rs and feature_axis_name is not None), \
+        "split_comms='reduce_scatter' does not compose with a feature axis"
+    P_row = comms.axis_size(axis_name)
+
     def allreduce(x):
-        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+        return comms.psum(x, axis_name)
+
+    def hist_collective(hs):
+        if rs:
+            hs = comms.pad_to_multiple(hs, 1, P_row)
+        return comms.hist_reduce(
+            hs, axis_name,
+            mode="reduce_scatter" if rs else "allreduce",
+            comms_dtype=hist_comms_dtype, scatter_dim=1)
+
+    # Local->global column map of this shard's reduce-scattered slab:
+    # slab s of width w contributes wp/P_row contiguous columns per
+    # shard (wp = w padded to the shard count); slab boundaries align to
+    # P_row (_slab_widths), so every padded local column id lands >= F
+    # and `col < F` is the validity test. None when not scattering.
+    col_ids = None
+    if rs:
+        idx = comms.flat_axis_index(axis_name)
+        parts, lo = [], 0
+        for w in _slab_widths(F, comms_slabs, P_row):
+            b = (-(-w // P_row) * P_row) // P_row
+            parts.append(lo + idx * b + jnp.arange(b, dtype=jnp.int32))
+            lo += w
+        col_ids = (jnp.concatenate(parts) if len(parts) > 1
+                   else parts[0]).astype(jnp.int32)
 
     cat_vec_g = S.cat_feature_vec(cat_features, F_global)  # bool [F_global]
     cat_vec = cat_vec_g                    # this shard's columns
@@ -252,10 +345,11 @@ def grow_tree(
             hist = level_histograms(
                 Xb, g, h, node_index, n_level, n_bins,
                 hist_impl=hist_impl, row_chunk=row_chunk,
-                input_dtype=input_dtype, allreduce=allreduce,
+                input_dtype=input_dtype, allreduce=hist_collective,
+                comms_slabs=comms_slabs, row_shards=P_row,
                 parent_hist=prev_hist, parent_split=prev_split,
             )
-            if feature_axis_name is None:
+            if feature_axis_name is None and not rs:
                 G, Hh = S.node_totals(hist)
             else:
                 # Node totals from the row vectors, not the histogram:
@@ -270,24 +364,43 @@ def grow_tree(
                 Hh = allreduce(jax.ops.segment_sum(
                     jnp.where(act, h, 0.0), seg, num_segments=n_level))
             with traced_scope("gain"):
-                gains, feats, bins, dls = S.best_splits_impl(
-                    hist, reg_lambda, min_child_weight, feature_mask,
-                    missing_bin=missing_bin, cat_mask=cat_vec)
+                if rs:
+                    # Slab-local split finding: masks gather down to this
+                    # shard's columns (padded ids >= F are invalid), the
+                    # slab argmax runs locally, winners map back to
+                    # GLOBAL feature ids via col_ids, and the tiny
+                    # per-shard tuples combine by global flattened
+                    # candidate index — exactly the single-device
+                    # argmax's pick (comms.combine_shard_winners).
+                    valid_loc = col_ids < F
+                    cid = jnp.minimum(col_ids, F - 1)
+                    fm_loc = valid_loc if feature_mask is None else (
+                        jnp.take(feature_mask, cid) & valid_loc)
+                    cm_loc = None if cat_vec is None else (
+                        jnp.take(cat_vec, cid) & valid_loc)
+                    gains, feats, bins, dls = S.best_splits_impl(
+                        hist, reg_lambda, min_child_weight, fm_loc,
+                        missing_bin=missing_bin, cat_mask=cm_loc)
+                    feats = jnp.take(col_ids, feats)
+                    gains, feats, bins, dls = comms.combine_shard_winners(
+                        gains, feats, bins, dls, axis_name,
+                        n_features=F, n_bins=n_bins,
+                        missing_bin=missing_bin)
+                else:
+                    gains, feats, bins, dls = S.best_splits_impl(
+                        hist, reg_lambda, min_child_weight, feature_mask,
+                        missing_bin=missing_bin, cat_mask=cat_vec)
                 if feature_axis_name is not None:
                     # Combine per-shard winners: all_gather the (gain,
-                    # feat, bin, direction) tuples (tiny), argmax over
-                    # shards — first shard wins ties, preserving the
-                    # global first-(feature,bin) tie-break rule.
+                    # feat, bin, direction) tuples (tiny) and pick by
+                    # global flattened candidate index — the global
+                    # first-(direction, feature, bin) tie-break rule
+                    # (comms.combine_shard_winners).
                     feats = feats + f_lo
-                    ga = jax.lax.all_gather(gains, feature_axis_name)
-                    fa = jax.lax.all_gather(feats, feature_axis_name)
-                    ba = jax.lax.all_gather(bins, feature_axis_name)
-                    da = jax.lax.all_gather(dls, feature_axis_name)
-                    w = jnp.argmax(ga, axis=0)                 # [n_level]
-                    gains = jnp.take_along_axis(ga, w[None], axis=0)[0]
-                    feats = jnp.take_along_axis(fa, w[None], axis=0)[0]
-                    bins = jnp.take_along_axis(ba, w[None], axis=0)[0]
-                    dls = jnp.take_along_axis(da, w[None], axis=0)[0]
+                    gains, feats, bins, dls = comms.combine_shard_winners(
+                        gains, feats, bins, dls, feature_axis_name,
+                        n_features=F_global, n_bins=n_bins,
+                        missing_bin=missing_bin)
             # Guarded like the final level and the streamed twin: an EMPTY
             # node at reg_lambda=0 would otherwise store -0/0 = NaN as its
             # leaf value, which a predict-time row (different data) can
@@ -358,7 +471,7 @@ def grow_tree(
                         jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
                         == loc[:, None]
                     )
-                    fv = jax.lax.psum(
+                    fv = comms.psum(
                         jnp.sum(jnp.where(foh, Xb.astype(jnp.int32), 0),
                                 axis=1),
                         feature_axis_name,

@@ -44,9 +44,10 @@ from ddt_tpu.reference.numpy_trainer import grad_hess
 from ddt_tpu.telemetry import costmodel
 from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry.annotations import phase_ctx
+from ddt_tpu.ops.grow import resolve_hist_subtraction
 from ddt_tpu.telemetry.events import (
-    PartitionRecorder, RoundRecorder, RunLog, derive_run_id,
-    emit_early_stop, finish_run_log)
+    PartitionRecorder, RoundRecorder, RunLog, comms_manifest_fields,
+    derive_run_id, emit_early_stop, finish_run_log)
 from ddt_tpu.utils import checkpoint
 from ddt_tpu.utils.profiling import PhaseTimer
 
@@ -221,6 +222,31 @@ def _apply_level_splits(
         else:
             is_leaf[slot] = True
             leaf_value[slot] = value[i]
+
+
+def _assemble_subtracted_level(
+    parent_hist: np.ndarray,     # [2^(d-1), F, B, 2]: previous level's
+    #                              fully-ACCUMULATED histograms
+    left: np.ndarray,            # [2^(d-1), F, B, 2]: this level's
+    #                              accumulated LEFT-child histograms
+    is_leaf: np.ndarray,
+    depth: int,
+) -> np.ndarray:
+    """Sibling-subtraction assembly for the streamed host accumulator —
+    the host twin of ops/grow.level_histograms' subtract branch: right
+    child = parent - left, gated to exactly zero for children of parents
+    that did NOT split (a frozen parent's phantom right child would
+    otherwise inherit the full parent mass), interleaved back to level
+    order (left = 2p, right = 2p + 1)."""
+    half = 1 << (depth - 1)
+    offset = half - 1
+    gate = ~is_leaf[offset:offset + half]
+    right = np.where(gate[:, None, None, None],
+                     parent_hist - left, np.float32(0.0))
+    out = np.empty((2 * half,) + left.shape[1:], np.float32)
+    out[0::2] = left
+    out[1::2] = right
+    return out
 
 
 def _apply_final_leaves(
@@ -613,6 +639,7 @@ def _fit_streaming_impl(
             distributed=bool(getattr(backend, "distributed", False)),
             run_id=run_id,
             host=int(getattr(backend, "host_index", 0)),
+            **comms_manifest_fields(backend),
             # v3 extras: the xprof cross-reference (telemetry/profiler).
             **(profiler_window.manifest_fields()
                if profiler_window is not None else {}))
@@ -623,8 +650,8 @@ def _fit_streaming_impl(
     part_rec = PartitionRecorder(
         run_log, backend,
         bytes_per_round=(
-            C * n_chunks * tele_counters.hist_allreduce_bytes(
-                cfg.max_depth, int(F), cfg.n_bins)
+            C * n_chunks * backend.collective_bytes_per_tree(
+                int(F), streamed=True)
             if getattr(backend, "distributed", False) else 0))
     # Straggler watchdog (robustness/watchdog.py) — DETECTION only on
     # the streaming path (fault events per trip; repartitioning a
@@ -729,10 +756,19 @@ def _fit_streaming_impl(
                     ev.fn(c)[0], binned=True).astype(np.float32)
 
     missing_val = cfg.missing_bin_value
+    # Streamed sibling subtraction (the fused rounds' halving, extended
+    # to the host accumulation loop): levels >= 1 build only LEFT-child
+    # chunk histograms — half the device work AND half the streamed
+    # collective payload per pass — and the right children are assembled
+    # by subtraction from the previous level's ACCUMULATED histogram
+    # (_assemble_subtracted_level). Platform-gated exactly like the
+    # fused path (resolve_hist_subtraction): right children differ from
+    # direct builds by f32 chunk-summation ULPs.
+    subtract = resolve_hist_subtraction(cfg.hist_subtraction)
     coll_bytes_round = 0
     if getattr(backend, "distributed", False):
-        coll_bytes_round = C * n_chunks * tele_counters.hist_allreduce_bytes(
-            cfg.max_depth, F, cfg.n_bins)
+        coll_bytes_round = C * n_chunks * backend.collective_bytes_per_tree(
+            F, streamed=True)
     t_out = start_round * C
     for rnd in range(start_round, cfg.n_trees):
         if profiler_window is not None:       # xprof window: start edge
@@ -780,8 +816,10 @@ def _fit_streaming_impl(
             route_kw = dict(default_left=default_left,
                             missing_bin_value=missing_val,
                             cat_features=cfg.cat_features)
+            prev_hist = None
             for depth in range(cfg.max_depth):
                 n_level = 1 << depth
+                sub = subtract and depth >= 1 and prev_hist is not None
                 hist = None
                 with ph("hist"):
                     for c in range(n_chunks):
@@ -790,18 +828,31 @@ def _fit_streaming_impl(
                             Xc, feature, threshold_bin, is_leaf, depth,
                             **route_kw
                         )
+                        if sub:
+                            # LEFT children keyed by parent slot: half
+                            # the per-chunk build and half the streamed
+                            # collective payload (right children come
+                            # from subtraction below).
+                            is_l = (ni >= 0) & (ni % 2 == 0)
+                            ni = np.where(is_l, ni // 2, -1).astype(
+                                np.int32)
                         g, h = chunk_grads(c, Xc, yc, cls)
                         data = backend.upload(Xc)
                         part = np.asarray(
-                            backend.build_histograms(data, g, h, ni,
-                                                     n_level)
+                            backend.build_histograms(
+                                data, g, h, ni,
+                                n_level // 2 if sub else n_level)
                         )
                         hist = part if hist is None else hist + part
+                if sub:
+                    hist = _assemble_subtracted_level(prev_hist, hist,
+                                                      is_leaf, depth)
                 with ph("gain"):
                     _apply_level_splits(hist, cfg, depth, feature,
                                         threshold_bin, is_leaf, leaf_value,
                                         split_gain, default_left,
                                         feature_mask=fmask)
+                prev_hist = hist if subtract else None
 
             # Final level: per-terminal (G, H) aggregates streamed the
             # same way.
@@ -991,16 +1042,21 @@ def _fit_streaming_device(
         if ev is not None:
             _replay(val_pred, val_chunks, ev.n)
 
-    def passes(tree, depth, kind, class_idx, rnd):
+    n_feat = ens.n_features
+
+    def passes(tree, depth, kind, class_idx, rnd, build_left=False):
         """One full pass over the chunks; yields per-chunk device outputs
-        with the next read/upload already in flight."""
+        with the next read/upload already in flight. Histogram outputs
+        are sliced back to the real feature count (reduce-scatter mode
+        pads F to the shard count with zero columns)."""
         data = chunks.get(0)
         for c in range(n_chunks):
             tc0 = time.perf_counter()
             if kind == "hist":
                 out = backend.stream_level_hist(
                     data, pred_dev[c], y_dev[c], tree, depth, class_idx,
-                    rnd=rnd, row_start=int(chunk_starts[c]))
+                    rnd=rnd, row_start=int(chunk_starts[c]),
+                    build_left=build_left)
             else:
                 out = backend.stream_leaf_gh(
                     data, pred_dev[c], y_dev[c], tree, depth, class_idx,
@@ -1012,7 +1068,10 @@ def _fit_streaming_device(
             # under the next chunk's H2D; the asarray below was already
             # a sync, so active-recorder cost is the probe bookkeeping.
             part_rec.observe(kind, out, tc0)
-            yield np.asarray(out)       # fetch (device likely done by now)
+            part = np.asarray(out)      # fetch (device likely done by now)
+            if kind == "hist" and part.shape[1] != n_feat:
+                part = part[:, :n_feat]     # drop scatter pad columns
+            yield part
 
     t_out = start_round * C
     # The previous round's finished trees, NOT yet applied to the resident
@@ -1022,10 +1081,11 @@ def _fit_streaming_device(
     # (pred is dead after the last gradients — same as the old loop, which
     # skipped its trailing update pass).
     prev_trees = None
+    subtract = resolve_hist_subtraction(cfg.hist_subtraction)
     coll_bytes_round = 0
     if getattr(backend, "distributed", False):
-        coll_bytes_round = C * n_chunks * tele_counters.hist_allreduce_bytes(
-            cfg.max_depth, ens.n_features, cfg.n_bins)
+        coll_bytes_round = C * n_chunks * backend.collective_bytes_per_tree(
+            ens.n_features, streamed=True)
     for rnd in range(start_round, cfg.n_trees):
         if window is not None:                # xprof window: start edge
             window.round_start(rnd)
@@ -1051,7 +1111,9 @@ def _fit_streaming_device(
             default_left = np.zeros(cfg.n_nodes_total, bool)
             tree = (feature, threshold_bin, is_leaf, default_left)
 
+            prev_hist = None
             for depth in range(cfg.max_depth):
+                sub = subtract and depth >= 1 and prev_hist is not None
                 hist = None
                 with ph("hist"):
                     if depth == 0 and cls == 0 and prev_trees is not None:
@@ -1069,15 +1131,25 @@ def _fit_streaming_device(
                                 data = chunks.get(c + 1)
                             part_rec.observe("roundstart", out, tc0)
                             part = np.asarray(out)
+                            if part.shape[1] != ens.n_features:
+                                part = part[:, :ens.n_features]
                             hist = part if hist is None else hist + part
                     else:
-                        for part in passes(tree, depth, "hist", cls, rnd):
+                        # Sibling subtraction (levels >= 1): stream only
+                        # LEFT-child histograms — half the per-chunk
+                        # device work and half the collective payload.
+                        for part in passes(tree, depth, "hist", cls, rnd,
+                                           build_left=sub):
                             hist = part if hist is None else hist + part
+                if sub:
+                    hist = _assemble_subtracted_level(prev_hist, hist,
+                                                      is_leaf, depth)
                 with ph("gain"):
                     _apply_level_splits(hist, cfg, depth, feature,
                                         threshold_bin, is_leaf, leaf_value,
                                         split_gain, default_left,
                                         feature_mask=fmask)
+                prev_hist = hist if subtract else None
 
             # Final level: streamed (G, H) aggregates.
             GH = None

@@ -62,10 +62,14 @@ except ImportError:               # jax-less host: capture never activates
 #: rows are order-of-magnitude defaults so off-TPU logs still render a
 #: table. Utilization fractions, not absolute verdicts, are the signal —
 #: refine per fleet in one place here.
+#: `coll_gbs` is the interconnect ceiling the comms roofline row divides
+#: by: order-of-magnitude per-chip collective bandwidth (v5e ICI; DCN is
+#: lower still — the verdict is about whether the wire binds at all, not
+#: which wire).
 PEAK_CEILINGS: dict[str, dict] = {
-    "tpu": {"gflops": 197_000.0, "gbs": 819.0},
-    "gpu": {"gflops": 19_500.0, "gbs": 900.0},
-    "cpu": {"gflops": 150.0, "gbs": 30.0},
+    "tpu": {"gflops": 197_000.0, "gbs": 819.0, "coll_gbs": 90.0},
+    "gpu": {"gflops": 19_500.0, "gbs": 900.0, "coll_gbs": 300.0},
+    "cpu": {"gflops": 150.0, "gbs": 30.0, "coll_gbs": 10.0},
 }
 
 #: Below this utilization on BOTH roofline axes the device was mostly
@@ -342,5 +346,30 @@ def roofline_table(phases: list[dict], cost_events: list[dict],
                    flops_util=round(uc, 4), hbm_util=round(ub, 4),
                    verdict=verdict)
         rows.append(row)
+    # Comms roofline row (ISSUE 10, docs/PERF.md "Histogram comms"): the
+    # run's EFFECTIVE collective payload (counters.collective_bytes_est —
+    # post-compression, post-scatter, subtraction-halved) against the
+    # interconnect ceiling, attributed to the phase whose programs carry
+    # the collective. Verdict "comms" when the wire's utilization rivals
+    # or beats the carrying phase's HBM leg (the wire binds); else
+    # "overlapped" — the latency is hidden behind compute, which is the
+    # state the comms-lean split finding exists to reach.
+    coll_bytes = float((counters or {}).get("collective_bytes_est") or 0.0)
+    if coll_bytes > 0:
+        carrier = next((r for r in rows
+                        if r["phase"] in ("grow_block", "grow", "hist")
+                        and r["ms"] > 0), None)
+        if carrier is not None:
+            gbs = coll_bytes / (carrier["ms"] / 1e3) / 1e9
+            cu = gbs / peaks.get("coll_gbs", peaks["gbs"])
+            verdict = ("comms"
+                       if cu >= HOST_BOUND_UTIL
+                       and cu >= (carrier.get("hbm_util") or 0.0)
+                       else "overlapped")
+            rows.append({
+                "phase": "comms", "ms": carrier["ms"], "calls": None,
+                "n_programs": 0, "gflops": None, "gbs": round(gbs, 2),
+                "flops_util": None, "hbm_util": None,
+                "coll_util": round(cu, 4), "verdict": verdict})
     rows.sort(key=lambda r: -r["ms"])
     return rows

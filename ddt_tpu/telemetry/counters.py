@@ -211,13 +211,46 @@ def host_peak_rss_bytes() -> int | None:
     return int(ru) if sys.platform == "darwin" else int(ru) * 1024
 
 
-def hist_allreduce_bytes(max_depth: int, n_features: int,
-                         n_bins: int) -> int:
-    """Estimated allreduce payload for ONE tree's histogram phases: the
-    [n_level, F, n_bins, 2] f32 histogram psum'd at every level (the
-    fabric-allreduce analog, ops/grow.py), plus the final level's [2^d, 2]
-    leaf-aggregate reduction."""
-    per_entry = 4 * 2                     # (g, h) float32 pairs
-    levels = sum((1 << d) for d in range(max_depth))
-    return levels * n_features * n_bins * per_entry \
-        + (1 << max_depth) * per_entry
+def hist_allreduce_bytes(max_depth: int, n_features: int, n_bins: int,
+                         *, partitions: int = 1, mode: str = "allreduce",
+                         subtraction: bool = False,
+                         comms_dtype: str = "f32") -> int:
+    """EFFECTIVE per-device collective payload estimate for ONE tree's
+    histogram phases (parallel/comms.py is the wire this models; the
+    two must change together).
+
+    Baseline (positional args only — the historical estimate): the
+    [n_level, F, n_bins, 2] f32 histogram psum'd at every level plus the
+    final level's [2^d, 2] leaf-aggregate reduction. The keyword knobs
+    mirror the resolved comms configuration
+    (TPUDevice.collective_bytes_per_tree passes them):
+
+    - `subtraction` — sibling-subtraction levels (>= 1) move only LEFT
+      children: half the level's entries.
+    - `mode="reduce_scatter"` — each device receives its merged
+      F_pad/P slab instead of the full table (F pads to the shard
+      count), plus the split-winner combine's all_gather: 4 int/f32
+      [n_level] vectors from each of the P shards.
+    - `comms_dtype` — wire bytes per histogram value (f32/int32_fixed 4,
+      bf16 2; parallel/comms.COMMS_DTYPE_BYTES).
+
+    An estimate because the collective lives inside a fused device
+    program where the host cannot observe the wire; shapes are static
+    per config, so it is exact up to XLA's own reduction scheduling."""
+    from ddt_tpu.parallel.comms import COMMS_DTYPE_BYTES
+
+    per_entry = COMMS_DTYPE_BYTES[comms_dtype] * 2   # (g, h) pairs
+    P = max(1, partitions)
+    total = 0
+    for d in range(max_depth):
+        nodes = 1 << d
+        if subtraction and d >= 1:
+            nodes //= 2                   # left children only
+        if mode == "reduce_scatter":
+            f_pad = -(-n_features // P) * P
+            total += nodes * (f_pad // P) * n_bins * per_entry
+            # Winner combine: gain/feat/bin/dl x [n_level] from P shards.
+            total += P * (1 << d) * 4 * 4
+        else:
+            total += nodes * n_features * n_bins * per_entry
+    return total + (1 << max_depth) * 4 * 2   # leaf aggregates: f32 psum

@@ -233,6 +233,21 @@ def finish_run_log(run_log: "RunLog | None", timer, counters_start,
                  wallclock_s=wallclock_s)
 
 
+def comms_manifest_fields(backend) -> dict:
+    """run_manifest extras describing the RESOLVED split-finding comms
+    configuration (ISSUE 10; schema extras only, no version bump —
+    absent on single-device backends and in every pre-existing log, and
+    report treats them as optional). The one home the Driver's and the
+    streaming trainers' manifests share."""
+    if not getattr(backend, "distributed", False):
+        return {}
+    return {
+        "split_comms": getattr(backend, "split_comms", "allreduce"),
+        "hist_comms_dtype": backend.cfg.hist_comms_dtype,
+        "hist_comms_slabs": int(getattr(backend, "comms_slabs", 1)),
+    }
+
+
 def derive_run_id(**fields) -> str:
     """Deterministic 12-hex run id from the run's config facts. Every
     host of a multi-host run derives the IDENTICAL id from its (identical

@@ -201,6 +201,16 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
             [e for e in events if e["event"] == "artifact"],
             manifest.get("run_id")),
     }
+    # Split-finding comms (ISSUE 10; manifest schema extras — absent on
+    # single-device runs and every pre-existing log, which render
+    # exactly as before).
+    summary["comms"] = None
+    if manifest.get("split_comms"):
+        summary["comms"] = {
+            "split_comms": manifest["split_comms"],
+            "hist_comms_dtype": manifest.get("hist_comms_dtype", "f32"),
+            "hist_comms_slabs": manifest.get("hist_comms_slabs", 1),
+        }
     # Roofline join (telemetry/costmodel.py): only when the log carries
     # cost_analysis events — pre-v3 logs render exactly as before.
     summary["roofline"] = None
@@ -327,7 +337,10 @@ def render(summary: dict) -> str:
         out.append("roofline (XLA cost model vs host wallclock; "
                    "achieved against per-platform peak ceilings):")
         for r in summary["roofline"]:
-            if r.get("gflops") is None:
+            if r.get("coll_util") is not None:
+                dev = (f"{r['gbs']:>8.2f} GB/s wire "
+                       f"({100 * r['coll_util']:5.1f}% interconnect)")
+            elif r.get("gflops") is None:
                 dev = "no device cost registered"
             else:
                 dev = (f"{r['gflops']:>9.2f} GFLOP/s "
@@ -430,6 +443,19 @@ def render(summary: dict) -> str:
             f"collective≈{_fmt_bytes(c.get('collective_bytes_est'))}  "
             f"device_peak={_fmt_bytes(c.get('device_peak_bytes'))}  "
             f"host_rss_peak={_fmt_bytes(c.get('host_peak_rss_bytes'))}")
+        # Per-mode comms line (ISSUE 10): the resolved split-finding
+        # collective + wire dtype next to the EFFECTIVE payload the
+        # counter above already reflects (subtraction-halved levels,
+        # scattered slabs, compressed entries).
+        cm = summary.get("comms")
+        if cm:
+            out.append(
+                "comms: "
+                f"split_comms={cm['split_comms']}  "
+                f"wire_dtype={cm['hist_comms_dtype']}  "
+                f"slabs={cm['hist_comms_slabs']}  "
+                f"payload≈{_fmt_bytes(c.get('collective_bytes_est'))} "
+                "(effective)")
         # Scoring-cache effectiveness (absent in pre-overhaul logs).
         hits = c.get("compiled_ensemble_cache_hits")
         if hits is not None:

@@ -1,0 +1,37 @@
+"""one-home-collective negative fixture: the sanctioned spellings —
+the comms module's wrappers, topology reads, and names that merely
+resemble collectives."""
+
+import jax
+
+from ddt_tpu.parallel import comms
+
+
+def merge_hist(hist, axis):
+    return comms.psum(hist, axis)                 # the one-home wrapper
+
+
+def scatter_hist(hist, axis):
+    return comms.reduce_scatter(hist, axis, dim=1)
+
+
+def gather_winners(gains, feats, bins, dls, axis):
+    return comms.combine_shard_winners(
+        gains, feats, bins, dls, axis, n_features=8, n_bins=16)
+
+
+def shard_offset(axis):
+    # Topology reads are not traffic.
+    return jax.lax.axis_index(axis) * jax.lax.axis_size(axis)
+
+
+def local_reduce(psum, x, axis):
+    return psum(x, axis)                          # injected callable
+
+
+class Reducer:
+    def psum(self, x, axis):                      # method named psum
+        return x
+
+    def run(self, x, axis):
+        return self.psum(x, axis)

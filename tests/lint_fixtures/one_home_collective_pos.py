@@ -1,0 +1,23 @@
+"""one-home-collective positive fixture: raw jax.lax collectives in
+library code outside parallel/comms.py — each bypasses the comms
+module's mode/dtype seams and its payload accounting."""
+
+import jax
+from jax import lax
+
+
+def merge_hist(hist, axis):
+    return jax.lax.psum(hist, axis)               # LINT: one-home-collective
+
+
+def scatter_hist(hist, axis):
+    return jax.lax.psum_scatter(                  # LINT: one-home-collective
+        hist, axis, scatter_dimension=1, tiled=True)
+
+
+def gather_winners(gains, axis):
+    return lax.all_gather(gains, axis)            # LINT: one-home-collective
+
+
+def global_max(x, axis):
+    return jax.lax.pmax(x, axis)                  # LINT: one-home-collective

@@ -204,17 +204,21 @@ def test_predict_raw_distributed():
 
 
 # --------------------------------------------------------------------------- #
-# Compiled-collective contract (round-4 verdict item 2). The pod-scale
+# Compiled-collective contract (round-4 verdict item 2; comms inventory
+# updated for ISSUE 10's reduce-scatter split finding). The pod-scale
 # extrapolation rests on the property that the ONLY cross-device traffic in
-# tree growth is (a) the histogram / node-aggregate / loss psum over the row
-# axes, (b) the tiny per-level split-winner all_gather over the feature axis,
-# and (c) the [R_loc] winning-column-value psum over the feature axis
-# (ops/grow.py routing). Bit-identity tests cannot catch an accidental
-# row-sized all_gather — on a one-host virtual mesh it is merely slow, not
-# wrong — so these tests pin the compiled program's collective inventory
-# itself: they FAIL if any new collective kind appears, if any gather grows
-# beyond split-winner size, or if a row-sized operand rides a row-axis
-# collective.
+# tree growth is (a) the histogram collective over the row axes — a psum
+# under split_comms=allreduce, a reduce-scatter (at most histogram-sized)
+# under the reduce_scatter default, (b) the tiny per-level split-winner
+# all_gather — over the feature axis on column-sharded meshes, over the ROW
+# axes under reduce-scatter split finding (never both in one program),
+# (c) node-aggregate / loss psums over the row axes, and (d) the [R_loc]
+# winning-column-value psum over the feature axis (ops/grow.py routing).
+# Bit-identity tests cannot catch an accidental row-sized all_gather — on a
+# one-host virtual mesh it is merely slow, not wrong — so these tests pin
+# the compiled program's collective inventory itself: they FAIL if any new
+# collective kind appears, if any gather grows beyond split-winner size, or
+# if a row-sized operand rides a row-axis collective.
 # --------------------------------------------------------------------------- #
 
 import re  # noqa: E402
@@ -304,19 +308,38 @@ def _assert_collective_contract(hlo_text, be, *, r_loc, f_loc, n_bins,
     assert hist_cap < r_loc, "test shapes must separate hist from row size"
     inv = _collective_inventory(hlo_text)
     assert inv, "distributed program lowered with no collectives at all"
+    rs = getattr(be, "split_comms", "allreduce") == "reduce_scatter"
     for kind, shapes, groups in inv:
         desc = f"{kind} {shapes} groups={sorted(groups)}"
-        assert kind in ("all-reduce", "all-gather"), \
+        assert kind in ("all-reduce", "all-gather", "reduce-scatter"), \
             f"forbidden collective kind: {desc}"
         assert groups in (row_groups, feature_groups), \
             f"collective over unexpected device groups: {desc}"
-        if kind == "all-gather":
-            # Only the per-level split-winner gather (gain/feat/bin/dir
-            # tuples) over the feature axis: [n_shards, n_level] at most.
-            assert groups == feature_groups != row_groups, \
-                f"all-gather outside the feature axis: {desc}"
+        if kind == "reduce-scatter":
+            # Only the histogram slab scatter over the row axes, only
+            # when reduce-scatter split finding is resolved on; the
+            # (scattered) result is at most slab-sized.
+            assert rs, f"reduce-scatter without split_comms=rs: {desc}"
+            assert groups == row_groups, \
+                f"reduce-scatter outside the row axes: {desc}"
             for s in shapes:
-                assert _numel(s) <= be.feature_partitions * n_level, \
+                assert r_loc not in s and _numel(s) <= hist_cap, \
+                    f"oversized reduce-scatter operand: {desc}"
+        elif kind == "all-gather":
+            # Only the per-level split-winner gather (gain/feat/bin/dir
+            # tuples): over the feature axis on column-sharded meshes,
+            # over the ROW axes under reduce-scatter split finding —
+            # [n_shards, n_level] at most either way.
+            if rs:
+                assert groups == row_groups, \
+                    f"all-gather outside the row axes under rs: {desc}"
+                cap = be.row_shards * n_level
+            else:
+                assert groups == feature_groups != row_groups, \
+                    f"all-gather outside the feature axis: {desc}"
+                cap = be.feature_partitions * n_level
+            for s in shapes:
+                assert _numel(s) <= cap, \
                     f"all-gather operand beyond split-winner size: {desc}"
         elif groups == feature_groups and feature_groups != row_groups:
             # Feature-axis psum: the [R_loc] winning-column routing value

@@ -72,6 +72,8 @@ CASES = [
      "ddt_tpu/ops/fixture_mod.py"),
     ("serve-blocking-io", "serve_blocking_pos.py", "serve_blocking_neg.py",
      "ddt_tpu/serve/engine.py"),
+    ("one-home-collective", "one_home_collective_pos.py",
+     "one_home_collective_neg.py", "ddt_tpu/ops/fixture_mod.py"),
 ]
 
 
@@ -93,6 +95,18 @@ def test_checker_silent_on_clean_code(rule, _pos, neg, path):
     got = _flagged_lines(neg, path, rule)
     assert got == set(), f"{rule}: false positives at lines {sorted(got)} " \
                          f"in {neg}"
+
+
+def test_one_home_collective_exempts_comms_module():
+    """parallel/comms.py IS the one home: the same raw-collective source
+    must not be flagged there (or outside ddt_tpu/ — tools and tests
+    spell collectives freely)."""
+    src = _fixture_src("one_home_collective_pos.py")
+    for path in ("ddt_tpu/parallel/comms.py", "tests/test_comms.py",
+                 "tools/ddtlint/fixture_mod.py"):
+        findings = runner.run_on_source(path, src,
+                                        rules={"one-home-collective"})
+        assert findings == [], (path, [f.render() for f in findings])
 
 
 def test_serve_blocking_io_exempts_transport_and_other_layers():

@@ -59,6 +59,12 @@ METRICS: dict[str, str] = {
     "hist_fused_mrows_per_sec": "higher",
     "hist_fused_ab_ratio": "higher",
     "hist_fused_roofline_flops_util": "higher",
+    # Split-comms A/B (ISSUE 10): losing the reduce-scatter wallclock
+    # edge, the scattered arm's throughput, or the deterministic payload
+    # reduction are all regressions.
+    "hist_comms_ab_ratio": "higher",
+    "hist_comms_rs_mrows_per_sec": "higher",
+    "hist_comms_payload_ratio": "higher",
     "e2e_train_s": "lower",
     "e2e_ms_per_tree": "lower",
     "e2e_implied_hist_mrows": "higher",

@@ -823,6 +823,45 @@ class ServeBlockingIOChecker(Checker):
         self.generic_visit(node)
 
 
+# --------------------------------------------------------------------- #
+# 12. one-home-collective
+# --------------------------------------------------------------------- #
+class OneHomeCollectiveChecker(Checker):
+    """Raw `jax.lax` collectives outside parallel/comms.py: every
+    cross-device byte the trainer moves must funnel through the one-home
+    comms module (psum/pmax/pmin/all_gather/reduce_scatter wrappers with
+    version-portable fallbacks, compression, `ddt:comms:*` scopes) — a
+    raw psum elsewhere silently bypasses split_comms/hist_comms_dtype
+    AND desynchronizes the `hist_allreduce_bytes` payload model from the
+    wire it claims to estimate. comms.py itself is the sanctioned home;
+    `axis_index`/`axis_size` are topology reads, not traffic, and stay
+    legal everywhere (collective-consistency still checks their axis
+    names)."""
+
+    rule = "one-home-collective"
+    path_scope = (r"^ddt_tpu/(?!parallel/comms\.py$)",)
+    _COLLECTIVES = {
+        "psum", "psum_scatter", "pmin", "pmax", "pmean",
+        "all_gather", "all_to_all", "ppermute", "pshuffle",
+    }
+
+    def visit_Call(self, node: ast.Call):
+        d = callgraph.dotted(node.func)
+        last = d.split(".")[-1] if d else None
+        # Require the lax./jax.lax. spelling (like collective-consistency):
+        # comms.psum(...) and locally-defined helpers named psum are the
+        # sanctioned indirections, not raw collectives.
+        if last in self._COLLECTIVES and d != last \
+                and d.split(".")[-2] in ("lax",):
+            self.report(node, (
+                f"raw `{d}(...)` outside parallel/comms.py — route the "
+                "collective through the one-home comms module so "
+                "split_comms/hist_comms_dtype apply and the "
+                "hist_allreduce_bytes payload model stays true to the "
+                "wire (docs/ANALYSIS.md one-home-collective)"))
+        self.generic_visit(node)
+
+
 AST_CHECKERS = [
     TracedBranchChecker,
     HostSyncChecker,
@@ -836,6 +875,7 @@ AST_CHECKERS = [
     AtomicArtifactWriteChecker,
     RawPhaseTimingChecker,
     ServeBlockingIOChecker,
+    OneHomeCollectiveChecker,
 ]
 
 
