@@ -63,6 +63,14 @@ chaos-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py
 
+# Billion-row-shape smoke (docs/PERF.md "2D sharding"): host-sharded
+# streamed training at a scaled-down out-of-core config — each "host"
+# reads only its own chunk sub-shards, flat per-host peak RSS asserted
+# against the run log's host_peak_rss_bytes counter, and streamed ==
+# in-memory split agreement checked.
+bigdata-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/bigdata_smoke.py
+
 # Registry smoke (docs/REGISTRY.md): train -> CLI push -> COLD-process
 # restore through the zero-retrace AOT loader -> serve -> bit-match vs
 # the exporting process, with the jit_compiles counter witnessing zero
@@ -83,4 +91,4 @@ native:
 
 .PHONY: lint lint-baseline tsan-audit test report trace-smoke \
 	profile-smoke kernel-smoke chaos-smoke serve-smoke registry-smoke \
-	benchwatch native
+	bigdata-smoke benchwatch native

@@ -209,6 +209,16 @@ HIST_FUSED_AB_FLOOR = 1.05
 # first two chip artifacts per docs/PERF.md "Histogram comms"
 # (Re-calibration status), ratcheting UP if the fabric win is real.
 HIST_COMMS_AB_FLOOR = 1.0
+# 2D-mesh paired ratio (ISSUE 11, chip only): at the wide bench shape
+# (F >= 1k) the 2D (rows x features) mesh cuts the per-device
+# reduce-scatter slab another Pf-fold vs the 1D row mesh on the same
+# device count (payload_ratio is deterministic counter math, asserted
+# in tests/test_mesh2d.py) and must never cost wallclock — ratio ~1.0
+# on a one-host virtual mesh, > 1.0 once a real fabric carries the
+# slabs. ENCODED-BUT-UNWITNESSED like every post-r05 floor (rounds
+# 6-11 ran CPU-only); re-calibrate against the first two chip
+# artifacts per docs/PERF.md "2D sharding" (Re-calibration status).
+HIST_2D_AB_FLOOR = 1.0
 # Cross-platform training parity (experiments/chip_parity.py): 2-4/155
 # split flips from MXU f32 summation order straddling bf16 gain-rounding
 # ties; quality-equivalent. Wider divergence means a real kernel bug.
@@ -334,6 +344,18 @@ def main() -> None:
         cab = bench_hist_comms_ab(rows=rows, features=features, bins=bins,
                                   depth=depth)
 
+    # 2D-mesh paired A/B (ISSUE 11): 1D row mesh vs (rows x features)
+    # at a WIDE shape (F >= 1k, where feature replication hurts) on the
+    # same device count. Real chip only in the headline run (the CPU
+    # multi-device twin lives in tier-1 as
+    # tests/test_mesh2d.py::test_bench_hist_2d_smoke); the payload
+    # ratio is deterministic counter math either way.
+    h2d = None
+    if on_tpu and len(jax.devices()) >= 2:
+        from ddt_tpu.bench import bench_hist_2d
+
+        h2d = bench_hist_2d()
+
     # Scoring config: device-resident (floored) + total (context) +
     # compute-only (floored, band-stable), one shared
     # dataset/ensemble/warm-up.
@@ -427,6 +449,17 @@ def main() -> None:
             cab["payload_ratio"] if cab else None,
         "hist_comms_rs_mrows_per_sec":
             round(cab["mrows_rs"], 2) if cab else None,
+        # 2D-mesh A/B (ISSUE 11): paired wallclock ratio (chip only) +
+        # the deterministic payload ratio from the second-axis-aware
+        # hist_allreduce_bytes model — per-device slab <= 1/(Pr·Pf) of
+        # the replicated-feature baseline, witnessed in-process by
+        # tests/test_mesh2d.py.
+        "hist_2d_ab_ratio":
+            round(h2d["ratio_1d_over_2d"], 3) if h2d else None,
+        "hist_2d_payload_ratio":
+            h2d["payload_ratio"] if h2d else None,
+        "hist_2d_mrows_per_sec":
+            round(h2d["mrows_2d"], 2) if h2d else None,
         "predict_mrows_per_sec": round(pr["mrows_per_sec"], 2),
         "predict_total_s": round(pr_total["wallclock_s"], 2),
         "predict_compute_mrows_per_sec": round(pr_comp["mrows_per_sec"], 2),
@@ -565,6 +598,12 @@ def main() -> None:
             f"{cab['ratio_allreduce_over_rs']:.3f} < {HIST_COMMS_AB_FLOOR} "
             "(reduce-scatter split finding costs wallclock on a real "
             "fabric — parallel/comms.py; docs/PERF.md Histogram comms)")
+    if h2d is not None and h2d["ratio_1d_over_2d"] < HIST_2D_AB_FLOOR:
+        fails.append(
+            f"2D-mesh paired ratio {h2d['ratio_1d_over_2d']:.3f} < "
+            f"{HIST_2D_AB_FLOOR} (feature sharding costs wallclock at "
+            "the wide shape — parallel/mesh.py SpecLayout; docs/PERF.md "
+            "'2D sharding')")
     if lab is not None \
             and lab["ratio_lut_over_f32"] < PREDICT_LUT_AB_FLOOR:
         fails.append(

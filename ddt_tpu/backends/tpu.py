@@ -176,30 +176,14 @@ class TPUDevice(DeviceBackend):
                 self.feature_partitions * self.host_partitions)
         elif (self.n_partitions > 1 or self.feature_partitions > 1
               or self.host_partitions > 1):
-            n_dev = (self.host_partitions * self.n_partitions
-                     * self.feature_partitions)
-            devs = devices if devices is not None else jax.devices()
-            if len(devs) < n_dev:
-                raise ValueError(
-                    f"host_partitions={self.host_partitions} x n_partitions="
-                    f"{self.n_partitions} x feature_partitions="
-                    f"{self.feature_partitions} needs {n_dev} devices but "
-                    f"only {len(devs)} visible"
-                )
-            # hosts outermost (DCN, slowest), rows middle, features innermost
-            # (ICI-adjacent) — the feature psum/all_gather per level is
-            # latency-sensitive; the hosts hop happens once per reduction.
-            if self.host_partitions > 1:
-                self.mesh = jax.make_mesh(
-                    (self.host_partitions, self.n_partitions,
-                     self.feature_partitions),
-                    (HAXIS, AXIS, FAXIS), devices=devs[:n_dev],
-                )
-            else:
-                self.mesh = jax.make_mesh(
-                    (self.n_partitions, self.feature_partitions),
-                    (AXIS, FAXIS), devices=devs[:n_dev],
-                )
+            # Declarative 2D (rows x features) mesh — ONE constructor
+            # (parallel/mesh.make_mesh_2d): hosts outermost (DCN,
+            # slowest), rows middle, features innermost (ICI-adjacent) —
+            # the feature winner gather per level is latency-sensitive;
+            # the hosts hop happens once per reduction.
+            self.mesh = mesh_lib.make_mesh_2d(
+                self.n_partitions, self.feature_partitions,
+                n_hosts=self.host_partitions, devices=devices)
         else:
             self.mesh = None
         self.distributed = self.mesh is not None
@@ -209,15 +193,24 @@ class TPUDevice(DeviceBackend):
         self.row_shards = self.host_partitions * self.n_partitions
         self._row_axes = (
             (HAXIS, AXIS) if self.host_partitions > 1 else AXIS)
+        # The declarative operand->PartitionSpec layout (parallel/mesh.
+        # SpecLayout + match_partition_rules): every shard_map below
+        # resolves its in/out specs through this table by operand name,
+        # so the mesh's axis story lives in ONE rule table.
+        self.layout = mesh_lib.SpecLayout(
+            row_axes=self._row_axes if self.distributed else None,
+            feature_axis=FAXIS if self.feature_partitions > 1 else None)
         self._input_dtype = jnp.dtype(cfg.matmul_input_dtype)
         # Split-finding comms, resolved ONCE at backend construction so
-        # a forced-but-impossible combination (reduce_scatter on a
-        # feature-sharded mesh) fails here, not mid-trace, and every
-        # program this backend builds — fused, granular, streamed — and
-        # the telemetry payload model all read the same answer.
+        # every program this backend builds — fused, granular, streamed —
+        # and the telemetry payload model all read the same answer.
+        # Reduce-scatter now COMPOSES with a sharded feature axis (the
+        # scatter runs over the row axes within each feature slab), so
+        # the resolver keys on whether a ROW wire exists.
         self.split_comms = comms_lib.resolve_split_comms(
             cfg.split_comms, distributed=self.distributed,
-            feature_partitions=self.feature_partitions)
+            feature_partitions=self.feature_partitions,
+            row_shards=self.row_shards)
         # Host-FETCH histogram surfaces (the granular build_histograms
         # and the streamed hist ops) return the table to the host; under
         # reduce_scatter that output is row-sharded, which a
@@ -249,6 +242,7 @@ class TPUDevice(DeviceBackend):
         return tele_counters.hist_allreduce_bytes(
             self.cfg.max_depth, n_features, self.cfg.n_bins,
             partitions=self.row_shards,
+            feature_partitions=self.feature_partitions,
             mode=self.stream_hist_comms if streamed else self.split_comms,
             comms_dtype=self.cfg.hist_comms_dtype,
             subtraction=resolve_hist_subtraction(self.cfg.hist_subtraction),
@@ -262,6 +256,13 @@ class TPUDevice(DeviceBackend):
         if not self.distributed:
             return None
         return jax.sharding.NamedSharding(self.mesh, P(*spec))
+
+    def _named(self, spec):
+        """NamedSharding from a SpecLayout-resolved PartitionSpec (None
+        on single-device backends — device_put picks the default)."""
+        if not self.distributed:
+            return None
+        return jax.sharding.NamedSharding(self.mesh, spec)
 
     @staticmethod
     def _put(a: np.ndarray, sh) -> jax.Array:
@@ -313,10 +314,61 @@ class TPUDevice(DeviceBackend):
             if Fp != F:
                 Xb = np.pad(Xb, ((0, 0), (0, Fp - F)))
             Xp = self._pad_rows(np.ascontiguousarray(Xb))
-            data = self._put(Xp, self._sharding(self._row_axes, FAXIS))
+            data = self._put(Xp, self._named(self.layout.binned_data()))
         else:
             data = self._put_rows(Xb, extra_dims=1)
         return data
+
+    def upload_row_shards(self, parts: list, total_rows: int) -> jax.Array:
+        """Host-sharded chunk upload (ROADMAP item 2's ingest half):
+        assemble a row-sharded [R, F] uint8 device array from THIS
+        process's contiguous row block — `parts` are the sub-shards this
+        process owns (data.chunks.HostShardedChunks), in global order;
+        other processes' rows are NEVER materialized on this host.
+
+        Single-process meshes (where every sub-shard is local) simply
+        concatenate and take the normal padded upload — identical device
+        layout, so the two paths are interchangeable per process count.
+        Multi-process meshes use jax.make_array_from_process_local_data:
+        each process contributes exactly its addressable devices' rows,
+        replacing the single-controller make_array_from_callback that
+        forced every host to hold the full global chunk. Row padding (to
+        the shard count) lands in the LAST process's block, matching
+        _pad_rows' global layout; uneven blocks raise — the chunk writer
+        cuts uniform sub-shards (shard_arrays / shard_stress_chunks)."""
+        local = (np.ascontiguousarray(np.concatenate(parts))
+                 if len(parts) > 1 else np.ascontiguousarray(parts[0]))
+        if local.dtype != np.uint8:
+            raise TypeError(
+                f"binned data must be uint8, got {local.dtype}")
+        if not self.distributed or jax.process_count() == 1:
+            return self.upload(local)
+        if self.feature_partitions > 1:
+            # The streamed path is row-parallel only (the stream ops
+            # raise too); saying so HERE keeps the multi-process branch
+            # from silently skipping upload()'s feature-axis column
+            # padding if that contract ever loosens.
+            raise NotImplementedError(
+                "host-sharded uploads are row-parallel only; "
+                "feature_partitions > 1 does not stream")
+        n_proc = jax.process_count()
+        Rp = -(-total_rows // self.row_shards) * self.row_shards
+        if Rp % n_proc:
+            raise ValueError(
+                f"padded rows {Rp} do not split over {n_proc} processes")
+        block = Rp // n_proc
+        pad = block - local.shape[0]
+        if pad < 0 or (pad > 0 and jax.process_index() != n_proc - 1):
+            raise ValueError(
+                f"process {jax.process_index()} holds {local.shape[0]} "
+                f"rows but its block is {block}; host-sharded chunks "
+                "need uniform sub-shard sizes (re-cut the shards)")
+        if pad:
+            local = np.pad(local, ((0, pad), (0, 0)))
+        tele_counters.record_h2d(local.nbytes)
+        sh = self._named(self.layout.binned_data())
+        return jax.make_array_from_process_local_data(
+            sh, local, (Rp, local.shape[1]))
 
     def upload_labels(self, y: np.ndarray,
                       sample_weight: np.ndarray | None = None
@@ -387,12 +439,16 @@ class TPUDevice(DeviceBackend):
             return out
 
         if self.distributed:
+            lay = self.layout
+
             def sharded(Xb, g, h, node_index, *, n_nodes):
-                out_specs = P(None, rax) if rs else P()
+                out_specs = (lay.level_hist_scattered() if rs
+                             else lay.replicated())
                 f = mesh_lib.shard_map(
                     functools.partial(hist, n_nodes=n_nodes),
                     mesh=self.mesh,
-                    in_specs=(P(rax, None), P(rax), P(rax), P(rax)),
+                    in_specs=lay.specs("data", "grad", "hess",
+                                       "node_index"),
                     out_specs=out_specs,
                 )
                 out = f(Xb, g, h, node_index)
@@ -567,16 +623,15 @@ class TPUDevice(DeviceBackend):
                 return inner(Xb, g, h, None)
 
         if self.distributed:
-            rax = self._row_axes
-            data_spec = P(rax, FAXIS) if faxis else P(rax, None)
-            in_specs = (data_spec, P(rax), P(rax))
+            lay = self.layout
+            in_specs = lay.specs("data", "grad", "hess")
             if with_mask:
-                in_specs = in_specs + (P(),)       # mask replicated
+                in_specs = in_specs + lay.specs("mask")   # replicated
             grow = mesh_lib.shard_map(
                 grow,
                 mesh=self.mesh,
                 in_specs=in_specs,
-                out_specs=(P(), P(rax)),
+                out_specs=(lay.replicated(), lay.row_vector()),
                 # Feature-parallel growth replicates every output across the
                 # feature axis BIT-IDENTICALLY by construction (split triples
                 # come out of an all_gather + argmax every shard computes the
@@ -656,22 +711,27 @@ class TPUDevice(DeviceBackend):
         rework)."""
         if not self.distributed or jax.process_count() > 1:
             return False
-        devs = list(self.mesh.devices.flat)
-        rotated = devs[1:] + devs[:1]
+        # Rotate along the ROW axis of the device grid, feature (and
+        # host) coordinates preserved: on a 2D (rows x features) mesh a
+        # flat-list rotation would move devices ACROSS feature columns
+        # — scrambling which device owns which column slab and forcing
+        # an F-axis reshuffle of the data itself. Rolling the rows axis
+        # moves every row shard to the next device IN ITS COLUMN, which
+        # degenerates to the classic flat rotation on a pure row mesh.
+        grid = self.mesh.devices
+        rows_ax = list(self.mesh.axis_names).index(AXIS)
+        rotated = np.roll(grid, 1, axis=rows_ax)
         # Mesh(ndarray) — NOT jax.make_mesh: make_mesh routes through
         # mesh_utils.create_device_mesh, whose TPU branch rebuilds the
         # order from physical torus coordinates of the device SET and
         # silently discards the rotation (the CPU branch preserves it,
         # which is why only a chip run would have noticed). The explicit
         # ndarray constructor keeps the caller's order everywhere.
-        self.mesh = jax.sharding.Mesh(
-            np.asarray(rotated, dtype=object).reshape(
-                self.mesh.devices.shape),
-            self.mesh.axis_names)
+        self.mesh = jax.sharding.Mesh(rotated, self.mesh.axis_names)
         for attr in self._MESH_BOUND_CACHES:
             self.__dict__.pop(attr, None)
         log.info("rotated row partitions: shard 0 now on device %s",
-                 rotated[0].id)
+                 rotated.flat[0].id)
         return True
 
     def reshard_rows(self, handle, extra_dims: int = 0):
@@ -682,6 +742,14 @@ class TPUDevice(DeviceBackend):
             return handle
         return jax.device_put(
             handle, self._sharding(self._row_axes, *([None] * extra_dims)))
+
+    def reshard_data(self, handle):
+        """reshard_rows for the binned data handle: the 2D layout's
+        COLUMN sharding is preserved (a plain row reshard would
+        silently replicate every feature slab)."""
+        if handle is None or not self.distributed:
+            return handle
+        return jax.device_put(handle, self._named(self.layout.binned_data()))
 
     # ------------------------------------------------------------------ #
     # fused multi-round training: a whole block of boosting rounds in ONE
@@ -933,19 +1001,19 @@ class TPUDevice(DeviceBackend):
             return trees, predf, losses
 
         if self.distributed:
-            rax = self._row_axes
-            pred_spec = P(rax, None) if C > 1 else P(rax)
-            data_spec = P(rax, FAXIS) if faxis else P(rax, None)
-            in_specs = (data_spec, pred_spec, P(rax), P(rax))
-            out_specs = (P(), pred_spec, P())
+            lay = self.layout
+            pred_name = "pred" if C > 1 else "pred1d"
+            pred_spec = lay.spec(pred_name)
+            in_specs = lay.specs("data", pred_name, "y", "valid")
+            out_specs = (lay.replicated(), pred_spec, lay.replicated())
             if mfn is not None:
-                in_specs = in_specs + (data_spec, pred_spec, P(rax),
-                                       P(rax))
-                out_specs = out_specs + (pred_spec, P())
+                in_specs = in_specs + lay.specs("data", pred_name, "y",
+                                                "valid")
+                out_specs = out_specs + (pred_spec, lay.replicated())
             if masked:
-                in_specs = in_specs + (P(),)   # fmasks replicated
+                in_specs = in_specs + lay.specs("fmasks")   # replicated
             if bagging:
-                in_specs = in_specs + (P(),)   # rnd0 scalar replicated
+                in_specs = in_specs + lay.specs("scalar")   # rnd0 repl.
             rounds = mesh_lib.shard_map(
                 rounds,
                 mesh=self.mesh,
@@ -1040,10 +1108,12 @@ class TPUDevice(DeviceBackend):
                 rax if self.distributed else None))
 
         if self.distributed:
-            pred_spec = P(rax, None) if C > 1 else P(rax)
-            data_spec = P(rax, FAXIS) if faxis else P(rax, None)
-            in_specs = (data_spec, pred_spec, P(rax), P(rax)) + (P(),) * C
-            out_specs = (pred_spec, P())
+            lay = self.layout
+            pred_name = "pred" if C > 1 else "pred1d"
+            pred_spec = lay.spec(pred_name)
+            in_specs = (lay.specs("data", pred_name, "y", "valid")
+                        + lay.specs(*(["tree"] * C)))
+            out_specs = (pred_spec, lay.replicated())
             f = mesh_lib.shard_map(
                 f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
                 # Same rationale as _build_grow_fn: the feature-axis
@@ -1216,32 +1286,34 @@ class TPUDevice(DeviceBackend):
             raise ValueError(kind)
 
         if self.distributed:
-            rax = self._row_axes
+            lay = self.layout
             # Under split_comms=reduce_scatter the streamed histogram
             # outputs come back F-sharded over the row axes (the wire
             # moved one slab per shard); the trainers slice the scatter
             # pad columns off after fetch.
-            hist_spec = (P(None, rax)
+            hist_spec = (lay.level_hist_scattered()
                          if self.stream_hist_comms == "reduce_scatter"
-                         else P())
-            bag_specs = (P(), P(), P()) if bagged else ()
-            pred_spec = P(rax, None) if softmax else P(rax)
+                         else lay.replicated())
+            bag_specs = lay.specs("scalar", "scalar", "scalar") \
+                if bagged else ()
+            pred_name = "pred" if softmax else "pred1d"
+            pred_spec = lay.spec(pred_name)
             if kind == "update":
-                in_specs = (P(rax, None), pred_spec, P(), P(), P(), P(),
-                            P())
+                in_specs = lay.specs("data", pred_name) + \
+                    lay.specs(*(["replicated"] * 5))
                 out_specs = pred_spec
             elif kind == "roundstart":
-                in_specs = (P(rax, None), pred_spec, P(rax), P(rax)) + \
-                    (P(),) * (5 * depth) + bag_specs
+                in_specs = lay.specs("data", pred_name, "y", "valid") + \
+                    lay.specs(*(["replicated"] * (5 * depth))) + bag_specs
                 out_specs = (pred_spec, hist_spec)
             elif kind == "hist":
-                in_specs = (P(rax, None), pred_spec, P(rax), P(rax),
-                            P(), P(), P(), P()) + bag_specs
+                in_specs = lay.specs("data", pred_name, "y", "valid") + \
+                    lay.specs(*(["replicated"] * 4)) + bag_specs
                 out_specs = hist_spec
             else:
-                in_specs = (P(rax, None), pred_spec, P(rax), P(rax),
-                            P(), P(), P(), P()) + bag_specs
-                out_specs = P()
+                in_specs = lay.specs("data", pred_name, "y", "valid") + \
+                    lay.specs(*(["replicated"] * 4)) + bag_specs
+                out_specs = lay.replicated()
             f = mesh_lib.shard_map(f, mesh=self.mesh, in_specs=in_specs,
                               out_specs=out_specs)
         donate = (1,) if kind in ("update", "roundstart") else ()
@@ -1520,13 +1592,15 @@ class TPUDevice(DeviceBackend):
             # (SURVEY.md §3 predict stack). shard_map makes the row-gather
             # sharding explicit — XLA cannot infer it through the
             # take_along_axis traversal.
-            rax = self._row_axes
+            lay = self.layout
             C = ce.n_classes_out
-            out_spec = P(rax) if C == 1 else P(rax, None)
+            out_spec = lay.row_vector() if C == 1 else lay.row_matrix()
             fn = mesh_lib.shard_map(
                 fn,
                 mesh=self.mesh,
-                in_specs=(P(),) * n_rep + (P(rax, None),),
+                in_specs=(lay.replicated(),) * n_rep
+                + (lay.row_matrix(),),     # rows sharded, F replicated:
+                # scoring never feature-shards (trees are replicated)
                 out_specs=out_spec,
                 # predict_raw's scan carry starts replicated (zeros) and
                 # becomes row-varying after the first accumulation; the

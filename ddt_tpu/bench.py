@@ -393,6 +393,118 @@ def bench_hist_comms_ab(
     }
 
 
+def bench_hist_2d(
+    rows: int = 200_000,
+    features: int = 1024,
+    bins: int = 64,
+    depth: int = 6,
+    iters: int = 4,
+    reps: int = 8,
+    seed: int = 0,
+    n_partitions: int | None = None,
+    feature_partitions: int | None = None,
+) -> dict:
+    """PAIRED 1D-row-mesh vs 2D (rows x features)-mesh whole-tree A/B at
+    a WIDE shape (F >= 1k — the regime ROADMAP item 2 exists for: a
+    replicated feature axis makes every device hold, build, and ship
+    all F columns' histograms). Same device count both arms: the 1D arm
+    puts every device on rows, the 2D arm splits them (Pr, Pf); both
+    run the resolved split_comms (reduce_scatter on any row wire), so
+    the A/B isolates the LAYOUT — per-device histogram slab F/(Pr·Pf)
+    vs F/P, with the winner combine over both axes.
+
+    Same statistic as bench_hist_comms_ab (the only one that survives
+    the tunnel's ±20% bands): per-rep PAIRED ratio, order alternating
+    every rep, median-of-ratios as the A/B evidence
+    (ratio_1d_over_2d > 1 means the 2D mesh wins), min-of-reps per-arm
+    timing as the headline. The deterministic per-tree payload ratio
+    (telemetry.counters.hist_allreduce_bytes with the second axis) is
+    stamped alongside — on a one-host virtual mesh wallclock moves
+    little (localhost "wire"); the payload model is the invariant and
+    the chip floor (HIST_2D_AB_FLOOR) guards the wallclock side where
+    a real fabric exists."""
+    import jax
+
+    from ddt_tpu.backends import get_backend
+    from ddt_tpu.config import TrainConfig
+    from ddt_tpu.telemetry import counters as tele_counters
+    from ddt_tpu.utils.device import device_sync as sync
+
+    n_dev = len(jax.devices())
+    if n_partitions is None or feature_partitions is None:
+        if n_dev >= 4:
+            n_partitions, feature_partitions = n_dev // 2, 2
+        elif n_dev >= 2:
+            n_partitions, feature_partitions = 1, 2
+        else:
+            raise ValueError("bench_hist_2d needs >= 2 devices")
+    n_used = n_partitions * feature_partitions
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, bins, size=(rows, features), dtype=np.uint8)
+    g = rng.standard_normal(rows).astype(np.float32)
+    h = (rng.random(rows) + 0.5).astype(np.float32)
+
+    meshes = {"1d": (n_used, 1), "2d": (n_partitions, feature_partitions)}
+    arms = {}
+    for key, (pr, pf) in meshes.items():
+        cfg = TrainConfig(
+            backend="tpu", n_bins=bins, max_depth=depth,
+            mesh_shape=(pr, pf), seed=seed,
+        )
+        be = get_backend(cfg)
+        data = be.upload(Xb)
+        gd = be._put_rows(g)
+        hd = be._put_rows(h)
+        fn = be._grow_fn
+        sync(fn(data, gd, hd)[0])       # compile + first run
+        arms[key] = (fn, data, gd, hd, be)
+
+    def bout(key):
+        fn, data, gd, hd, _ = arms[key]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            packed, _delta = fn(data, gd, hd)
+        sync(packed)
+        return (time.perf_counter() - t0) / iters
+
+    # ratio = dt_1d / dt_2d: > 1 means the 2D mesh wins.
+    dts, ratios = _paired_ab_reps(bout, "1d", "2d", reps)
+    dt_2d, dt_1d = min(dts["2d"]), min(dts["1d"])
+    be_1d, be_2d = arms["1d"][4], arms["2d"][4]
+    bytes_1d = tele_counters.hist_allreduce_bytes(
+        depth, features, bins, partitions=be_1d.row_shards,
+        mode=be_1d.split_comms)
+    bytes_2d = tele_counters.hist_allreduce_bytes(
+        depth, features, bins, partitions=be_2d.row_shards,
+        feature_partitions=be_2d.feature_partitions,
+        mode=be_2d.split_comms)
+    # The acceptance comparator (ISSUE 11): the REPLICATED-FEATURE
+    # allreduce baseline — every device receiving every column's bins —
+    # on the same device count. payload_ratio = baseline / 2D effective
+    # bytes, the deterministic 1/(Pr·Pf) factor the counter model
+    # witnesses in-process (tests/test_mesh2d.py). NOTE the 1D-rs arm's
+    # RECEIVED slab ties the 2D arm's at equal device count (both
+    # F/n_dev per device); the 2D win over 1D-rs is the Pf-fold smaller
+    # pre-collective histogram working set and ring traffic, which the
+    # wallclock ratio — not the received-bytes model — measures.
+    bytes_replicated = tele_counters.hist_allreduce_bytes(
+        depth, features, bins, partitions=be_1d.row_shards,
+        mode="allreduce")
+    return {
+        "kernel": "hist_2d_ab",
+        "rows": rows, "features": features, "bins": bins, "depth": depth,
+        "iters": iters, "reps": reps,
+        "mesh_1d": list(meshes["1d"]), "mesh_2d": list(meshes["2d"]),
+        "mrows_2d": rows * depth / dt_2d / 1e6,
+        "mrows_1d": rows * depth / dt_1d / 1e6,
+        "ratio_1d_over_2d": float(np.median(ratios)),
+        "payload_bytes_replicated": bytes_replicated,
+        "payload_bytes_1d": bytes_1d,
+        "payload_bytes_2d": bytes_2d,
+        "payload_ratio": round(bytes_replicated / bytes_2d, 3),
+    }
+
+
 def bench_histogram_one_dispatch(
     rows: int = 1_000_000,
     features: int = 28,
@@ -984,6 +1096,11 @@ def bench_registry_cold_load(
 
 
 def run_bench(kernel: str = "histogram", **kw) -> dict:
+    # None-valued kwargs defer to each bench fn's own default — the CLI
+    # passes --features=None unless the user set it, so the wide-shape
+    # kernels (hist_2d: F=1024) keep their documented defaults instead
+    # of inheriting a narrow-arm constant.
+    kw = {k: v for k, v in kw.items() if v is not None}
     if kernel == "histogram":
         keys = ("backend", "rows", "features", "bins", "iters",
                 "partitions", "hist_impl", "seed", "reps")
@@ -1007,4 +1124,7 @@ def run_bench(kernel: str = "histogram", **kw) -> dict:
     if kernel == "hist_comms":
         keys = ("rows", "features", "bins", "depth", "iters", "seed")
         return bench_hist_comms_ab(**{k: kw[k] for k in keys if k in kw})
+    if kernel == "hist_2d":
+        keys = ("rows", "features", "bins", "depth", "iters", "seed")
+        return bench_hist_2d(**{k: kw[k] for k in keys if k in kw})
     raise ValueError(f"unknown bench kernel {kernel!r}")

@@ -29,6 +29,21 @@ from ddt_tpu.data import datasets
 from ddt_tpu.models.tree import TreeEnsemble
 
 
+def _parse_mesh_shape(v: "str | None") -> "tuple | None":
+    """--mesh-shape "Pr,Pf" -> (Pr, Pf) (TrainConfig.mesh_shape), None
+    passes through. Validation beyond the parse (>= 1, conflicts with
+    --partitions/--feature-partitions) lives in TrainConfig."""
+    if v is None:
+        return None
+    parts = [p.strip() for p in str(v).split(",")]
+    try:
+        pr, pf = (int(p) for p in parts)
+    except (TypeError, ValueError):
+        raise SystemExit(
+            f"--mesh-shape must be 'Pr,Pf' (two integers), got {v!r}")
+    return (pr, pf)
+
+
 def _positive_int(v: str) -> int:
     i = int(v)
     if i < 1:
@@ -460,6 +475,12 @@ def main(argv: list[str] | None = None) -> int:
     tp.add_argument("--host-partitions", type=int, default=1,
                     help="cross-slice DCN mesh axis for multi-host pods; "
                          "row shards span host-partitions x partitions")
+    tp.add_argument("--mesh-shape", default=None, metavar="Pr,Pf",
+                    help="declarative 2D (rows x features) mesh shape, "
+                         "e.g. 4,2 — the one-flag spelling of "
+                         "--partitions Pr --feature-partitions Pf "
+                         "(TrainConfig.mesh_shape; conflicts with "
+                         "setting those flags to different values)")
     tp.add_argument("--multihost-coordinator", default=None,
                     help="host:port of process 0 — runs jax.distributed."
                          "initialize before any device use, making "
@@ -693,8 +714,11 @@ def main(argv: list[str] | None = None) -> int:
     _add_common(bp)
     bp.add_argument("--kernel", default="histogram",
                     choices=["histogram", "train", "predict", "serve",
-                             "registry", "hist_comms"])
-    bp.add_argument("--features", type=int, default=28)
+                             "registry", "hist_comms", "hist_2d"])
+    bp.add_argument("--features", type=int, default=None,
+                    help="feature count; default = each kernel's own "
+                         "(28 for the narrow arms, 1024 for the wide "
+                         "hist_2d A/B)")
     bp.add_argument("--trees", type=int, default=100)
     bp.add_argument("--depth", type=int, default=6)
     bp.add_argument("--iters", type=int, default=10)
@@ -821,6 +845,7 @@ def main(argv: list[str] | None = None) -> int:
             backend=args.backend, n_partitions=args.partitions,
             feature_partitions=args.feature_partitions,
             host_partitions=args.host_partitions,
+            mesh_shape=_parse_mesh_shape(args.mesh_shape),
             subsample=args.subsample,
             colsample_bytree=args.colsample_bytree,
             hist_impl=args.hist_impl, seed=args.seed,

@@ -66,6 +66,18 @@ class TrainConfig:
     backend: str = "tpu"        # cpu | tpu | fpga(stub)
     n_partitions: int = 1       # row partitions (data parallel over mesh axis)
     feature_partitions: int = 1  # column partitions (TP-analog mesh axis)
+    # Declarative 2D mesh shape (Pr, Pf) — the ROADMAP item 2 spelling
+    # of the (rows x features) layout (--mesh-shape Pr,Pf on the CLI).
+    # When set it NORMALIZES into n_partitions/feature_partitions at
+    # construction and then resets to None — a pure constructor-time
+    # input, so both spellings of the same mesh produce byte-identical
+    # configs (equal run-id digests, backend cache keys, checkpoint
+    # fingerprints; `.replace()` never false-conflicts against a stale
+    # stored pair). Setting it alongside a CONFLICTING explicit
+    # n_partitions/feature_partitions raises — two sources of truth
+    # for the mesh shape is a silent-wrong-mesh bug, not a
+    # convenience.
+    mesh_shape: "Optional[tuple]" = None
     host_partitions: int = 1    # cross-slice "hosts" mesh axis (DCN): row
     #   shards span hosts x rows; histogram psum phases ICI-first then DCN.
     #   Total devices used = host_partitions x n_partitions x
@@ -178,6 +190,31 @@ class TrainConfig:
             raise ValueError("max_depth must be >= 1")
         if self.loss == "softmax" and self.n_classes < 2:
             raise ValueError("softmax needs n_classes >= 2")
+        if self.mesh_shape is not None:
+            ms = tuple(int(v) for v in self.mesh_shape)
+            if len(ms) != 2 or any(v < 1 for v in ms):
+                raise ValueError(
+                    f"mesh_shape must be a (Pr >= 1, Pf >= 1) pair, got "
+                    f"{self.mesh_shape!r}")
+            pr, pf = ms
+            if self.n_partitions not in (1, pr):
+                raise ValueError(
+                    f"mesh_shape={ms} conflicts with n_partitions="
+                    f"{self.n_partitions}; set one, not both")
+            if self.feature_partitions not in (1, pf):
+                raise ValueError(
+                    f"mesh_shape={ms} conflicts with feature_partitions="
+                    f"{self.feature_partitions}; set one, not both")
+            object.__setattr__(self, "n_partitions", pr)
+            object.__setattr__(self, "feature_partitions", pf)
+        # CANONICALIZE to None after normalizing: mesh_shape is a pure
+        # constructor-time input, so both spellings of the same mesh
+        # produce byte-IDENTICAL configs (equal run-id digests, backend
+        # cache keys, checkpoint fingerprints) and `.replace(
+        # n_partitions=...)` on a mesh_shape-built config cannot
+        # false-conflict against a stale stored pair. Consumers read
+        # the normalized n_partitions/feature_partitions fields.
+        object.__setattr__(self, "mesh_shape", None)
         if (self.n_partitions < 1 or self.feature_partitions < 1
                 or self.host_partitions < 1):
             raise ValueError("partition counts must be >= 1")
@@ -298,4 +335,6 @@ def load_config_file(path: str) -> dict:
         )
     if "cat_features" in d:
         d["cat_features"] = tuple(d["cat_features"])
+    if d.get("mesh_shape") is not None:
+        d["mesh_shape"] = tuple(d["mesh_shape"])
     return d

@@ -177,6 +177,169 @@ def directory_chunks(src_dir: str):
     return f
 
 
+class HostShardedChunks:
+    """Per-host-addressable chunk source (ROADMAP item 2's ingest half).
+
+    Every group of `shards_per_chunk` consecutive ``chunk_*.npz`` files
+    forms one LOGICAL training chunk: logical chunk ``c`` is the row
+    concatenation of sub-shards ``c*spc .. (c+1)*spc - 1`` in file
+    order. A view for process ``p`` reads the feature matrix of ONLY
+    the sub-shards the chunk-shard→host ``assignment`` maps to ``p`` —
+    fit_streaming assembles the global device array from those local
+    blocks (TPUDevice.upload_row_shards, the
+    jax.make_array_from_process_local_data path), so ingest bandwidth
+    scales with the host count instead of bottlenecking one controller.
+
+    Labels deliberately stay a GLOBAL side channel (``labels(c)`` reads
+    every sub-shard's ``y`` member): the base score, chunk lengths, and
+    validity masks are global metadata, and at 4 bytes/row labels are
+    noise next to the F bytes/row feature matrix the ownership contract
+    protects. npz members load lazily, so the label read never touches
+    an unowned shard's ``X``.
+
+    The ownership CONTRACT: with ``process_count > 1`` a full-chunk
+    call (``source(c)``) raises — nothing on the host-sharded path may
+    materialize another host's feature rows. Single-process views own
+    every slot, so the callable form keeps working (the in-memory
+    comparators and the host loop ride it).
+
+    ``rotate_assignment()`` is the skew response's ingest half (the
+    straggler watchdog's streamed re-partition): the slot→host map
+    rotates by one host, so after the paired mesh rotation each host
+    reads the sub-shards that now land on its devices. The GLOBAL row
+    order never changes — re-partitioning is bit-identical by
+    construction, exactly like ``rotate_row_partitions`` on the
+    in-memory path."""
+
+    host_sharded = True
+
+    def __init__(self, src_dir: str, shards_per_chunk: int,
+                 process_index: int | None = None,
+                 process_count: int | None = None,
+                 assignment: "tuple | None" = None):
+        if process_index is None or process_count is None:
+            import jax
+
+            process_index = jax.process_index()
+            process_count = jax.process_count()
+        files = chunk_files(src_dir)
+        if shards_per_chunk < 1:
+            raise ValueError(
+                f"shards_per_chunk must be >= 1, got {shards_per_chunk}")
+        if len(files) % shards_per_chunk:
+            raise ValueError(
+                f"{len(files)} shard files do not group into logical "
+                f"chunks of {shards_per_chunk} sub-shards; re-cut the "
+                "shards (data.chunks.shard_arrays with a multiple)")
+        if shards_per_chunk % process_count:
+            raise ValueError(
+                f"shards_per_chunk={shards_per_chunk} must be a multiple "
+                f"of process_count={process_count} so every host owns an "
+                "equal contiguous block")
+        self._files = files
+        self.n_shards_per_chunk = shards_per_chunk
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.n_chunks = len(files) // shards_per_chunk
+        if assignment is None:
+            # Contiguous blocks: slot s -> host s*P//spc, so each host's
+            # sub-shards are adjacent rows (matching the hosts-outermost
+            # mesh's contiguous addressable row range).
+            assignment = tuple(
+                s * process_count // shards_per_chunk
+                for s in range(shards_per_chunk))
+        self.assignment = tuple(int(a) for a in assignment)
+        if sorted(set(self.assignment)) != list(range(process_count)):
+            raise ValueError(
+                f"assignment {self.assignment} must cover every process "
+                f"in [0, {process_count})")
+        with np.load(files[0]) as d0:
+            X0 = d0["X"]
+            self.n_features = int(X0.shape[1])
+            self.binned = X0.dtype == np.uint8
+        self._lens: dict = {}
+
+    # -- ownership ----------------------------------------------------- #
+
+    def owned_slots(self, c: int) -> list[int]:
+        """Sub-shard slots of logical chunk `c` this process reads (the
+        assignment is chunk-independent: skew is a host property)."""
+        return [s for s in range(self.n_shards_per_chunk)
+                if self.assignment[s] == self.process_index]
+
+    def rotate_assignment(self) -> None:
+        """Rotate the slot→host map by one host (the watchdog's streamed
+        re-partition, ingest half). Callers pair this with the backend's
+        mesh rotation; the global row order is untouched."""
+        P = self.process_count
+        self.assignment = tuple((a + 1) % P for a in self.assignment)
+
+    # -- reads --------------------------------------------------------- #
+
+    def _file(self, c: int, s: int) -> str:
+        return self._files[c * self.n_shards_per_chunk + s]
+
+    def read_part(self, c: int, s: int) -> np.ndarray:
+        """Feature matrix of sub-shard `s` of logical chunk `c` — the
+        ONLY sanctioned X read on a multi-process view, and only for
+        owned slots."""
+        if self.process_count > 1 and self.assignment[s] != \
+                self.process_index:
+            raise PermissionError(
+                f"process {self.process_index} asked for sub-shard "
+                f"(chunk {c}, slot {s}) owned by process "
+                f"{self.assignment[s]} — the host-sharded ownership "
+                "contract forbids cross-host chunk reads")
+        with np.load(self._file(c, s)) as d:
+            return d["X"]
+
+    def part_rows(self, c: int) -> list[int]:
+        """Per-slot row counts of logical chunk `c` (y-member reads
+        only — cached)."""
+        lens = self._lens.get(c)
+        if lens is None:
+            lens = []
+            for s in range(self.n_shards_per_chunk):
+                with np.load(self._file(c, s)) as d:
+                    lens.append(int(d["y"].shape[0]))
+            self._lens[c] = lens
+        return lens
+
+    def chunk_rows(self, c: int) -> int:
+        return sum(self.part_rows(c))
+
+    def labels(self, c: int) -> np.ndarray:
+        """Logical chunk c's GLOBAL labels (y members only, every slot)."""
+        ys = []
+        for s in range(self.n_shards_per_chunk):
+            with np.load(self._file(c, s)) as d:
+                ys.append(d["y"])
+        return np.concatenate(ys)
+
+    def __call__(self, c: int):
+        """Full logical chunk — single-process only (comparators, the
+        host loop); a multi-process call is an ownership violation."""
+        if self.process_count > 1:
+            raise PermissionError(
+                "full-chunk reads are forbidden on a multi-process "
+                "host-sharded source (ownership contract); use "
+                "read_part(c, slot) for owned slots")
+        X = np.concatenate([self.read_part(c, s)
+                            for s in range(self.n_shards_per_chunk)])
+        return X, self.labels(c)
+
+
+def host_sharded_chunks(src_dir: str, shards_per_chunk: int,
+                        process_index: int | None = None,
+                        process_count: int | None = None) -> \
+        HostShardedChunks:
+    """This process's view of a host-sharded shard directory (see
+    HostShardedChunks). The fit_streaming-facing constructor."""
+    return HostShardedChunks(src_dir, shards_per_chunk,
+                             process_index=process_index,
+                             process_count=process_count)
+
+
 def write_binned_cache(
     raw_chunk_fn,
     n_chunks: int,

@@ -721,10 +721,12 @@ class Driver:
         feed_watchdog(self._watchdog, self.run_log, rnd, parts, log)
 
     def _wants_repartition(self) -> bool:
+        # The 2D (rows x features) mesh repartitions too since ISSUE 11:
+        # rotate_row_partitions rolls the ROW axis of the device grid
+        # (feature columns preserved), so no feature_partitions guard.
         return (self._watchdog is not None
                 and self._watchdog.pending_repartition
                 and self.cfg.straggler_repartition
-                and getattr(self.backend, "feature_partitions", 1) == 1
                 and getattr(self.backend, "rotate_row_partitions", None)
                 is not None)
 
@@ -744,12 +746,12 @@ class Driver:
             self._watchdog.repartition_done()
             return data, y_dev, pred, val_data, val_y, val_pred
         extra = 1 if C > 1 else 0
-        data = be.reshard_rows(data, extra_dims=1)
+        data = be.reshard_data(data)
         y_dev = type(y_dev)(be.reshard_rows(y_dev.y),
                             be.reshard_rows(y_dev.valid))
         pred = be.reshard_rows(pred, extra_dims=extra)
         if val_data is not None:
-            val_data = be.reshard_rows(val_data, extra_dims=1)
+            val_data = be.reshard_data(val_data)
         if val_y is not None:
             val_y = type(val_y)(be.reshard_rows(val_y.y),
                                 be.reshard_rows(val_y.valid))

@@ -238,6 +238,11 @@ def grow_tree(
     #   runs on the slab, and the tiny per-shard winner tuples are
     #   combined by GLOBAL flattened candidate index
     #   (comms.combine_shard_winners) — same trees, O(F·B/P) payload.
+    #   COMPOSES with feature_axis_name (the 2D rows x features mesh):
+    #   the scatter runs over the row axes WITHIN this shard's F/Pf
+    #   column slab (per-device slab F/(Pr·Pf)) and ONE winner combine
+    #   gathers over both axes — trees stay structure-identical to
+    #   single-device at any (Pr, Pf).
     hist_comms_dtype: str = "f32",   # wire dtype of the histogram
     #   collective (comms.hist_reduce): f32 | bf16 | int32_fixed.
     comms_slabs: int = 1,            # RESOLVED slab-pipelining factor
@@ -283,8 +288,6 @@ def grow_tree(
     # is hist_collective — psum or reduce_scatter over the row axes,
     # optionally compressed on the wire.
     rs = split_comms == "reduce_scatter" and axis_name is not None
-    assert not (rs and feature_axis_name is not None), \
-        "split_comms='reduce_scatter' does not compose with a feature axis"
     P_row = comms.axis_size(axis_name)
 
     def allreduce(x):
@@ -368,10 +371,15 @@ def grow_tree(
                     # Slab-local split finding: masks gather down to this
                     # shard's columns (padded ids >= F are invalid), the
                     # slab argmax runs locally, winners map back to
-                    # GLOBAL feature ids via col_ids, and the tiny
-                    # per-shard tuples combine by global flattened
-                    # candidate index — exactly the single-device
-                    # argmax's pick (comms.combine_shard_winners).
+                    # GLOBAL feature ids via col_ids (+ the feature-shard
+                    # offset on a 2D mesh), and the tiny per-shard tuples
+                    # combine by global flattened candidate index —
+                    # exactly the single-device argmax's pick
+                    # (comms.combine_shard_winners). With a feature axis
+                    # the combine gathers over BOTH axes in one pass:
+                    # every (row, feature) shard owns a disjoint global
+                    # column set, so the layout-independent tie-break key
+                    # needs no per-axis staging.
                     valid_loc = col_ids < F
                     cid = jnp.minimum(col_ids, F - 1)
                     fm_loc = valid_loc if feature_mask is None else (
@@ -382,25 +390,34 @@ def grow_tree(
                         hist, reg_lambda, min_child_weight, fm_loc,
                         missing_bin=missing_bin, cat_mask=cm_loc)
                     feats = jnp.take(col_ids, feats)
+                    if feature_axis_name is None:
+                        combine_axes, nf = axis_name, F
+                    else:
+                        feats = feats + f_lo
+                        row_t = (axis_name if isinstance(axis_name, tuple)
+                                 else (axis_name,))
+                        combine_axes = row_t + (feature_axis_name,)
+                        nf = F_global
                     gains, feats, bins, dls = comms.combine_shard_winners(
-                        gains, feats, bins, dls, axis_name,
-                        n_features=F, n_bins=n_bins,
+                        gains, feats, bins, dls, combine_axes,
+                        n_features=nf, n_bins=n_bins,
                         missing_bin=missing_bin)
                 else:
                     gains, feats, bins, dls = S.best_splits_impl(
                         hist, reg_lambda, min_child_weight, feature_mask,
                         missing_bin=missing_bin, cat_mask=cat_vec)
-                if feature_axis_name is not None:
-                    # Combine per-shard winners: all_gather the (gain,
-                    # feat, bin, direction) tuples (tiny) and pick by
-                    # global flattened candidate index — the global
-                    # first-(direction, feature, bin) tie-break rule
-                    # (comms.combine_shard_winners).
-                    feats = feats + f_lo
-                    gains, feats, bins, dls = comms.combine_shard_winners(
-                        gains, feats, bins, dls, feature_axis_name,
-                        n_features=F_global, n_bins=n_bins,
-                        missing_bin=missing_bin)
+                    if feature_axis_name is not None:
+                        # Combine per-shard winners: all_gather the
+                        # (gain, feat, bin, direction) tuples (tiny) and
+                        # pick by global flattened candidate index — the
+                        # global first-(direction, feature, bin)
+                        # tie-break rule (comms.combine_shard_winners).
+                        feats = feats + f_lo
+                        gains, feats, bins, dls = \
+                            comms.combine_shard_winners(
+                                gains, feats, bins, dls, feature_axis_name,
+                                n_features=F_global, n_bins=n_bins,
+                                missing_bin=missing_bin)
             # Guarded like the final level and the streamed twin: an EMPTY
             # node at reg_lambda=0 would otherwise store -0/0 = NaN as its
             # leaf value, which a predict-time row (different data) can

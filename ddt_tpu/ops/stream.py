@@ -16,7 +16,14 @@ whole per-(chunk, level) step on device:
 Everything here traces under jit and under shard_map (axis_name set): a
 pod streams chunks with each chunk row-sharded over the mesh, the partial
 histogram psum riding ICI/DCN exactly like the in-memory trainer
-(SURVEY.md §5 "Distributed communication backend", §7 M6).
+(SURVEY.md §5 "Distributed communication backend", §7 M6). Since ISSUE
+11 the chunks themselves can arrive HOST-SHARDED (each process reads
+only its own sub-shards — data/chunks.HostShardedChunks assembled by
+TPUDevice.upload_row_shards); these kernels are unchanged by that: the
+assembled global array has the identical row-sharded layout. The
+streamed ops stay row-parallel-only — the 2D (rows x features) mesh is
+the in-memory trainer's layout (streaming a wide dataset shards its
+LONG axis; ops/grow.py carries the feature-axis composition).
 
 Bit-compatibility: traversal mirrors streaming._traverse_partial (the
 host twin) and the histogram sum enters the same bf16-rounded split

@@ -32,7 +32,11 @@ Three concerns live here (ISSUE 10, docs/PERF.md "Histogram comms"):
   flattened candidate index (direction block, then feature, then bin),
   so the combined winner is exactly the single-device argmax's pick —
   including the missing-bin RIGHT-block-first rule — regardless of
-  which shard owns which slab.
+  which shard owns which slab. On a 2D (rows x features) mesh the
+  scatter runs over the row axes WITHIN each feature slab (per-device
+  slab F/(Pr·Pf)) and the winner combine gathers over BOTH axes — the
+  tie-break key is layout-independent, so composition needs no new
+  rule (ROADMAP item 2).
 
 - **Compressed collective payloads** (`cfg.hist_comms_dtype`, opt-in):
   `bf16` halves the wire bytes at ~2^-9 relative rounding per partial;
@@ -312,32 +316,32 @@ def combine_shard_winners(gains, feats, bins, dls, axis_name, *,
 # --------------------------------------------------------------------- #
 
 def resolve_split_comms(flag: str, *, distributed: bool,
-                        feature_partitions: int = 1) -> str:
+                        feature_partitions: int = 1,
+                        row_shards: "int | None" = None) -> str:
     """cfg.split_comms -> "allreduce" | "reduce_scatter" for this mesh.
 
-    "auto" picks reduce_scatter exactly when a row mesh is live (the
-    collective exists only then) and the feature axis is NOT sharded —
-    column sharding already distributes split finding, and scattering
-    its F/fp slabs again is ROADMAP follow-up, not silently composed.
-    Forcing "reduce_scatter" onto a feature-sharded mesh raises."""
+    Since the 2D (rows x features) mesh landed (ROADMAP item 2),
+    reduce-scatter split finding COMPOSES with a sharded feature axis:
+    the scatter runs over the ROW axes *within* each feature slab (each
+    of the Pr x Pf devices ends up with an F/(Pr*Pf) sub-slab) and the
+    winner combine all_gathers over both axes — grow_tree wires it, so
+    the old feature-sharded refusal is gone. `feature_partitions` is
+    kept for signature compatibility; it no longer changes the answer.
+
+    "auto" picks reduce_scatter exactly when a ROW wire exists —
+    `row_shards` > 1 when the caller knows the row-axis extent (the
+    hosts x rows product), else `distributed` as the legacy proxy. A
+    pure feature mesh (Pr=1, Pf>1) has no row wire to scatter, so it
+    resolves to allreduce (a size-1-axis scatter is an identity with
+    extra ceremony). Forcing "reduce_scatter" without a row wire
+    degrades to allreduce the same way."""
     if flag not in SPLIT_COMMS:
         raise ValueError(
             f"split_comms must be one of {SPLIT_COMMS}, got {flag!r}")
     if flag == "allreduce":
         return "allreduce"
-    if flag == "reduce_scatter":
-        if feature_partitions > 1:
-            raise ValueError(
-                "split_comms='reduce_scatter' does not compose with "
-                "feature_partitions > 1 (the feature axis already shards "
-                "split finding); use 'auto' or 'allreduce'")
-        if not distributed:
-            return "allreduce"       # no wire — nothing to scatter
-        return "reduce_scatter"
-    # auto
-    if distributed and feature_partitions == 1:
-        return "reduce_scatter"
-    return "allreduce"
+    has_row_wire = (distributed if row_shards is None else row_shards > 1)
+    return "reduce_scatter" if has_row_wire else "allreduce"
 
 
 #: Auto slab count for the pipelined build+collective loop: enough

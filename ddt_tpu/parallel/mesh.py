@@ -16,7 +16,9 @@ TPUDevice row-shards over the global mesh and the Driver loop never knows.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
+import re
 
 import jax
 
@@ -24,6 +26,105 @@ log = logging.getLogger("ddt_tpu.parallel")
 
 ROWS_AXIS = "rows"
 HOSTS_AXIS = "hosts"
+FEATURES_AXIS = "features"
+
+P = jax.sharding.PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs for every trainer operand over the
+    declarative 2D (rows x features) mesh — the SpecLayout idiom
+    (SNIPPETS [3]) applied to histogram GBDT.
+
+    `row_axes` is the row-shard axis name — a ("hosts", "rows") tuple on
+    pod meshes, plain "rows" otherwise, or None on single-device
+    backends (every spec degenerates to replicated, so single-device
+    traces share the callers' code). `feature_axis` is the optional
+    column axis ("features"), or None when the feature dimension is
+    replicated.
+
+    The layout is the ONE home of "which operand shards how": backends
+    resolve in_specs/out_specs through the rule table below
+    (match_partition_rules) by operand NAME, so adding a mesh axis is a
+    table edit, not a hunt through every shard_map call site."""
+
+    row_axes: "str | tuple[str, ...] | None" = ROWS_AXIS
+    feature_axis: "str | None" = None
+
+    # -- canonical per-operand specs ---------------------------------- #
+
+    def binned_data(self) -> P:
+        """uint8 [R, F]: rows sharded, columns sharded when the feature
+        axis is live (the wide-dataset case ROADMAP item 2 exists for)."""
+        if self.row_axes is None:
+            return P()
+        return P(self.row_axes, self.feature_axis)
+
+    def row_vector(self) -> P:
+        """float32/int32 [R]: gradients, hessians, node indices, labels,
+        validity masks — row-sharded, feature-replicated."""
+        return P() if self.row_axes is None else P(self.row_axes)
+
+    def row_matrix(self) -> P:
+        """[R, C] per-class state (softmax pred): rows sharded, classes
+        replicated."""
+        return P() if self.row_axes is None else P(self.row_axes, None)
+
+    def level_hist_scattered(self) -> P:
+        """[n_level, F, B, 2] POST-reduce-scatter level histogram: the
+        feature dim sharded over the ROW axes (each row shard merged one
+        F/Pr slab — parallel/comms.hist_reduce)."""
+        if self.row_axes is None:
+            return P()
+        return P(None, self.row_axes)
+
+    def replicated(self) -> P:
+        """Tree node arrays, split winners, scalars, colsample masks —
+        tiny, identical on every shard by construction."""
+        return P()
+
+    # -- the declarative rule table ----------------------------------- #
+
+    def rules(self) -> list:
+        """[(operand-name regex, PartitionSpec)] — first match wins
+        (match_partition_rules). Names are the backends' operand
+        vocabulary; `.*` (replicated) is the explicit fallback so a
+        typo'd name fails the match audit in tests, not silently."""
+        return [
+            (r"^(data|binned|Xb)", self.binned_data()),
+            (r"^(grad|hess|node_index|labels|valid|row_keep|pred1d|y)$",
+             self.row_vector()),
+            (r"^(pred|val_pred)$", self.row_matrix()),
+            (r"^hist_scattered$", self.level_hist_scattered()),
+            (r"^(tree|winners|mask|scalar|fmasks|replicated)",
+             self.replicated()),
+        ]
+
+    def spec(self, name: str) -> P:
+        return match_partition_rules(self.rules(), [name])[0]
+
+    def specs(self, *names: str) -> tuple:
+        return match_partition_rules(self.rules(), list(names))
+
+
+def match_partition_rules(rules, names) -> tuple:
+    """PartitionSpec per operand name from a [(regex, spec)] rule table
+    — the match_partition_rules idiom (SNIPPETS [1]) on operand names
+    instead of parameter-tree paths (a GBDT trainer has a dozen named
+    operands, not a parameter pytree). Unmatched names fail loudly: a
+    silently-replicated row matrix is a 10x memory bug, not a default."""
+    out = []
+    for name in names:
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                out.append(spec)
+                break
+        else:
+            raise ValueError(
+                f"no partition rule matches operand {name!r}; add it to "
+                "SpecLayout.rules()")
+    return tuple(out)
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -110,6 +211,45 @@ def make_pod_mesh(
         )
     return jax.make_mesh(
         (n_hosts, devices_per_host), (HOSTS_AXIS, ROWS_AXIS),
+        devices=devs[:n_dev],
+    )
+
+
+def make_mesh_2d(
+    row_partitions: int,
+    feature_partitions: int = 1,
+    n_hosts: int = 1,
+    devices: list | None = None,
+) -> jax.sharding.Mesh:
+    """Declarative 2D (rows x features) mesh — ROADMAP item 2's layout.
+
+    Axis order is (hosts?, rows, features): hosts outermost (DCN,
+    slowest-varying, so each host's devices stay ICI-contiguous), rows
+    middle, features innermost (ICI-adjacent — the per-level winner
+    gather over the feature axis is latency-sensitive; the hosts hop
+    happens once per reduction). The features axis is always present on
+    the 2-D form (size 1 when unsharded) so partition specs naming it
+    resolve on every mesh; the pure pod form (make_pod_mesh) remains the
+    (hosts, rows) spelling for row-only multi-slice runs.
+
+    This is the ONE mesh constructor the TPUDevice backend uses; pass
+    `cfg.mesh_shape=(Pr, Pf)` (or --mesh-shape Pr,Pf) and the backend
+    calls this with those extents."""
+    devs = devices if devices is not None else jax.devices()
+    n_dev = n_hosts * row_partitions * feature_partitions
+    if len(devs) < n_dev:
+        raise ValueError(
+            f"mesh ({n_hosts} hosts x {row_partitions} rows x "
+            f"{feature_partitions} features) needs {n_dev} devices, "
+            f"have {len(devs)}"
+        )
+    if n_hosts > 1:
+        return jax.make_mesh(
+            (n_hosts, row_partitions, feature_partitions),
+            (HOSTS_AXIS, ROWS_AXIS, FEATURES_AXIS), devices=devs[:n_dev],
+        )
+    return jax.make_mesh(
+        (row_partitions, feature_partitions), (ROWS_AXIS, FEATURES_AXIS),
         devices=devs[:n_dev],
     )
 

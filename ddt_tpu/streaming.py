@@ -366,14 +366,29 @@ class _DeviceChunkCache:
         self._backend = backend
         self._chunk_fn = chunk_fn
         self._budget = budget          # [remaining_bytes], shared train/val
-        self._cached: dict = {}
+        self._cached: dict = {}        # c -> (handle, nbytes)
+
+    def _upload(self, c: int):
+        """One chunk's device handle — via the host-sharded per-process
+        assembly when the source is per-host-addressable
+        (data.chunks.HostShardedChunks + TPUDevice.upload_row_shards:
+        this process reads ONLY its own sub-shards), else the classic
+        full-chunk read + row-sharded upload."""
+        src = self._chunk_fn
+        if getattr(src, "host_sharded", False) and \
+                getattr(self._backend, "upload_row_shards", None) \
+                is not None:
+            parts = [src.read_part(c, s) for s in src.owned_slots(c)]
+            return self._backend.upload_row_shards(parts,
+                                                   src.chunk_rows(c))
+        Xc = np.asarray(src(c)[0])
+        return self._backend.upload(Xc)
 
     def get(self, c: int):
-        h = self._cached.get(c)
-        if h is not None:
-            return h
-        Xc = np.asarray(self._chunk_fn(c)[0])
-        h = self._backend.upload(Xc)
+        hit = self._cached.get(c)
+        if hit is not None:
+            return hit[0]
+        h = self._upload(c)
         # Budget accounting uses the handle's ACTUAL per-process device
         # footprint (upload pads rows to the shard count and uneven chunk
         # sizes pad differently, so host-side Xc.nbytes undercounts).
@@ -382,11 +397,19 @@ class _DeviceChunkCache:
         try:
             nbytes = sum(s.data.nbytes for s in h.addressable_shards)
         except (AttributeError, TypeError):
-            nbytes = Xc.nbytes      # host-array backends: no shard view
+            nbytes = int(np.asarray(h).nbytes)   # host arrays: no shards
         if nbytes <= self._budget[0]:
             self._budget[0] -= nbytes
-            self._cached[c] = h
+            self._cached[c] = (h, nbytes)
         return h
+
+    def clear(self) -> None:
+        """Drop every cached handle and refund the budget — the streamed
+        re-partition rebuilt the mesh, so cached placements are stale
+        (the next get() re-uploads onto the rotated device order)."""
+        for _, nbytes in self._cached.values():
+            self._budget[0] += nbytes
+        self._cached.clear()
 
 
 def fit_streaming(
@@ -653,11 +676,14 @@ def _fit_streaming_impl(
             C * n_chunks * backend.collective_bytes_per_tree(
                 int(F), streamed=True)
             if getattr(backend, "distributed", False) else 0))
-    # Straggler watchdog (robustness/watchdog.py) — DETECTION only on
-    # the streaming path (fault events per trip; repartitioning a
-    # streamed run means re-cutting chunk->host assignment, which is
-    # ROADMAP item 3's elastic rework). Exists exactly when the
-    # recorder is active.
+    # Straggler watchdog (robustness/watchdog.py) — detection always
+    # (fault events per trip); behind cfg.straggler_repartition the
+    # DEVICE streaming loop also ACTS at checkpoint-cadence boundaries:
+    # mesh rotation + resident-state reshard + chunk-cache drop + a
+    # host-sharded source's chunk-shard->host assignment rotation
+    # (bit-identical by construction — the rotate_row_partitions
+    # contract extended to the streamed path, ROADMAP item 2). Exists
+    # exactly when the recorder is active.
     watchdog = None
     if part_rec.active:
         from ddt_tpu.robustness.watchdog import StragglerWatchdog
@@ -1222,6 +1248,56 @@ def _fit_streaming_device(
         log.info("streaming: round %d/%d done", rnd + 1, cfg.n_trees)
         checkpoint.maybe_save(checkpoint_dir, ens, cfg, rnd + 1,
                               checkpoint_every)
+        if (watchdog is not None and cfg.straggler_repartition
+                and watchdog.pending_repartition
+                and checkpoint_every >= 1
+                and (rnd + 1) % checkpoint_every == 0
+                and getattr(backend, "rotate_row_partitions", None)
+                is not None):
+            # The watchdog's streamed ACTION (the in-memory path's
+            # rotate_row_partitions contract extended to the streamed
+            # loop, ROADMAP item 2): rotate the row-shard -> device
+            # assignment at the checkpoint boundary, move every
+            # RESIDENT handle (labels, predictions) onto the rotated
+            # mesh, drop the device chunk caches (their placements are
+            # stale; the next pass re-uploads onto the new order), and
+            # rotate a host-sharded source's chunk-shard -> host
+            # assignment so reads keep following the devices. Shard
+            # CONTENTS and the global row order are untouched — the
+            # model is bit-identical by construction (tested). Scope
+            # honesty: rotate_row_partitions is single-controller only
+            # (multi-process meshes return False -> detection only,
+            # like the in-memory path), and on one process the
+            # assignment rotation is an identity (every slot is
+            # local) — the rot() call keeps the mesh/ingest pairing
+            # explicit for ROADMAP item 5's multi-process rework,
+            # where host-level rotation makes both halves real.
+            if backend.rotate_row_partitions():
+                extra = 1 if C > 1 else 0
+                y_dev = [type(h)(backend.reshard_rows(h.y),
+                                 backend.reshard_rows(h.valid))
+                         for h in y_dev]
+                pred_dev = [backend.reshard_rows(p, extra_dims=extra)
+                            for p in pred_dev]
+                if ev is not None:
+                    val_y_dev = [type(h)(backend.reshard_rows(h.y),
+                                         backend.reshard_rows(h.valid))
+                                 for h in val_y_dev]
+                    val_pred = [backend.reshard_rows(p, extra_dims=extra)
+                                for p in val_pred]
+                chunks.clear()
+                if val_chunks is not None:
+                    val_chunks.clear()
+                rot = getattr(chunk_fn, "rotate_assignment", None)
+                if rot is not None:
+                    rot()
+                log.warning(
+                    "streaming: repartitioned at round %d: rotated row "
+                    "shards off the straggling device", rnd + 1)
+                if run_log is not None:
+                    run_log.emit("fault", kind="repartition",
+                                 round=rnd + 1, rotation=1)
+            watchdog.repartition_done()
 
     checkpoint.maybe_save(checkpoint_dir, ens, cfg, cfg.n_trees)
     return ens

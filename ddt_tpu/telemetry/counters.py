@@ -214,7 +214,8 @@ def host_peak_rss_bytes() -> int | None:
 def hist_allreduce_bytes(max_depth: int, n_features: int, n_bins: int,
                          *, partitions: int = 1, mode: str = "allreduce",
                          subtraction: bool = False,
-                         comms_dtype: str = "f32") -> int:
+                         comms_dtype: str = "f32",
+                         feature_partitions: int = 1) -> int:
     """EFFECTIVE per-device collective payload estimate for ONE tree's
     histogram phases (parallel/comms.py is the wire this models; the
     two must change together).
@@ -233,6 +234,13 @@ def hist_allreduce_bytes(max_depth: int, n_features: int, n_bins: int,
       [n_level] vectors from each of the P shards.
     - `comms_dtype` — wire bytes per histogram value (f32/int32_fixed 4,
       bf16 2; parallel/comms.COMMS_DTYPE_BYTES).
+    - `feature_partitions` — the 2D (rows x features) mesh's second
+      axis (Pf): each device histograms only its F/Pf column slab, so
+      the row-axis collective carries F/Pf columns per device —
+      composed with reduce_scatter the per-device slab is F/(Pf·Pr),
+      i.e. <= 1/(Pr·Pf) of the replicated-feature allreduce baseline
+      (plus the O(Pr·Pf·nodes) winner term, which then gathers over
+      both axes).
 
     An estimate because the collective lives inside a fused device
     program where the host cannot observe the wire; shapes are static
@@ -241,16 +249,27 @@ def hist_allreduce_bytes(max_depth: int, n_features: int, n_bins: int,
 
     per_entry = COMMS_DTYPE_BYTES[comms_dtype] * 2   # (g, h) pairs
     P = max(1, partitions)
+    Pf = max(1, feature_partitions)
+    # Per-device column count: the feature axis slices columns FIRST
+    # (upload pads F to a multiple of Pf), then reduce_scatter sub-slabs
+    # that slice over the row shards.
+    f_dev = -(-n_features // Pf)
     total = 0
     for d in range(max_depth):
         nodes = 1 << d
         if subtraction and d >= 1:
             nodes //= 2                   # left children only
         if mode == "reduce_scatter":
-            f_pad = -(-n_features // P) * P
+            f_pad = -(-f_dev // P) * P
             total += nodes * (f_pad // P) * n_bins * per_entry
-            # Winner combine: gain/feat/bin/dl x [n_level] from P shards.
-            total += P * (1 << d) * 4 * 4
+            # Winner combine: gain/feat/bin/dl x [n_level] from every
+            # shard that owns a distinct slab (Pr row shards x Pf
+            # feature shards on the 2D mesh).
+            total += P * Pf * (1 << d) * 4 * 4
         else:
-            total += nodes * n_features * n_bins * per_entry
+            total += nodes * f_dev * n_bins * per_entry
+            if Pf > 1:
+                # Column-sharded allreduce mode still combines winners
+                # across the feature axis (tiny tuples per level).
+                total += Pf * (1 << d) * 4 * 4
     return total + (1 << max_depth) * 4 * 2   # leaf aggregates: f32 psum

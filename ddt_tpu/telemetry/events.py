@@ -245,6 +245,15 @@ def comms_manifest_fields(backend) -> dict:
         "split_comms": getattr(backend, "split_comms", "allreduce"),
         "hist_comms_dtype": backend.cfg.hist_comms_dtype,
         "hist_comms_slabs": int(getattr(backend, "comms_slabs", 1)),
+        # ISSUE 11 extra: the LIVE mesh's (row shards, feature shards)
+        # pair — the second axis the partition_phases lanes and the
+        # comms roofline's effective-bytes model account for. Named
+        # mesh_LAYOUT, not mesh_shape: row_shards folds host_partitions
+        # in (hosts x rows), so this is NOT replayable as
+        # cfg.mesh_shape on pod runs. Schema extra like the rest:
+        # absent in pre-2D logs, optional to report.
+        "mesh_layout": [int(getattr(backend, "row_shards", 1)),
+                        int(getattr(backend, "feature_partitions", 1))],
     }
 
 

@@ -81,7 +81,7 @@ def _cfg_fingerprint(cfg: TrainConfig) -> dict:
     # The robustness knobs are system knobs too: a run that crashed UNDER a
     # fault plan must resume WITHOUT one.
     for k in ("n_trees", "n_partitions", "feature_partitions",
-              "host_partitions", "hist_impl", "backend",
+              "host_partitions", "mesh_shape", "hist_impl", "backend",
               "matmul_input_dtype", "fault_plan", "straggler_repartition",
               "straggler_skew_threshold"):
         d.pop(k, None)

@@ -168,4 +168,23 @@ def retrying_chunk_fn(chunk_fn, policy: RetryPolicy | None = None):
 
         f.labels = lambda c: retry_call(
             read_labels, c, seam="stream.chunk_read", policy=policy)
+    if getattr(chunk_fn, "host_sharded", False):
+        # Host-sharded sources (data.chunks.HostShardedChunks): the
+        # per-part X reads go through the SAME retry seam; ownership
+        # bookkeeping (owned_slots / rotate_assignment / row counts)
+        # passes through to the live source object so an assignment
+        # rotation is visible to every holder of this wrapper.
+        f.host_sharded = True
+        f.n_shards_per_chunk = chunk_fn.n_shards_per_chunk
+        f.owned_slots = chunk_fn.owned_slots
+        f.rotate_assignment = chunk_fn.rotate_assignment
+        f.part_rows = chunk_fn.part_rows
+        f.chunk_rows = chunk_fn.chunk_rows
+
+        def read_part(c: int, s: int):
+            faultplan.inject("stream.chunk_read", chunk=c)
+            return chunk_fn.read_part(c, s)
+
+        f.read_part = lambda c, s: retry_call(
+            read_part, c, s, seam="stream.chunk_read", policy=policy)
     return f

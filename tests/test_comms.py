@@ -200,13 +200,24 @@ def test_resolve_split_comms():
         "auto", distributed=True) == "reduce_scatter"
     assert comms.resolve_split_comms(
         "auto", distributed=False) == "allreduce"
+    # ISSUE 11: reduce-scatter COMPOSES with a sharded feature axis on
+    # the 2D mesh — the old refusal is gone; the resolver keys on
+    # whether a ROW wire exists.
     assert comms.resolve_split_comms(
-        "auto", distributed=True, feature_partitions=2) == "allreduce"
+        "auto", distributed=True, feature_partitions=2,
+        row_shards=4) == "reduce_scatter"
+    assert comms.resolve_split_comms(
+        "reduce_scatter", distributed=True, feature_partitions=2,
+        row_shards=4) == "reduce_scatter"
+    # A pure feature mesh (Pr=1) has no row wire: nothing to scatter.
+    assert comms.resolve_split_comms(
+        "auto", distributed=True, feature_partitions=4,
+        row_shards=1) == "allreduce"
+    assert comms.resolve_split_comms(
+        "reduce_scatter", distributed=True,
+        row_shards=1) == "allreduce"
     assert comms.resolve_split_comms(
         "reduce_scatter", distributed=False) == "allreduce"
-    with pytest.raises(ValueError, match="feature_partitions"):
-        comms.resolve_split_comms("reduce_scatter", distributed=True,
-                                  feature_partitions=2)
     with pytest.raises(ValueError, match="split_comms"):
         comms.resolve_split_comms("ring", distributed=True)
 

@@ -280,14 +280,17 @@ def _collective_inventory(hlo_text):
 
 
 def _mesh_groups(be):
-    """(row_axis_groups, feature_axis_groups) as frozensets of sorted
-    device-id tuples, derived from the backend's own mesh layout."""
+    """(row_axis_groups, feature_axis_groups, all_axis_groups) as
+    frozensets of sorted device-id tuples, derived from the backend's
+    own mesh layout. all_axis_groups is the single whole-mesh group the
+    2D winner combine gathers over (rows x features in one pass)."""
     ids = np.vectorize(lambda d: d.id)(be.mesh.devices)
     f = be.feature_partitions
     flat = ids.reshape(-1, f)
     feature_groups = frozenset(tuple(sorted(row)) for row in flat)
     row_groups = frozenset(tuple(sorted(flat[:, i])) for i in range(f))
-    return row_groups, feature_groups
+    all_groups = frozenset({tuple(sorted(ids.flat))})
+    return row_groups, feature_groups, all_groups
 
 
 def _numel(shape):
@@ -299,26 +302,35 @@ def _numel(shape):
 
 def _assert_collective_contract(hlo_text, be, *, r_loc, f_loc, n_bins,
                                 max_depth):
-    row_groups, feature_groups = _mesh_groups(be)
+    row_groups, feature_groups, all_groups = _mesh_groups(be)
     n_level = 1 << max_depth
     # Any operand this big is "row-sized" — between the largest legitimate
     # row-axis payload (one level's histograms) and the smallest per-shard
-    # row count the test uses.
+    # row count the test uses. f_loc is the per-FEATURE-SHARD column
+    # count, so a feature-column-sized operand on the wrong axis trips
+    # the same caps (both row-sized and feature-column-sized operands
+    # are forbidden outside the patterns below).
     hist_cap = n_level * f_loc * n_bins * 2
     assert hist_cap < r_loc, "test shapes must separate hist from row size"
     inv = _collective_inventory(hlo_text)
     assert inv, "distributed program lowered with no collectives at all"
     rs = getattr(be, "split_comms", "allreduce") == "reduce_scatter"
+    fp = be.feature_partitions
     for kind, shapes, groups in inv:
         desc = f"{kind} {shapes} groups={sorted(groups)}"
         assert kind in ("all-reduce", "all-gather", "reduce-scatter"), \
             f"forbidden collective kind: {desc}"
-        assert groups in (row_groups, feature_groups), \
+        allowed = {row_groups, feature_groups}
+        if rs and fp > 1:
+            allowed.add(all_groups)    # the 2D winner combine
+        assert groups in allowed, \
             f"collective over unexpected device groups: {desc}"
         if kind == "reduce-scatter":
             # Only the histogram slab scatter over the row axes, only
             # when reduce-scatter split finding is resolved on; the
-            # (scattered) result is at most slab-sized.
+            # (scattered) result is at most slab-sized. On the 2D mesh
+            # the scatter stays WITHIN each feature slab — row groups,
+            # never the whole mesh.
             assert rs, f"reduce-scatter without split_comms=rs: {desc}"
             assert groups == row_groups, \
                 f"reduce-scatter outside the row axes: {desc}"
@@ -328,16 +340,22 @@ def _assert_collective_contract(hlo_text, be, *, r_loc, f_loc, n_bins,
         elif kind == "all-gather":
             # Only the per-level split-winner gather (gain/feat/bin/dir
             # tuples): over the feature axis on column-sharded meshes,
-            # over the ROW axes under reduce-scatter split finding —
-            # [n_shards, n_level] at most either way.
-            if rs:
+            # over the ROW axes under reduce-scatter split finding, and
+            # over BOTH axes at once on the 2D rs mesh (every shard
+            # owns a distinct global column slab — one combine) —
+            # [n_shards, n_level] at most in every form.
+            if rs and fp > 1:
+                assert groups == all_groups, \
+                    f"2D winner gather outside the full mesh: {desc}"
+                cap = be.row_shards * fp * n_level
+            elif rs:
                 assert groups == row_groups, \
                     f"all-gather outside the row axes under rs: {desc}"
                 cap = be.row_shards * n_level
             else:
                 assert groups == feature_groups != row_groups, \
                     f"all-gather outside the feature axis: {desc}"
-                cap = be.feature_partitions * n_level
+                cap = fp * n_level
             for s in shapes:
                 assert _numel(s) <= cap, \
                     f"all-gather operand beyond split-winner size: {desc}"
@@ -362,11 +380,16 @@ _MESH_CASES = [
     dict(n_partitions=8),
     dict(host_partitions=2, n_partitions=4),
     dict(host_partitions=2, n_partitions=2, feature_partitions=2),
+    # The declarative 2D (rows x features) mesh (ISSUE 11): auto
+    # resolves reduce_scatter COMPOSED with the feature axis — slab
+    # scatter over row groups, ONE winner gather over the whole mesh.
+    dict(mesh_shape=(4, 2)),
 ]
 
+_MESH_IDS = ["rows8", "hosts2rows4", "hosts2rows2feat2", "mesh4x2"]
 
-@pytest.mark.parametrize("mesh_kw", _MESH_CASES,
-                         ids=["rows8", "hosts2rows4", "hosts2rows2feat2"])
+
+@pytest.mark.parametrize("mesh_kw", _MESH_CASES, ids=_MESH_IDS)
 def test_grow_collective_inventory(mesh_kw):
     """The granular whole-tree grow program's compiled collectives match
     the contract for every supported mesh shape."""
@@ -387,8 +410,7 @@ def test_grow_collective_inventory(mesh_kw):
         n_bins=B, max_depth=D)
 
 
-@pytest.mark.parametrize("mesh_kw", _MESH_CASES,
-                         ids=["rows8", "hosts2rows4", "hosts2rows2feat2"])
+@pytest.mark.parametrize("mesh_kw", _MESH_CASES, ids=_MESH_IDS)
 def test_fused_rounds_collective_inventory(mesh_kw):
     """The fused multi-round scan (the production training path) compiles
     to the same collective inventory — the scan must not introduce any new

@@ -29,6 +29,23 @@ import pytest
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "mp_worker.py")
 
+# Capability gate (ISSUE 11 satellite): some images' XLA CPU builds
+# cannot run true multi-process programs at all — every collective
+# compile fails with this exact runtime error. That is an environment
+# capability, not a regression in this repo, so the tests SKIP with the
+# error quoted (tier-1 stays green-or-meaningful) instead of carrying a
+# known failure into every PR's triage; where the runtime supports
+# multi-process CPU (gloo) or a real pod, they run fully.
+_MP_CPU_ERR = "Multiprocess computations aren't implemented on the CPU backend"
+
+
+def _skip_if_multiprocess_unsupported(logs) -> None:
+    joined = "\n".join(logs)
+    if _MP_CPU_ERR in joined:
+        pytest.skip(
+            "XLA capability gate: this image's CPU backend refuses "
+            f"multi-process programs (worker failed with: {_MP_CPU_ERR!r})")
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -80,6 +97,7 @@ def test_multiprocess_bringup_bit_identical(nproc, host_partitions,
                 q.kill()
             raise
         logs.append(stdout)
+    _skip_if_multiprocess_unsupported(logs)
     assert all(p.returncode == 0 for p in procs), (
         "worker failed:\n" + "\n----\n".join(logs))
 
@@ -169,6 +187,7 @@ def test_cli_multihost_train(tmp_path):
                 q.kill()
             raise
         logs.append(stdout)
+    _skip_if_multiprocess_unsupported(logs)
     assert all(p.returncode == 0 for p in procs), (
         "cli multihost worker failed:\n" + "\n----\n".join(logs))
     d0 = np.load(outs[0])

@@ -65,6 +65,12 @@ METRICS: dict[str, str] = {
     "hist_comms_ab_ratio": "higher",
     "hist_comms_rs_mrows_per_sec": "higher",
     "hist_comms_payload_ratio": "higher",
+    # 2D-mesh A/B (ISSUE 11): losing the (rows x features) layout's
+    # wallclock edge at the wide shape, the 2D arm's throughput, or the
+    # deterministic second-axis payload reduction are all regressions.
+    "hist_2d_ab_ratio": "higher",
+    "hist_2d_mrows_per_sec": "higher",
+    "hist_2d_payload_ratio": "higher",
     "e2e_train_s": "lower",
     "e2e_ms_per_tree": "lower",
     "e2e_implied_hist_mrows": "higher",
