@@ -245,6 +245,26 @@ SERVE_COALESCE_MIN = 8
 # and 1.5 on a real chip, record the roofline explanation in
 # docs/PERF.md "Serving latency" instead of shipping a lower floor.
 PREDICT_LUT_AB_FLOOR = 1.5
+# int4-vs-int8 paired ratio (chip only; ISSUE 12): the bit-packed tier
+# halves the int8 tier's threshold/leaf table bytes again, but tables
+# are the SMALL term at the 4M-row batch shape (rows dominate and both
+# arms stream identical uint8 rows), so the expected batch-shape edge
+# is modest — the tier's real win is the resident single-row footprint.
+# 1.1 says "the pack must not LOSE to int8 and should show its table
+# saving"; parity below 1.0 means the in-VPU unpack is costing more
+# than the bytes it saves. ENCODED-BUT-UNWITNESSED per the docs/PERF.md
+# post-r05 re-calibration convention: no chip image has run since this
+# floor landed — the first chip bench must re-calibrate it from the
+# measured band before trusting a failure.
+PREDICT_LUT4_AB_FLOOR = 1.1
+# Express lane (every platform — host behavior): at an EMPTY queue a
+# single-row request through the lane must beat the coalesced path's
+# admission-window floor (its p99 sits BELOW max_wait_ms, where the
+# lane-off path's p50 sits ABOVE it — measured CPU: 2.0 ms vs 23.5 ms
+# at the 20 ms bench window, gain ~12x); and under SATURATION the lane
+# must be invisible (closed), so express-on p99 may not exceed
+# express-off p99 by more than the noise slack.
+SERVE_EXPRESS_SAT_SLACK = 1.5
 
 
 def _parity_check() -> dict:
@@ -388,6 +408,14 @@ def main() -> None:
 
         lab = bench_predict_lut_ab(rows=4_000_000, trees=1000, depth=6)
 
+    # int4 bit-packed tier + express lane (ISSUE 12): the paired
+    # int8-vs-int4 arm is chip-gated like the other Pallas A/Bs
+    # (ab=on_tpu), but the express-lane two-regime arm is host code and
+    # runs — and is FLOORED — on every platform.
+    from ddt_tpu.bench import bench_predict_lut4_ab
+
+    l4 = bench_predict_lut4_ab(ab=on_tpu)
+
     parity = _parity_check() if on_tpu else {}
 
     # Honest-baseline context (round-1 verdict): record what the CPU
@@ -496,6 +524,25 @@ def main() -> None:
             round(lab["ratio_lut_over_f32"], 3) if lab else None,
         "predict_lut_max_abs_err":
             lab["lut_max_abs_err"] if lab else None,
+        # int4 bit-packed tier (chip only) + express lane (every
+        # platform): the int8-vs-int4 paired ratio with its witnessed
+        # error/bound pair, and the two-regime single-row latencies —
+        # empty-queue express p99 bands lower-is-better next to the
+        # other serve latencies; express_gain (coalesced/express at an
+        # empty queue) bands higher.
+        "predict_lut4_mrows_per_sec":
+            round(l4["lut4_mrows_per_sec"], 2)
+            if "lut4_mrows_per_sec" in l4 else None,
+        "predict_lut4_ab_ratio":
+            round(l4["ratio_int4_over_int8"], 3)
+            if "ratio_int4_over_int8" in l4 else None,
+        "predict_lut4_max_abs_err":
+            l4.get("lut4_max_abs_err"),
+        "serve_express_empty_p99_ms": l4["express_empty_p99_ms"],
+        "serve_express_gain": l4["express_gain"],
+        "serve_express_saturated_p99_ms": l4["express_saturated_p99_ms"],
+        "serve_coalesced_saturated_p99_ms":
+            l4["coalesced_saturated_p99_ms"],
         # Roofline utilization stamps (device-truth cost observatory):
         # achieved/peak fractions from XLA's own cost model at the
         # measured wallclocks (telemetry/costmodel.py; benchwatch bands
@@ -530,6 +577,24 @@ def main() -> None:
             f"serve coalesce width max {sv['serve_coalesce_max']} < "
             f"{SERVE_COALESCE_MIN} across open-loop arms — the batcher "
             "has degenerated to per-request dispatch (docs/SERVING.md)")
+    # Express lane, both regimes (ISSUE 12 acceptance; host behavior,
+    # enforced on every platform like the serving floors above).
+    if l4["express_empty_p99_ms"] >= l4["express_max_wait_ms"]:
+        serve_fails.append(
+            f"express-lane empty-queue p99 "
+            f"{l4['express_empty_p99_ms']:.2f} ms is not below the "
+            f"coalesced path's {l4['express_max_wait_ms']:.0f} ms "
+            "admission-window floor — the lane is not bypassing the "
+            "window (docs/SERVING.md 'Express lane')")
+    if l4["express_saturated_p99_ms"] > SERVE_EXPRESS_SAT_SLACK * max(
+            l4["coalesced_saturated_p99_ms"], 1e-9):
+        serve_fails.append(
+            f"express-on saturated p99 "
+            f"{l4['express_saturated_p99_ms']:.2f} ms exceeds "
+            f"{SERVE_EXPRESS_SAT_SLACK}x the express-off p99 "
+            f"({l4['coalesced_saturated_p99_ms']:.2f} ms) — the lane "
+            "is leaking into the loaded regime instead of closing "
+            "(docs/SERVING.md 'Express lane')")
 
     if not on_tpu:
         if serve_fails:
@@ -613,6 +678,16 @@ def main() -> None:
             "back to f32 — ops/predict_lut.py; if the ratio is real and "
             "between 1.0 and 1.5, record the roofline explanation in "
             "docs/PERF.md 'Serving latency')")
+    if "ratio_int4_over_int8" in l4 \
+            and l4["ratio_int4_over_int8"] < PREDICT_LUT4_AB_FLOOR:
+        fails.append(
+            f"int4-vs-int8 paired ratio "
+            f"{l4['ratio_int4_over_int8']:.3f} < {PREDICT_LUT4_AB_FLOOR} "
+            "(the bit-packed tier's in-VPU unpack is costing more than "
+            "the table bytes it saves, or the lut4 dispatch silently "
+            "degraded — ops/predict_lut.py; floor is encoded-but-"
+            "unwitnessed, re-calibrate per docs/PERF.md 'Serving "
+            "latency' before trusting a failure)")
     if parity and (parity["split_agreement"] < PARITY_MIN_AGREEMENT
                    or parity["auc_delta"] > PARITY_MAX_AUC_DELTA):
         fails.append(

@@ -691,7 +691,7 @@ class TPUDevice(DeviceBackend):
         "_hist_fns", "_grow_fn", "_grow_masked_fn", "_grad_fn",
         "_rounds_fns", "_rounds_masked_fns", "_rounds_eval_fns",
         "_eval_fns", "_stream_cache", "_apply_fn", "_row_mask_fn",
-        "_loss_fn", "_predict_cache",
+        "_loss_fn", "_predict_cache", "_predict_impl_resolved",
     )
 
     def rotate_row_partitions(self) -> bool:
@@ -1471,53 +1471,81 @@ class TPUDevice(DeviceBackend):
         # token -> (fn, device arrays); insertion order = LRU order.
         return {}
 
+    @functools.cached_property
+    def _predict_impl_resolved(self) -> dict:
+        # token -> the tier _predict_fn actually compiled ("lut4" |
+        # "lut" | "f32") — pruned with _predict_cache.
+        return {}
+
+    def resolved_predict_impl(self, token: str) -> str:
+        """The scoring tier that ACTUALLY serves model `token` after
+        the fallback ladder ("lut4" | "lut" | "f32"; "f32" when the
+        model never scored here). The serving tier stamps this into
+        /healthz and serve_latency so a silent VMEM-guard fallback is
+        an observable fact, not a debug-log line."""
+        return self._predict_impl_resolved.get(token, "f32")
+
     @property
     def _use_pallas(self) -> "bool | None":
         """cfg.predict_impl as predict_raw_effective's use_pallas value
-        (None = auto-dispatch; ops/predict.resolve_use_pallas). "lut"
-        resolves here to the f32 auto value — it is the FALLBACK the
-        quantized dispatch in _predict_fn degrades to when the LUT
-        kernel's VMEM budget refuses the shape."""
-        return {"auto": None, "pallas": True,
-                "onehot": False, "lut": None}[self.cfg.predict_impl]
+        (None = auto-dispatch; ops/predict.resolve_use_pallas). "lut" /
+        "lut4" resolve here to the f32 auto value — it is the FALLBACK
+        the quantized dispatch in _predict_fn degrades to when the LUT
+        kernels' VMEM budgets refuse the shape."""
+        return {"auto": None, "pallas": True, "onehot": False,
+                "lut": None, "lut4": None}[self.cfg.predict_impl]
 
-    def _lut_fn(self, ce, n_features: int):
+    def _lut_fn(self, ce, n_features: int, tier: str = "lut"):
         """(jitted LUT scoring fn, device operand tuple) for one model
-        version, or None when the shape exceeds the kernel's budget
-        (predict_lut_fits — the pallas-vmem-guard contract; the caller
-        falls back to the f32 path). Tables quantize on host once per
-        model version; the error bound rides on the tables
-        (docs/SERVING.md "Quantized serving")."""
+        version at quantization `tier` ("lut" = int8, "lut4" = int4
+        bit-packed), or None when the shape exceeds that kernel's
+        budget (predict_lut_fits / predict_lut4_fits — the
+        pallas-vmem-guard contract; the caller walks the fallback
+        ladder). Tables quantize on host once per model version; the
+        error bound rides on the tables (docs/SERVING.md "Quantized
+        serving")."""
         from ddt_tpu.ops import predict_lut
 
         # ce.quantize() memoizes: when the serving tier already
         # quantized this model version at publish (for its error-bound
         # reporting), this is a dict hit, not a second O(model) pass.
-        tables = ce.quantize()
-        if not predict_lut.predict_lut_fits(
-                tables.n_trees_padded, tables.tree_chunk,
-                tables.max_depth, n_features, tables.n_classes_out):
-            return None
-        host_ops = predict_lut.lut_device_operands(tables)
+        if tier == "lut4":
+            tables = ce.quantize(leaf_dtype="int4")
+            packed = tables.pack_int4()
+            if not predict_lut.predict_lut4_fits(
+                    tables.n_trees_padded, tables.tree_chunk,
+                    tables.max_depth, n_features, tables.n_classes_out,
+                    thr_packed=packed.thr_packed):
+                return None
+            host_ops = packed.ops
+            static = packed.static_kwargs()
+            core = predict_lut.predict_effective_lut4_ops
+        else:
+            tables = ce.quantize()
+            if not predict_lut.predict_lut_fits(
+                    tables.n_trees_padded, tables.tree_chunk,
+                    tables.max_depth, n_features, tables.n_classes_out):
+                return None
+            host_ops = predict_lut.lut_device_operands(tables)
+            static = dict(
+                max_depth=tables.max_depth,
+                learning_rate=tables.learning_rate,
+                base=tables.base_score, n_classes=tables.n_classes_out,
+                tree_chunk=tables.tree_chunk,
+                n_trees_padded=tables.n_trees_padded,
+                missing_bin_value=tables.missing_bin_value,
+                use_missing=tables.eff_dl is not None,
+                use_cat=tables.eff_cat is not None,
+                use_scale=tables.leaf_scale is not None,
+            )
+            core = predict_lut.predict_effective_lut_ops
         with phase_span("predict:upload"):
             dev_ops = tuple(self._put(a, self._sharding())
                             for a in host_ops)
-        static = dict(
-            max_depth=tables.max_depth,
-            learning_rate=tables.learning_rate,
-            base=tables.base_score, n_classes=tables.n_classes_out,
-            tree_chunk=tables.tree_chunk,
-            n_trees_padded=tables.n_trees_padded,
-            missing_bin_value=tables.missing_bin_value,
-            use_missing=tables.eff_dl is not None,
-            use_cat=tables.eff_cat is not None,
-            use_scale=tables.leaf_scale is not None,
-        )
 
         def lut0(*args):
             *ops, Xc = args
-            return predict_lut.predict_effective_lut_ops(
-                tuple(ops), Xc, **static)
+            return core(tuple(ops), Xc, **static)
 
         return jax.jit(lut0), dev_ops
 
@@ -1540,7 +1568,13 @@ class TPUDevice(DeviceBackend):
         With cfg.predict_impl="lut" the cached entry is the int8
         quantized path (ops/predict_lut.py): tables quantize + upload
         once per model version; shapes past the LUT kernel's VMEM
-        budget fall back to the f32 path (predict_lut_fits)."""
+        budget fall back to the f32 path (predict_lut_fits). "lut4" is
+        the bit-packed int4 tier one rung up, degrading int4 -> int8 ->
+        f32 down the same guards; whatever rung actually serves is
+        recorded per token (`resolved_predict_impl`) so the serving
+        tier can stamp the TRUE tier into /healthz + serve_latency —
+        a silent guard trip must be visible in telemetry, not only in
+        debug logs."""
         token = compiled.token if compiled is not None \
             else ens.cache_token()
         hit = self._predict_cache.pop(token, None)
@@ -1550,15 +1584,31 @@ class TPUDevice(DeviceBackend):
             return hit
         ce = compiled if compiled is not None else ens.compile(
             tree_chunk=64)
-        lut = (self._lut_fn(ce, ens.n_features)
-               if self.cfg.predict_impl == "lut" else None)
+        impl_req = self.cfg.predict_impl
+        lut = None
+        resolved = "f32"
+        if impl_req in ("lut", "lut4"):
+            if impl_req == "lut4":
+                lut = self._lut_fn(ce, ens.n_features, tier="lut4")
+                if lut is not None:
+                    resolved = "lut4"
+                else:
+                    log.warning(
+                        "predict_impl='lut4': shape exceeds the int4 "
+                        "kernel's VMEM budget; falling back to the int8 "
+                        "LUT tier")
+            if lut is None:
+                lut = self._lut_fn(ce, ens.n_features, tier="lut")
+                if lut is not None:
+                    resolved = "lut"
         if lut is not None:
             fn0, ens_dev = lut
         else:
-            if self.cfg.predict_impl == "lut":
+            if impl_req in ("lut", "lut4"):
                 log.warning(
-                    "predict_impl='lut': shape exceeds the LUT kernel's "
-                    "VMEM budget; falling back to the f32 path")
+                    "predict_impl=%r: shape exceeds the LUT kernel's "
+                    "VMEM budget; falling back to the f32 path",
+                    impl_req)
             with phase_span("predict:upload"):
                 ens_dev = tuple(self._put(a, self._sharding())
                                 for a in ce.arrays())
@@ -1609,6 +1659,9 @@ class TPUDevice(DeviceBackend):
                 check_vma=False,
             )
         self._predict_cache[token] = (fn, ens_dev)
+        self._predict_impl_resolved[token] = resolved
         while len(self._predict_cache) > self.PREDICT_CACHE_MAX:
-            self._predict_cache.pop(next(iter(self._predict_cache)))
+            gone = next(iter(self._predict_cache))
+            self._predict_cache.pop(gone)
+            self._predict_impl_resolved.pop(gone, None)
         return fn, ens_dev

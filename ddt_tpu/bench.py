@@ -1024,6 +1024,201 @@ def bench_predict_lut_ab(
     }
 
 
+def bench_predict_lut4_ab(
+    rows: int = 4_000_000,
+    features: int = 28,
+    bins: int = 15,
+    trees: int = 1000,
+    depth: int = 6,
+    seed: int = 0,
+    reps: int = 8,
+    ab: "bool | None" = None,
+    express_trees: int = 50,
+    express_depth: int = 4,
+    express_features: int = 16,
+    express_bins: int = 15,
+    n_single: int = 120,
+    n_storm: int = 300,
+    max_wait_ms: float = 20.0,
+) -> dict:
+    """int4 tier + express lane, the two ISSUE 12 measurements in one
+    artifact.
+
+    PART 1 — paired int8-vs-int4 A/B (the bench_predict_lut_ab
+    protocol: alternating order, median-of-ratios): both quantized
+    kernels at the bench shape, `bins=15` so the int4 thresholds ride
+    the nibble pack (the TreeLUT regime the tier exists for). The int4
+    error contract is witnessed per run against the f32 one-hot path.
+    Meaningful on a real chip only (off-TPU both arms run the Pallas
+    interpreter) — `ab=None` auto-skips there; the repo-root bench
+    gates on on_tpu and the chip floor is PREDICT_LUT4_AB_FLOOR.
+
+    PART 2 — express-lane two-regime arm (host behavior, runs on every
+    platform): a small int4-served engine measured in BOTH regimes.
+    EMPTY QUEUE: sequential single-row requests — with the lane on,
+    latency is dispatch only; with it off, every lone request eats the
+    admission window, so `max_wait_ms` (deliberately large, 20 ms, to
+    dominate host noise) is the coalesced path's latency FLOOR and
+    express p99 must sit measurably below it. SATURATED: a burst of
+    async submissions keeps the queue non-empty, the lane closes, and
+    both engines coalesce — express-on p99 must not regress the
+    express-off p99 (the lane's never-worse contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddt_tpu import api
+    from ddt_tpu.ops import predict as predict_ops
+    from ddt_tpu.ops import predict_lut
+    from ddt_tpu.serve.engine import ServeEngine
+    from ddt_tpu.utils.device import device_sync
+
+    out = {
+        "kernel": "predict_lut4_ab",
+        "rows": rows, "features": features, "bins": bins,
+        "trees": trees, "depth": depth, "reps": reps,
+        "express_max_wait_ms": max_wait_ms,
+    }
+    if ab is None:
+        ab = jax.default_backend() == "tpu"
+
+    if ab:
+        _, Xb, ens = _predict_setup(rows, features, bins, trees, depth,
+                                    seed)
+        ce = ens.compile(tree_chunk=64)
+        t8 = ce.quantize()
+        t4 = ce.quantize(leaf_dtype="int4")
+        pk = t4.pack_int4()
+        ops8 = tuple(jnp.asarray(a)
+                     for a in predict_lut.lut_device_operands(t8))
+        ops4 = tuple(jnp.asarray(a) for a in pk.ops)
+        Xd = jax.device_put(Xb)
+        device_sync(Xd)
+        st8 = dict(
+            max_depth=t8.max_depth, learning_rate=t8.learning_rate,
+            base=t8.base_score, n_classes=t8.n_classes_out,
+            tree_chunk=t8.tree_chunk, n_trees_padded=t8.n_trees_padded,
+            missing_bin_value=t8.missing_bin_value,
+            use_missing=t8.eff_dl is not None,
+            use_cat=t8.eff_cat is not None,
+            use_scale=t8.leaf_scale is not None)
+        jit8 = jax.jit(lambda *a: predict_lut.predict_effective_lut_ops(
+            a[:-1], a[-1], **st8))
+        st4 = pk.static_kwargs()
+        jit4 = jax.jit(lambda *a: predict_lut.predict_effective_lut4_ops(
+            a[:-1], a[-1], **st4))
+
+        def run(arm):
+            o = (jit4(*ops4, Xd) if arm == "int4" else jit8(*ops8, Xd))
+            device_sync(o)
+            return o
+
+        # Warm-up compiles both arms AND witnesses the int4 error
+        # contract against the true f32 one-hot answer.
+        a4 = np.asarray(run("int4"))
+        np.asarray(run("int8"))
+        f32 = np.asarray(predict_ops.predict_raw_effective(
+            *[jnp.asarray(a) for a in ce.arrays()], Xd,
+            max_depth=ce.max_depth, learning_rate=ce.learning_rate,
+            base=ce.base_score, n_classes=ce.n_classes_out,
+            tree_chunk=ce.tree_chunk, use_pallas=False))
+        err = float(np.abs(a4 - f32).max())
+        assert err <= t4.max_abs_err * (1 + 1e-5) + 1e-6, \
+            (err, t4.max_abs_err)
+
+        def bout(arm):
+            t0 = time.perf_counter()
+            run(arm)
+            return time.perf_counter() - t0
+
+        # ratio = dt_int8 / dt_int4: > 1 means the bit-packed tier wins.
+        dts, ratios = _paired_ab_reps(bout, "int8", "int4", reps)
+        med = {arm: float(np.median(v)) for arm, v in dts.items()}
+        out.update({
+            "lut4_mrows_per_sec": rows / med["int4"] / 1e6,
+            "lut8_mrows_per_sec": rows / med["int8"] / 1e6,
+            "ratio_int4_over_int8": float(np.median(ratios)),
+            "lut4_max_abs_err": err,
+            "lut4_err_bound": t4.max_abs_err,
+            "lut4_thr_packed": pk.thr_packed,
+        })
+
+    # ---- express-lane two-regime arm (host code, every platform) ----
+    _, Xe, ens_e = _predict_setup(4096, express_features, express_bins,
+                                  express_trees, express_depth, seed)
+    bundle = api.ModelBundle(ensemble=ens_e, mapper=None)
+    cfg = TrainConfig(backend="tpu", n_bins=express_bins,
+                      predict_impl="lut4")
+    rng = np.random.default_rng(seed)
+
+    def one_engine(express: bool) -> dict:
+        eng = ServeEngine(bundle, cfg, max_wait_ms=max_wait_ms,
+                          max_batch=64, quantize="int4",
+                          express_lane=express)
+        try:
+            # EMPTY-QUEUE regime: strictly sequential singles — the
+            # queue is empty at every submit by construction.
+            eng.stats.window_summary(reset=True)
+            for _ in range(n_single):
+                r = int(rng.integers(0, len(Xe)))
+                eng.predict(Xe[r:r + 1], timeout=60.0)
+            empty = eng.stats.window_summary(reset=True)
+            # SATURATED regime: concurrent submitters keep the queue
+            # non-empty (a single-threaded async burst would SERIALIZE
+            # through the express lane — each synchronous express
+            # dispatch completes before the next submit, so the queue
+            # never forms); under real concurrency the lane closes and
+            # coalescing takes over.
+            import threading
+
+            n_threads = 16
+            per = max(1, n_storm // n_threads)
+            barrier = threading.Barrier(n_threads)
+            errs: list = []
+
+            def worker(tid):
+                rngl = np.random.default_rng(seed + 1 + tid)
+                barrier.wait()
+                for _ in range(per):
+                    r = int(rngl.integers(0, len(Xe)))
+                    try:
+                        eng.predict(Xe[r:r + 1], timeout=120.0)
+                    # Collected and asserted empty after the join — a
+                    # failed storm request is the bench's own verdict.
+                    except Exception as e:  # ddtlint: disable=broad-except
+                        errs.append(repr(e))
+
+            threads = [threading.Thread(target=worker, args=(t,))
+                       for t in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(180)
+            if errs:
+                raise AssertionError(
+                    f"saturated-arm requests failed: {errs[:3]}")
+            sat = eng.stats.window_summary(reset=True)
+            return {"empty": empty, "sat": sat}
+        finally:
+            eng.close()
+
+    on = one_engine(express=True)
+    off = one_engine(express=False)
+    out.update({
+        "express_empty_p50_ms": on["empty"]["p50_ms"],
+        "express_empty_p99_ms": on["empty"]["p99_ms"],
+        "coalesced_empty_p50_ms": off["empty"]["p50_ms"],
+        "coalesced_empty_p99_ms": off["empty"]["p99_ms"],
+        "express_hits_empty": on["empty"]["express"],
+        "express_saturated_p99_ms": on["sat"]["p99_ms"],
+        "coalesced_saturated_p99_ms": off["sat"]["p99_ms"],
+        "express_hits_saturated": on["sat"]["express"],
+        "express_gain": (round(off["empty"]["p99_ms"]
+                               / on["empty"]["p99_ms"], 2)
+                         if on["empty"]["p99_ms"] > 0 else None),
+    })
+    return out
+
+
 def bench_registry_cold_load(
     backend: str = "tpu",
     features: int = 16,
@@ -1127,4 +1322,8 @@ def run_bench(kernel: str = "histogram", **kw) -> dict:
     if kernel == "hist_2d":
         keys = ("rows", "features", "bins", "depth", "iters", "seed")
         return bench_hist_2d(**{k: kw[k] for k in keys if k in kw})
+    if kernel == "lut4":
+        keys = ("rows", "features", "bins", "trees", "depth", "seed")
+        return bench_predict_lut4_ab(
+            **{k: kw[k] for k in keys if k in kw})
     raise ValueError(f"unknown bench kernel {kernel!r}")

@@ -613,13 +613,18 @@ def main(argv: list[str] | None = None) -> int:
     pp = sub.add_parser("predict", help="score a batch with a saved ensemble")
     _add_common(pp)
     pp.add_argument("--model", required=True)
-    pp.add_argument("--quantized", action="store_true",
-                    help="score through the int8 TreeLUT fast path "
+    pp.add_argument("--quantized", nargs="?", const="int8", default=None,
+                    choices=["int8", "int4"],
+                    help="score through the quantized TreeLUT ladder "
+                         "(docs/SERVING.md): bare flag = the int8 tier "
                          "(cfg.predict_impl='lut': int8 thresholds + "
                          "fp16 leaf tables, ~4x less HBM traffic per "
-                         "request; leaf values within the tables' "
-                         "documented max-abs-error bound of f32 — "
-                         "docs/SERVING.md)")
+                         "request); 'int4' = the bit-packed tier "
+                         "(cfg.predict_impl='lut4': two-nibbles-per-"
+                         "byte leaf tables + per-tree scales, half the "
+                         "int8 tier's resident bytes again). Leaf "
+                         "values stay within the tables' documented "
+                         "max-abs-error bound of f32")
     pp.add_argument("--partitions", type=int, default=1,
                     help="row-shard scoring over this many chips "
                          "(parallel.mesh row mesh; trees replicate, each "
@@ -662,11 +667,20 @@ def main(argv: list[str] | None = None) -> int:
                     help="largest micro-batch (rows); batches pad to a "
                          "fixed power-of-two bucket ladder up to this, "
                          "so load never retraces")
-    sv.add_argument("--quantized", action="store_true",
-                    help="serve through the int8 TreeLUT fast path "
-                         "(ops/predict_lut.py)")
+    sv.add_argument("--quantized", nargs="?", const="int8", default=None,
+                    choices=["int8", "int4"],
+                    help="serve through the quantized TreeLUT ladder "
+                         "(ops/predict_lut.py): bare flag = int8 tier, "
+                         "'int4' = the bit-packed microsecond tier "
+                         "(docs/SERVING.md quantization-tier table)")
     sv.add_argument("--raw", action="store_true",
                     help="return raw margins instead of probabilities")
+    sv.add_argument("--no-express-lane", action="store_true",
+                    help="disable the express lane (single-row "
+                         "requests at an empty queue dispatch "
+                         "immediately instead of waiting out the "
+                         "admission window — on by default; "
+                         "docs/SERVING.md)")
     sv.add_argument("--run-log", default=None,
                     help="JSONL run log for serve_latency SLO events "
                          "(render with `report` — docs/OBSERVABILITY.md)")
@@ -692,9 +706,13 @@ def main(argv: list[str] | None = None) -> int:
                      help="largest serving micro-batch: the exported "
                           "pad-to-bucket ladder covers powers of two up "
                           "to this (must match the serving engine's)")
-    rpu.add_argument("--quantize", action="store_true",
-                     help="also export the int8 TreeLUT variant and "
-                          "carry the quantized tables in the artifact")
+    rpu.add_argument("--quantize", nargs="?", const="int8", default=None,
+                     choices=["int8", "int4"],
+                     help="also export a quantized TreeLUT variant and "
+                          "carry its tables in the artifact: bare flag "
+                          "= the int8 tier, 'int4' = the bit-packed "
+                          "tier (lut4 AOT blobs + int4 tables, "
+                          "token-pinned round trip)")
     rpu.add_argument("--run-log", default=None,
                      help="append an `artifact` push event to this "
                           "JSONL run log (renders in `report`)")
@@ -714,7 +732,8 @@ def main(argv: list[str] | None = None) -> int:
     _add_common(bp)
     bp.add_argument("--kernel", default="histogram",
                     choices=["histogram", "train", "predict", "serve",
-                             "registry", "hist_comms", "hist_2d"])
+                             "registry", "hist_comms", "hist_2d",
+                             "lut4"])
     bp.add_argument("--features", type=int, default=None,
                     help="feature count; default = each kernel's own "
                          "(28 for the narrow arms, 1024 for the wide "
@@ -929,10 +948,13 @@ def main(argv: list[str] | None = None) -> int:
             )
         X, y, _, _ = _load_dataset(args, encoder=bundle.encoder,
                                    n_features=ens.n_features)
+        from ddt_tpu.serve.engine import TIER_IMPL
+
         cfg = TrainConfig(backend=args.backend, loss=ens.loss,
                           n_classes=max(ens.n_classes, 2),
                           n_partitions=max(1, args.partitions),
-                          predict_impl="lut" if args.quantized else "auto")
+                          predict_impl=TIER_IMPL.get(args.quantized,
+                                                     "auto"))
         t0 = time.perf_counter()
         if bundle.mapper is not None:
             # Training-time binning, loaded from the artifact — NEVER refit
@@ -957,7 +979,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.cmd == "serve":
-        from ddt_tpu.serve.engine import ServeEngine
+        from ddt_tpu.serve.engine import TIER_IMPL, ServeEngine
         from ddt_tpu.serve.http import serve_forever
 
         mode = "file"
@@ -979,7 +1001,11 @@ def main(argv: list[str] | None = None) -> int:
             run_log = RunLog.coerce(args.run_log)
             try:
                 report = reg_loader.load_servable(
-                    args.registry, args.model, quantize=args.quantized,
+                    args.registry, args.model,
+                    # Flag absent (None) = the engine serves f32 even
+                    # from a quantized artifact (the engine's mode
+                    # wins) — None would FOLLOW the artifact instead.
+                    quantize=args.quantized or False,
                     raw=args.raw, backend=args.backend,
                     run_log=run_log)
             except (RegistryError, ValueError, OSError) as e:
@@ -989,21 +1015,23 @@ def main(argv: list[str] | None = None) -> int:
             cfg = TrainConfig(
                 backend=args.backend, loss=servable.ens.loss,
                 n_classes=max(servable.ens.n_classes, 2),
-                predict_impl="lut" if args.quantized else "auto")
+                predict_impl=TIER_IMPL.get(args.quantized, "auto"))
             engine = ServeEngine(
                 servable, cfg, max_wait_ms=args.max_wait_ms,
                 max_batch=servable.buckets[-1], quantize=args.quantized,
-                raw=args.raw, run_log=run_log)
+                raw=args.raw, run_log=run_log,
+                express_lane=not args.no_express_lane)
         else:
             bundle = api.load_model(args.model)
             cfg = TrainConfig(
                 backend=args.backend, loss=bundle.ensemble.loss,
                 n_classes=max(bundle.ensemble.n_classes, 2),
-                predict_impl="lut" if args.quantized else "auto")
+                predict_impl=TIER_IMPL.get(args.quantized, "auto"))
             engine = ServeEngine(
                 bundle, cfg, max_wait_ms=args.max_wait_ms,
                 max_batch=args.max_batch, quantize=args.quantized,
-                raw=args.raw, run_log=args.run_log)
+                raw=args.raw, run_log=args.run_log,
+                express_lane=not args.no_express_lane)
         engine.registry_root = args.registry
         print(json.dumps({
             "cmd": "serve", "model": args.model,
@@ -1011,6 +1039,7 @@ def main(argv: list[str] | None = None) -> int:
             "quantized": args.quantized, "host": args.host,
             "port": args.port, "max_wait_ms": args.max_wait_ms,
             "max_batch": engine.buckets[-1],
+            "express_lane": not args.no_express_lane,
             "registry": args.registry, "mode": mode,
             "artifact_digest": digest,
         }), flush=True)

@@ -137,7 +137,12 @@ class TrainConfig:
     # traffic per request, leaf values within the tables' documented
     # max-abs-error bound of f32; auto-falls back to the f32 path when
     # the shape exceeds the kernel's VMEM budget (predict_lut_fits).
-    predict_impl: str = "auto"  # auto | pallas | onehot | lut
+    # "lut4" is the bit-packed int4 tier (`--quantized int4`): leaf
+    # tables two-nibbles-per-byte with per-tree scales (thresholds join
+    # the pack on <= 15-bin models), halving the int8 tier's resident
+    # bytes again; falls back int4 -> int8 -> f32 down the same guard
+    # ladder (predict_lut4_fits / predict_lut_fits).
+    predict_impl: str = "auto"  # auto | pallas | onehot | lut | lut4
     seed: int = 0
     # Cap on boosting rounds per fused device dispatch (Driver._fit_fused).
     # One block already amortizes dispatch latency to nothing, so bigger
@@ -246,9 +251,10 @@ class TrainConfig:
                 f"hist_comms_slabs must be >= 0 (0 = auto), got "
                 f"{self.hist_comms_slabs}"
             )
-        if self.predict_impl not in ("auto", "pallas", "onehot", "lut"):
+        if self.predict_impl not in ("auto", "pallas", "onehot", "lut",
+                                     "lut4"):
             raise ValueError(
-                f"predict_impl must be auto|pallas|onehot|lut, got "
+                f"predict_impl must be auto|pallas|onehot|lut|lut4, got "
                 f"{self.predict_impl!r}"
             )
         if self.missing_policy not in ("zero", "learn"):

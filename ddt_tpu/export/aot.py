@@ -55,6 +55,10 @@ LUT_TABLES_FILE = "lut_tables.npz"
 AOT_DIR = "aot"
 F32_BLOB = "predict_f32_b{bucket:05d}.bin"
 LUT_BLOB = "predict_lut_b{bucket:05d}.bin"
+#: int4 bit-packed tier (ISSUE 12) — its own blob family: the operand
+#: layout (packed nibbles) differs from the int8 tier's, and a
+#: self-describing name beats decoding the manifest to tell them apart.
+LUT4_BLOB = "predict_lut4_b{bucket:05d}.bin"
 #: platforms one f32 export covers when multi-platform lowering works
 #: (pure StableHLO — no custom calls — so lowering for the absent
 #: platform needs no hardware).
@@ -107,6 +111,21 @@ def lut_predict_fn(tables):
     def fn(*args):
         *ops, Xc = args
         return predict_lut.predict_effective_lut_ops(
+            tuple(ops), Xc, **static)
+
+    return fn
+
+
+def lut4_predict_fn(packed):
+    """The int4 bit-packed scoring closure (ops/predict_lut.py "int4
+    TIER") over one model's PackedTables; `interpret` pinned at EXPORT
+    time like the int8 variant."""
+    static = dict(packed.static_kwargs(),
+                  interpret=jax.default_backend() != "tpu")
+
+    def fn(*args):
+        *ops, Xc = args
+        return predict_lut.predict_effective_lut4_ops(
             tuple(ops), Xc, **static)
 
     return fn
@@ -224,17 +243,22 @@ def stage_servable(
     bundle,                       # api.ModelBundle (or TrainResult-like)
     *,
     buckets: tuple,
-    quantize: bool = False,
+    quantize=False,               # False | True/"int8" | "int4"
     raw: bool = False,
     tree_chunk: int = 64,
     run_id: str | None = None,
 ) -> StagedArtifact:
     """Build a complete servable artifact in `stage_dir` (the registry's
-    staging area): model.npz, per-bucket AOT blobs (f32 always, lut when
-    `quantize` and the kernel admits the shape), lut_tables.npz, and
-    the finalized manifest.json. Returns the staged paths + digest;
+    staging area): model.npz, per-bucket AOT blobs (f32 always, the
+    requested LUT tier when `quantize` and that kernel admits the
+    shape), lut_tables.npz, and the finalized manifest.json. `quantize`
+    is a tier: True/"int8" exports the int8 TreeLUT variant, "int4"
+    the bit-packed tier (its tables — leaf_dtype "int4" — ride the same
+    lut_tables.npz, token-pinned, so the 4-bit representation survives
+    export verbatim). Returns the staged paths + digest;
     `Registry.push(stage_dir, …)` publishes it atomically."""
     from ddt_tpu import api
+    from ddt_tpu.serve.engine import normalize_quantize
 
     ens = bundle.ensemble
     buckets = tuple(sorted({int(b) for b in buckets}))
@@ -268,14 +292,17 @@ def stage_servable(
             f.write(blob)
     platforms = platforms or ()
 
+    tier = normalize_quantize(quantize)
     quantized_meta = None
     lut_platforms: tuple | None = None
-    if quantize:
-        tables = ce.quantize()
-        quantized_meta = {"leaf_dtype": tables.leaf_dtype,
+    if tier:
+        from ddt_tpu.serve.engine import TIER_LEAF_DTYPE
+
+        tables = ce.quantize(leaf_dtype=TIER_LEAF_DTYPE[tier])
+        quantized_meta = {"tier": tier, "leaf_dtype": tables.leaf_dtype,
                           "max_abs_err": tables.max_abs_err}
-        # The int8 representation itself rides in the artifact — the
-        # TreeLUT fast path survives export even where the lowered
+        # The quantized representation itself rides in the artifact —
+        # the TreeLUT fast path survives export even where the lowered
         # kernel blob cannot follow (foreign serving platform).
         from ddt_tpu.utils.atomic import atomic_savez
 
@@ -283,23 +310,36 @@ def stage_servable(
                      compressed=True, deterministic=True,
                      **tables_to_arrays(tables))
         on_tpu = jax.default_backend() == "tpu"
-        if not on_tpu or predict_lut.predict_lut_fits(
+        if tier == "int4":
+            packed = tables.pack_int4()
+            quantized_meta["thr_packed"] = packed.thr_packed
+            fits = predict_lut.predict_lut4_fits(
                 tables.n_trees_padded, tables.tree_chunk,
-                tables.max_depth, F, tables.n_classes_out):
-            lfn = lut_predict_fn(tables)
-            lops = predict_lut.lut_device_operands(tables)
+                tables.max_depth, F, tables.n_classes_out,
+                thr_packed=packed.thr_packed)
+            lfn, lops, blob_tpl = (lut4_predict_fn(packed), packed.ops,
+                                   LUT4_BLOB)
+        else:
+            fits = predict_lut.predict_lut_fits(
+                tables.n_trees_padded, tables.tree_chunk,
+                tables.max_depth, F, tables.n_classes_out)
+            lfn, lops, blob_tpl = (lut_predict_fn(tables),
+                                   predict_lut.lut_device_operands(
+                                       tables), LUT_BLOB)
+        if not on_tpu or fits:
             for b in buckets:
                 blob, covered = export_bucket(lfn, lops, b, F)
                 lut_platforms = covered if lut_platforms is None \
                     else tuple(p for p in lut_platforms if p in covered)
                 with open(os.path.join(
                         stage_dir, AOT_DIR,
-                        LUT_BLOB.format(bucket=b)), "wb") as f:
+                        blob_tpl.format(bucket=b)), "wb") as f:
                     f.write(blob)
         else:
             log.warning(
-                "LUT shape exceeds the kernel's VMEM budget; artifact "
-                "carries quantized tables but no lut AOT blobs")
+                "%s LUT shape exceeds the kernel's VMEM budget; "
+                "artifact carries quantized tables but no lut AOT "
+                "blobs", tier)
 
     # No timestamps: the manifest bytes ARE the artifact digest, and
     # re-exporting the same model must reproduce the same address

@@ -509,14 +509,16 @@ class CompiledEnsemble:
         return tuple(out)
 
     def quantize(self, leaf_dtype: str = "float16"):
-        """TreeLUT-style int8/fp16 scoring tables (ops/predict_lut.
+        """TreeLUT-style quantized scoring tables (ops/predict_lut.
         QuantizedTables): int8 recentred thresholds (EXACT — bin ids are
-        integers in [0, 255]), fp16 or int8+per-tree-scale leaf tables,
+        integers in [0, 255]), fp16 / int8+scale / int4+scale leaf
+        tables ("int4" is the bit-packed tier's logical form —
+        `.pack_int4()` makes the two-nibbles-per-byte device layout),
         and a computed `max_abs_err` bound on |lut - f32| (the rounding
         contract documented in ops/predict_lut.py). The low-latency
-        serving opt-in (cfg.predict_impl="lut" / `cli predict
-        --quantized` / ServeEngine(quantize=True)). Lazy import keeps
-        this module jax-free for hosts that never score quantized.
+        serving opt-in (cfg.predict_impl="lut"/"lut4" / `cli predict
+        --quantized[=int4]` / ServeEngine(quantize=...)). Lazy import
+        keeps this module jax-free for hosts that never score quantized.
 
         Memoized per leaf_dtype (this instance is immutable — frozen
         snapshot of one model version): the serving tier quantizes at

@@ -39,7 +39,8 @@ import numpy as np
 
 from ddt_tpu.registry.manifest import IntegrityError
 from ddt_tpu.registry.store import DIGEST_LEN, Registry, RegistryError
-from ddt_tpu.serve.engine import ServableModel, default_buckets
+from ddt_tpu.serve.engine import (TIER_IMPL, ServableModel,
+                                  default_buckets)
 
 log = logging.getLogger("ddt_tpu.registry.loader")
 
@@ -55,7 +56,7 @@ class RestoredModel(ServableModel):
 
     def __init__(self, bundle, manifest: dict, digest: str,
                  fns: dict, operands: tuple, *, quantized: bool,
-                 raw: bool):
+                 raw: bool, tier: "str | None" = None):
         # Deliberately NOT calling ServableModel.__init__: this model
         # must never touch a backend or re-trace — its build cost was
         # paid in the exporting process.
@@ -65,6 +66,11 @@ class RestoredModel(ServableModel):
         self.buckets = tuple(sorted(int(b) for b in manifest["buckets"]))
         self.raw = bool(raw)
         self.quantized = bool(quantized)
+        self.quantize_tier = tier
+        # The tier is PINNED by what was deserialized — there is no
+        # backend ladder to consult (ServableModel.predict_impl), and a
+        # restored program cannot silently fall anywhere.
+        self._impl_override = TIER_IMPL.get(tier, "f32")
         self.compiled = None
         self.tables = None
         self.token = manifest["model_token"]
@@ -107,22 +113,27 @@ def _emit_artifact_event(run_log, action: str, digest: str, man: dict,
             mode=mode)
 
 
-def load_servable(root, ref: str, *, quantize: bool | None = None,
+def load_servable(root, ref: str, *, quantize=None,
                   raw: bool = False, backend=None, cfg=None,
                   run_log=None) -> LoadReport:
     """Restore a servable model from registry reference `ref` (digest,
     `name`, `name@version`, or `name@tag`). `quantize=None` follows the
-    artifact (quantized exports serve quantized); `backend`/`cfg` are
-    only consulted when the ladder has to fall back to an in-process
-    build — `backend` is a DeviceBackend, or a backend NAME (the CLI's
-    --backend) to combine with the model-derived config here.
-    File I/O and deserialization all happen HERE, on the caller's
+    artifact (quantized exports serve quantized, at the TIER they were
+    exported with — int8 or int4); True serves the artifact's exported
+    tier; "int8"/"int4" demand that specific tier and refuse a
+    mismatched artifact (the carried tables ARE the representation — a
+    different grid would make the manifest's error bound a lie).
+    `backend`/`cfg` are only consulted when the ladder has to fall back
+    to an in-process build — `backend` is a DeviceBackend, or a backend
+    NAME (the CLI's --backend) to combine with the model-derived config
+    here. File I/O and deserialization all happen HERE, on the caller's
     thread — never inside the engine's dispatch loop (the
     serve-blocking-io contract)."""
     import jax
 
     from ddt_tpu import api
     from ddt_tpu.export import aot
+    from ddt_tpu.serve.engine import normalize_quantize
     from ddt_tpu.telemetry.events import RunLog
 
     # Coerce ONCE: per-event coercion would restart seq at 0 for every
@@ -153,22 +164,42 @@ def load_servable(root, ref: str, *, quantize: bool | None = None,
             f"{digest}: model.npz rebuilds to token {ce.token[:12]} but "
             f"the manifest pins {str(man['model_token'])[:12]} — the "
             "model file and the exported programs disagree")
+    qmeta = man.get("quantized")
+    # Pre-int4 artifacts carry no "tier" key — they are the int8 tier.
+    art_tier = (qmeta.get("tier", "int8") if qmeta else None)
     if quantize is None:
-        quantize = man.get("quantized") is not None
-    if quantize and man.get("quantized") is None:
+        tier = art_tier                  # follow the artifact
+    elif quantize is True:
+        # "serve quantized, whatever tier was exported" — an
+        # unquantized artifact still fails loudly below.
+        tier = art_tier or "int8"
+    else:
+        tier = normalize_quantize(quantize)
+    if tier and qmeta is None:
         raise ValueError(
             f"{ref!r} was exported without the quantized variant; "
-            "re-push with --quantize to serve the LUT path")
+            f"re-push with --quantize={tier} to serve the LUT path")
+    if tier and tier != art_tier:
+        raise RegistryError(
+            f"{ref!r} carries the {art_tier!r} quantized tier but "
+            f"{tier!r} was requested — the carried tables are the "
+            f"representation that serves; re-push with "
+            f"--quantize={tier}")
 
     platform = jax.default_backend()
     buckets = tuple(sorted(int(b) for b in man["buckets"]))
-    variant, blob_tpl = (
-        ("aot-lut", aot.LUT_BLOB) if quantize else ("aot-f32",
-                                                    aot.F32_BLOB))
-    covered = man.get("lut_platforms" if quantize else "platforms") or []
+    variant, blob_tpl = {
+        None: ("aot-f32", aot.F32_BLOB),
+        "int8": ("aot-lut", aot.LUT_BLOB),
+        "int4": ("aot-lut4", aot.LUT4_BLOB),
+    }[tier]
+    covered = man.get("lut_platforms" if tier else "platforms") or []
 
     if platform in covered:
-        if quantize:
+        if tier == "int4":
+            tables = _load_tables(art_dir, man)
+            host_ops = tables.pack_int4().ops
+        elif tier:
             tables = _load_tables(art_dir, man)
             from ddt_tpu.ops.predict_lut import lut_device_operands
 
@@ -186,7 +217,8 @@ def load_servable(root, ref: str, *, quantize: bool | None = None,
                 exp = aot.deserialize_blob(f.read())
             fns[b] = jax.jit(exp.call)
         model = RestoredModel(bundle, man, digest, fns, operands,
-                              quantized=quantize, raw=raw)
+                              quantized=tier is not None, raw=raw,
+                              tier=tier)
         _emit_artifact_event(run_log, "load", digest, man, mode=variant)
         log.info("restored %s from %s (%s, buckets %s, zero retrace)",
                  man["model_token"][:12], digest, variant, list(buckets))
@@ -195,7 +227,7 @@ def load_servable(root, ref: str, *, quantize: bool | None = None,
 
     # ---- fallback: the artifact is still fully servable, just not
     # zero-retrace on this platform ------------------------------------
-    mode = "tables-fallback" if quantize else "rebuild"
+    mode = "tables-fallback" if tier else "rebuild"
     log.warning(
         "artifact %s carries no %s AOT program for platform %r "
         "(covered: %s); rebuilding the scoring path in-process", digest,
@@ -210,15 +242,16 @@ def load_servable(root, ref: str, *, quantize: bool | None = None,
                 backend=backend if isinstance(backend, str) else "tpu",
                 loss=bundle.ensemble.loss,
                 n_classes=max(bundle.ensemble.n_classes, 2),
-                predict_impl="lut" if quantize else "auto")
+                predict_impl=TIER_IMPL.get(tier, "auto"))
         be = get_backend(cfg)
-    # tables-fallback serves the CARRIED int8 representation (token-
-    # pinned), not a re-quantization — the manifest's error bound keeps
-    # describing what actually serves even across version skew.
-    model = ServableModel(bundle, be, quantize=quantize,
+    # tables-fallback serves the CARRIED quantized representation
+    # (token-pinned), not a re-quantization — the manifest's error
+    # bound keeps describing what actually serves even across version
+    # skew.
+    model = ServableModel(bundle, be, quantize=tier,
                           buckets=buckets, raw=raw,
                           tables=_load_tables(art_dir, man)
-                          if quantize else None)
+                          if tier else None)
     model.artifact_digest = digest
     _emit_artifact_event(run_log, "load", digest, man, mode=mode)
     return _done(LoadReport(digest=digest, mode=mode, model=model,
@@ -244,14 +277,15 @@ def _load_tables(art_dir: str, man: dict):
 
 
 def push_servable(root, bundle, *, name: str | None = None,
-                  max_batch: int = 256, quantize: bool = False,
+                  max_batch: int = 256, quantize=False,
                   raw: bool = False, tree_chunk: int = 64,
                   run_id: str | None = None, tag: str | None = None,
                   run_log=None) -> dict:
     """Export + publish in one call (the `cli registry push` body and
     the test/bench entry): stage a servable artifact for the engine's
-    power-of-two bucket ladder up to `max_batch`, then push it. Returns
-    the store's {digest, name, version}."""
+    power-of-two bucket ladder up to `max_batch`, then push it.
+    `quantize` is the tier (False | True/"int8" | "int4" — see
+    aot.stage_servable). Returns the store's {digest, name, version}."""
     from ddt_tpu.export import aot
     from ddt_tpu.telemetry.events import RunLog
 

@@ -7,11 +7,24 @@ admits work in micro-batches:
 
 - a batch CLOSES when either (a) `max_wait_ms` has elapsed since its
   OLDEST admitted request (the latency budget a request can pay waiting
-  for company — default ~1 ms), or (b) the batch reaches `max_batch`
-  rows (the largest pre-traced bucket);
+  for company — default ~1 ms; the deadline is PINNED to that oldest
+  request when its window opens and never re-armed by later arrivals,
+  so a steady trickle cannot stretch a batch past the head request's
+  budget — the fake-clock regression test in tests/test_serve.py), or
+  (b) the batch reaches `max_batch` rows (the largest pre-traced
+  bucket);
 - the dispatcher never sleeps: it parks on a Condition and wakes on
   submit, so an idle server burns nothing and a lone request under no
   load waits only the max-wait admission window;
+- EXPRESS LANE (ISSUE 12): when the queue is empty AND no batch is
+  mid-dispatch, a single-row request skips the admission window
+  entirely — `express()` dispatches it synchronously on the CALLER's
+  thread against the pre-traced [1, F] bucket, so an idle server's
+  single-row latency is dispatch time, not `max_wait_ms` + dispatch.
+  Under load the lane closes (queue non-empty, or the dispatch gate
+  held) and requests coalesce exactly as before, so the saturated-
+  regime tail cannot regress; the gate also means an express dispatch
+  and a batch dispatch never overlap on the device;
 - requests are never split across batches and never reordered within
   one — each remembers its row span, so the dispatcher's response
   scatter is positional and a request's rows can neither drop nor
@@ -20,7 +33,10 @@ admits work in micro-batches:
 
 HOT-LOOP MODULE (the ddtlint serve-blocking-io rule): no `time.sleep`,
 no synchronous file I/O anywhere in here — a blocked dispatcher thread
-stalls EVERY in-flight request's latency, not just its own.
+stalls EVERY in-flight request's latency, not just its own. The
+express lane raises the stakes: the SAME dispatch callable now also
+runs on HTTP handler threads, so blocking I/O in the dispatch path
+taxes the express path's whole point.
 """
 
 from __future__ import annotations
@@ -46,16 +62,20 @@ class PendingRequest:
     content digest of the model that actually scored this request —
     reading the engine's current token around submit/result instead is
     a race against hot swap (a swap landing in between attributes the
-    response to the wrong version; scripts/serve_smoke.py catches it)."""
+    response to the wrong version; scripts/serve_smoke.py catches it).
+    `express` marks a request the express lane dispatched synchronously
+    (never queued) — the engine's stats read it for the two-regime
+    telemetry."""
 
-    __slots__ = ("rows", "n", "t_submit", "model_token", "_event",
-                 "_result", "_error")
+    __slots__ = ("rows", "n", "t_submit", "model_token", "express",
+                 "_event", "_result", "_error")
 
     def __init__(self, rows, n: int):
         self.rows = rows
         self.n = n
         self.t_submit = time.perf_counter()
         self.model_token = None
+        self.express = False
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -92,7 +112,7 @@ class MicroBatcher:
     submitter hangs."""
 
     def __init__(self, dispatch, max_wait_ms: float = 1.0,
-                 max_batch: int = 256):
+                 max_batch: int = 256, clock=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -101,8 +121,18 @@ class MicroBatcher:
         self._dispatch = dispatch
         self.max_wait_s = max_wait_ms / 1e3
         self.max_batch = int(max_batch)
+        # Injectable clock (tests drive the admission-deadline math with
+        # a fake clock; production always runs perf_counter). Used for
+        # t_submit stamps and deadline arithmetic only — the Condition
+        # waits themselves are real time.
+        self._clock = clock if clock is not None else time.perf_counter
         self._q: collections.deque[PendingRequest] = collections.deque()
         self._cv = threading.Condition()
+        # Held around EVERY dispatch (batch loop and express lane): an
+        # express dispatch and a batch dispatch never overlap on the
+        # device, and the express lane only opens when nothing is
+        # mid-flight (its tail-latency-never-regresses contract).
+        self._gate = threading.Lock()
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, name="ddt-serve-batcher", daemon=True)
@@ -112,11 +142,49 @@ class MicroBatcher:
         """Enqueue one request (`rows` is the request's row block, `n`
         its row count). Returns immediately; wait on the PendingRequest."""
         req = PendingRequest(rows, n)
+        req.t_submit = self._clock()
         with self._cv:
             if self._closed:
                 raise ShuttingDown("serve batcher is shut down")
             self._q.append(req)
             self._cv.notify_all()
+        return req
+
+    def express(self, rows, n: int) -> "PendingRequest | None":
+        """Express lane: dispatch ONE request synchronously on the
+        calling thread, bypassing the admission window — but only when
+        the lane is open (queue empty, dispatch gate free). Returns the
+        completed PendingRequest, or None when the lane is closed and
+        the caller should `submit()` into the queue like everyone else.
+
+        Fairness: the lane is only entered from an EMPTY queue, so no
+        queued request is ever overtaken; a batch admitted while the
+        express dispatch runs blocks on the gate for at most one
+        single-row pre-traced dispatch — and under load the queue is
+        never empty, so the lane stays shut and the coalesced path is
+        untouched (the two-regime contract bench_predict_lut4_ab
+        measures)."""
+        with self._cv:
+            if self._closed:
+                raise ShuttingDown("serve batcher is shut down")
+            if self._q:
+                return None                  # load: coalesce as before
+            if not self._gate.acquire(blocking=False):
+                return None                  # a dispatch is in flight
+        req = PendingRequest(rows, n)
+        req.t_submit = self._clock()
+        req.express = True
+        try:
+            try:
+                self._dispatch([req], 0)
+            # Same error contract as the dispatcher loop: a scoring
+            # failure reaches THIS request's waiter, never the caller's
+            # stack mid-flight.
+            except Exception as e:  # ddtlint: disable=broad-except
+                if not req.done():
+                    req.set_error(e)
+        finally:
+            self._gate.release()
         return req
 
     def close(self, timeout: float = 5.0) -> None:
@@ -156,12 +224,17 @@ class MicroBatcher:
                     return                       # closed and drained
                 # Admission window: wait for company until the OLDEST
                 # queued request's budget expires or the row budget
-                # fills. cv.wait(timeout) parks the thread — no
-                # sleep-polling (the serve-blocking-io contract).
+                # fills. The deadline is computed ONCE from that head
+                # request and never touched inside the wake loop — a
+                # steady trickle of arrivals re-notifies the Condition
+                # but cannot re-arm the window past the head's budget
+                # (the fake-clock regression test pins this).
+                # cv.wait(timeout) parks the thread — no sleep-polling
+                # (the serve-blocking-io contract).
                 deadline = self._q[0].t_submit + self.max_wait_s
                 while (not self._closed
                        and sum(r.n for r in self._q) < self.max_batch):
-                    remaining = deadline - time.perf_counter()
+                    remaining = deadline - self._clock()
                     if remaining <= 0:
                         break
                     self._cv.wait(remaining)
@@ -171,7 +244,8 @@ class MicroBatcher:
                     continue
                 batch, depth = self._admit_locked()
             try:
-                self._dispatch(batch, depth)
+                with self._gate:
+                    self._dispatch(batch, depth)
             # The dispatcher thread must survive any scoring failure:
             # deliver it to the batch's waiters and keep serving — dying
             # here would hang every future submitter.

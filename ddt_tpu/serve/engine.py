@@ -43,6 +43,33 @@ from ddt_tpu.utils.metrics import predict_proba_np as proba_np
 log = logging.getLogger("ddt_tpu.serve")
 
 
+def normalize_quantize(q) -> "str | None":
+    """Normalize every spelling of the serving quantization tier to
+    None | "int8" | "int4" (the ladder docs/SERVING.md tabulates).
+    Accepts the legacy bool opt-in (True = the int8 TreeLUT tier), the
+    cfg.predict_impl spellings ("lut"/"lut4"), and the leaf-dtype
+    spellings the registry manifests carry."""
+    if q is None or q is False:
+        return None
+    if q is True:
+        return "int8"
+    s = str(q).lower()
+    if s in ("", "none", "false", "f32"):
+        return None
+    if s in ("int8", "lut", "true", "float16"):
+        return "int8"
+    if s in ("int4", "lut4"):
+        return "int4"
+    raise ValueError(
+        f"unknown quantization tier {q!r} (expected int8 or int4)")
+
+
+#: serving tier -> the cfg.predict_impl that dispatches it.
+TIER_IMPL = {"int8": "lut", "int4": "lut4"}
+#: serving tier -> the QuantizedTables leaf dtype it quantizes to.
+TIER_LEAF_DTYPE = {"int8": "float16", "int4": "int4"}
+
+
 def default_buckets(max_batch: int) -> tuple[int, ...]:
     """Power-of-two pad-to-bucket ladder up to max_batch — the FIXED set
     of batch shapes every dispatch rides (each bucket traces once at
@@ -81,8 +108,11 @@ class ServableModel:
     artifact_digest: "str | None" = None
     #: True when scoring rides deserialized AOT blobs (zero retrace).
     aot: bool = False
+    #: RestoredModel pins the tier it restored; backend-scoring models
+    #: leave this None and ask the backend what actually resolved.
+    _impl_override: "str | None" = None
 
-    def __init__(self, bundle, backend, *, quantize: bool = False,
+    def __init__(self, bundle, backend, *, quantize=False,
                  buckets: tuple[int, ...] = (1,), raw: bool = False,
                  tables=None):
         from ddt_tpu.api import validate_mapper_model
@@ -92,7 +122,8 @@ class ServableModel:
         self.backend = backend
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.raw = bool(raw)
-        self.quantized = bool(quantize)
+        self.quantize_tier = normalize_quantize(quantize)
+        self.quantized = self.quantize_tier is not None
         if self.mapper is not None:
             # The full mapper-vs-model contract (missing-bin policy,
             # identity-binned categorical columns), checked ONCE per
@@ -100,14 +131,25 @@ class ServableModel:
             validate_mapper_model(self.mapper, self.ens)
         self.compiled = self.ens.compile(tree_chunk=64)
         self.token = self.compiled.token
-        if quantize:
+        if self.quantize_tier:
             # Error contract rides on the tables (ops/predict_lut.py);
             # recorded here so /healthz and the smoke test can surface
             # the served bound. Pre-built `tables` (the registry's
             # carried lut_tables.npz, token-pinned by the loader) take
-            # precedence over re-quantizing: the exported int8
+            # precedence over re-quantizing: the exported quantized
             # representation is what serves, even across version skew.
             if tables is not None:
+                # Carried tables define the representation; an int4
+                # request must get int4 tables (an int8 artifact cannot
+                # silently serve as the int4 tier, or the reported
+                # error bound would describe the wrong grid).
+                if ((tables.leaf_dtype == "int4")
+                        != (self.quantize_tier == "int4")):
+                    raise ValueError(
+                        f"carried tables are leaf_dtype="
+                        f"{tables.leaf_dtype!r} but the serving tier is "
+                        f"{self.quantize_tier!r}; re-export with "
+                        f"--quantize={self.quantize_tier}")
                 # Seed the compiled model's memo so the backend's LUT
                 # dispatch consumes THESE tables, not a re-derivation —
                 # keyed by THEIR leaf_dtype, not the default's.
@@ -115,11 +157,26 @@ class ServableModel:
                 self.tables = self.compiled.quantize(
                     leaf_dtype=tables.leaf_dtype)
             else:
-                self.tables = self.compiled.quantize()
+                self.tables = self.compiled.quantize(
+                    leaf_dtype=TIER_LEAF_DTYPE[self.quantize_tier])
             self.max_abs_err = self.tables.max_abs_err
         else:
             self.tables = None
             self.max_abs_err = 0.0
+
+    @property
+    def predict_impl(self) -> str:
+        """The tier ACTUALLY serving this model ("lut4" | "lut" |
+        "f32") — asks the backend what its fallback ladder resolved, so
+        a silent VMEM-guard trip is visible in /healthz and
+        serve_latency instead of only in debug logs (resolution happens
+        at warmup, before the model is ever published)."""
+        if self._impl_override is not None:
+            return self._impl_override
+        be = self.backend
+        if be is not None and hasattr(be, "resolved_predict_impl"):
+            return be.resolved_predict_impl(self.token)
+        return "f32"
 
     @property
     def n_features(self) -> int:
@@ -189,6 +246,7 @@ class _Window:
     requests: int = 0
     queue_depth_max: int = 0
     batches: int = 0
+    express: int = 0            # requests the express lane dispatched
     t_start: float = dataclasses.field(default_factory=time.perf_counter)
 
 
@@ -213,9 +271,10 @@ class ServeStats:
         self._win = _Window()
         self.requests = 0
         self.coalesce_max = 0
+        self.express = 0
 
-    def record_batch(self, n_requests: int,
-                     queue_depth: int, latencies_ms: list) -> None:
+    def record_batch(self, n_requests: int, queue_depth: int,
+                     latencies_ms: list, express: bool = False) -> None:
         with self._lock:
             self.requests += n_requests
             self.coalesce_max = max(self.coalesce_max, n_requests)
@@ -226,6 +285,9 @@ class ServeStats:
             w.widths.append(n_requests)
             w.queue_depth_max = max(w.queue_depth_max, queue_depth)
             w.latencies_ms.extend(latencies_ms)
+            if express:
+                self.express += n_requests
+                w.express += n_requests
 
     def _summary_locked(self, w: _Window) -> dict:
         lat = sorted(w.latencies_ms)
@@ -241,6 +303,7 @@ class ServeStats:
                               if w.widths else 0.0),
             "coalesce_max": max(w.widths) if w.widths else 0,
             "queue_depth_max": w.queue_depth_max,
+            "express": w.express,
         }
 
     def window_summary(self, reset: bool = False) -> dict:
@@ -259,6 +322,7 @@ class ServeStats:
             return {
                 "requests": self.requests,
                 "coalesce_max": self.coalesce_max,
+                "express": self.express,
                 "p50_ms": round(_quantile(lat, 0.50), 4),
                 "p99_ms": round(_quantile(lat, 0.99), 4),
                 "p999_ms": round(_quantile(lat, 0.999), 4),
@@ -279,20 +343,24 @@ class ServeEngine:
 
     def __init__(self, bundle, cfg: TrainConfig | None = None, *,
                  backend=None, max_wait_ms: float = 1.0,
-                 max_batch: int = 256, quantize: bool = False,
-                 raw: bool = False, run_log=None):
+                 max_batch: int = 256, quantize=False,
+                 raw: bool = False, run_log=None,
+                 express_lane: bool = True):
         from ddt_tpu.telemetry.events import RunLog
 
         self.cfg = cfg if cfg is not None else TrainConfig()
-        if quantize and self.cfg.predict_impl != "lut":
-            # quantize=True IS the LUT opt-in — the backend dispatch and
-            # the engine's health/error-bound reporting must agree.
-            self.cfg = self.cfg.replace(predict_impl="lut")
+        self.quantize_tier = normalize_quantize(quantize)
+        want_impl = TIER_IMPL.get(self.quantize_tier)
+        if want_impl is not None and self.cfg.predict_impl != want_impl:
+            # quantize= IS the LUT-tier opt-in — the backend dispatch
+            # and the engine's health/error-bound reporting must agree.
+            self.cfg = self.cfg.replace(predict_impl=want_impl)
         self.backend = backend if backend is not None \
             else get_backend(self.cfg)
         self.buckets = default_buckets(max_batch)
-        self.quantize = bool(quantize)
+        self.quantize = self.quantize_tier is not None
         self.raw = bool(raw)
+        self.express_lane = bool(express_lane)
         self.stats = ServeStats()
         self.run_log = RunLog.coerce(run_log)
         # Registry root for reference-based hot swaps (`cli serve
@@ -319,7 +387,8 @@ class ServeEngine:
             # model it is a handful of cached dispatches.
             bundle.warmup()
             return bundle
-        m = ServableModel(bundle, self.backend, quantize=self.quantize,
+        m = ServableModel(bundle, self.backend,
+                          quantize=self.quantize_tier,
                           buckets=self.buckets, raw=self.raw)
         m.warmup()
         return m
@@ -327,6 +396,13 @@ class ServeEngine:
     @property
     def model_token(self) -> str:
         return self._model.token
+
+    @property
+    def n_features(self) -> int:
+        """Feature width of the CURRENTLY served model (the raw wire
+        path derives row count from it; a request racing a hot swap is
+        re-validated at dispatch like every other)."""
+        return self._model.n_features
 
     def swap(self, bundle) -> dict:
         """Zero-downtime hot swap: build + warm the new version OFF the
@@ -367,6 +443,17 @@ class ServeEngine:
                 f"expects {self._model.n_features}")
         if rows.dtype != np.uint8:
             rows = np.ascontiguousarray(rows, np.float32)
+        if self.express_lane and rows.shape[0] == 1:
+            # Express lane (ISSUE 12): with an empty queue and no batch
+            # mid-dispatch, a single-row request scores RIGHT HERE on
+            # the caller's thread against the pre-traced [1, F] bucket
+            # — no admission window, no handoff. Under load express()
+            # returns None and the request coalesces like any other
+            # (tail latency never regresses; batcher.py documents the
+            # fairness argument).
+            req = self._batcher.express(rows, 1)
+            if req is not None:
+                return req
         return self._batcher.submit(rows, rows.shape[0])
 
     def predict(self, rows: np.ndarray, timeout: float | None = 30.0):
@@ -412,13 +499,17 @@ class ServeEngine:
         scores = model.score_binned(Xb)
         done = time.perf_counter()
         lats = [(done - r.t_submit) * 1e3 for r in good]
+        express = bool(good and good[0].express)
         # Stats land BEFORE any waiter wakes: a caller that resets the
         # stats window the moment result() returns must find this batch
         # in the window it completed in, and never see it leak into the
         # next one (bench_serve_latency's per-QPS arms do exactly that).
         tele_counters.record_serve_requests(len(good))
         tele_counters.record_serve_batch()
-        self.stats.record_batch(len(good), queue_depth, lats)
+        if express:
+            tele_counters.record_serve_express()
+        self.stats.record_batch(len(good), queue_depth, lats,
+                                express=express)
         off = 0
         for req in good:
             # Attribution BEFORE the result event fires: a waiter that
@@ -441,6 +532,10 @@ class ServeEngine:
             return None
         m = self._model
         summary["model_token"] = m.token
+        # The tier ACTUALLY serving (satellite fix, ISSUE 12): a vmem
+        # guard that silently degraded lut4 -> lut -> f32 shows up in
+        # every telemetry window, not only in debug logs.
+        summary["predict_impl"] = m.predict_impl
         if m.artifact_digest is not None:
             summary["artifact_digest"] = m.artifact_digest
         if self.run_log is not None:
@@ -453,8 +548,11 @@ class ServeEngine:
             "ok": True,
             "model_token": m.token,
             "quantized": m.quantized,
+            "quantize_tier": getattr(m, "quantize_tier", None),
+            "predict_impl": m.predict_impl,
             "lut_max_abs_err": m.max_abs_err,
             "buckets": list(self.buckets),
+            "express_lane": self.express_lane,
             "artifact_digest": m.artifact_digest,
             "aot": m.aot,
             **self.stats.snapshot(),

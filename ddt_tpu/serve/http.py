@@ -13,6 +13,17 @@ Endpoints (all JSON):
 
 - POST /predict   {"rows": [[...], ...], "binned": false}
                   -> {"scores": [...], "model": token}
+- POST /predict?binned=raw   ZERO-COPY binned wire path (ISSUE 12):
+                  the body IS the uint8 row block (Content-Type
+                  application/octet-stream, Content-Length required =
+                  n_rows * n_features bytes). The bytes go wire ->
+                  np.frombuffer view -> device untouched — no float
+                  parse, no re-bin, no JSON; the LUT kernels stream
+                  raw uint8, so a single-row request's payload is F
+                  bytes end to end. Bounds are structural (a byte IS a
+                  valid bin id, the same 0..255 domain the JSON binned
+                  path range-checks); a body that is not a whole
+                  number of rows is rejected 400 loudly.
 - POST /swap      {"model": "/path/to/model.npz"} — or a REGISTRY
                   reference {"model": "name@version" | "name@tag" |
                   "<digest>"} when the server was started with
@@ -64,17 +75,49 @@ def _swap(engine, ref: str) -> dict:
             "without --registry so registry references cannot resolve")
     from ddt_tpu.registry import loader as reg_loader
 
-    # The engine's serving mode wins: a quantized server stays
-    # quantized (missing LUT export -> loud 400), an f32 server serves
-    # the f32 variant even from a quantized artifact.
+    # The engine's serving mode wins: a quantized server stays on its
+    # TIER (missing LUT export -> loud 400), an f32 server serves the
+    # f32 variant even from a quantized artifact.
     report = reg_loader.load_servable(
-        registry_root, ref, quantize=engine.quantize,
+        registry_root, ref,
+        quantize=engine.quantize_tier if engine.quantize else False,
         raw=engine.raw, backend=engine.backend,
         run_log=engine.run_log)
     out = engine.swap(report.model)
     out["artifact_digest"] = report.digest
     out["mode"] = report.mode
     return out
+
+
+def decode_raw_rows(body: bytes, n_features: int,
+                    declared_len: "int | None") -> np.ndarray:
+    """`binned=raw` wire decode: the body IS the uint8 row block.
+
+    Zero-copy by construction — np.frombuffer wraps the received bytes
+    and the reshape is a view, so the array handed to the engine (and
+    from there to the device upload) is the wire buffer itself. The
+    checks are exactly once and O(1): Content-Length must be declared
+    and match what arrived (a truncated body must not become fewer
+    rows), and the byte count must be a whole number of `n_features`-
+    wide rows (a width mismatch is a 400, never a silent reshape).
+    Bin-id bounds are structural: a byte cannot leave [0, 255], the
+    same domain the JSON binned path range-checks value by value."""
+    if declared_len is None:
+        raise ValueError(
+            "binned=raw requires a Content-Length header (the row "
+            "block is validated against it before it touches the "
+            "engine)")
+    if len(body) != declared_len:
+        raise ValueError(
+            f"binned=raw body is {len(body)} bytes but Content-Length "
+            f"declared {declared_len}")
+    if len(body) == 0:
+        raise ValueError("binned=raw body is empty")
+    if len(body) % n_features:
+        raise ValueError(
+            f"binned=raw body of {len(body)} bytes is not a whole "
+            f"number of {n_features}-feature rows")
+    return np.frombuffer(body, dtype=np.uint8).reshape(-1, n_features)
 
 
 def _make_handler(engine, server_box: dict):
@@ -112,21 +155,46 @@ def _make_handler(engine, server_box: dict):
 
         def do_POST(self):
             try:
-                if self.path == "/predict":
-                    req = self._body()
-                    rows = np.asarray(req["rows"])
-                    if req.get("binned"):
-                        # astype(uint8) would silently WRAP out-of-range
-                        # ids (300 -> 44) and truncate floats — fail the
-                        # contract violation loudly like every other
-                        # malformed input in this handler.
-                        if rows.dtype.kind not in "iu" or (
-                                rows.size and (int(rows.min()) < 0
-                                               or int(rows.max()) > 255)):
+                if self.path.split("?", 1)[0] == "/predict":
+                    qs = self.path.partition("?")[2]
+                    ctype = self.headers.get("Content-Type", "")
+                    if ("binned=raw" in qs.split("&")
+                            or ctype.startswith(
+                                "application/octet-stream")):
+                        # Zero-copy binned wire path (module doc): the
+                        # body bytes become the row array directly —
+                        # width derived from the CURRENT model (a swap
+                        # race is caught again at dispatch, like every
+                        # other request).
+                        n = self.headers.get("Content-Length")
+                        declared = int(n) if n is not None else None
+                        if declared is not None and declared < 0:
+                            # read(-1) would block to EOF on a
+                            # keep-alive socket — reject before reading.
                             raise ValueError(
-                                "binned rows must be integer bin ids "
-                                "in 0..255")
-                        rows = rows.astype(np.uint8)
+                                "binned=raw Content-Length must be "
+                                f">= 0, got {declared}")
+                        body = self.rfile.read(declared) \
+                            if declared else b""
+                        rows = decode_raw_rows(
+                            body, engine.n_features, declared)
+                    else:
+                        req = self._body()
+                        rows = np.asarray(req["rows"])
+                        if req.get("binned"):
+                            # astype(uint8) would silently WRAP
+                            # out-of-range ids (300 -> 44) and truncate
+                            # floats — fail the contract violation
+                            # loudly like every other malformed input
+                            # in this handler.
+                            if rows.dtype.kind not in "iu" or (
+                                    rows.size and (int(rows.min()) < 0
+                                                   or int(rows.max())
+                                                   > 255)):
+                                raise ValueError(
+                                    "binned rows must be integer bin "
+                                    "ids in 0..255")
+                            rows = rows.astype(np.uint8)
                     # The dispatcher stamps the token of the model that
                     # ACTUALLY scored the batch — reading engine.
                     # model_token here instead races the hot swap and

@@ -79,6 +79,10 @@ _c = {
     "serve_requests": 0,
     "serve_batches": 0,
     "serve_hot_swaps": 0,
+    # Requests the express lane dispatched synchronously (ISSUE 12) —
+    # serve_express/serve_requests is the lifetime share of traffic
+    # that skipped the admission window (== idle-regime traffic).
+    "serve_express": 0,
 }
 _listener_installed = False
 # When truthy, the compile listener drops events: the cost observatory's
@@ -159,6 +163,10 @@ def record_serve_batch() -> None:
 
 def record_serve_hot_swap() -> None:
     _c["serve_hot_swaps"] += 1
+
+
+def record_serve_express() -> None:
+    _c["serve_express"] += 1
 
 
 def snapshot() -> dict:

@@ -91,14 +91,19 @@ EVENT_FIELDS: dict[str, set] = {
     # 16-hex content address. Extras: name, version, kind, the training
     # run_id (the cross-reference `report`'s registry section joins on),
     # model_token, and mode (the loader's restore ladder: aot-f32 /
-    # aot-lut / tables-fallback / rebuild).
+    # aot-lut / aot-lut4 / tables-fallback / rebuild).
     "artifact": {"action", "digest"},
     # Serving-tier SLO window (schema v4, ddt_tpu/serve/engine.py): one
     # per emitted latency window — per-request latency quantiles
     # (p50/p99; extras p999_ms, max_ms), admission-batching shape
     # (batches, coalesce_mean/max, queue_depth_max), window_s, and the
-    # served model's content-digest token. Consumed by `report`'s
-    # serving section and banded (via the bench stamps) by benchwatch.
+    # served model's content-digest token. Additive ISSUE 12 extras:
+    # `predict_impl` (the quantization tier ACTUALLY serving the window
+    # — "lut4"/"lut"/"f32"; a silent VMEM-guard fallback is visible
+    # here, not only in debug logs) and `express` (requests the express
+    # lane dispatched without an admission window). Consumed by
+    # `report`'s serving section and banded (via the bench stamps) by
+    # benchwatch.
     "serve_latency": {"requests", "p50_ms", "p99_ms"},
     # Last record of a completed run.
     "run_end": {"completed_rounds", "wallclock_s"},

@@ -247,6 +247,11 @@ def _serving_summary(serve_ev: list[dict]) -> dict | None:
                             for e in serve_ev),
         "queue_depth_max": max((e.get("queue_depth_max") or 0)
                                for e in serve_ev),
+        # ISSUE 12 extras (None/0 on pre-int4 logs): the quantization
+        # tier that ACTUALLY served the last window, and how much
+        # traffic rode the express lane across all windows.
+        "predict_impl": last.get("predict_impl"),
+        "express": sum(e.get("express", 0) or 0 for e in serve_ev),
         "model_tokens": sorted({e["model_token"][:12] for e in serve_ev
                                 if e.get("model_token")}),
     }
@@ -381,6 +386,13 @@ def render(summary: dict) -> str:
         out.append(
             f"  latency: p50={s['p50_ms']:.3f} ms  "
             f"p99={s['p99_ms']:.3f} ms{p999}{worst}")
+        extras = []
+        if s.get("predict_impl"):
+            extras.append(f"tier={s['predict_impl']}")
+        if s.get("express"):
+            extras.append(f"express={s['express']}")
+        if extras:
+            out.append("  " + "  ".join(extras))
         if s.get("model_tokens"):
             out.append("  models served: "
                        + ", ".join(s["model_tokens"]))

@@ -16,7 +16,14 @@ One process, five assertions:
    behind a barrier; under real HTTP concurrency width depends on the
    box, so the smoke asserts coalescing happened, not a number);
 5. the `serve_latency` SLO event lands in the run log and renders
-   through `cli report`'s serving section.
+   through `cli report`'s serving section;
+6. (ISSUE 12 arm) an int4-quantized engine behind the SAME front end:
+   a storm of `binned=raw` octet-stream requests (the zero-copy wire
+   path) interleaved with sequential express-lane singles — every
+   response BIT-matches the offline answer of the tier that actually
+   served it (predict_impl='lut4', verified from /healthz), raw and
+   JSON bodies agree bitwise, the express counter moved, and the
+   malformed-width raw body 400s loudly.
 
 Exit 0 = all hold.
 """
@@ -26,6 +33,7 @@ import os
 import sys
 import tempfile
 import threading
+import urllib.error
 import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -54,6 +62,17 @@ def _post(port: int, path: str, payload: dict) -> dict:
 def _get(port: int, path: str) -> dict:
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post_raw(port: int, body: bytes) -> dict:
+    """POST /predict?binned=raw with the uint8 row block AS the body
+    (the zero-copy wire path)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict?binned=raw", data=body,
+        headers={"Content-Type": "application/octet-stream"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
         return json.loads(r.read())
 
 
@@ -165,6 +184,83 @@ def main() -> int:
         assert "serving:" in rendered and "latency:" in rendered
         out["serve_latency_events"] = len(sl)
         out["p99_ms"] = sl[-1]["p99_ms"]
+
+    # --- ISSUE 12 arm: int4 tier + binned=raw wire path + express lane.
+    # A 15-bin model so the int4 thresholds ride the nibble pack.
+    X4, y4 = datasets.synthetic_binary(3000, seed=9)
+    res4 = api.train(X4, y4, n_trees=8, max_depth=3, n_bins=15,
+                     backend="tpu", log_every=10**9)
+    cfg4 = TrainConfig(backend="tpu", n_bins=15, predict_impl="lut4")
+    # Offline reference THROUGH THE SAME TIER: responses must bit-match
+    # the tier that serves them, not merely sit near f32.
+    ref4 = np.asarray(api.predict(res4.ensemble, X4, mapper=res4.mapper,
+                                  cfg=cfg4))
+    Xb4 = res4.mapper.transform(X4)
+    engine4 = ServeEngine(
+        api.ModelBundle(ensemble=res4.ensemble, mapper=res4.mapper),
+        cfg4, max_wait_ms=2.0, max_batch=64, quantize="int4")
+    ready4 = threading.Event()
+    th4 = threading.Thread(
+        target=serve_forever, args=(engine4,),
+        kwargs=dict(port=0, ready_event=ready4), daemon=True)
+    th4.start()
+    assert ready4.wait(60), "int4 server never came up"
+    port4 = engine4.http_port
+
+    h4 = _get(port4, "/healthz")
+    assert h4["quantized"] and h4["quantize_tier"] == "int4"
+    assert h4["predict_impl"] == "lut4", (
+        f"int4 engine silently fell back: serving {h4['predict_impl']}")
+    out["int4_predict_impl"] = h4["predict_impl"]
+    out["int4_err_bound"] = h4["lut_max_abs_err"]
+
+    # Express singles FIRST (sequential -> empty queue -> the lane).
+    for i in range(6):
+        r = _post_raw(port4, Xb4[i:i + 1].tobytes())
+        np.testing.assert_array_equal(
+            np.asarray(r["scores"], np.float32),
+            ref4[i:i + 1].astype(np.float32))
+
+    # Then the raw-wire storm: concurrent multi-row raw bodies.
+    n4, errs4 = 40, []
+
+    def raw_worker(i):
+        try:
+            lo = 7 * i
+            r = _post_raw(port4, Xb4[lo:lo + 7].tobytes())
+            np.testing.assert_array_equal(
+                np.asarray(r["scores"], np.float32),
+                ref4[lo:lo + 7].astype(np.float32))
+        except Exception as e:       # noqa: BLE001 — smoke verdict
+            errs4.append((i, repr(e)))
+
+    threads4 = [threading.Thread(target=raw_worker, args=(i,))
+                for i in range(n4)]
+    for t in threads4:
+        t.start()
+    for t in threads4:
+        t.join(60)
+    assert not errs4, f"raw-wire storm failures: {errs4[:5]}"
+
+    # Raw and JSON bodies agree BITWISE on the same rows.
+    r_raw = _post_raw(port4, Xb4[:5].tobytes())
+    r_json = _post(port4, "/predict", {"rows": X4[:5].tolist()})
+    np.testing.assert_array_equal(np.asarray(r_raw["scores"]),
+                                  np.asarray(r_json["scores"]))
+
+    # Malformed width: loud 400, never a silent reshape.
+    try:
+        _post_raw(port4, Xb4[:1].tobytes()[:-1])
+        raise AssertionError("truncated raw body was accepted")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400, e.code
+
+    stats4 = _get(port4, "/healthz")
+    assert stats4["express"] >= 6, stats4      # the lane carried singles
+    out["int4_raw_storm"] = n4
+    out["int4_express_hits"] = stats4["express"]
+    _post(port4, "/shutdown", {})
+    th4.join(30)
 
     out["ok"] = True
     print(json.dumps(out))

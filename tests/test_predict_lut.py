@@ -166,9 +166,11 @@ def test_threshold_quantization_is_exact():
 
 
 def test_quantize_rejects_unknown_leaf_dtype():
+    # "int4" became a real tier in ISSUE 12 (tests/test_predict_lut4.py)
+    # — the refusal contract now guards genuinely unknown dtypes.
     ens = _rand_ens()
     with pytest.raises(ValueError, match="leaf_dtype"):
-        ens.compile().quantize(leaf_dtype="int4")
+        ens.compile().quantize(leaf_dtype="int2")
 
 
 def test_fits_guard_refuses_monster_shapes():

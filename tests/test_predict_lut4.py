@@ -1,0 +1,362 @@
+"""int4 bit-packed TreeLUT tier (ops/predict_lut.py "int4 TIER"): the
+pack/unpack round trip, the exactness contract, the extended error
+bound, and the fallback ladder — pinned.
+
+Exactness framing (the module doc spells it out): descent and the
+per-leaf dequantize are exact at int4 width, but f32 SUMMATION ORDER
+across trees belongs to XLA's fusion choices, the same slack every
+kernel-parity contract in this repo carries (test_hist_fused pins its
+bitwise claims on integer-valued inputs for exactly this reason). So:
+
+1. BITWISE parity vs the f32 one-hot reference is pinned on EXACT-GRID
+   models — leaf values on a power-of-two grid with the per-tree scale
+   forced to exactly 1/8, where every product and partial sum is exact
+   in f32 and summation order cannot matter. Swept across n_classes
+   {1, 3} x missing x categorical x ragged trees/tiles x BOTH
+   threshold regimes (nibble-packed <= 15-bin models and the lossless
+   int8 form).
+2. ERROR BOUND end to end on random-valued models: |lut4 - f32| <=
+   QuantizedTables.max_abs_err (computed for the int4 rounding step)
+   plus f32-accumulation slack only — and the dequantized reference
+   sits within pure accumulation slack (1e-5 absolute), witnessing
+   that the ONLY real error source is the documented rounding step.
+3. PACK ROUND TRIP: unpacking PackedTables' nibble arrays host-side
+   reproduces thr/leaf_q bit-for-bit (two's-complement low nibbles,
+   threshold sentinel semantics included).
+4. DISPATCH: cfg.predict_impl="lut4" routes the backend through the
+   packed tables within the bound; the ladder degrades lut4 -> lut ->
+   f32 when the guards refuse, and `resolved_predict_impl` reports the
+   rung that actually serves (the telemetry-stamp satellite).
+
+All kernels run in Pallas interpret mode on the CPU suite.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.models.tree import empty_ensemble
+from ddt_tpu.ops import predict as predict_ops
+from ddt_tpu.ops import predict_lut
+
+
+def _rand_ens(seed=0, trees=12, depth=3, features=7, bins=31,
+              loss="logloss", n_classes=2, missing=False, cat=(),
+              exact_grid=False):
+    rng = np.random.default_rng(seed)
+    n_nodes = 2 ** (depth + 1) - 1
+    ens = empty_ensemble(
+        trees, depth, features, 0.125 if exact_grid else 0.1,
+        0.25, loss, n_classes=n_classes,
+        missing_bin=missing, n_bins=bins, cat_features=tuple(cat))
+    ens.feature[:] = rng.integers(0, features, size=(trees, n_nodes))
+    ens.threshold_bin[:] = rng.integers(
+        0, bins - (2 if missing else 1), size=(trees, n_nodes))
+    ens.is_leaf[:] = rng.random((trees, n_nodes)) < 0.25
+    if exact_grid:
+        # Power-of-two grid: integer leaf_q in [-7, 7] at scale exactly
+        # 1/8 — the left spine stays internal and the leftmost bottom
+        # node pins each tree's max|bot_val| to 7/8, so
+        # scale = max/7 = 0.125 exactly and quantization is LOSSLESS
+        # (max_abs_err == 0; asserted where used).
+        q = rng.integers(-7, 8, size=(trees, n_nodes)).astype(np.float32)
+        ens.leaf_value[:] = q / 8.0
+        ens.is_leaf[:, [(1 << d) - 1 for d in range(depth)]] = False
+        ens.leaf_value[:, (1 << depth) - 1] = 7 / 8.0
+    else:
+        ens.leaf_value[:] = rng.standard_normal(
+            (trees, n_nodes)).astype(np.float32)
+    if missing:
+        ens.default_left[:] = rng.random((trees, n_nodes)) < 0.5
+    return ens
+
+
+def _rows(ens, rows=50, bins=31, missing=False, seed=1):
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, bins - (1 if missing else 0),
+                      size=(rows, ens.n_features)).astype(np.uint8)
+    if missing:
+        mask = rng.random(Xb.shape) < 0.2
+        Xb[mask] = bins - 1
+    return Xb
+
+
+def _f32_reference(ce, Xb, tables=None):
+    """Jitted one-hot scores on the original (tables=None) or
+    dequantized int4 tables — jitted like the production dispatch."""
+    if tables is None:
+        eff_feat, eff_thr = ce.eff_feat, ce.eff_thr
+        bot_val, cls_oh = ce.bot_val, ce.cls_oh
+        dl, cn = ce.eff_dl, ce.eff_cat
+    else:
+        eff_thr, bot_val = tables.dequantized()
+        eff_feat, cls_oh = tables.eff_feat, tables.cls_oh
+        dl, cn = tables.eff_dl, tables.eff_cat
+    kw = {}
+    if dl is not None:
+        kw["eff_dl"] = jnp.asarray(dl)
+    if cn is not None:
+        kw["eff_cat"] = jnp.asarray(cn)
+    fn = jax.jit(functools.partial(
+        predict_ops.predict_raw_effective,
+        max_depth=ce.max_depth, learning_rate=ce.learning_rate,
+        base=ce.base_score, n_classes=ce.n_classes_out,
+        tree_chunk=ce.tree_chunk,
+        missing_bin_value=ce.missing_bin_value, use_pallas=False, **kw))
+    return np.asarray(fn(jnp.asarray(eff_feat), jnp.asarray(eff_thr),
+                         jnp.asarray(bot_val), jnp.asarray(cls_oh),
+                         jnp.asarray(Xb)))
+
+
+def _lut4_scores(packed, Xb, tile_r=None):
+    fn = jax.jit(lambda X: predict_lut.predict_effective_lut4(
+        packed, X, tile_r=tile_r))
+    return np.asarray(fn(jnp.asarray(Xb)))
+
+
+# bins 13 -> thresholds fit a nibble (thr_packed=True), bins 31 -> the
+# lossless int8 threshold form; both regimes ride every sweep.
+VARIANTS = [
+    pytest.param(dict(), 13, id="binary-thrpacked"),
+    pytest.param(dict(), 31, id="binary-thr8"),
+    pytest.param(dict(loss="softmax", n_classes=3, trees=12), 13,
+                 id="softmax3-thrpacked"),
+    pytest.param(dict(missing=True), 13, id="missing-thrpacked"),
+    pytest.param(dict(missing=True), 31, id="missing-thr8"),
+    pytest.param(dict(cat=(1, 4)), 13, id="categorical-thrpacked"),
+    pytest.param(dict(cat=(1, 4)), 31, id="categorical-thr8"),
+    pytest.param(dict(loss="softmax", n_classes=3, cat=(0, 2), trees=9),
+                 31, id="softmax3-cat-ragged"),
+    pytest.param(dict(trees=13, depth=4), 13, id="ragged-deep"),
+]
+
+
+@pytest.mark.parametrize("variant,bins", VARIANTS)
+def test_lut4_bitexact_on_exact_grid(variant, bins):
+    """Property 1: on order-free exact-grid models the int4 tier equals
+    the f32 one-hot path BITWISE — descent, threshold nibble decode,
+    sign extension, and the scale multiply all exact; the ragged tile
+    (tile_r=16 on 50 rows) rides along."""
+    missing = variant.get("missing", False)
+    ens = _rand_ens(bins=bins, exact_grid=True, **variant)
+    Xb = _rows(ens, bins=bins, missing=missing)
+    ce = ens.compile(tree_chunk=8)
+    tables = ce.quantize(leaf_dtype="int4")
+    packed = tables.pack_int4()
+    assert packed.thr_packed == (bins <= 15)
+    assert tables.max_abs_err == 0.0        # the grid is lossless
+    got = _lut4_scores(packed, Xb, tile_r=16)
+    np.testing.assert_array_equal(got, _f32_reference(ce, Xb))
+    # ... and therefore also bitwise vs the dequantized reference.
+    np.testing.assert_array_equal(got,
+                                  _f32_reference(ce, Xb, tables=tables))
+
+
+@pytest.mark.parametrize("variant,bins", VARIANTS)
+def test_lut4_error_bound_end_to_end(variant, bins):
+    """Property 2: random-valued models hold the computed int4 bound
+    vs true f32, and sit within pure f32-accumulation slack of the
+    dequantized reference (the rounding step is the only real error)."""
+    missing = variant.get("missing", False)
+    ens = _rand_ens(bins=bins, **variant)
+    Xb = _rows(ens, bins=bins, missing=missing)
+    ce = ens.compile(tree_chunk=8)
+    tables = ce.quantize(leaf_dtype="int4")
+    packed = tables.pack_int4()
+    got = _lut4_scores(packed, Xb)
+    want = _f32_reference(ce, Xb)
+    err = float(np.abs(got - want).max())
+    assert err <= tables.max_abs_err * (1 + 1e-5) + 1e-6, \
+        (err, tables.max_abs_err)
+    # int4 genuinely rounds at these random leaf values.
+    assert tables.max_abs_err > 0
+    deq_ref = _f32_reference(ce, Xb, tables=tables)
+    assert float(np.abs(got - deq_ref).max()) <= 1e-5
+    # The int4 grid is coarser than int8's: its bound must dominate.
+    assert tables.max_abs_err >= ce.quantize(
+        leaf_dtype="int8").max_abs_err
+
+
+def test_pack_round_trip_bit_exact():
+    """Property 3: unpacking the nibble arrays host-side reproduces the
+    logical tables bit-for-bit — thresholds (values <= 14 verbatim, the
+    15 sentinel for every clipped +BIG) and two's-complement leaves."""
+    ens = _rand_ens(bins=13)
+    ce = ens.compile(tree_chunk=8)
+    t = ce.quantize(leaf_dtype="int4")
+    p = t.pack_int4()
+    assert p.thr_packed
+    tc = t.tree_chunk
+    n_tc = t.n_trees_padded // tc
+    n_int = (1 << t.max_depth) - 1
+    n_leaves = 1 << t.max_depth
+    h_n, h_l = (n_int + 1) // 2, (n_leaves + 1) // 2
+
+    def unpack_node_major(packed, half, width):
+        """[n_tc, half*tc] bytes -> [Tpad, width] nibbles (node-major
+        inverse: low nibbles = blocks [0, half), high = [half, 2*half))."""
+        out = np.zeros((t.n_trees_padded, 2 * half), np.int64)
+        for c in range(n_tc):
+            b = packed[c].astype(np.int64)
+            for j in range(half):
+                out[c * tc:(c + 1) * tc, j] = b[j * tc:(j + 1) * tc] & 15
+                out[c * tc:(c + 1) * tc, half + j] = \
+                    (b[j * tc:(j + 1) * tc] >> 4) & 15
+        return out[:, :width]
+
+    thr_nib = unpack_node_major(p.ops[1], h_n, n_int)
+    thr_raw = t.thr_i8[:, :n_int].astype(np.int64) + 128
+    want_nib = np.where(thr_raw >= 255, 15, thr_raw)
+    np.testing.assert_array_equal(thr_nib, want_nib)
+
+    leaf_nib = unpack_node_major(p.ops[2], h_l, n_leaves)
+    leaf = np.where(leaf_nib >= 8, leaf_nib - 16, leaf_nib)
+    np.testing.assert_array_equal(leaf, t.leaf_q.astype(np.int64))
+    np.testing.assert_array_equal(
+        p.ops[3].reshape(-1), t.leaf_scale)
+
+
+def test_thr_pack_condition_is_value_based():
+    """A 31-bin model whose thresholds all happen to be <= 14 still
+    packs (the condition is the VALUES, not n_bins); one threshold at
+    15 unpacks (15 is the sentinel, not a value)."""
+    ens = _rand_ens(bins=31)
+    ens.threshold_bin[:] = ens.threshold_bin % 15      # <= 14
+    t = ens.compile(tree_chunk=8).quantize(leaf_dtype="int4")
+    assert t.pack_int4().thr_packed
+    ens.threshold_bin[0, 0] = 15
+    ens.is_leaf[0, 0] = False
+    t2 = ens.compile(tree_chunk=8).quantize(leaf_dtype="int4")
+    assert not t2.pack_int4().thr_packed
+
+
+def test_thr_pack_refuses_categorical_sentinel_collision():
+    """A categorical node's comparison is EQUALITY, so it gets no
+    always-left 255 exemption: a cat split whose bin id would clip into
+    the sentinel must refuse the pack (packed, 'bin == 255 goes left'
+    would decode to 256 and flip into always-right — review finding)."""
+    ens = _rand_ens(bins=31, cat=(1,))
+    ens.threshold_bin[:] = ens.threshold_bin % 15
+    # A real (non-leaf) categorical node on feature 1 with bin id 255.
+    ens.feature[0, 0] = 1
+    ens.is_leaf[0, 0] = False
+    ens.threshold_bin[0, 0] = 255
+    t = ens.compile(tree_chunk=8).quantize(leaf_dtype="int4")
+    assert not t.pack_int4().thr_packed
+    # The SAME 255 on a numeric node is fine (">" semantics: 255 and
+    # the 256 sentinel are both always-left for uint8 bins).
+    ens2 = _rand_ens(bins=31, cat=(1,))
+    ens2.threshold_bin[:] = ens2.threshold_bin % 15
+    ens2.feature[0, 0] = 0                 # numeric feature
+    ens2.is_leaf[0, 0] = False
+    ens2.threshold_bin[0, 0] = 255
+    t2 = ens2.compile(tree_chunk=8).quantize(leaf_dtype="int4")
+    assert t2.pack_int4().thr_packed
+
+
+def test_pack_refuses_non_int4_tables():
+    ens = _rand_ens()
+    with pytest.raises(ValueError, match="int4"):
+        ens.compile(tree_chunk=8).quantize().pack_int4()
+
+
+def test_fits_guard_refuses_monster_shapes():
+    """predict_lut4_fits is the vmem-guard: a shape whose trace/VMEM
+    budget explodes must return False, and a forced COMPILED dispatch
+    at it must raise at the cause (interpret mode stays callable)."""
+    assert predict_lut.predict_lut4_fits(64, 64, 3, 7, 1)
+    assert predict_lut.predict_lut4_fits(64, 64, 3, 7, 1,
+                                         thr_packed=True)
+    assert not predict_lut.predict_lut4_fits(131072, 64, 10, 4096, 1)
+    ens = _rand_ens()
+    packed = ens.compile(tree_chunk=8).quantize(
+        leaf_dtype="int4").pack_int4()
+    with pytest.raises(ValueError, match="VMEM"):
+        predict_lut.predict_effective_lut4(
+            packed, _rows(ens), tile_r=10**6, interpret=False)
+
+
+def test_backend_lut4_dispatch_and_fallback_ladder(monkeypatch):
+    """Property 4: predict_impl='lut4' serves the packed tables within
+    the bound; the guard ladder degrades lut4 -> lut -> f32 and
+    `resolved_predict_impl` reports the serving rung each time."""
+    from ddt_tpu.backends import get_backend
+
+    ens = _rand_ens(trees=8, bins=13)
+    Xb = _rows(ens, rows=33, bins=13)
+    ce = ens.compile()
+    be_f32 = get_backend(TrainConfig(backend="tpu", n_bins=13),
+                         use_cache=False)
+    be_l4 = get_backend(TrainConfig(backend="tpu", n_bins=13,
+                                    predict_impl="lut4"),
+                        use_cache=False)
+    want = be_f32.predict_raw(ens, Xb)
+    got = be_l4.predict_raw(ens, Xb)
+    bound = ce.quantize(leaf_dtype="int4").max_abs_err
+    assert float(np.abs(got - want).max()) <= bound * (1 + 1e-5) + 1e-6
+    assert be_l4.resolved_predict_impl(ce.token) == "lut4"
+    assert be_f32.resolved_predict_impl(ce.token) == "f32"
+
+    # int4 guard refuses -> the int8 tier serves...
+    monkeypatch.setattr(predict_lut, "predict_lut4_fits",
+                        lambda *a, **k: False)
+    be_l8 = get_backend(TrainConfig(backend="tpu", n_bins=13,
+                                    predict_impl="lut4"),
+                        use_cache=False)
+    got8 = be_l8.predict_raw(ens, Xb)
+    assert be_l8.resolved_predict_impl(ce.token) == "lut"
+    bound8 = ce.quantize().max_abs_err
+    assert float(np.abs(got8 - want).max()) <= bound8 * (1 + 1e-5) + 1e-6
+
+    # ...and with both quantized guards refusing, f32 serves exactly.
+    monkeypatch.setattr(predict_lut, "predict_lut_fits",
+                        lambda *a, **k: False)
+    be_ff = get_backend(TrainConfig(backend="tpu", n_bins=13,
+                                    predict_impl="lut4"),
+                        use_cache=False)
+    np.testing.assert_array_equal(be_ff.predict_raw(ens, Xb), want)
+    assert be_ff.resolved_predict_impl(ce.token) == "f32"
+
+
+def test_lut4_quantize_memoized_and_seedable():
+    ens = _rand_ens()
+    ce = ens.compile(tree_chunk=8)
+    t1 = ce.quantize(leaf_dtype="int4")
+    assert ce.quantize(leaf_dtype="int4") is t1
+    ce2 = ens.compile(tree_chunk=8)
+    ce2.seed_quantized(t1)
+    assert ce2.quantize(leaf_dtype="int4") is t1
+
+
+def test_lut4_empty_batch():
+    ens = _rand_ens()
+    packed = ens.compile(tree_chunk=8).quantize(
+        leaf_dtype="int4").pack_int4()
+    out = predict_lut.predict_effective_lut4(
+        packed, np.zeros((0, ens.n_features), np.uint8))
+    assert np.asarray(out).shape == (0,)
+
+
+def test_lut4_tables_npz_round_trip_token_pinned():
+    """The int4 tables survive the aot npz round trip verbatim (the
+    registry's carried-representation contract): every array bitwise,
+    the scalars exact, and re-packing the restored tables yields
+    byte-identical device operands."""
+    from ddt_tpu.export import aot
+
+    ens = _rand_ens(bins=13, missing=False, cat=(2,))
+    t = ens.compile(tree_chunk=8).quantize(leaf_dtype="int4")
+    back = aot.tables_from_arrays(aot.tables_to_arrays(t))
+    assert back.token == t.token and back.leaf_dtype == "int4"
+    assert back.max_abs_err == t.max_abs_err
+    np.testing.assert_array_equal(back.leaf_q, t.leaf_q)
+    np.testing.assert_array_equal(back.leaf_scale, t.leaf_scale)
+    np.testing.assert_array_equal(back.thr_i8, t.thr_i8)
+    p0, p1 = t.pack_int4(), back.pack_int4()
+    assert p0.thr_packed == p1.thr_packed
+    for a, b in zip(p0.ops, p1.ops):
+        np.testing.assert_array_equal(a, b)

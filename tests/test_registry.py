@@ -320,6 +320,71 @@ def test_lut_restore_bitexact_and_bounded(trained, pushed):
         assert np.array_equal(np.asarray(want), np.asarray(got)), n
 
 
+def test_lut4_push_restore_bitexact_and_tier_pinned(trained, tmp_path):
+    """ISSUE 12: --quantize=int4 exports lut4 AOT blobs + int4 tables;
+    the cold restore is aot-lut4, bit-exact vs the in-process lut4
+    path, stamps predict_impl='lut4', and tier mismatches refuse."""
+    root = str(tmp_path / "reg4")
+    out = push_servable(root, _bundle(trained), name="m4",
+                        max_batch=8, quantize="int4")
+    rep = load_servable(root, "m4")               # follows the artifact
+    assert rep.mode == "aot-lut4"
+    m = rep.model
+    assert m.quantized and m.quantize_tier == "int4"
+    assert m.predict_impl == "lut4"
+    assert rep.manifest["quantized"]["tier"] == "int4"
+    assert rep.manifest["quantized"]["leaf_dtype"] == "int4"
+    assert m.max_abs_err == rep.manifest["quantized"]["max_abs_err"]
+    m.warmup()
+    X = trained["X"]
+    cfg4 = trained["cfg"].replace(predict_impl="lut4")
+    for n in (1, 7, 8, 19):
+        want = api.predict(trained["res"].ensemble, X[:n],
+                           mapper=trained["res"].mapper, cfg=cfg4)
+        got = m.score_binned(trained["res"].mapper.transform(X[:n]))
+        assert np.array_equal(np.asarray(want), np.asarray(got)), n
+    # Tier pinning: an int4 artifact refuses an int8 request (and vice
+    # versa via the `pushed` fixture) — the carried tables ARE the
+    # representation, so a different grid would falsify the manifest's
+    # error bound.
+    with pytest.raises(RegistryError, match="int4.*tier|tier"):
+        load_servable(root, "m4", quantize="int8")
+    # f32 restore from the same artifact still works (mode wins).
+    rep32 = load_servable(root, "m4", quantize=False)
+    assert rep32.mode == "aot-f32"
+    assert rep32.model.predict_impl == "f32"
+    assert out["digest"] != ""
+
+
+def test_lut4_tables_fallback_serves_carried_representation(
+        trained, tmp_path, monkeypatch):
+    """An int4 artifact on a platform its lut4 blobs don't cover still
+    serves the CARRIED int4 tables through the backend ladder
+    (tables-fallback), not a re-quantization."""
+    root = str(tmp_path / "reg4f")
+    push_servable(root, _bundle(trained), name="m4",
+                  max_batch=8, quantize="int4")
+    art_dir, man, _ = Registry(root).get("m4")
+    man2 = dict(man, lut_platforms=[])            # simulate foreign platform
+    monkeypatch.setattr(Registry, "get",
+                        lambda self, ref: (art_dir, man2, "f" * 16))
+    rep = load_servable(root, "m4", quantize="int4", backend="tpu")
+    assert rep.mode == "tables-fallback"
+    m = rep.model
+    assert m.quantize_tier == "int4"
+    assert m.tables.leaf_dtype == "int4"
+    # The seeded memo IS the dispatch source.
+    assert m.compiled.quantize(leaf_dtype="int4") is m.tables
+    m.warmup()
+    assert m.predict_impl == "lut4"               # backend ladder resolved
+    X = trained["X"]
+    got = m.score_binned(trained["res"].mapper.transform(X[:8]))
+    want = api.predict(trained["res"].ensemble, X[:8],
+                       mapper=trained["res"].mapper,
+                       cfg=trained["cfg"].replace(predict_impl="lut4"))
+    assert np.allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
 def test_restore_rejects_model_blob_swap(trained, pushed, tmp_path):
     """model.npz and the AOT programs must agree: an object whose model
     file was swapped for a DIFFERENT (valid, digest-consistent at the

@@ -383,6 +383,236 @@ def test_old_schema_logs_parse_through_report_merge_trace(tmp_path):
     assert perfetto.write_trace(events, out) > 0
 
 
+# --------------------------------------------------------------------- #
+# express lane (ISSUE 12)
+# --------------------------------------------------------------------- #
+def test_express_lane_dispatches_single_rows_at_empty_queue(trained):
+    """A lone single-row request at an empty queue rides the express
+    lane: correct score, stamped token, express counted in the stats
+    window — and it never paid the admission window (structural: the
+    window is absurdly long, the test would time out if it waited)."""
+    eng = _engine(trained, max_wait_ms=60_000.0)
+    try:
+        X, ref = trained["X"], trained["ref"]["a"]
+        got = eng.predict(X[:1], timeout=30.0)
+        np.testing.assert_allclose(got, ref[:1], rtol=1e-6, atol=1e-7)
+        w = eng.stats.window_summary(reset=False)
+        assert w["express"] == 1 and w["requests"] == 1
+        assert eng.health()["express_lane"] is True
+    finally:
+        eng.close()
+
+
+def test_express_lane_closes_under_load(trained):
+    """With the dispatch gate held (a batch 'mid-flight') and requests
+    queued, a single-row submit must NOT express — it joins the queue
+    and coalesces with the backlog once the gate frees."""
+    eng = _engine(trained, max_wait_ms=5.0)
+    try:
+        X, ref = trained["X"], trained["ref"]["a"]
+        eng._batcher._gate.acquire()          # simulate dispatch in flight
+        try:
+            queued = [eng.predict_async(X[i:i + 1]) for i in range(4)]
+        finally:
+            eng._batcher._gate.release()
+        for i, p in enumerate(queued):
+            np.testing.assert_allclose(p.result(timeout=30.0),
+                                       ref[i:i + 1],
+                                       rtol=1e-6, atol=1e-7)
+        w = eng.stats.window_summary(reset=False)
+        assert w["express"] == 0, w           # the lane stayed shut
+        assert w["coalesce_max"] > 1          # the backlog coalesced
+    finally:
+        eng.close()
+
+
+def test_express_lane_old_or_new_never_a_mix_under_hot_swap(trained):
+    """Express responses under a mid-flight hot swap: every single-row
+    answer matches model A's or model B's offline score exactly — the
+    lane reads the model reference once, so a swap cannot blend."""
+    eng = _engine(trained, max_wait_ms=1.0)
+    try:
+        X = trained["X"]
+        ra, rb = trained["ref"]["a"], trained["ref"]["b"]
+        stop = threading.Event()
+        results, errors = [], []
+
+        def hammer(tid):
+            rng = np.random.default_rng(tid)
+            while not stop.is_set():
+                s = int(rng.integers(0, 100))
+                try:
+                    out = eng.predict(X[s:s + 1], timeout=60.0)
+                    results.append((s, np.asarray(out)))
+                except Exception as e:  # ddtlint: disable=broad-except — collected and asserted empty below
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(3)]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        while len(results) < 15:
+            _time.sleep(0.002)
+        eng.swap(_bundle(trained["res_b"]))
+        while len(results) < 45:
+            _time.sleep(0.002)
+        stop.set()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors[:5]
+        n_b = 0
+        for s, out in results:
+            is_a = np.allclose(out, ra[s:s + 1], rtol=1e-6, atol=1e-7)
+            is_b = np.allclose(out, rb[s:s + 1], rtol=1e-6, atol=1e-7)
+            assert is_a or is_b, f"row {s} matches neither model"
+            n_b += bool(is_b and not is_a)
+        assert n_b > 0
+        assert eng.stats.express > 0          # some traffic took the lane
+    finally:
+        eng.close()
+
+
+def test_express_lane_opt_out_and_shutdown(trained):
+    """express_lane=False keeps every request on the queued path; a
+    closed engine's express path raises ShuttingDown like submit."""
+    eng = _engine(trained, max_wait_ms=2.0, express_lane=False)
+    X = trained["X"]
+    out = eng.predict(X[:1], timeout=30.0)
+    assert out.shape[0] == 1
+    assert eng.stats.window_summary(reset=False)["express"] == 0
+    assert eng.health()["express_lane"] is False
+    eng.close()
+    eng2 = _engine(trained)
+    eng2.close()
+    with pytest.raises(ShuttingDown):
+        eng2.predict_async(np.zeros((1, eng2.n_features), np.uint8))
+
+
+def test_batcher_deadline_pinned_to_oldest_request_fake_clock():
+    """The admission deadline is pinned to the OLDEST queued request
+    when its window opens — later arrivals re-notify the Condition but
+    must not re-arm the window (a re-arming batcher stretches a batch
+    past the head request's budget under a steady trickle; this
+    fake-clock drive would then never dispatch and the result() below
+    would time out)."""
+    fake = {"t": 0.0}
+    batches = []
+
+    def dispatch(batch, depth):
+        batches.append([r.n for r in batch])
+        for r in batch:
+            r.set_result(np.zeros(r.n))
+
+    mb = MicroBatcher(dispatch, max_wait_ms=50.0, max_batch=1000,
+                      clock=lambda: fake["t"])
+    try:
+        a = mb.submit(np.zeros((1, 2)), 1)       # head: deadline t=0.05
+        trickle = [mb.submit(np.zeros((1, 2)), 1) for _ in range(3)]
+        # Advance PAST the head's deadline, then trickle one more
+        # arrival: its notify wakes the dispatcher, which must see the
+        # head's (expired) deadline — NOT a fresh one measured from
+        # this arrival — and dispatch everything queued.
+        fake["t"] = 0.06
+        late = mb.submit(np.zeros((1, 2)), 1)
+        a.result(timeout=10.0)
+        late.result(timeout=10.0)
+        for r in trickle:
+            r.result(timeout=10.0)
+        # Everything dispatched (a re-armer never gets here), and the
+        # head request was not left waiting behind the trickle: its
+        # batch is the FIRST one. (The real-time timeout wake can race
+        # the late submit, legally splitting `late` into a second
+        # batch — the pin under test is the head's deadline, not the
+        # packing.)
+        assert sum(len(b) for b in batches) == 5
+        assert len(batches[0]) >= 4, batches
+    finally:
+        mb.close()
+
+
+# --------------------------------------------------------------------- #
+# zero-copy binned wire path (ISSUE 12)
+# --------------------------------------------------------------------- #
+def test_decode_raw_rows_contract():
+    from ddt_tpu.serve.http import decode_raw_rows
+
+    body = bytes(range(12))
+    rows = decode_raw_rows(body, 4, 12)
+    assert rows.shape == (3, 4) and rows.dtype == np.uint8
+    np.testing.assert_array_equal(rows.reshape(-1),
+                                  np.frombuffer(body, np.uint8))
+    with pytest.raises(ValueError, match="Content-Length"):
+        decode_raw_rows(body, 4, None)
+    with pytest.raises(ValueError, match="declared"):
+        decode_raw_rows(body, 4, 13)          # truncated body
+    with pytest.raises(ValueError, match="whole number"):
+        decode_raw_rows(body, 5, 12)          # width mismatch
+    with pytest.raises(ValueError, match="empty"):
+        decode_raw_rows(b"", 4, 0)
+
+
+def test_binned_raw_wire_parity_with_float_body(trained):
+    """End to end over real HTTP: POST /predict?binned=raw (the body IS
+    the uint8 row block) scores bit-identically to the JSON float-body
+    path on the same engine — the zero-copy path changes transport,
+    never answers."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from ddt_tpu.serve.http import serve_forever
+
+    eng = _engine(trained, max_wait_ms=2.0)
+    ready = threading.Event()
+    th = threading.Thread(target=serve_forever, args=(eng,),
+                          kwargs=dict(port=0, ready_event=ready),
+                          daemon=True)
+    th.start()
+    assert ready.wait(60)
+    port = eng.http_port
+    try:
+        X = trained["X"]
+        Xb = trained["res_a"].mapper.transform(X[:5])
+
+        def post(path, data, ctype):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", data=data,
+                headers={"Content-Type": ctype}, method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return _json.loads(r.read())
+
+        r_raw = post("/predict?binned=raw", Xb.tobytes(),
+                     "application/octet-stream")
+        r_json = post("/predict",
+                      _json.dumps({"rows": X[:5].tolist()}).encode(),
+                      "application/json")
+        assert r_raw["model"] == r_json["model"]
+        np.testing.assert_array_equal(np.asarray(r_raw["scores"]),
+                                      np.asarray(r_json["scores"]))
+        # Width mismatch: 400, loudly.
+        try:
+            post("/predict?binned=raw", Xb.tobytes()[:-1],
+                 "application/octet-stream")
+            raise AssertionError("truncated raw body was accepted")
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            assert e.code == 400
+            assert b"whole number" in body or b"declared" in body
+        # /healthz reports the serving tier (f32 here — no quantize).
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            h = _json.loads(r.read())
+        assert h["predict_impl"] == "f32"
+    finally:
+        post_shutdown = urllib.request.Request(
+            f"http://127.0.0.1:{port}/shutdown", data=b"{}",
+            method="POST")
+        urllib.request.urlopen(post_shutdown, timeout=30).read()
+        th.join(30)
+
+
 def test_v4_serve_log_roundtrips_merge_and_trace(trained, tmp_path):
     """A log WITH serve_latency events survives merge + Perfetto export
     (the event rides as an instant marker)."""

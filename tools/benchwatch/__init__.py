@@ -117,6 +117,20 @@ METRICS: dict[str, str] = {
     "predict_lut_mrows_per_sec": "higher",
     "predict_lut_ab_ratio": "higher",
     "predict_lut_max_abs_err": "lower",
+    # int4 bit-packed tier + express lane (ISSUE 12): same sign
+    # conventions — tier throughput/paired-ratio band higher, the
+    # witnessed error bands lower, and the express lane's single-row
+    # latencies band lower next to the other serve_* milliseconds.
+    # express_gain (coalesced-over-express at an empty queue) bands
+    # higher: losing it means the lane stopped bypassing the admission
+    # window even if absolute latency drift hides it.
+    "predict_lut4_mrows_per_sec": "higher",
+    "predict_lut4_ab_ratio": "higher",
+    "predict_lut4_max_abs_err": "lower",
+    "serve_express_empty_p99_ms": "lower",
+    "serve_express_saturated_p99_ms": "lower",
+    "serve_coalesced_saturated_p99_ms": "lower",
+    "serve_express_gain": "higher",
 }
 
 #: metric -> minimum bench_schema whose artifacts are comparable. When a

@@ -787,14 +787,20 @@ class ServeBlockingIOChecker(Checker):
     dispatcher thread is shared by EVERY in-flight request — one
     `time.sleep` poll or synchronous file read there adds its wall time
     to the whole queue's tail latency, invisibly (the p999 the SLO
-    counters exist to expose). Flagged: `time.sleep` (park on a
+    counters exist to expose). Since the express lane (ISSUE 12) the
+    stakes are doubled: the SAME dispatch path (`ServeEngine._dispatch`
+    and everything it reaches) also runs synchronously on HTTP handler
+    threads for empty-queue single-row requests, so a blocking call
+    there is both the whole queue's tail tax AND the express path's
+    whole latency budget — the lane exists to score in ~dispatch time,
+    and one file read erases it. Flagged: `time.sleep` (park on a
     Condition/Event with a timeout instead — the batcher's admission
     window does exactly that), `open(...)` in any mode, `np.load` /
     `json.load`, and Path `.read_text`/`.read_bytes` (model files load
     in the cli/http layer and arrive as ready ModelBundles —
     docs/SERVING.md "Hot swap"). The transport layer (serve/http.py)
     and everything outside ddt_tpu/serve/ are out of scope: their
-    blocking is the caller's thread, not the dispatcher's."""
+    blocking is the caller's thread, not the dispatch path's."""
 
     rule = "serve-blocking-io"
     path_scope = (r"^ddt_tpu/serve/batcher\.py$",
@@ -808,9 +814,11 @@ class ServeBlockingIOChecker(Checker):
         if d in self._BLOCKING_CALLS:
             self.report(node, (
                 f"`{d}(...)` in a serving hot-loop module blocks the "
-                "shared dispatcher thread and taxes every in-flight "
-                "request's tail latency — park on a Condition/Event "
-                "timeout, or move the I/O to the cli/http layer "
+                "shared dispatch path — it taxes every in-flight "
+                "request's tail latency on the dispatcher thread AND "
+                "is the express lane's whole latency budget on the "
+                "handler thread — park on a Condition/Event timeout, "
+                "or move the I/O to the cli/http layer "
                 "(docs/SERVING.md; ddtlint serve-blocking-io)"))
         elif isinstance(node.func, ast.Attribute) \
                 and node.func.attr in self._READ_ATTRS:
