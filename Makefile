@@ -14,6 +14,14 @@ lint:
 lint-baseline:
 	$(PY) -m tools.ddtlint ddt_tpu/ tests/ --write-baseline
 
+# ddtlint v2 smoke (docs/ANALYSIS.md): seed every ISSUE-13 hazard
+# (lock inversion, cross-role write, blocking-under-gate, leaked
+# acquire, hand-built spec, literal axis, uncovered layout operand,
+# stale annotation) into copies of the REAL serve/backends modules and
+# drive the CLI end-to-end (--format json), asserting each fires.
+lint-smoke:
+	$(PY) scripts/lint_smoke.py
+
 # Mechanized TSan suppression audit (ddt_tpu/native/Makefile tsan-audit):
 # soak with process-wide suppressions dropped, shape-check the survivors.
 tsan-audit:
@@ -89,6 +97,6 @@ benchwatch:
 native:
 	$(MAKE) -C ddt_tpu/native
 
-.PHONY: lint lint-baseline tsan-audit test report trace-smoke \
+.PHONY: lint lint-baseline lint-smoke tsan-audit test report trace-smoke \
 	profile-smoke kernel-smoke chaos-smoke serve-smoke registry-smoke \
 	bigdata-smoke benchwatch native

@@ -59,14 +59,15 @@ from ddt_tpu.utils import retry as retry_lib
 
 log = logging.getLogger("ddt_tpu.backends.tpu")
 
-P = jax.sharding.PartitionSpec
-
-AXIS = "rows"    # the data-parallel mesh axis (SURVEY.md §2 "Mesh axes")
-FAXIS = "features"  # optional TP-analog axis: column-sharded histogramming
-HAXIS = "hosts"  # cross-slice DCN axis (SURVEY.md §5 "Distributed comm
-#   backend"): row shards span (hosts, rows); the histogram allreduce
-#   becomes psum over BOTH axes, which XLA phases as an ICI-local reduce
-#   followed by a DCN allreduce. Must match parallel.mesh.HOSTS_AXIS.
+# Mesh axis names are OWNED by parallel/mesh.py (the ddtlint
+# axis-name-literal contract): the backend aliases the constants, never
+# the strings, so a rename there cannot silently desynchronize here.
+AXIS = mesh_lib.ROWS_AXIS       # data-parallel axis (SURVEY.md §2)
+FAXIS = mesh_lib.FEATURES_AXIS  # optional TP-analog column axis
+HAXIS = mesh_lib.HOSTS_AXIS  # cross-slice DCN axis (SURVEY.md §5
+#   "Distributed comm backend"): row shards span (hosts, rows); the
+#   histogram allreduce becomes psum over BOTH axes, which XLA phases as
+#   an ICI-local reduce followed by a DCN allreduce.
 
 
 def _axis_allreduce(axis):
@@ -252,10 +253,15 @@ class TPUDevice(DeviceBackend):
     # sharding helpers
     # ------------------------------------------------------------------ #
 
-    def _sharding(self, *spec):
-        if not self.distributed:
-            return None
-        return jax.sharding.NamedSharding(self.mesh, P(*spec))
+    def _row_sharding(self, extra_dims: int = 0):
+        """NamedSharding for a row-sharded [R, ...] operand, resolved
+        through the declarative layout (row_vector / row_matrix — the
+        ddtlint handbuilt-partition-spec contract: the backend never
+        hand-builds a PartitionSpec). Trailing dims past the spec are
+        replicated by PartitionSpec semantics."""
+        lay = self.layout
+        return self._named(
+            lay.row_vector() if extra_dims == 0 else lay.row_matrix())
 
     def _named(self, spec):
         """NamedSharding from a SpecLayout-resolved PartitionSpec (None
@@ -294,8 +300,7 @@ class TPUDevice(DeviceBackend):
 
     def _put_rows(self, a: np.ndarray, extra_dims: int = 0) -> jax.Array:
         a = self._pad_rows(np.ascontiguousarray(a))
-        sh = self._sharding(self._row_axes, *([None] * extra_dims))
-        return self._put(a, sh)
+        return self._put(a, self._row_sharding(extra_dims))
 
     # ------------------------------------------------------------------ #
     # data plane
@@ -541,10 +546,10 @@ class TPUDevice(DeviceBackend):
         Rp = y.y.shape[0]
         if self.cfg.loss == "softmax":
             z = np.zeros((Rp, self.cfg.n_classes), np.float32)
-            sh = self._sharding(self._row_axes, None)
+            sh = self._row_sharding(extra_dims=1)
         else:
             z = np.full(Rp, base, np.float32)
-            sh = self._sharding(self._row_axes)
+            sh = self._row_sharding()
         return self._put(z, sh)
 
     def load_pred(self, raw: np.ndarray):
@@ -740,8 +745,7 @@ class TPUDevice(DeviceBackend):
         untouched."""
         if handle is None or not self.distributed:
             return handle
-        return jax.device_put(
-            handle, self._sharding(self._row_axes, *([None] * extra_dims)))
+        return jax.device_put(handle, self._row_sharding(extra_dims))
 
     def reshard_data(self, handle):
         """reshard_rows for the binned data handle: the 2D layout's
@@ -1540,8 +1544,8 @@ class TPUDevice(DeviceBackend):
             )
             core = predict_lut.predict_effective_lut_ops
         with phase_span("predict:upload"):
-            dev_ops = tuple(self._put(a, self._sharding())
-                            for a in host_ops)
+            dev_ops = tuple(self._put(a, self._named(
+                self.layout.replicated())) for a in host_ops)
 
         def lut0(*args):
             *ops, Xc = args
@@ -1610,8 +1614,8 @@ class TPUDevice(DeviceBackend):
                     "VMEM budget; falling back to the f32 path",
                     impl_req)
             with phase_span("predict:upload"):
-                ens_dev = tuple(self._put(a, self._sharding())
-                                for a in ce.arrays())
+                ens_dev = tuple(self._put(a, self._named(
+                    self.layout.replicated())) for a in ce.arrays())
             use_missing = ce.eff_dl is not None
             use_cat = ce.eff_cat is not None
             use_pallas = self._use_pallas

@@ -171,10 +171,15 @@ class MicroBatcher:
                 return None                  # load: coalesce as before
             if not self._gate.acquire(blocking=False):
                 return None                  # a dispatch is in flight
-        req = PendingRequest(rows, n)
-        req.t_submit = self._clock()
-        req.express = True
+        # The try/finally opens IMMEDIATELY on the held path: any raise
+        # between a successful acquire and the release (even from
+        # PendingRequest construction) would otherwise leak the gate and
+        # close the lane — and stall the dispatcher loop — forever (the
+        # ddtlint lock-release rule pins this shape).
         try:
+            req = PendingRequest(rows, n)
+            req.t_submit = self._clock()
+            req.express = True
             try:
                 self._dispatch([req], 0)
             # Same error contract as the dispatcher loop: a scoring

@@ -413,7 +413,12 @@ class ServeEngine:
             new = self._build(bundle)
             old = self._model.token
             old_digest = self._model.artifact_digest
-            self._model = new               # atomic reference publish
+            # Single-assignment publish: readers (_dispatch, health,
+            # the express lane) take ONE unlocked reference read and see
+            # exactly the old or the new model, never a mix — the
+            # declared exemption the threadmodel pass verifies stays a
+            # lone reference store.
+            self._model = new  # ddtlint: atomic-publish
         tele_counters.record_serve_hot_swap()
         if self.run_log is not None:
             # Registry provenance rides on the event: which ARTIFACT

@@ -1,0 +1,142 @@
+"""lint_smoke: end-to-end drive of ddtlint v2's two new passes.
+
+Builds a throwaway mini-repo (real serve/batcher.py + backends/tpu.py +
+parallel/mesh.py copies) with every ISSUE-13 hazard seeded — lock-order
+inversion, unguarded cross-role write, blocking-under-gate, acquire
+without try/finally, hand-built PartitionSpec, literal axis name,
+uncovered layout-rule operand, stale atomic-publish annotation — then
+runs the REAL CLI (`python -m tools.ddtlint --format json`) against it
+and asserts each hazard is detected with the expected rule id at the
+expected location. This is the tier the fixture unit tests cannot
+cover: the walker, project-context resolution (mesh axes + rule table
+from the copied mesh.py), the JSON output contract, and the exit code,
+all through the subprocess boundary `make lint` itself uses.
+
+Usage: python scripts/lint_smoke.py      (also: make lint-smoke)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MARKER = "# SMOKE-HAZARD:"
+
+BATCHER_APPENDIX = f"""
+    def _smoke_path_a(self):
+        with self._cv:
+            with self._gate:  {MARKER} lock-order
+                pass
+
+    def _smoke_path_b(self):
+        with self._gate:
+            with self._cv:  {MARKER} lock-order
+                pass
+
+    def retune(self, ms):
+        self.max_wait_s = ms / 1e3  {MARKER} cross-role-state
+
+    def grab_unsafe(self):
+        self._gate.acquire()  {MARKER} lock-release
+        self._q.clear()
+        self._gate.release()
+"""
+
+BLOCKING_TARGET = ("                with self._gate:\n"
+                   "                    self._dispatch(batch, depth)")
+BLOCKING_MUTANT = (
+    "                with self._gate:\n"
+    f"                    time.sleep(0.001)  {MARKER} blocking-under-lock\n"
+    "                    self._dispatch(batch, depth)")
+
+TPU_APPENDIX = f"""
+
+def _smoke_handbuilt(mesh):
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(None))  {MARKER} handbuilt-partition-spec
+
+
+SMOKE_ROW_AXIS = "rows"  {MARKER} axis-name-literal
+
+
+def _smoke_coverage(lay):
+    return lay.spec("operand_no_rule_matches")  {MARKER} layout-rule-coverage
+"""
+
+STALE_PUBLISH_MODULE = f"""\
+class SmokeStale:
+    def f(self):
+        x = 1  # ddtlint: atomic-publish   {MARKER} suppression-hygiene
+        return x
+"""
+
+
+def _expected(src: str, path: str) -> set:
+    out = set()
+    for i, line in enumerate(src.splitlines(), start=1):
+        if MARKER in line:
+            rule = line.split(MARKER, 1)[1].strip()
+            out.add((rule, path, i))
+    return out
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="ddtlint_smoke_")
+    try:
+        expected: set = set()
+
+        def plant(rel: str, src: str) -> None:
+            dst = os.path.join(tmp, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            with open(dst, "w", encoding="utf-8") as f:
+                f.write(src)
+            expected.update(_expected(src, rel))
+
+        with open(os.path.join(REPO, "ddt_tpu/serve/batcher.py"),
+                  encoding="utf-8") as f:
+            batcher = f.read()
+        assert BLOCKING_TARGET in batcher, \
+            "batcher.py dispatch shape moved; update lint_smoke.py"
+        plant("ddt_tpu/serve/batcher.py",
+              batcher.replace(BLOCKING_TARGET, BLOCKING_MUTANT)
+              + BATCHER_APPENDIX)
+        with open(os.path.join(REPO, "ddt_tpu/backends/tpu.py"),
+                  encoding="utf-8") as f:
+            plant("ddt_tpu/backends/tpu.py", f.read() + TPU_APPENDIX)
+        plant("ddt_tpu/serve/stale_smoke.py", STALE_PUBLISH_MODULE)
+        # Project context: axis names + the SpecLayout rule table come
+        # from the scanned tree's own mesh.py, exactly like the gate.
+        shutil.copytree(os.path.join(REPO, "ddt_tpu/parallel"),
+                        os.path.join(tmp, "ddt_tpu/parallel"))
+
+        env = dict(os.environ, PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.ddtlint", "ddt_tpu/",
+             "--no-baseline", "--format", "json"],
+            cwd=tmp, env=env, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 1, (
+            f"seeded hazards must fail the gate (rc=1), got "
+            f"{proc.returncode}: {proc.stderr}")
+        out = json.loads(proc.stdout)
+        got = {(f["rule"], f["path"], f["line"]) for f in out["findings"]}
+
+        missing = expected - got
+        assert not missing, f"hazards NOT detected: {sorted(missing)}"
+        # Every seeded rule fired where seeded; the JSON contract holds.
+        assert out["summary"]["new"] == len(out["findings"])
+        rules = sorted({r for r, _p, _l in expected})
+        print(f"lint_smoke: {len(expected)} seeded hazards all detected "
+              f"({', '.join(rules)}); json contract + exit code OK")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""Fixture: axis names threaded correctly — imported constants,
+parameters, and strings that merely COINCIDE with axis names in
+non-axis positions (dict keys, bench metadata)."""
+import jax.numpy as jnp
+
+from ddt_tpu.parallel import comms
+from ddt_tpu.parallel import mesh as mesh_lib
+
+AXIS = mesh_lib.ROWS_AXIS              # alias the constant, not the string
+ROW_AXES = (mesh_lib.HOSTS_AXIS, mesh_lib.ROWS_AXIS)
+
+
+def reduce_it(x, axis_name):
+    return comms.psum(x, axis_name)    # threaded parameter: the pattern
+
+
+def kwarg_form(x, axis):
+    return comms.hist_reduce(x, axis_name=axis)
+
+
+def metadata(rows, features):
+    # bench/metrics dicts spell dimension NAMES, not mesh axes.
+    return {"rows": rows, "features": features, "hosts": 1}
+
+
+def unrelated_literal():
+    label = "rows"                     # not axis-named, not axis-passed
+    return label

@@ -1,0 +1,28 @@
+"""Fixture: mesh axis names as literals in axis-bearing positions
+outside parallel/mesh.py."""
+import jax.numpy as jnp
+
+from ddt_tpu.parallel import comms
+
+AXIS = "rows"                          # LINT: axis-name-literal
+ROW_AXES = ("hosts", "rows")           # LINT: axis-name-literal
+
+
+def reduce_it(x):
+    return comms.psum(x, "rows")       # LINT: axis-name-literal
+
+
+def gather_it(x, lax):
+    return lax.all_gather(x, "features", axis=0)  # LINT: axis-name-literal
+
+
+def kwarg_form(x):
+    return comms.hist_reduce(x, axis_name="rows")  # LINT: axis-name-literal
+
+
+def shard_index():
+    return comms.flat_axis_index(("hosts", "rows"))  # LINT: axis-name-literal
+
+
+def spec_form(P):
+    return P("rows", None)             # LINT: axis-name-literal

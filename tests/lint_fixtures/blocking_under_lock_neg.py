@@ -1,0 +1,25 @@
+"""Fixture: blocking I/O OUTSIDE any lock (the file-scope
+serve-blocking-io rule may still have opinions; lock scope does not),
+and Condition.wait parking (the sanctioned time-based wait)."""
+import json
+import threading
+import time
+
+
+class Batcher:
+    def __init__(self):
+        self._gate = threading.Lock()
+        self._cv = threading.Condition()
+
+    def load_then_lock(self, path):
+        with open(path) as f:
+            data = json.load(f)
+        with self._gate:
+            return data
+
+    def park(self):
+        with self._cv:
+            self._cv.wait(0.01)     # parking on the Condition is the idiom
+
+    def unlocked_sleep(self):
+        time.sleep(0)               # file-scope rule's business, not ours

@@ -1,0 +1,28 @@
+"""Fixture: blocking calls while a lock / the dispatch gate is held —
+directly, and through a resolved module-local call."""
+import json
+import threading
+import time
+
+
+class Batcher:
+    def __init__(self):
+        self._gate = threading.Lock()
+        self._cv = threading.Condition()
+
+    def dispatch(self):
+        with self._gate:
+            time.sleep(0.01)            # LINT: blocking-under-lock
+
+    def load_model(self, path):
+        with self._cv:
+            f = open(path)              # LINT: blocking-under-lock
+            return json.load(f)         # LINT: blocking-under-lock
+
+    def indirect(self):
+        with self._gate:
+            self._read()                # LINT: blocking-under-lock
+
+    def _read(self):
+        with open("x") as f:
+            return f.read()

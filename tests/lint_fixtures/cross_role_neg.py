@@ -1,0 +1,46 @@
+"""Fixture: cross-role state handled correctly — common lock on every
+access, an annotated atomic publish, init-only publication, and
+single-role mutation."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.model = object()
+        self.count = 0
+        self.config = {}        # written only here, read everywhere: fine
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                use(self.count)
+            use(self.model)
+            use(self.config)
+
+    def swap(self, new):
+        self.model = new  # ddtlint: atomic-publish
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+
+class SingleRole:
+    """No thread target: every method runs on caller threads only —
+    one role, nothing for the cross-role rule to say."""
+
+    def __init__(self):
+        self.state = 0
+
+    def set(self, v):
+        self.state = v
+
+    def get(self):
+        return self.state
+
+
+def use(x):
+    return x

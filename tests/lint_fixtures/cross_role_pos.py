@@ -1,0 +1,29 @@
+"""Fixture: cross-role unguarded attribute — written on the handler
+role (public method), read on the dispatcher role (thread-target loop),
+no common lock, no atomic-publish annotation."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.model = object()
+        self.limit = 4
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            use(self.model)
+            use(self.limit)
+
+    def swap(self, new):
+        self.model = new        # LINT: cross-role-state
+
+    def resize(self, n):
+        with self._lock:
+            self.limit = n      # LINT: cross-role-state (reader unlocked)
+
+
+def use(x):
+    return x

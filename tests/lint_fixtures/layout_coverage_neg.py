@@ -1,0 +1,14 @@
+"""Fixture: every operand name matches the rule table; non-layout
+receivers with spec() methods are someone else's API."""
+
+
+def build(lay):
+    a = lay.specs("data", "grad", "hess", "node_index")
+    b = lay.spec("pred1d")
+    c = lay.specs("tree", "winners", "scalar", "fmasks")
+    d = lay.specs(*(["replicated"] * 5))     # non-literal star: skipped
+    return a, b, c, d
+
+
+def other_api(catalog):
+    return catalog.spec("anything_goes_here")

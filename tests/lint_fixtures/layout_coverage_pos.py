@@ -1,0 +1,12 @@
+"""Fixture: operand names no SpecLayout rule matches — a trace-time
+ValueError today, a lint finding now."""
+
+
+def build(lay, mesh):
+    in_specs = lay.specs("data", "bogus_operand")      # LINT: layout-rule-coverage
+    out = lay.spec("another_unknown")                  # LINT: layout-rule-coverage
+    return in_specs, out
+
+
+def starred(layout):
+    return layout.specs(*["data", "mystery_name"])     # LINT: layout-rule-coverage

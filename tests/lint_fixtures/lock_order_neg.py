@@ -1,0 +1,37 @@
+"""Fixture: clean lock usage — nesting always in one global order, a
+trylock under another lock (cannot deadlock), and sequential (never
+nested) acquisition."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._gate = threading.Lock()
+
+    def nested_one_order(self):
+        with self._cv:
+            with self._gate:
+                pass
+
+    def also_that_order(self):
+        with self._cv:
+            with self._gate:
+                pass
+
+    def trylock_under_lock(self):
+        # Opposite order, but non-blocking: a trylock returns instead of
+        # waiting, so it cannot complete a deadlock cycle.
+        with self._gate:
+            got = self._cv.acquire(blocking=False)
+            if got:
+                try:
+                    pass
+                finally:
+                    self._cv.release()
+
+    def sequential(self):
+        with self._gate:
+            pass
+        with self._cv:
+            pass

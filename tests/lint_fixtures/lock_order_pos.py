@@ -1,0 +1,19 @@
+"""Fixture: lock-order inversion — two methods take the same two locks
+in opposite orders (the classic AB/BA deadlock)."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._gate = threading.Lock()
+
+    def path_a(self):
+        with self._cv:
+            with self._gate:    # LINT: lock-order
+                pass
+
+    def path_b(self):
+        with self._gate:
+            with self._cv:      # LINT: lock-order
+                pass

@@ -1,0 +1,35 @@
+"""Fixture: disciplined acquisition — `with`, and acquire immediately
+guarded by try/finally (the express-lane shape)."""
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._gate = threading.Lock()
+        self._cv = threading.Condition()
+
+    def with_block(self):
+        with self._gate:
+            do_work()
+
+    def guarded(self, rows):
+        with self._cv:
+            if not self._gate.acquire(blocking=False):
+                return None
+        try:
+            req = make_request(rows)
+            return dispatch(req)
+        finally:
+            self._gate.release()
+
+
+def do_work():
+    pass
+
+
+def make_request(rows):
+    return rows
+
+
+def dispatch(req):
+    return req

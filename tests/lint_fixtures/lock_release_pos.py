@@ -1,0 +1,35 @@
+"""Fixture: acquire() without a dominating try/finally release — and an
+acquire whose guard starts too late (a call between them can raise and
+leak the lock)."""
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._gate = threading.Lock()
+
+    def leaky(self):
+        self._gate.acquire()        # LINT: lock-release
+        do_work()
+        self._gate.release()
+
+    def late_guard(self, rows):
+        if not self._gate.acquire(blocking=False):  # LINT: lock-release
+            return None
+        req = make_request(rows)    # a raise here leaks the gate
+        try:
+            return dispatch(req)
+        finally:
+            self._gate.release()
+
+
+def do_work():
+    pass
+
+
+def make_request(rows):
+    return rows
+
+
+def dispatch(req):
+    return req

@@ -15,12 +15,15 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-from tools.ddtlint import callgraph, checkers, runner, tsan_audit  # noqa: E402
+from tools.ddtlint import callgraph, checkers, runner, shardspec  # noqa: E402
+from tools.ddtlint import threadmodel  # noqa: E402
+from tools.ddtlint import tsan_audit  # noqa: E402
 from tools.ddtlint.findings import assign_fingerprints  # noqa: E402
 
 FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
@@ -37,11 +40,14 @@ def _marker_lines(src: str, rule: str) -> set:
             if f"# LINT: {rule}" in line}
 
 
-def _flagged_lines(fname: str, synthetic_path: str, rule: str) -> set:
-    src = _fixture_src(fname)
-    findings = runner.run_on_source(
+def _lint_src(synthetic_path: str, src: str, rule: str):
+    return runner.run_on_source(
         synthetic_path, src, mesh_axes=runner.mesh_axis_names(REPO),
-        rules={rule})
+        layout_rules=runner.layout_rule_patterns(REPO), rules={rule})
+
+
+def _flagged_lines(fname: str, synthetic_path: str, rule: str) -> set:
+    findings = _lint_src(synthetic_path, _fixture_src(fname), rule)
     assert all(f.rule == rule for f in findings), findings
     return {f.line for f in findings}
 
@@ -74,6 +80,22 @@ CASES = [
      "ddt_tpu/serve/engine.py"),
     ("one-home-collective", "one_home_collective_pos.py",
      "one_home_collective_neg.py", "ddt_tpu/ops/fixture_mod.py"),
+    # ddtlint v2 (ISSUE 13): the serve-tier thread/lock pass...
+    ("lock-order", "lock_order_pos.py", "lock_order_neg.py",
+     "ddt_tpu/serve/batcher.py"),
+    ("cross-role-state", "cross_role_pos.py", "cross_role_neg.py",
+     "ddt_tpu/serve/engine.py"),
+    ("blocking-under-lock", "blocking_under_lock_pos.py",
+     "blocking_under_lock_neg.py", "ddt_tpu/serve/batcher.py"),
+    ("lock-release", "lock_release_pos.py", "lock_release_neg.py",
+     "ddt_tpu/serve/batcher.py"),
+    # ...and the mechanized sharding-spec contract.
+    ("handbuilt-partition-spec", "handbuilt_spec_pos.py",
+     "handbuilt_spec_neg.py", "ddt_tpu/backends/fixture_mod.py"),
+    ("axis-name-literal", "axis_literal_pos.py", "axis_literal_neg.py",
+     "ddt_tpu/ops/fixture_mod.py"),
+    ("layout-rule-coverage", "layout_coverage_pos.py",
+     "layout_coverage_neg.py", "ddt_tpu/backends/fixture_mod.py"),
 ]
 
 
@@ -170,6 +192,377 @@ def test_repo_tsan_supp_passes_hygiene():
         src = f.read()
     findings = checkers.check_suppressions("ddt_tpu/native/tsan.supp", src)
     assert findings == [], [f.render() for f in findings]
+
+
+# --------------------------------------------------------------------- #
+# threadmodel pass: the real serve tier + mutation-style hazard seeding
+# --------------------------------------------------------------------- #
+def _read_repo(rel: str) -> str:
+    with open(os.path.join(REPO, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+def _mut_lines(src: str, marker: str) -> set:
+    return {i for i, line in enumerate(src.splitlines(), start=1)
+            if marker in line}
+
+
+def test_thread_model_real_serve_tier():
+    """The analyzer's model of the ACTUAL serve tier: the injected
+    dispatch callable gives ServeEngine._dispatch both roles, the swap
+    publish is the one declared atomic-publish attr, and the clean tree
+    carries zero thread findings."""
+    import ast as ast_mod
+
+    trees, sources = {}, {}
+    for rel in ("ddt_tpu/serve/batcher.py", "ddt_tpu/serve/engine.py",
+                "ddt_tpu/serve/http.py", "ddt_tpu/robustness/watchdog.py"):
+        sources[rel] = _read_repo(rel)
+        trees[rel] = ast_mod.parse(sources[rel])
+    m = threadmodel.build(trees, sources)
+    assert m.findings == [], [f.render() for f in m.findings]
+    disp = m.methods[("ddt_tpu/serve/engine.py", "ServeEngine",
+                      "_dispatch")]
+    assert disp.roles == {"dispatcher", "handler"}
+    loop = m.methods[("ddt_tpu/serve/batcher.py", "MicroBatcher", "_loop")]
+    assert loop.roles == {"dispatcher"}
+    assert ("ServeEngine", "_model") in m.published
+    assert ("MicroBatcher", "_closed") in m.guarded
+    # watchdog: single-role, no locks — nothing inferred, nothing flagged
+    assert not any(c.locks for c in m.classes.values()
+                   if c.path.endswith("watchdog.py"))
+
+
+#: (rule, mutation applied to a copy of serve/batcher.py, marker)
+_BATCHER_MUTATIONS = [
+    ("lock-order", (
+        "\n"
+        "    def _mut_path_a(self):\n"
+        "        with self._cv:\n"
+        "            with self._gate:  # MUT-HAZARD\n"
+        "                pass\n"
+        "\n"
+        "    def _mut_path_b(self):\n"
+        "        with self._gate:\n"
+        "            with self._cv:  # MUT-HAZARD\n"
+        "                pass\n")),
+    ("cross-role-state", (
+        "\n"
+        "    def retune(self, ms):\n"
+        "        self.max_wait_s = ms / 1e3  # MUT-HAZARD\n")),
+    ("lock-release", (
+        "\n"
+        "    def grab_unsafe(self):\n"
+        "        self._gate.acquire()  # MUT-HAZARD\n"
+        "        self._q.clear()\n"
+        "        self._gate.release()\n")),
+]
+
+
+@pytest.mark.parametrize("rule,appendix", _BATCHER_MUTATIONS,
+                         ids=[m[0] for m in _BATCHER_MUTATIONS])
+def test_mutated_batcher_hazards_detected(rule, appendix):
+    """Mutation-style acceptance (ISSUE 13): inject each thread hazard
+    into a COPY of the real serve/batcher.py and assert the exact rule
+    fires at the exact injected location — proving the pass catches the
+    hazard in production-shaped code, not just minimal fixtures."""
+    src = _read_repo("ddt_tpu/serve/batcher.py") + appendix
+    want = _mut_lines(src, "# MUT-HAZARD")
+    assert want
+    findings = _lint_src("ddt_tpu/serve/batcher.py", src, rule)
+    got = {f.line for f in findings}
+    assert got == want, (rule, sorted(got), sorted(want),
+                         [f.render() for f in findings])
+
+
+def test_mutated_batcher_blocking_under_gate():
+    """Blocking call injected INSIDE the dispatch gate of the real
+    batcher loop — the lock-scope upgrade of serve-blocking-io."""
+    src = _read_repo("ddt_tpu/serve/batcher.py")
+    target = ("                with self._gate:\n"
+              "                    self._dispatch(batch, depth)")
+    assert target in src
+    src = src.replace(target, (
+        "                with self._gate:\n"
+        "                    time.sleep(0.001)  # MUT-HAZARD\n"
+        "                    self._dispatch(batch, depth)"))
+    want = _mut_lines(src, "# MUT-HAZARD")
+    findings = _lint_src("ddt_tpu/serve/batcher.py", src,
+                         "blocking-under-lock")
+    assert {f.line for f in findings} == want, \
+        [f.render() for f in findings]
+
+
+#: (rule, mutation appended to a copy of backends/tpu.py)
+_TPU_MUTATIONS = [
+    ("handbuilt-partition-spec", (
+        "\n\n"
+        "def _mut_handbuilt(mesh):\n"
+        "    return jax.sharding.NamedSharding(\n"
+        "        mesh, jax.sharding.PartitionSpec(None))  # MUT-HAZARD\n")),
+    ("axis-name-literal", (
+        "\n\n"
+        'MUT_ROW_AXIS = "rows"  # MUT-HAZARD\n')),
+    ("layout-rule-coverage", (
+        "\n\n"
+        "def _mut_coverage(lay):\n"
+        '    return lay.spec("operand_no_rule_matches")  # MUT-HAZARD\n')),
+]
+
+
+@pytest.mark.parametrize("rule,appendix", _TPU_MUTATIONS,
+                         ids=[m[0] for m in _TPU_MUTATIONS])
+def test_mutated_backend_hazards_detected(rule, appendix):
+    """Same mutation-style acceptance for the sharding-spec contract:
+    each hazard seeded into a copy of the real backends/tpu.py fires
+    the expected rule at the injected line (and ONLY there — the rest
+    of the backend is clean under the new rules)."""
+    src = _read_repo("ddt_tpu/backends/tpu.py") + appendix
+    want = _mut_lines(src, "# MUT-HAZARD")
+    assert want
+    findings = _lint_src("ddt_tpu/backends/tpu.py", src, rule)
+    got = {f.line for f in findings}
+    assert got == want, (rule, sorted(got), sorted(want),
+                         [f.render() for f in findings])
+
+
+def test_branch_release_does_not_clear_fallthrough_hold():
+    """A release() on ONE branch (early-return fast path) must not mark
+    the lock free for the fall-through (review finding): the
+    over-holding bias means branchy releases can only ADD findings,
+    never hide one."""
+    src = ("import threading\n"
+           "import time\n\n\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lk = threading.Lock()\n\n"
+           "    def f(self, fast):\n"
+           "        self._lk.acquire()\n"
+           "        if fast:\n"
+           "            self._lk.release()\n"
+           "            return None\n"
+           "        time.sleep(1.0)\n"
+           "        self._lk.release()\n"
+           "        return 1\n")
+    fs = _lint_src("ddt_tpu/serve/engine.py", src, "blocking-under-lock")
+    assert [f.line for f in fs] == [14], [f.render() for f in fs]
+    # ...and a straight-line release DOES clear the hold.
+    linear = src.replace(
+        "        if fast:\n"
+        "            self._lk.release()\n"
+        "            return None\n"
+        "        time.sleep(1.0)\n",
+        "        self._lk.release()\n"
+        "        time.sleep(1.0)\n")
+    assert _lint_src("ddt_tpu/serve/engine.py", linear,
+                     "blocking-under-lock") == []
+
+
+def test_stale_atomic_publish_annotation_flagged():
+    """The annotation grammar's staleness half: `# ddtlint:
+    atomic-publish` on a line that stores nothing is a finding (under
+    suppression-hygiene — an annotation IS a suppression), while a
+    real attribute store keeps it legal."""
+    stale = ("class E:\n"
+             "    def f(self):\n"
+             "        x = 1  # ddtlint: atomic-publish\n"
+             "        return x\n")
+    fs = _lint_src("ddt_tpu/serve/engine.py", stale,
+                   "suppression-hygiene")
+    assert [f.line for f in fs] == [3], [f.render() for f in fs]
+    fresh = ("class E:\n"
+             "    def f(self, v):\n"
+             "        self.model = v  # ddtlint: atomic-publish\n")
+    assert _lint_src("ddt_tpu/serve/engine.py", fresh,
+                     "suppression-hygiene") == []
+
+
+def test_serving_doc_thread_model_in_sync():
+    """docs/SERVING.md embeds the analyzer's stable (no line numbers)
+    model dump between ddtlint:thread-model markers; a serve change
+    that moves the model must regenerate the doc block — that diff is
+    the review artifact ISSUE 13 asks for."""
+    import ast as ast_mod
+    import re as re_mod
+
+    trees, sources = {}, {}
+    for rel in ("ddt_tpu/serve/__init__.py", "ddt_tpu/serve/batcher.py",
+                "ddt_tpu/serve/engine.py", "ddt_tpu/serve/http.py",
+                "ddt_tpu/robustness/watchdog.py"):
+        sources[rel] = _read_repo(rel)
+        trees[rel] = ast_mod.parse(sources[rel])
+    model = threadmodel.build(trees, sources)
+    block = threadmodel.explain(model, details=False).strip()
+    doc = _read_repo("docs/SERVING.md")
+    mm = re_mod.search(
+        r"<!-- ddtlint:thread-model:begin -->\s*```\n(.*?)```\s*"
+        r"<!-- ddtlint:thread-model:end -->", doc, re_mod.DOTALL)
+    assert mm, "SERVING.md lost its thread-model markers"
+    assert mm.group(1).strip() == block, (
+        "docs/SERVING.md thread-model block is out of date — "
+        "regenerate with `python -m tools.ddtlint --explain-threads` "
+        "(stable form: drop the [file:line] suffixes)")
+
+
+def test_explain_threads_cli():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.ddtlint", "--explain-threads"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "lock-order edges:" in proc.stdout
+    assert "MicroBatcher._gate" in proc.stdout
+
+
+# --------------------------------------------------------------------- #
+# speed satellites: single-parse sharing, wall-time budget, changed-only
+# --------------------------------------------------------------------- #
+def test_lint_wall_time_budget():
+    """The full-repo run must stay fast enough to live in tier-1 and in
+    pre-push habits. Budget is ~6x the measured wall time at
+    introduction (~2.3 s with shared ASTs) — headroom for CI noise, a
+    tripwire for an accidentally quadratic checker."""
+    t0 = time.perf_counter()
+    findings = runner.lint_paths(GATE_PATHS, root=REPO)
+    dt = time.perf_counter() - t0
+    assert findings is not None
+    assert dt < 15.0, f"full-repo ddtlint took {dt:.1f}s (budget 15s)"
+
+
+def test_shared_ast_parse_once(monkeypatch):
+    """lint_paths parses each file exactly once and shares the tree
+    across checkers, the call graph, and the thread model: total
+    ast.parse calls == number of scanned .py files (the analysis floor
+    is the default scope, so that walk counts too; several distinct
+    files legitimately share identical content — empty __init__.py —
+    hence the total-count form)."""
+    import ast as ast_mod
+
+    calls = [0]
+    real_parse = ast_mod.parse
+
+    def counting_parse(src, *a, **k):
+        calls[0] += 1
+        return real_parse(src, *a, **k)
+
+    monkeypatch.setattr(ast_mod, "parse", counting_parse)
+    runner.lint_paths(["ddt_tpu/serve/"], root=REPO)
+    scanned = set(runner._walk_py(["ddt_tpu/serve/"], REPO)) \
+        | set(runner._walk_py(runner.DEFAULT_SCOPE, REPO))
+    n_py = sum(1 for f in scanned if f.endswith(".py"))
+    assert calls[0] == n_py, (calls[0], n_py)
+
+
+def test_changed_files_vs_merge_base(tmp_path):
+    """--changed-only's git plumbing: committed changes since the
+    branch point + worktree edits + untracked files; None (full-scan
+    fallback) without a merge base."""
+    def git(*args):
+        return subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=tmp_path, capture_output=True, text=True, timeout=30)
+
+    if git("init", "-b", "main").returncode != 0:
+        pytest.skip("git unavailable")
+    (tmp_path / "a.py").write_text("A = 1\n")
+    (tmp_path / "b.py").write_text("B = 1\n")
+    git("add", "-A")
+    assert git("commit", "-m", "seed").returncode == 0
+    git("checkout", "-b", "feature")
+    (tmp_path / "a.py").write_text("A = 2\n")
+    git("add", "a.py")
+    assert git("commit", "-m", "change a").returncode == 0
+    (tmp_path / "b.py").write_text("B = 2\n")        # worktree edit
+    (tmp_path / "c.py").write_text("C = 1\n")        # untracked
+    (tmp_path / "d.py").write_text("D = 1\n")        # staged-only (the
+    git("add", "c.py", "d.py")                       # pre-commit state)
+    changed = runner.changed_files(str(tmp_path))
+    assert changed == {"a.py", "b.py", "c.py", "d.py"}
+
+
+def test_changed_only_keeps_cross_file_analysis(tmp_path):
+    """--changed-only narrows finding EMISSION, never the analysis
+    inputs (review finding): a cross-role hazard in engine-only edits
+    is detectable only because the thread model still sees batcher.py's
+    Thread target + injected-callable binding."""
+    serve = tmp_path / "ddt_tpu" / "serve"
+    serve.mkdir(parents=True)
+    (serve / "batcher.py").write_text(
+        "import threading\n\n\n"
+        "class Batcher:\n"
+        "    def __init__(self, dispatch):\n"
+        "        self._dispatch = dispatch\n"
+        "        self._thread = threading.Thread(target=self._loop)\n"
+        "        self._thread.start()\n\n"
+        "    def _loop(self):\n"
+        "        while True:\n"
+        "            self._dispatch()\n")
+    (serve / "engine.py").write_text(
+        "from ddt_tpu.serve.batcher import Batcher\n\n\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.model = object()\n"
+        "        self._batcher = Batcher(self._dispatch)\n\n"
+        "    def _dispatch(self):\n"
+        "        return self.model\n\n"
+        "    def swap(self, new):\n"
+        "        self.model = new\n")
+    want = [("ddt_tpu/serve/engine.py", "cross-role-state")]
+    findings = runner.lint_paths(
+        ["ddt_tpu/"], root=str(tmp_path),
+        rules={"cross-role-state"},
+        only_files={"ddt_tpu/serve/engine.py"})
+    assert [(f.path, f.rule) for f in findings] == want, \
+        [f.render() for f in findings]
+    # Same contract for an EXPLICIT single-file path argument (review
+    # finding): the analysis floor is the default scope, so
+    # `ddtlint engine.py` sees batcher.py's thread roots too.
+    findings = runner.lint_paths(
+        ["ddt_tpu/serve/engine.py"], root=str(tmp_path),
+        rules={"cross-role-state"})
+    assert [(f.path, f.rule) for f in findings] == want, \
+        [f.render() for f in findings]
+
+
+def test_write_baseline_refuses_changed_only(tmp_path):
+    """--write-baseline under a partial scan would truncate the ratchet
+    to the changed files' findings (review finding) — refused."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.ddtlint", "--changed-only",
+         "--write-baseline", "--baseline", str(tmp_path / "bl.json")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "full scan" in proc.stderr
+    assert not (tmp_path / "bl.json").exists()
+
+
+def test_changed_only_scopes_stale_baseline():
+    """A --changed-only run must not declare untouched files' baseline
+    entries stale (split_vs_baseline's `scanned` contract)."""
+    findings = []                       # nothing scanned found anything
+    baseline = {"f1": {"fingerprint": "f1", "path": "ddt_tpu/api.py"},
+                "f2": {"fingerprint": "f2", "path": "ddt_tpu/cli.py"}}
+    new, known, stale = runner.split_vs_baseline(
+        findings, baseline, scanned={"ddt_tpu/api.py"})
+    assert (new, known) == ([], [])
+    assert [e["path"] for e in stale] == ["ddt_tpu/api.py"]
+
+
+def test_cli_json_format():
+    """--format json: the stable machine-readable contract
+    scripts/lint_smoke.py consumes."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.ddtlint", "ddt_tpu/serve/",
+         "--no-baseline", "--format", "json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    out = json.loads(proc.stdout)
+    assert set(out) == {"findings", "new", "stale_baseline", "summary"}
+    assert out["summary"]["total"] == len(out["findings"])
+    for f in out["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message",
+                          "line_text", "fingerprint"}
+    # the serve tier is clean under every rule -> rc 0, no new findings
+    assert proc.returncode == 0, proc.stdout
+    assert out["new"] == []
 
 
 # --------------------------------------------------------------------- #

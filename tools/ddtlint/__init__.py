@@ -7,10 +7,20 @@ Python branching on traced values, dtype drift between backends, collective
 axis names that don't exist on any mesh.  ddtlint mechanizes those reviews
 as small AST checkers with a checked-in ratchet baseline (docs/ANALYSIS.md).
 
+Since v2 (ISSUE 13) two FLOW-AWARE passes join the per-file visitors:
+threadmodel.py (serve-tier thread roles + lock discipline — lock-order
+cycles, cross-role unguarded state, blocking-under-lock, leaked
+acquires, `--explain-threads`) and shardspec.py (the mechanized
+SpecLayout contract — hand-built PartitionSpecs, literal mesh axis
+names, layout-rule-table coverage).
+
 Usage:
     python -m tools.ddtlint ddt_tpu/ tests/            # gate (exit 1 on new)
     python -m tools.ddtlint --write-baseline ...       # regenerate baseline
     python -m tools.ddtlint --list-rules
+    python -m tools.ddtlint --changed-only             # vs git merge-base
+    python -m tools.ddtlint --format json              # stable CI output
+    python -m tools.ddtlint --explain-threads          # serve thread model
 
 The pytest gate lives in tests/test_lint.py (tier-1, marker-free).
 """

@@ -6,12 +6,34 @@ See docs/ANALYSIS.md for the rule catalogue and the baseline workflow.
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 
-from tools.ddtlint import checkers, runner
+from tools.ddtlint import checkers, runner, threadmodel
 
 ALL_RULES = sorted(
-    [c.rule for c in checkers.AST_CHECKERS] + [checkers.SUPPRESSION_RULE])
+    {r for c in checkers.AST_CHECKERS for r in c.rule_set()}
+    | {checkers.SUPPRESSION_RULE})
+
+
+def _json_payload(findings, new, known, stale) -> dict:
+    """Stable machine-readable output (--format json): findings sorted
+    by position (assign_fingerprints already did), keys fixed — the
+    contract scripts/lint_smoke.py and CI consumers parse."""
+    def enc(f):
+        return {"rule": f.rule, "path": f.path, "line": f.line,
+                "col": f.col, "message": f.message,
+                "line_text": f.line_text.strip(),
+                "fingerprint": f.fingerprint}
+
+    return {
+        "findings": [enc(f) for f in findings],
+        "new": [enc(f) for f in new],
+        "stale_baseline": stale,
+        "summary": {"total": len(findings), "new": len(new),
+                    "baselined": len(known), "stale": len(stale)},
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -29,6 +51,19 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset of rules to run")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files changed vs the git merge-base "
+                         "(falls back to a full scan without git); stale "
+                         "baseline entries are only checked for scanned "
+                         "files")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="output format (json: one stable object on "
+                         "stdout — the scripts/lint_smoke.py contract)")
+    ap.add_argument("--explain-threads", action="store_true",
+                    help="dump the serve tier's inferred threading model "
+                         "(roles, locks, publish points, lock-order "
+                         "edges) instead of linting — reviewers diff "
+                         "this across serve PRs (docs/SERVING.md)")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="summary line only")
     args = ap.parse_args(argv)
@@ -36,6 +71,22 @@ def main(argv: list[str] | None = None) -> int:
     if args.list_rules:
         for r in ALL_RULES:
             print(r)
+        return 0
+
+    root = os.getcwd()
+
+    if args.explain_threads:
+        files = runner._walk_py(args.paths or ["ddt_tpu/"], root)
+        trees, sources = {}, {}
+        for rel in files:
+            if not threadmodel.in_scope(rel):
+                continue
+            with open(os.path.join(root, rel), encoding="utf-8",
+                      errors="replace") as f:
+                sources[rel] = f.read()
+            trees[rel] = runner._parse(sources[rel])
+        model = threadmodel.build(trees, sources)
+        print(threadmodel.explain(model), end="")
         return 0
 
     rules = None
@@ -47,8 +98,23 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             return 2
 
+    if args.changed_only and args.write_baseline:
+        # A partial-scope scan must never REWRITE the ratchet: the
+        # baseline would be truncated to just the changed files'
+        # findings, destroying every unscanned file's curated entry.
+        print("ddtlint: --write-baseline requires a full scan; drop "
+              "--changed-only", file=sys.stderr)
+        return 2
+
+    only_files = None
+    if args.changed_only:
+        only_files = runner.changed_files(root)
+        if only_files is None and args.format == "text":
+            print("ddtlint: --changed-only: no git merge-base available; "
+                  "falling back to a full scan", file=sys.stderr)
+
     findings = runner.lint_paths(args.paths or ["ddt_tpu/", "tests/"],
-                                 rules=rules)
+                                 rules=rules, only_files=only_files)
 
     if args.write_baseline:
         runner.save_baseline(args.baseline, findings)
@@ -57,7 +123,17 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     baseline = {} if args.no_baseline else runner.load_baseline(args.baseline)
-    new, known, stale = runner.split_vs_baseline(findings, baseline)
+    scanned = None
+    if only_files is not None:
+        scanned = {f for f in runner._walk_py(
+            args.paths or ["ddt_tpu/", "tests/"], root) if f in only_files}
+    new, known, stale = runner.split_vs_baseline(findings, baseline,
+                                                 scanned=scanned)
+
+    if args.format == "json":
+        print(json.dumps(_json_payload(findings, new, known, stale),
+                         indent=1, sort_keys=False))
+        return 1 if (new or stale) else 0
 
     if not args.quiet:
         for f in new:

@@ -1,0 +1,73 @@
+"""Checker base + per-file context — shared by checkers.py and the
+flow-aware pass modules (shardspec.py, threadmodel.py), which subclass
+`Checker` without importing the whole rule catalogue (no import cycle).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.ddtlint.findings import Finding
+
+
+class CheckContext:
+    """Per-file inputs plus the project-level facts checkers share."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST,
+                 mesh_axes: set[str] | None = None,
+                 reachable: set[str] | None = None,
+                 layout_rules: "list[str] | None" = None,
+                 thread_model=None):
+        self.path = path                      # repo-relative, fwd slashes
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.mesh_axes = mesh_axes if mesh_axes is not None else set()
+        self.reachable = reachable if reachable is not None else set()
+        #: SpecLayout.rules() regexes (shardspec.layout_rule_patterns);
+        #: None = table unresolved, coverage rule skips.
+        self.layout_rules = layout_rules
+        #: package-wide threadmodel.ThreadModel for the serve tier; None
+        #: = build a single-file model on demand (fixture tests).
+        self.thread_model = thread_model
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class Checker(ast.NodeVisitor):
+    rule = "base"
+    #: multi-rule checkers (the threadmodel pass) list every rule id
+    #: they can emit; None = just `rule`. Used by --rules selection.
+    rules: tuple[str, ...] | None = None
+    #: relpath regexes this rule runs on (None = every scanned .py file)
+    path_scope: tuple[str, ...] | None = None
+
+    def __init__(self, ctx: CheckContext):
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+
+    @classmethod
+    def rule_set(cls) -> set[str]:
+        return set(cls.rules) if cls.rules is not None else {cls.rule}
+
+    @classmethod
+    def applies_to(cls, relpath: str) -> bool:
+        if cls.path_scope is None:
+            return True
+        return any(re.search(p, relpath) for p in cls.path_scope)
+
+    def report(self, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        self.findings.append(Finding(
+            rule=self.rule, path=self.ctx.path, line=line,
+            col=getattr(node, "col_offset", 0) + 1, message=message,
+            line_text=self.ctx.line_text(line),
+        ))
+
+    def run(self) -> list[Finding]:
+        self.visit(self.ctx.tree)
+        return self.findings

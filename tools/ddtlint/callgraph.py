@@ -54,6 +54,193 @@ def dotted(node: ast.AST) -> str | None:
     return None
 
 
+def self_attr(node: ast.AST) -> str | None:
+    """`self.X` -> "X", else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def walk_skip_defs(node: ast.AST):
+    """`node` and its descendants, excluding nested function/lambda
+    bodies — code inside a nested def does not execute where it is
+    defined, so lock state and call events must not leak across the
+    boundary. The root is always yielded and always expanded (callers
+    pass function nodes as roots on purpose)."""
+    yield node
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+# --------------------------------------------------------------------- #
+# intra-procedural lock-state tracking (the threadmodel pass, ISSUE 13)
+# --------------------------------------------------------------------- #
+class LockTracker:
+    """Walk ONE function body tracking which `self.<lock>` locks are held
+    at each program point.
+
+    Tracked acquisition forms: `with self.lock:` blocks (exact extent,
+    multi-item `with` acquires left-to-right), and `self.lock.acquire()`
+    ... `self.lock.release()` call pairs (held from the acquire's
+    statement to the matching release at the same or an outer statement
+    level, else to the end of the function — a sound over-approximation
+    matching the try/finally idiom the lock-release rule enforces).
+    Non-blocking try-acquires (`acquire(blocking=False)`) still mark the
+    lock held on the fallthrough path, but the acquisition event carries
+    `blocking=False` so the lock-order pass can exempt them — a trylock
+    cannot participate in a deadlock cycle.
+
+    Collected (all with the held-set at that point):
+    - `calls`: every Call node (lock-method calls excluded),
+    - `accesses`: every `self.<attr>` Load/Store,
+    - `acquisitions`: (lock, held_before, blocking, node) per acquire,
+    - `acquire_calls`: the explicit `.acquire()` call sites,
+    - `finally_releases`: locks `.release()`d inside a `finally:` block.
+
+    Deliberately approximate where Python makes path-sensitivity
+    expensive (an acquire in an `if` test marks the lock held for the
+    body AND the fallthrough); the bias is over-holding, which for the
+    rules built on top means findings fire, never silently pass.
+    """
+
+    def __init__(self, lock_attrs: set):
+        self.lock_attrs = set(lock_attrs)
+        self.calls: list = []            # (Call node, frozenset held)
+        self.accesses: list = []         # (attr, "load"|"store", node, held)
+        self.acquisitions: list = []     # (lock, held_before, blocking, node)
+        self.acquire_calls: list = []    # (lock, Call node)
+        self.finally_releases: set = set()
+
+    def run(self, fn: ast.AST) -> "LockTracker":
+        self._body(list(fn.body), frozenset())
+        return self
+
+    # -- helpers -------------------------------------------------------- #
+    def lock_call(self, call: ast.Call):
+        """self.X.acquire/release -> ("X", "acquire"/"release"), else
+        None (X must be a known lock attribute)."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in ("acquire", "release"):
+            attr = self_attr(f.value)
+            if attr in self.lock_attrs:
+                return attr, f.attr
+        return None
+
+    @staticmethod
+    def _nonblocking(call: ast.Call) -> bool:
+        for k in call.keywords:
+            if k.arg == "blocking" and isinstance(k.value, ast.Constant) \
+                    and k.value.value is False:
+                return True
+        return (call.args and isinstance(call.args[0], ast.Constant)
+                and call.args[0].value is False)
+
+    def _scan(self, node: ast.AST, held: frozenset) -> None:
+        """Record calls + self-attr accesses inside `node` (nested defs
+        excluded), with `held` active."""
+        for n in walk_skip_defs(node):
+            if isinstance(n, ast.Call):
+                lk = self.lock_call(n)
+                if lk is not None:
+                    lock, what = lk
+                    if what == "acquire":
+                        self.acquisitions.append(
+                            (lock, held, not self._nonblocking(n), n))
+                        self.acquire_calls.append((lock, n))
+                    continue
+                self.calls.append((n, held))
+            elif isinstance(n, ast.Attribute) \
+                    and isinstance(n.value, ast.Name) and n.value.id == "self":
+                if isinstance(n.ctx, ast.Store):
+                    self.accesses.append((n.attr, "store", n, held))
+                elif isinstance(n.ctx, ast.Load):
+                    self.accesses.append((n.attr, "load", n, held))
+
+    def _effects(self, stmt: ast.AST) -> tuple:
+        """Locks (acquired, released) anywhere inside `stmt` — the net
+        state change this statement propagates to its successors."""
+        acq, rel = set(), set()
+        for n in walk_skip_defs(stmt):
+            if isinstance(n, ast.Call):
+                lk = self.lock_call(n)
+                if lk is not None:
+                    (acq if lk[1] == "acquire" else rel).add(lk[0])
+        return acq, rel
+
+    # -- the walker ----------------------------------------------------- #
+    def _body(self, body: list, held: frozenset) -> None:
+        cur = set(held)
+        for stmt in body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = set(cur)
+                for item in stmt.items:
+                    attr = self_attr(item.context_expr)
+                    if attr in self.lock_attrs:
+                        self.acquisitions.append(
+                            (attr, frozenset(inner), True,
+                             item.context_expr))
+                        inner.add(attr)
+                    else:
+                        self._scan(item.context_expr, frozenset(inner))
+                self._body(stmt.body, frozenset(inner))
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._scan(stmt.test, frozenset(cur))
+                acq, rel = self._effects(stmt.test)
+                branch = (cur | acq) - rel
+                self._body(stmt.body, frozenset(branch))
+                self._body(stmt.orelse, frozenset(branch))
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan(stmt.iter, frozenset(cur))
+                self._body(stmt.body, frozenset(cur))
+                self._body(stmt.orelse, frozenset(cur))
+            elif isinstance(stmt, ast.Try):
+                self._body(stmt.body, frozenset(cur))
+                for h in stmt.handlers:
+                    self._body(h.body, frozenset(cur))
+                self._body(stmt.orelse, frozenset(cur))
+                self._body(stmt.finalbody, frozenset(cur))
+                for n in stmt.finalbody:
+                    for c in walk_skip_defs(n):
+                        if isinstance(c, ast.Call):
+                            lk = self.lock_call(c)
+                            if lk is not None and lk[1] == "release":
+                                self.finally_releases.add(lk[0])
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                pass                       # separate execution context
+            else:
+                self._scan(stmt, frozenset(cur))
+            # Fall-through state. A release inside a COMPOUND statement
+            # executes only on some paths (an early-return branch, an
+            # except arm), so it must NOT clear the lock for the code
+            # after the statement — only straight-line releases (simple
+            # statements) and try/FINALLY releases (run on every path)
+            # subtract. Acquires always propagate. This is the
+            # documented over-holding bias: branchy releases can only
+            # ADD findings, never hide one.
+            acq, rel = self._effects(stmt)
+            if isinstance(stmt, ast.Try):
+                fin_rel = set()
+                for fs in stmt.finalbody:
+                    for c in walk_skip_defs(fs):
+                        if isinstance(c, ast.Call):
+                            lk = self.lock_call(c)
+                            if lk is not None and lk[1] == "release":
+                                fin_rel.add(lk[0])
+                cur = (cur | acq) - fin_rel
+            elif isinstance(stmt, (ast.If, ast.While, ast.For,
+                                   ast.AsyncFor, ast.With, ast.AsyncWith)):
+                cur = cur | acq
+            else:
+                cur = (cur | acq) - rel
+
+
 def _resolves_to_jit(expr: ast.AST) -> bool:
     """Does a decorator/callee expression denote jit/pjit?  Covers ``jit``,
     ``jax.jit``, ``@partial(jax.jit, ...)`` and ``@jax.jit(...)`` forms."""
@@ -219,11 +406,14 @@ def _resolve_scoped(mod: ModuleInfo, scope: str, name: str) -> str | None:
     return None
 
 
-def build(sources: dict[str, str]) -> dict[str, set[str]]:
+def build(sources: dict[str, str],
+          trees: "dict[str, ast.AST | None] | None" = None
+          ) -> dict[str, set[str]]:
     """{relpath: source} -> {relpath: set of jit-reachable func qualnames}.
 
-    Files that fail to parse contribute nothing (the runner reports syntax
-    errors separately)."""
+    `trees` reuses ASTs the caller already parsed (the runner's
+    single-parse cache). Files that fail to parse contribute nothing
+    (the runner reports syntax errors separately)."""
     mods: dict[str, ModuleInfo] = {}          # modname -> info
     by_path: dict[str, ModuleInfo] = {}
     for path, src in sources.items():
@@ -231,10 +421,12 @@ def build(sources: dict[str, str]) -> dict[str, set[str]]:
         if modname.endswith(".__init__"):
             modname = modname[: -len(".__init__")]
         mi = ModuleInfo(path=path, modname=modname)
-        try:
-            tree = ast.parse(src)
-        except SyntaxError:
-            continue
+        tree = trees.get(path) if trees is not None else None
+        if tree is None:
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue
         _Collector(mi).visit(tree)
 
         def alias_targets(scope: str, name: str) -> list[str]:

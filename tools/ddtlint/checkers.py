@@ -12,7 +12,10 @@ from __future__ import annotations
 import ast
 import re
 
-from tools.ddtlint import callgraph
+from tools.ddtlint import callgraph, shardspec, threadmodel
+from tools.ddtlint.base import Checker, CheckContext  # noqa: F401 — the
+# base moved to tools/ddtlint/base.py so the flow-aware pass modules can
+# subclass it without an import cycle; re-exported here for callers.
 from tools.ddtlint.findings import Finding
 
 # Attribute-chain roots that produce traced arrays when called.
@@ -35,53 +38,6 @@ def _is_traced_call(node: ast.AST) -> bool:
         return False
     return d.split(".")[-1] not in _HOST_FUNCS \
         and not callgraph._resolves_to_jit(node.func)
-
-
-class CheckContext:
-    """Per-file inputs plus the project-level facts checkers share."""
-
-    def __init__(self, path: str, source: str, tree: ast.AST,
-                 mesh_axes: set[str] | None = None,
-                 reachable: set[str] | None = None):
-        self.path = path                      # repo-relative, fwd slashes
-        self.source = source
-        self.lines = source.splitlines()
-        self.tree = tree
-        self.mesh_axes = mesh_axes if mesh_axes is not None else set()
-        self.reachable = reachable if reachable is not None else set()
-
-    def line_text(self, lineno: int) -> str:
-        if 1 <= lineno <= len(self.lines):
-            return self.lines[lineno - 1].strip()
-        return ""
-
-
-class Checker(ast.NodeVisitor):
-    rule = "base"
-    #: relpath regexes this rule runs on (None = every scanned .py file)
-    path_scope: tuple[str, ...] | None = None
-
-    def __init__(self, ctx: CheckContext):
-        self.ctx = ctx
-        self.findings: list[Finding] = []
-
-    @classmethod
-    def applies_to(cls, relpath: str) -> bool:
-        if cls.path_scope is None:
-            return True
-        return any(re.search(p, relpath) for p in cls.path_scope)
-
-    def report(self, node: ast.AST, message: str) -> None:
-        line = getattr(node, "lineno", 1)
-        self.findings.append(Finding(
-            rule=self.rule, path=self.ctx.path, line=line,
-            col=getattr(node, "col_offset", 0) + 1, message=message,
-            line_text=self.ctx.line_text(line),
-        ))
-
-    def run(self) -> list[Finding]:
-        self.visit(self.ctx.tree)
-        return self.findings
 
 
 # --------------------------------------------------------------------- #
@@ -884,6 +840,10 @@ AST_CHECKERS = [
     RawPhaseTimingChecker,
     ServeBlockingIOChecker,
     OneHomeCollectiveChecker,
+    # ddtlint v2 flow-aware passes (ISSUE 13): the sharding-spec
+    # contract and the serve-tier thread/lock-discipline analysis.
+    *shardspec.CHECKERS,
+    threadmodel.ThreadModelChecker,
 ]
 
 

@@ -6,11 +6,16 @@ import ast
 import json
 import os
 import re
+import subprocess
 
-from tools.ddtlint import callgraph, checkers
+from tools.ddtlint import callgraph, checkers, shardspec, threadmodel
+from tools.ddtlint.base import CheckContext
 from tools.ddtlint.findings import Finding, assign_fingerprints
 
 DEFAULT_BASELINE = "tools/ddtlint/baseline.json"
+#: the gate's default scan scope — also the floor for cross-file
+#: ANALYSIS inputs on narrowed runs (see lint_paths).
+DEFAULT_SCOPE = ["ddt_tpu/", "tests/"]
 MESH_FILE = "ddt_tpu/parallel/mesh.py"
 #: directories holding deliberate violations (checker fixtures) — skipped
 #: by the walker; tests exercise them through run_on_source directly.
@@ -19,21 +24,35 @@ SKIP_DIRS = {"lint_fixtures", "__pycache__", ".git"}
 _PRAGMA_RE = re.compile(r"ddtlint:\s*disable=([\w,-]+)")
 
 
+def _parse(source: str) -> "ast.AST | None":
+    try:
+        return ast.parse(source)
+    except SyntaxError:
+        return None
+
+
 # --------------------------------------------------------------------- #
 # project context
 # --------------------------------------------------------------------- #
-def mesh_axis_names(root: str) -> set[str]:
+def _mesh_tree(root: str, tree: "ast.AST | None" = None) -> "ast.AST | None":
+    """Parsed parallel/mesh.py — reuses a tree the caller already parsed
+    (the lint run's shared-AST cache) or reads from disk."""
+    if tree is not None:
+        return tree
+    path = os.path.join(root, MESH_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return _parse(f.read())
+
+
+def mesh_axis_names(root: str, tree: "ast.AST | None" = None) -> set[str]:
     """Axis names any mesh in parallel/mesh.py can define: module-level
     `*_AXIS = "..."` constants plus string literals in the axis-name
     tuples handed to make_mesh."""
-    path = os.path.join(root, MESH_FILE)
-    if not os.path.exists(path):
+    tree = _mesh_tree(root, tree)
+    if tree is None:
         return set()
-    with open(path, encoding="utf-8") as f:
-        try:
-            tree = ast.parse(f.read())
-        except SyntaxError:
-            return set()
     axes: set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
@@ -57,6 +76,14 @@ def mesh_axis_names(root: str) -> set[str]:
     return axes
 
 
+def layout_rule_patterns(root: str,
+                         tree: "ast.AST | None" = None
+                         ) -> "list[str] | None":
+    """SpecLayout.rules() regexes out of parallel/mesh.py — the
+    layout-rule-coverage oracle (shardspec.layout_rule_patterns)."""
+    return shardspec.layout_rule_patterns(_mesh_tree(root, tree))
+
+
 def _walk_py(paths: list[str], root: str) -> list[str]:
     """Expand files/dirs into sorted repo-relative .py (and .supp) paths."""
     out: set[str] = set()
@@ -72,6 +99,43 @@ def _walk_py(paths: list[str], root: str) -> list[str]:
                     rel = os.path.relpath(os.path.join(dirpath, fn), root)
                     out.add(rel.replace(os.sep, "/"))
     return sorted(out)
+
+
+def changed_files(root: str) -> "set[str] | None":
+    """Repo-relative paths changed vs `git merge-base HEAD <default>` —
+    the --changed-only scope: committed changes since the branch point,
+    plus working-tree modifications and untracked files. None when git
+    (or a merge base) is unavailable, in which case the caller falls
+    back to the full scan — degrading to MORE coverage, never less."""
+    def _git(*args) -> "str | None":
+        try:
+            p = subprocess.run(["git", *args], cwd=root,
+                               capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return p.stdout if p.returncode == 0 else None
+
+    base = None
+    for ref in ("origin/main", "origin/master", "main", "master"):
+        out = _git("merge-base", "HEAD", ref)
+        if out:
+            base = out.strip()
+            break
+    if base is None:
+        return None
+    out: set[str] = set()
+    # ONE diff of base vs the WORKTREE (no HEAD operand): covers
+    # committed-since-base, STAGED, and unstaged edits in one pass — a
+    # base..HEAD + worktree pair misses staged-but-uncommitted files
+    # (worktree == index there), exactly the state a pre-commit lint
+    # runs in.
+    for args in (("diff", "--name-only", base),
+                 ("ls-files", "--others", "--exclude-standard")):
+        text = _git(*args)
+        if text is None:
+            return None
+        out.update(ln.strip() for ln in text.splitlines() if ln.strip())
+    return out
 
 
 # --------------------------------------------------------------------- #
@@ -96,53 +160,99 @@ def _apply_pragmas(findings: list[Finding],
 
 def run_on_source(path: str, source: str, mesh_axes: set[str] | None = None,
                   reachable: set[str] | None = None,
-                  rules: set[str] | None = None) -> list[Finding]:
+                  rules: set[str] | None = None,
+                  tree: "ast.AST | None" = None,
+                  layout_rules: "list[str] | None" = None,
+                  thread_model=None) -> list[Finding]:
     """Lint one in-memory python source. For .supp content use
-    checkers.check_suppressions directly."""
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        return [Finding(rule="syntax-error", path=path,
-                        line=e.lineno or 1, col=(e.offset or 0) + 1,
-                        message=f"does not parse: {e.msg}")]
+    checkers.check_suppressions directly. `tree` reuses an AST the
+    caller already parsed (lint_paths parses each file exactly once and
+    shares it across every checker AND the call-graph/thread-model
+    builders — the single-parse contract tests/test_lint.py times)."""
+    if tree is None:
+        tree = _parse(source)
+    if tree is None:
+        try:
+            ast.parse(source)
+        except SyntaxError as e:
+            return [Finding(rule="syntax-error", path=path,
+                            line=e.lineno or 1, col=(e.offset or 0) + 1,
+                            message=f"does not parse: {e.msg}")]
     if reachable is None:
-        reachable = callgraph.build({path: source}).get(path, set())
+        reachable = callgraph.build({path: source},
+                                    trees={path: tree}).get(path, set())
     out: list[Finding] = []
     for cls in checkers.AST_CHECKERS:
-        if rules is not None and cls.rule not in rules:
+        if rules is not None and not (cls.rule_set() & rules):
             continue
         if not cls.applies_to(path):
             continue
-        ctx = checkers.CheckContext(path, source, tree, mesh_axes, reachable)
+        ctx = CheckContext(path, source, tree, mesh_axes, reachable,
+                           layout_rules=layout_rules,
+                           thread_model=thread_model)
         out.extend(cls(ctx).run())
+    if rules is not None:
+        # Multi-rule checkers emit their whole catalogue; keep only the
+        # selection (--rules contract).
+        out = [f for f in out if f.rule in rules]
     return _apply_pragmas(out, {path: source})
 
 
 def lint_paths(paths: list[str], root: str | None = None,
-               rules: set[str] | None = None) -> list[Finding]:
+               rules: set[str] | None = None,
+               only_files: "set[str] | None" = None) -> list[Finding]:
     """Lint files/directories; returns fingerprinted findings sorted by
-    position.  `root` defaults to the repo root (cwd)."""
+    position.  `root` defaults to the repo root (cwd).  `only_files`
+    (repo-relative) restricts which files REPORT findings — the
+    --changed-only scope. The cross-file analysis inputs (the jit
+    call graph, the serve thread model) are always built from the FULL
+    walk: a thread model missing batcher.py would silently strip
+    ServeEngine._dispatch of its dispatcher role and wave through a
+    cross-role hazard an engine-only edit introduced — restricting
+    emission, never analysis, is what keeps --changed-only "more
+    coverage, never less"."""
     root = os.path.abspath(root or os.getcwd())
-    files = _walk_py(paths, root)
+    requested = _walk_py(paths, root)
+    emit_files = requested if only_files is None \
+        else [f for f in requested if f in only_files]
+    # Analysis inputs always cover the DEFAULT scope (plus anything the
+    # caller explicitly named outside it): `ddtlint engine.py` must
+    # still see batcher.py's thread roots and the backends' jit roots,
+    # or a narrowed run reports false-clean — the same failure mode
+    # only_files guards against.
+    files = sorted(set(requested) | set(_walk_py(DEFAULT_SCOPE, root)))
     sources: dict[str, str] = {}
     for rel in files:
         with open(os.path.join(root, rel), encoding="utf-8",
                   errors="replace") as f:
             sources[rel] = f.read()
 
+    # Parse ONCE per file; every consumer below shares the tree.
     py_sources = {p: s for p, s in sources.items() if p.endswith(".py")}
-    reach = callgraph.build(py_sources)
-    axes = mesh_axis_names(root)
+    trees = {p: _parse(s) for p, s in py_sources.items()}
+    reach = callgraph.build(py_sources, trees=trees)
+    mesh_t = _mesh_tree(root, trees.get(MESH_FILE))
+    axes = mesh_axis_names(root, mesh_t)
+    layout_rules = shardspec.layout_rule_patterns(mesh_t)
+    # ONE serve-tier thread model over every scanned in-scope file, so
+    # cross-file edges (the injected dispatch callable) resolve.
+    tm_files = {p for p in py_sources
+                if threadmodel.in_scope(p) and trees.get(p) is not None}
+    tmodel = threadmodel.build(
+        {p: trees[p] for p in tm_files},
+        {p: py_sources[p] for p in tm_files}) if tm_files else None
 
     findings: list[Finding] = []
-    for rel, src in sources.items():
+    for rel in emit_files:
+        src = sources[rel]
         if rel.endswith(".supp"):
             if rules is None or checkers.SUPPRESSION_RULE in rules:
                 findings.extend(checkers.check_suppressions(rel, src))
         else:
             findings.extend(run_on_source(
                 rel, src, mesh_axes=axes, reachable=reach.get(rel, set()),
-                rules=rules))
+                rules=rules, tree=trees.get(rel),
+                layout_rules=layout_rules, thread_model=tmodel))
     return assign_fingerprints(findings)
 
 
@@ -178,11 +288,17 @@ def save_baseline(path: str, findings: list[Finding]) -> None:
         f.write("\n")
 
 
-def split_vs_baseline(findings: list[Finding], baseline: dict[str, dict]
+def split_vs_baseline(findings: list[Finding], baseline: dict[str, dict],
+                      scanned: "set[str] | None" = None
                       ) -> tuple[list[Finding], list[Finding], list[dict]]:
-    """(new, known, stale_baseline_entries)."""
+    """(new, known, stale_baseline_entries).  `scanned` restricts the
+    stale check to baseline entries whose file was actually linted — a
+    --changed-only run must not declare every untouched file's entry
+    stale."""
     fps = {f.fingerprint for f in findings}
     new = [f for f in findings if f.fingerprint not in baseline]
     known = [f for f in findings if f.fingerprint in baseline]
-    stale = [e for fp, e in baseline.items() if fp not in fps]
+    stale = [e for fp, e in baseline.items()
+             if fp not in fps
+             and (scanned is None or e.get("path") in scanned)]
     return new, known, stale

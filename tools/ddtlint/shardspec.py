@@ -1,0 +1,243 @@
+"""Mechanized sharding-spec contract (ddtlint v2, ISSUE 13).
+
+PR 11 made partition correctness DECLARATIVE: `parallel/mesh.SpecLayout`
+is the one rule table mapping operand names to PartitionSpecs, and every
+`shard_map` in the backend resolves its in/out specs through it by name.
+But that was a convention — nothing stopped a hand-built `P("rows")`, a
+raw axis-name literal, or an operand name the rule table doesn't know
+from compiling fine and silently de-sharding (or replicating) an
+operand. Until now the only enforcement was dynamic: the collective-
+inventory contract in tests/test_distributed.py and the trace-time raise
+inside `match_partition_rules`. This pass moves the contract to lint
+time:
+
+* `handbuilt-partition-spec` — direct `PartitionSpec(...)`/`P(...)`
+  construction in `ddt_tpu/backends/`: specs there must resolve through
+  `backend.layout` (SpecLayout) by operand name, so the mesh's axis
+  story lives in ONE rule table and a new axis is a table edit, not a
+  hunt through shard_map call sites.
+* `axis-name-literal` — a mesh axis name ("rows"/"hosts"/"features" —
+  whatever parallel/mesh.py defines) spelled as a string literal
+  anywhere outside parallel/mesh.py, in an axis-bearing position: an
+  `axis_name=` keyword, a positional argument to a collective /
+  topology helper, an axis-named assignment target, or a
+  PartitionSpec argument. Axis names must be THREADED from the mesh
+  module as parameters — a literal compiles on every mesh that happens
+  to define it and silently de-shards on one that doesn't. This is
+  also the collective-parameterization contract for parallel/comms.py
+  itself: its wrappers take `axis_name` arguments, never literals.
+* `layout-rule-coverage` — operand names passed to
+  `layout.spec("name")` / `layout.specs(...)` are checked against the
+  regex rule table statically read out of `SpecLayout.rules()`: an
+  unmatched name is a lint finding at the call site, not a trace-time
+  `ValueError` on the first distributed run.
+
+Scope notes: tests/ spell axes and specs freely (they construct
+adversarial meshes on purpose) and parallel/mesh.py IS the home of the
+names — both stay out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.ddtlint import callgraph
+from tools.ddtlint.base import Checker
+
+RULE_HANDBUILT = "handbuilt-partition-spec"
+RULE_AXIS_LITERAL = "axis-name-literal"
+RULE_COVERAGE = "layout-rule-coverage"
+
+RULES = (RULE_HANDBUILT, RULE_AXIS_LITERAL, RULE_COVERAGE)
+
+#: functions whose positional string args are mesh axis names — the
+#: comms wrappers, the raw lax collectives (one-home-collective already
+#: bans those outside comms.py; the literal ban applies in BOTH homes),
+#: and the topology readers.
+_AXIS_FUNCS = {
+    "psum", "psum_scatter", "pmin", "pmax", "pmean", "all_gather",
+    "all_to_all", "ppermute", "pshuffle", "reduce_scatter",
+    "hist_reduce", "combine_shard_winners", "axis_index", "axis_size",
+    "static_axis_size", "flat_axis_index",
+}
+_AXIS_KWARGS = {"axis_name", "axis_names", "feature_axis_name"}
+
+
+def layout_rule_patterns(tree: ast.AST | None) -> "list[str] | None":
+    """Statically read the [(regex, spec)] rule table out of
+    SpecLayout.rules() in a parsed parallel/mesh.py — the
+    layout-rule-coverage oracle. None when the table cannot be found
+    (the rule then skips rather than guessing)."""
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "SpecLayout":
+            for fn in ast.iter_child_nodes(node):
+                if isinstance(fn, ast.FunctionDef) and fn.name == "rules":
+                    pats = []
+                    for n in ast.walk(fn):
+                        if isinstance(n, ast.Tuple) and n.elts \
+                                and isinstance(n.elts[0], ast.Constant) \
+                                and isinstance(n.elts[0].value, str):
+                            pats.append(n.elts[0].value)
+                    return pats or None
+    return None
+
+
+class HandbuiltPartitionSpecChecker(Checker):
+    """Direct PartitionSpec construction in the backend layer — the
+    declarative layout's one bypass. `backend.layout` (SpecLayout,
+    parallel/mesh.py) must be the only producer of specs there: a
+    hand-built `P(...)` compiles fine and silently de-shards (or
+    replicates — a 10x memory bug) the operand the rule table would
+    have placed correctly, and nothing dynamic catches it until a pod
+    run reads the wrong bytes."""
+
+    rule = RULE_HANDBUILT
+    path_scope = (r"^ddt_tpu/backends/",)
+
+    def run(self):
+        # Every name the module binds to PartitionSpec: import aliases
+        # (`from jax.sharding import PartitionSpec as P`) and assigned
+        # aliases of ANY name (`Spec = jax.sharding.PartitionSpec`,
+        # chained `Q = Spec`), to a fixpoint — the rule exists to catch
+        # bypasses, so a renamed alias must not be one.
+        aliases = {"PartitionSpec"}
+        for _ in range(8):
+            n0 = len(aliases)
+            for n in ast.walk(self.ctx.tree):
+                if isinstance(n, ast.ImportFrom):
+                    for a in n.names:
+                        if a.name == "PartitionSpec" and a.asname:
+                            aliases.add(a.asname)
+                elif isinstance(n, ast.Assign) \
+                        and isinstance(n.value, (ast.Attribute, ast.Name)):
+                    d = callgraph.dotted(n.value)
+                    if d is not None and d.split(".")[-1] in aliases:
+                        aliases.update(t.id for t in n.targets
+                                       if isinstance(t, ast.Name))
+            if len(aliases) == n0:
+                break
+        for n in ast.walk(self.ctx.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            d = callgraph.dotted(n.func)
+            if d is not None and d.split(".")[-1] in aliases:
+                self.report(n, (
+                    f"hand-built `{d}(...)` in the backend layer — "
+                    "resolve the spec through backend.layout "
+                    "(SpecLayout, parallel/mesh.py) by operand name so "
+                    "the mesh's axis story stays in the one rule table "
+                    "(docs/ANALYSIS.md handbuilt-partition-spec)"))
+        return self.findings
+
+
+class AxisNameLiteralChecker(Checker):
+    """Mesh axis names as string literals outside parallel/mesh.py, in
+    axis-bearing positions (see module doc). The safe pattern is the
+    one the codebase already uses everywhere else: import the
+    `*_AXIS` constant or thread the name as a parameter."""
+
+    rule = RULE_AXIS_LITERAL
+    path_scope = (r"^ddt_tpu/(?!parallel/mesh\.py$)",)
+
+    def _literal_axes(self, node: ast.AST | None):
+        if node is None:
+            return
+        if isinstance(node, ast.Constant) and node.value in self.ctx.mesh_axes:
+            yield node
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                if isinstance(e, ast.Constant) \
+                        and e.value in self.ctx.mesh_axes:
+                    yield e
+
+    def _flag(self, node: ast.AST, where: str) -> None:
+        self.report(node, (
+            f"mesh axis name {node.value!r} as a literal {where} outside "
+            "parallel/mesh.py — import the *_AXIS constant or thread the "
+            "axis name as a parameter; a literal compiles on any mesh "
+            "that happens to define it and silently de-shards on one "
+            "that doesn't (docs/ANALYSIS.md axis-name-literal)"))
+
+    def visit_Assign(self, node: ast.Assign):
+        targets = node.targets
+        if any(self._axis_named(t) for t in targets):
+            for lit in self._literal_axes(node.value):
+                self._flag(lit, "bound to an axis-named variable")
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if self._axis_named(node.target) and node.value is not None:
+            for lit in self._literal_axes(node.value):
+                self._flag(lit, "bound to an axis-named variable")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _axis_named(t: ast.AST) -> bool:
+        name = t.id if isinstance(t, ast.Name) else (
+            t.attr if isinstance(t, ast.Attribute) else "")
+        return re.search(r"axis|axes", name.lower()) is not None
+
+    def visit_Call(self, node: ast.Call):
+        d = callgraph.dotted(node.func)
+        last = d.split(".")[-1] if d else None
+        for k in node.keywords:
+            if k.arg in _AXIS_KWARGS:
+                for lit in self._literal_axes(k.value):
+                    self._flag(lit, f"as `{k.arg}=`")
+        if last in _AXIS_FUNCS:
+            for a in node.args:
+                for lit in self._literal_axes(a):
+                    self._flag(lit, f"passed to `{last}`")
+        if last in ("P", "PartitionSpec"):
+            for a in node.args:
+                for lit in self._literal_axes(a):
+                    self._flag(lit, "inside a PartitionSpec")
+        self.generic_visit(node)
+
+
+class LayoutRuleCoverageChecker(Checker):
+    """Operand names handed to `layout.spec(...)`/`layout.specs(...)`
+    must match a rule in SpecLayout.rules() — checked here against the
+    statically-read rule table, so an unknown name is a lint finding at
+    the call site instead of `match_partition_rules`' ValueError on the
+    first distributed trace. Receivers named `lay`/`layout` count (the
+    backend idiom: `lay = self.layout`); other objects with spec()
+    methods are someone else's API."""
+
+    rule = RULE_COVERAGE
+    path_scope = (r"^ddt_tpu/(?!parallel/mesh\.py$)",)
+
+    def visit_Call(self, node: ast.Call):
+        rules = self.ctx.layout_rules
+        if rules and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("spec", "specs"):
+            recv = callgraph.dotted(node.func.value)
+            if recv is not None and recv.split(".")[-1] in ("lay", "layout"):
+                names = []
+                for a in node.args:
+                    if isinstance(a, ast.Constant) \
+                            and isinstance(a.value, str):
+                        names.append((a, a.value))
+                    elif isinstance(a, ast.Starred) and isinstance(
+                            a.value, (ast.List, ast.Tuple)):
+                        names.extend(
+                            (e, e.value) for e in a.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str))
+                for lit, name in names:
+                    if not any(re.search(p, name) for p in rules):
+                        self.report(lit, (
+                            f"operand name {name!r} matches no rule in "
+                            "SpecLayout.rules() (parallel/mesh.py) — "
+                            "match_partition_rules would raise at trace "
+                            "time on the first distributed run; add the "
+                            "operand to the rule table "
+                            "(docs/ANALYSIS.md layout-rule-coverage)"))
+        self.generic_visit(node)
+
+
+CHECKERS = [HandbuiltPartitionSpecChecker, AxisNameLiteralChecker,
+            LayoutRuleCoverageChecker]

@@ -1,0 +1,654 @@
+"""Thread-role + lock-discipline analysis for the serve tier (ddtlint v2).
+
+The serving tier is the one place in this codebase where concurrent
+mutable state is load-bearing: HTTP handler threads submit into the
+admission queue, a dedicated dispatcher thread drains it, the express
+lane (ISSUE 12) runs the SAME dispatch path synchronously on handler
+threads, and hot swap publishes a new model from whatever thread called
+/swap. ROADMAP item 3 (multi-model tenancy, weighted dispatcher, LRU
+eviction) multiplies that surface — so this pass mechanizes the review
+that used to guard it, BEFORE the tenancy work lands.
+
+The analysis is structural — no annotations beyond the one documented
+escape hatch (`# ddtlint: atomic-publish`):
+
+* **Thread roles.** "dispatcher" is the closure of every
+  `threading.Thread(target=...)` target; "handler" is the closure of
+  every public method / module function (HTTP handler threads, the
+  express-lane caller thread, the swap path, tests). Call edges resolve
+  `self.m()`, `self.attr.m()` through constructor-derived attribute
+  types (`self.stats = ServeStats()`), bare module-function calls, and
+  INJECTED CALLABLES (`MicroBatcher(self._dispatch, ...)` binds the
+  batcher's stored `self._dispatch` to `ServeEngine._dispatch`, so the
+  engine's dispatch body correctly carries BOTH roles: dispatcher via
+  the batcher loop, handler via the express lane).
+* **Lock state.** `callgraph.LockTracker` walks each method tracking
+  which `threading.Lock`/`Condition` attributes are held at every call
+  and every `self.<attr>` access (`with lock:` exact; acquire/release
+  pairs over-approximated toward "held", so findings fire rather than
+  silently pass; `acquire(blocking=False)` try-locks are held but
+  exempt from the deadlock graph — a trylock cannot deadlock).
+
+Rules (docs/ANALYSIS.md has the full catalogue):
+
+* `lock-order` — a cycle in the lock-acquisition graph (lock B taken
+  while A is held, directly or through resolved calls, and somewhere
+  else A while B): the classic inversion deadlock, which no CPU test
+  hits until the exact interleaving does.
+* `cross-role-state` — an attribute written on one role and read on
+  another with neither a common guarding lock on every access nor a
+  `# ddtlint: atomic-publish` annotation on the write (the documented
+  single-assignment publish idiom: one reference store, readers
+  tolerate old-or-new-never-a-mix).
+* `blocking-under-lock` — the serve-blocking-io predicate (time.sleep,
+  open, np.load/json.load, .read_text/.read_bytes) upgraded from file
+  scope to LOCK scope: a blocking call made while a lock or the
+  dispatch gate is held stalls every thread that contends on it, not
+  just the caller.
+* `lock-release` — `.acquire()` without a dominating try/finally
+  `.release()` of the same lock, or with call-bearing statements
+  between the acquire and the try (a raise there leaks the lock
+  forever; every future contender deadlocks).
+* stale `# ddtlint: atomic-publish` annotations (lines that no longer
+  store an attribute) report under `suppression-hygiene` — an
+  annotation that outlives its publish is a suppression with nothing
+  to suppress.
+
+`python -m tools.ddtlint --explain-threads` dumps the inferred model
+(roles, locks, publish points, lock-order edges) so reviewers of serve
+PRs can diff it; docs/SERVING.md embeds the stable part and
+tests/test_lint.py keeps the two in sync.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from tools.ddtlint import callgraph
+from tools.ddtlint.base import Checker
+from tools.ddtlint.findings import Finding
+
+#: files the pass runs on (relpath regexes).
+SCOPE = (r"^ddt_tpu/serve/", r"^ddt_tpu/robustness/watchdog\.py$")
+
+RULE_LOCK_ORDER = "lock-order"
+RULE_CROSS_ROLE = "cross-role-state"
+RULE_BLOCKING = "blocking-under-lock"
+RULE_RELEASE = "lock-release"
+#: stale atomic-publish annotations report under the existing
+#: suppression-hygiene rule (an annotation is a suppression).
+RULE_STALE_PUBLISH = "suppression-hygiene"
+
+RULES = (RULE_LOCK_ORDER, RULE_CROSS_ROLE, RULE_BLOCKING, RULE_RELEASE,
+         RULE_STALE_PUBLISH)
+
+#: the serve-blocking-io predicate, reused at lock scope.
+BLOCKING_CALLS = {"time.sleep", "open", "np.load", "numpy.load",
+                  "json.load"}
+BLOCKING_READ_ATTRS = {"read_text", "read_bytes"}
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+ATOMIC_PUBLISH_RE = re.compile(r"#\s*ddtlint:\s*atomic-publish")
+
+
+def in_scope(path: str) -> bool:
+    return any(re.search(p, path) for p in SCOPE)
+
+
+def _blocking_label(call: ast.Call) -> str | None:
+    """Dotted label when `call` matches the blocking predicate."""
+    d = callgraph.dotted(call.func)
+    if d in BLOCKING_CALLS:
+        return d
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in BLOCKING_READ_ATTRS:
+        return f".{call.func.attr}"
+    return None
+
+
+@dataclass
+class Method:
+    path: str
+    cls: str                    # "" for module-level functions
+    name: str
+    node: ast.AST
+    roles: set = field(default_factory=set)
+    tracker: "callgraph.LockTracker | None" = None
+    edges: list = field(default_factory=list)   # (key, held, Call node)
+    # transitive facts (fixpoint below)
+    order_acquires: set = field(default_factory=set)   # blocking (cls, lock)
+    blocking: "tuple | None" = None      # (label, line) of a reachable
+    #                                      blocking call, None when clean
+
+    @property
+    def key(self) -> tuple:
+        return (self.path, self.cls, self.name)
+
+    @property
+    def qual(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+@dataclass
+class ClassModel:
+    path: str
+    name: str
+    node: ast.AST
+    methods: dict = field(default_factory=dict)      # name -> Method
+    locks: dict = field(default_factory=dict)        # attr -> ctor kind
+    attr_types: dict = field(default_factory=dict)   # attr -> class name
+    injected: dict = field(default_factory=dict)     # attr -> __init__ param
+
+
+@dataclass
+class ThreadModel:
+    classes: dict = field(default_factory=dict)      # name -> ClassModel
+    functions: dict = field(default_factory=dict)    # (path, name) -> Method
+    methods: dict = field(default_factory=dict)      # key -> Method
+    thread_roots: list = field(default_factory=list)  # Method keys
+    #: (cls, attr) -> (path, cls, meth) the injected callable binds to
+    bindings: dict = field(default_factory=dict)
+    #: lock-order digraph: (from_lock, to_lock) -> representative site
+    #: (path, node);  locks are (class, attr) pairs.
+    order_edges: dict = field(default_factory=dict)
+    #: attributes declared atomic-publish: (cls, attr) -> [write lines]
+    published: dict = field(default_factory=dict)
+    #: attributes guarded by a common lock: (cls, attr) -> lock attr
+    guarded: dict = field(default_factory=dict)
+    #: {path: set of `# ddtlint: atomic-publish` line numbers}
+    annotated: dict = field(default_factory=dict)
+    findings: list = field(default_factory=list)     # Finding (no line_text)
+
+
+# --------------------------------------------------------------------- #
+# model construction
+# --------------------------------------------------------------------- #
+def build(trees: dict, sources: dict | None = None) -> ThreadModel:
+    """{relpath: parsed ast.Module} for the serve-scope files -> the
+    package-wide thread model with findings computed. `sources` (same
+    keys) resolves `# ddtlint: atomic-publish` annotation lines — the
+    cross-role exemption; without it no line is annotated."""
+    m = ThreadModel()
+    set_annotations(m, sources or {})
+
+    # pass A: classes, methods, module functions, locks, attr seeds ---- #
+    for path, tree in sorted(trees.items()):
+        if tree is None:
+            continue
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, ast.ClassDef):
+                cm = ClassModel(path=path, name=node.name, node=node)
+                for ch in ast.iter_child_nodes(node):
+                    if isinstance(ch, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        meth = Method(path, node.name, ch.name, ch)
+                        cm.methods[ch.name] = meth
+                        m.methods[meth.key] = meth
+                # __init__ seeds: locks, attr types, injected callables
+                init = cm.methods.get("__init__")
+                if init is not None:
+                    params = [a.arg for a in init.node.args.args[1:]]
+                    for st in ast.walk(init.node):
+                        if not isinstance(st, ast.Assign):
+                            continue
+                        for t in st.targets:
+                            attr = callgraph.self_attr(t)
+                            if attr is None:
+                                continue
+                            v = st.value
+                            if isinstance(v, ast.Call):
+                                d = callgraph.dotted(v.func)
+                                last = d.split(".")[-1] if d else None
+                                if last in _LOCK_CTORS:
+                                    cm.locks[attr] = last
+                                elif last is not None:
+                                    cm.attr_types[attr] = last
+                            elif isinstance(v, ast.Name) \
+                                    and v.id in params:
+                                cm.injected[attr] = v.id
+                # classes may collide across files only by accident;
+                # first (path-sorted) wins, deterministically.
+                m.classes.setdefault(node.name, cm)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                meth = Method(path, "", node.name, node)
+                m.functions[(path, node.name)] = meth
+                m.methods[meth.key] = meth
+
+    # attr types only count when they name a modelled class
+    for cm in m.classes.values():
+        cm.attr_types = {a: t for a, t in cm.attr_types.items()
+                         if t in m.classes}
+
+    # pass B: thread targets + injected-callable bindings -------------- #
+    for meth in m.methods.values():
+        cls = m.classes.get(meth.cls)
+        for n in callgraph.walk_skip_defs(meth.node):
+            if not isinstance(n, ast.Call):
+                continue
+            d = callgraph.dotted(n.func)
+            last = d.split(".")[-1] if d else None
+            if last == "Thread":
+                for k in n.keywords:
+                    if k.arg != "target":
+                        continue
+                    attr = callgraph.self_attr(k.value)
+                    if attr is not None and cls is not None \
+                            and attr in cls.methods:
+                        m.thread_roots.append(cls.methods[attr].key)
+                    elif isinstance(k.value, ast.Name):
+                        f = m.functions.get((meth.path, k.value.id))
+                        if f is not None:
+                            m.thread_roots.append(f.key)
+            elif last in m.classes and cls is not None:
+                callee = m.classes[last]
+                init = callee.methods.get("__init__")
+                if init is None:
+                    continue
+                params = [a.arg for a in init.node.args.args[1:]]
+                bound: dict = {}
+                for i, a in enumerate(n.args):
+                    if i < len(params):
+                        bound[params[i]] = a
+                for k in n.keywords:
+                    if k.arg is not None:
+                        bound[k.arg] = k.value
+                for attr, pname in callee.injected.items():
+                    v = bound.get(pname)
+                    tgt = callgraph.self_attr(v) if v is not None else None
+                    if tgt is not None and tgt in cls.methods:
+                        m.bindings[(callee.name, attr)] = \
+                            cls.methods[tgt].key
+
+    # pass C: lock tracking + call-edge resolution --------------------- #
+    for meth in m.methods.values():
+        cls = m.classes.get(meth.cls)
+        lock_attrs = set(cls.locks) if cls is not None else set()
+        meth.tracker = callgraph.LockTracker(lock_attrs).run(meth.node)
+        for call, held in meth.tracker.calls:
+            key = _resolve_call(m, meth, cls, call)
+            if key is not None:
+                meth.edges.append((key, held, call))
+
+    # pass D: roles ----------------------------------------------------- #
+    _flood(m, "dispatcher", m.thread_roots)
+    handler_seeds = [meth.key for meth in m.methods.values()
+                     if not meth.name.startswith("_")]
+    _flood(m, "handler", handler_seeds)
+
+    # pass E: transitive acquire/blocking facts (fixpoint) ------------- #
+    for meth in m.methods.values():
+        for lock, _held, blocking, _n in meth.tracker.acquisitions:
+            if blocking:
+                meth.order_acquires.add((meth.cls, lock))
+        for call, _held in meth.tracker.calls:
+            lbl = _blocking_label(call)
+            if lbl is not None and meth.blocking is None:
+                meth.blocking = (lbl, getattr(call, "lineno", 0))
+    for _ in range(len(m.methods) + 1):
+        changed = False
+        for meth in m.methods.values():
+            for key, _held, _call in meth.edges:
+                callee = m.methods.get(key)
+                if callee is None:
+                    continue
+                if not callee.order_acquires <= meth.order_acquires:
+                    meth.order_acquires |= callee.order_acquires
+                    changed = True
+                if meth.blocking is None and callee.blocking is not None:
+                    meth.blocking = (f"{callee.qual} -> "
+                                     f"{callee.blocking[0]}",
+                                     callee.blocking[1])
+                    changed = True
+        if not changed:
+            break
+
+    # pass F: lock-order digraph ---------------------------------------- #
+    for meth in m.methods.values():
+        for lock, held, blocking, node in meth.tracker.acquisitions:
+            if not blocking:
+                continue                      # trylocks cannot deadlock
+            for h in held:
+                m.order_edges.setdefault(
+                    ((meth.cls, h), (meth.cls, lock)), (meth.path, node))
+        for key, held, call in meth.edges:
+            callee = m.methods.get(key)
+            if callee is None or not held:
+                continue
+            for h in held:
+                for tgt in callee.order_acquires:
+                    if tgt == (meth.cls, h):
+                        continue
+                    m.order_edges.setdefault(
+                        ((meth.cls, h), tgt), (meth.path, call))
+
+    _find_lock_order(m)
+    _find_cross_role(m)
+    _find_blocking(m)
+    _find_release(m)
+    return m
+
+
+def _resolve_call(m: ThreadModel, meth: Method, cls, call: ast.Call):
+    """Call node -> callee Method key, where statically resolvable."""
+    f = call.func
+    # self.m(...) / self.attr(...) on an injected callable
+    attr = callgraph.self_attr(f)
+    if attr is not None and cls is not None:
+        if attr in cls.methods:
+            return cls.methods[attr].key
+        if (cls.name, attr) in m.bindings:
+            return m.bindings[(cls.name, attr)]
+        return None
+    # self.obj.m(...) through a constructor-derived attribute type
+    if isinstance(f, ast.Attribute):
+        owner = callgraph.self_attr(f.value)
+        if owner is not None and cls is not None:
+            t = cls.attr_types.get(owner)
+            if t is not None and f.attr in m.classes[t].methods:
+                return m.classes[t].methods[f.attr].key
+        return None
+    # bare module-function call (same file first, then any scanned file)
+    if isinstance(f, ast.Name):
+        hit = m.functions.get((meth.path, f.id))
+        if hit is not None:
+            return hit.key
+        for (_p, name), fn in sorted(m.functions.items()):
+            if name == f.id:
+                return fn.key
+    return None
+
+
+def _flood(m: ThreadModel, role: str, seeds: list) -> None:
+    work, seen = list(seeds), set()
+    while work:
+        key = work.pop()
+        if key in seen:
+            continue
+        seen.add(key)
+        meth = m.methods.get(key)
+        if meth is None:
+            continue
+        meth.roles.add(role)
+        for key2, _held, _call in meth.edges:
+            work.append(key2)
+
+
+# --------------------------------------------------------------------- #
+# findings
+# --------------------------------------------------------------------- #
+def _emit(m: ThreadModel, rule: str, path: str, node, message: str) -> None:
+    m.findings.append(Finding(
+        rule=rule, path=path, line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1, message=message))
+
+
+def _lock_name(lock: tuple) -> str:
+    cls, attr = lock
+    return f"{cls}.{attr}" if cls else attr
+
+
+def _find_lock_order(m: ThreadModel) -> None:
+    """Cycles in the lock-acquisition digraph, reported once per cycle
+    at each participating edge's site (so every involved file shows the
+    finding)."""
+    graph: dict = {}
+    for (a, b) in m.order_edges:
+        graph.setdefault(a, set()).add(b)
+
+    def reachable(src, dst) -> bool:
+        work, seen = [src], set()
+        while work:
+            n = work.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            work.extend(graph.get(n, ()))
+        return False
+
+    for (a, b), (path, node) in sorted(
+            m.order_edges.items(),
+            key=lambda kv: (kv[1][0], getattr(kv[1][1], "lineno", 0))):
+        if a != b and reachable(b, a):
+            _emit(m, RULE_LOCK_ORDER, path, node, (
+                f"lock-order inversion: {_lock_name(b)} is acquired here "
+                f"while {_lock_name(a)} is held, and elsewhere "
+                f"{_lock_name(a)} is acquired under {_lock_name(b)} — two "
+                "threads taking the locks in opposite orders deadlock; "
+                "pick one global order (docs/ANALYSIS.md lock-order)"))
+
+
+def _find_cross_role(m: ThreadModel) -> None:
+    """Attributes written on one role and read on another, with neither
+    a common guarding lock on every access nor an atomic-publish
+    annotation on every write."""
+    for cname, cm in sorted(m.classes.items()):
+        per_attr: dict = {}
+        for meth in cm.methods.values():
+            if not meth.roles and meth.name != "__init__":
+                continue                   # never reached: no thread runs it
+            for attr, kind, node, held in meth.tracker.accesses:
+                if attr in cm.locks:
+                    continue
+                per_attr.setdefault(attr, []).append(
+                    (kind, meth, node, held))
+        for attr, accs in sorted(per_attr.items()):
+            writes = [a for a in accs
+                      if a[0] == "store" and a[1].name != "__init__"]
+            if not writes:
+                continue                   # init-published, then read-only
+            outside = [a for a in accs if a[1].name != "__init__"]
+            roles = set()
+            for _k, meth, _n, _h in outside:
+                roles |= meth.roles
+            if len(roles) < 2:
+                continue                   # single-role: no concurrency
+            common = None
+            for _k, _meth, _n, held in outside:
+                common = set(held) if common is None else common & set(held)
+            if common:
+                m.guarded[(cname, attr)] = sorted(common)[0]
+                continue
+            # atomic-publish annotation on EVERY write line exempts
+            ann = m.annotated.get(cm.path, set())
+            if all(getattr(n, "lineno", 0) in ann
+                   for _k, _meth, n, _h in writes):
+                m.published[(cname, attr)] = sorted(
+                    getattr(n, "lineno", 0) for _k, _meth, n, _h in writes)
+                continue
+            wroles = sorted({r for _k, meth, _n, _h in writes
+                             for r in meth.roles})
+            rroles = sorted(roles)
+            for _k, meth, node, _h in writes:
+                if getattr(node, "lineno", 0) in ann:
+                    continue
+                _emit(m, RULE_CROSS_ROLE, cm.path, node, (
+                    f"`{cname}.{attr}` is written here on role(s) "
+                    f"{'/'.join(wroles) or 'unreached'} and accessed on "
+                    f"role(s) {'/'.join(rroles)} with no common guarding "
+                    "lock — hold one lock on every access, or make this "
+                    "a single-assignment atomic publish and annotate the "
+                    "store with `# ddtlint: atomic-publish` "
+                    "(docs/ANALYSIS.md cross-role-state)"))
+
+
+def set_annotations(m: ThreadModel, sources: dict) -> None:
+    """Record which lines of each source carry the atomic-publish
+    annotation (the cross-role exemption); runs before findings are
+    computed."""
+    ann: dict = {}
+    for path, src in sources.items():
+        lines = set()
+        for i, line in enumerate(src.splitlines(), start=1):
+            if ATOMIC_PUBLISH_RE.search(line):
+                lines.add(i)
+        if lines:
+            ann[path] = lines
+    m.annotated = ann
+
+
+def _find_blocking(m: ThreadModel) -> None:
+    for meth in m.methods.values():
+        for call, held in meth.tracker.calls:
+            if not held:
+                continue
+            locks = "/".join(sorted(f"{meth.cls}.{h}" if meth.cls else h
+                                    for h in held))
+            lbl = _blocking_label(call)
+            if lbl is not None:
+                _emit(m, RULE_BLOCKING, meth.path, call, (
+                    f"`{lbl}(...)` while {locks} is held — every thread "
+                    "contending on the lock (the dispatch gate included) "
+                    "inherits the block's wall time; release first, or "
+                    "park on a Condition/Event timeout "
+                    "(docs/ANALYSIS.md blocking-under-lock)"))
+                continue
+            key = _resolve_call(m, meth, m.classes.get(meth.cls), call)
+            callee = m.methods.get(key) if key is not None else None
+            if callee is not None and callee.blocking is not None:
+                _emit(m, RULE_BLOCKING, meth.path, call, (
+                    f"call to `{callee.qual}` while {locks} is held "
+                    f"reaches blocking I/O ({callee.blocking[0]}, line "
+                    f"{callee.blocking[1]}) — the lock serialises every "
+                    "contender behind it (docs/ANALYSIS.md "
+                    "blocking-under-lock)"))
+
+
+def _find_release(m: ThreadModel) -> None:
+    for meth in m.methods.values():
+        tr = meth.tracker
+        if not tr.acquire_calls:
+            continue
+        stmts = [s for s in callgraph.walk_skip_defs(meth.node)
+                 if isinstance(s, ast.stmt)]
+        # first try whose finally releases each lock
+        for lock, call in tr.acquire_calls:
+            if lock not in tr.finally_releases:
+                _emit(m, RULE_RELEASE, meth.path, call, (
+                    f"`{lock}.acquire()` with no dominating try/finally "
+                    f"`{lock}.release()` in `{meth.qual}` — any raise on "
+                    "the held path leaks the lock and deadlocks every "
+                    "future contender; use `with`, or release in a "
+                    "finally (docs/ANALYSIS.md lock-release)"))
+                continue
+            guard_line = None
+            for s in stmts:
+                if isinstance(s, ast.Try) and any(
+                        isinstance(c, ast.Call)
+                        and tr.lock_call(c) == (lock, "release")
+                        for fs in s.finalbody
+                        for c in callgraph.walk_skip_defs(fs)):
+                    if s.lineno > call.lineno and (
+                            guard_line is None or s.lineno < guard_line):
+                        guard_line = s.lineno
+            if guard_line is None:
+                continue                  # acquire inside the try: fine
+            risky = [
+                s for s in stmts
+                if call.lineno < s.lineno < guard_line
+                and not any(c is call
+                            for c in ast.walk(s))
+                and any(isinstance(c, ast.Call)
+                        and tr.lock_call(c) is None
+                        for c in callgraph.walk_skip_defs(s))
+            ]
+            if risky:
+                first = min(risky, key=lambda s: s.lineno)
+                _emit(m, RULE_RELEASE, meth.path, call, (
+                    f"`{lock}.acquire()` in `{meth.qual}` is guarded by a "
+                    f"try/finally only from line {guard_line}, but line "
+                    f"{first.lineno} between them makes a call that can "
+                    "raise and leak the lock — enter the try immediately "
+                    "after the acquire (docs/ANALYSIS.md lock-release)"))
+
+
+def stale_annotations(path: str, tree: ast.AST, source: str) -> list:
+    """`# ddtlint: atomic-publish` lines that no longer store an
+    attribute — a stale publish declaration hides nothing today and
+    will silently exempt whatever lands on that line tomorrow."""
+    out: list = []
+    store_lines = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Store):
+            store_lines.add(getattr(n, "lineno", 0))
+    for i, line in enumerate(source.splitlines(), start=1):
+        if ATOMIC_PUBLISH_RE.search(line) and i not in store_lines:
+            out.append(Finding(
+                rule=RULE_STALE_PUBLISH, path=path, line=i,
+                col=line.index("#") + 1,
+                message=(
+                    "stale `# ddtlint: atomic-publish` annotation — this "
+                    "line no longer stores an attribute, so the "
+                    "declaration exempts nothing today and would "
+                    "silently exempt whatever publish lands here next; "
+                    "delete it or move it to the store it describes")))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# the checker (runner wiring)
+# --------------------------------------------------------------------- #
+class ThreadModelChecker(Checker):
+    """Emits this file's slice of the package-wide thread model's
+    findings (runner builds ONE model over every scanned serve-scope
+    file so cross-file edges — the injected dispatch callable — resolve;
+    fixture tests get a single-file model built on demand)."""
+
+    rule = RULE_LOCK_ORDER
+    rules = RULES
+    path_scope = SCOPE
+
+    def run(self):
+        m = self.ctx.thread_model
+        if m is None:
+            m = build({self.ctx.path: self.ctx.tree},
+                      {self.ctx.path: self.ctx.source})
+        for f in m.findings:
+            if f.path != self.ctx.path:
+                continue
+            self.findings.append(Finding(
+                rule=f.rule, path=f.path, line=f.line, col=f.col,
+                message=f.message,
+                line_text=self.ctx.line_text(f.line)))
+        for f in stale_annotations(self.ctx.path, self.ctx.tree,
+                                   self.ctx.source):
+            f.line_text = self.ctx.line_text(f.line)
+            self.findings.append(f)
+        return self.findings
+
+
+# --------------------------------------------------------------------- #
+# --explain-threads
+# --------------------------------------------------------------------- #
+def explain(m: ThreadModel, details: bool = True) -> str:
+    """Human-readable dump of the inferred model. The `details=False`
+    form omits line numbers and is byte-stable across unrelated edits —
+    docs/SERVING.md embeds it and tests keep the two in sync."""
+    out = ["inferred threading model (tools/ddtlint --explain-threads)"]
+    by_role: dict = {}
+    for meth in m.methods.values():
+        for r in sorted(meth.roles) or ["(unreached)"]:
+            by_role.setdefault(r, []).append(meth.qual)
+    out.append("roles:")
+    for role in sorted(r for r in by_role if r != "(unreached)"):
+        names = ", ".join(sorted(set(by_role[role])))
+        out.append(f"  {role}: {names}")
+    out.append("locks:")
+    for cname, cm in sorted(m.classes.items()):
+        for attr, kind in sorted(cm.locks.items()):
+            out.append(f"  {cname}.{attr}: threading.{kind}")
+    out.append("atomic-publish attrs:")
+    for (cname, attr) in sorted(m.published):
+        out.append(f"  {cname}.{attr}")
+    out.append("lock-guarded attrs:")
+    for (cname, attr), lock in sorted(m.guarded.items()):
+        out.append(f"  {cname}.{attr} <- {cname}.{lock}")
+    out.append("lock-order edges:")
+    for (a, b), (path, node) in sorted(m.order_edges.items()):
+        loc = f"  [{path}:{getattr(node, 'lineno', 0)}]" if details else ""
+        out.append(f"  {_lock_name(a)} -> {_lock_name(b)}{loc}")
+    return "\n".join(out) + "\n"
