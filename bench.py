@@ -219,6 +219,17 @@ HIST_COMMS_AB_FLOOR = 1.0
 # 6-11 ran CPU-only); re-calibrate against the first two chip
 # artifacts per docs/PERF.md "2D sharding" (Re-calibration status).
 HIST_2D_AB_FLOOR = 1.0
+# Quantized-gradient paired ratio (ISSUE 14, chip only): int8 g/h cut
+# the per-level g/h HBM stream 4x (the payload_ratio stamp is
+# deterministic byte math — telemetry.counters.grad_stream_bytes,
+# asserted in tests/test_grad_quant.py) and the integer dot rides the
+# MXU's native s8 path, so the quantized arm must never cost wallclock
+# — ratio ~1.0 is the never-regress bar, > 1.0 once real HBM bandwidth
+# is the constraint. ENCODED-BUT-UNWITNESSED like every post-r05 floor
+# (this round ran CPU-only); re-calibrate against the first two chip
+# artifacts per docs/PERF.md "Quantized gradients" (Re-calibration
+# status), ratcheting UP if the HBM win is real.
+HIST_QUANT_AB_FLOOR = 1.0
 # Cross-platform training parity (experiments/chip_parity.py): 2-4/155
 # split flips from MXU f32 summation order straddling bf16 gain-rounding
 # ties; quality-equivalent. Wider divergence means a real kernel bug.
@@ -376,6 +387,23 @@ def main() -> None:
 
         h2d = bench_hist_2d()
 
+    # Quantized-gradient paired A/B (ISSUE 14): f32 vs int8 whole-tree
+    # fused level loop on one chip. Real chip only in the headline run
+    # (the CPU twin lives in tier-1 as tests/test_grad_quant.py::
+    # test_bench_hist_quant_ab_smoke); the g/h HBM-stream payload ratio
+    # is deterministic byte math and stamped on every platform.
+    qab = None
+    if on_tpu:
+        from ddt_tpu.bench import bench_hist_quant_ab
+
+        qab = bench_hist_quant_ab(rows=rows, features=features, bins=bins,
+                                  depth=depth)
+    from ddt_tpu.telemetry.counters import grad_stream_bytes
+
+    quant_payload_ratio = round(
+        grad_stream_bytes(rows, depth, "f32")
+        / grad_stream_bytes(rows, depth, "int8"), 3)
+
     # Scoring config: device-resident (floored) + total (context) +
     # compute-only (floored, band-stable), one shared
     # dataset/ensemble/warm-up.
@@ -488,6 +516,16 @@ def main() -> None:
             h2d["payload_ratio"] if h2d else None,
         "hist_2d_mrows_per_sec":
             round(h2d["mrows_2d"], 2) if h2d else None,
+        # Quantized-gradient A/B (ISSUE 14): paired wallclock ratio
+        # (chip only) + the deterministic g/h HBM-stream payload ratio
+        # (grad_stream_bytes byte model — 4x for int8), witnessed
+        # in-process by tests/test_grad_quant.py's counter tests.
+        "hist_quant_ab_ratio":
+            round(qab["ratio_f32_over_quant"], 3) if qab else None,
+        "hist_quant_payload_ratio":
+            qab["payload_ratio"] if qab else quant_payload_ratio,
+        "hist_quant_mrows_per_sec":
+            round(qab["mrows_quant"], 2) if qab else None,
         "predict_mrows_per_sec": round(pr["mrows_per_sec"], 2),
         "predict_total_s": round(pr_total["wallclock_s"], 2),
         "predict_compute_mrows_per_sec": round(pr_comp["mrows_per_sec"], 2),
@@ -669,6 +707,16 @@ def main() -> None:
             f"{HIST_2D_AB_FLOOR} (feature sharding costs wallclock at "
             "the wide shape — parallel/mesh.py SpecLayout; docs/PERF.md "
             "'2D sharding')")
+    if qab is not None \
+            and qab["ratio_f32_over_quant"] < HIST_QUANT_AB_FLOOR:
+        fails.append(
+            f"quantized-gradient paired ratio "
+            f"{qab['ratio_f32_over_quant']:.3f} < {HIST_QUANT_AB_FLOOR} "
+            "(the integer histogram path costs wallclock on chip — the "
+            "s8 MXU dot or the narrow g/h stream degraded; ops/grad.py "
+            "+ ops/hist_pallas.py; floor is encoded-but-unwitnessed, "
+            "re-calibrate per docs/PERF.md 'Quantized gradients' before "
+            "trusting a failure)")
     if lab is not None \
             and lab["ratio_lut_over_f32"] < PREDICT_LUT_AB_FLOOR:
         fails.append(

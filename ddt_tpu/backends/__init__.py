@@ -58,12 +58,20 @@ _JIT_FIELDS = (
     # and the comms parity tests flip exactly these).
     "hist_subtraction", "split_comms", "hist_comms_dtype",
     "hist_comms_slabs",
+    # Quantized-gradient training (ISSUE 14): the integer histogram
+    # programs differ from f32 at every level — a cached f32 instance
+    # reused under grad_dtype='int8' would silently train unquantized.
+    "grad_dtype",
 )
 
 
 def _cache_key(cfg: TrainConfig) -> tuple:
+    # seed is trace-relevant under bagging (in-scan counter hash) AND
+    # under quantized gradients (the stochastic-rounding key bakes it
+    # into the grow programs) — normalise to 0 only when neither is on.
+    seed_live = cfg.subsample < 1.0 or cfg.grad_dtype != "f32"
     return tuple(getattr(cfg, f) for f in _JIT_FIELDS) + (
-        cfg.seed if cfg.subsample < 1.0 else 0,
+        cfg.seed if seed_live else 0,
     )
 # LRU-bounded: each cached TPUDevice pins its compiled executables (and any
 # upload-derived device state) for its lifetime, so a hyperparameter sweep

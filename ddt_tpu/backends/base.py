@@ -125,10 +125,14 @@ class DeviceBackend(abc.ABC):
 
     @abc.abstractmethod
     def grow_tree(self, data: Any, g: Any, h: Any,
-                  feature_mask: np.ndarray | None = None) -> tuple[Any, Any]:
+                  feature_mask: np.ndarray | None = None,
+                  tree_id: int = 0) -> tuple[Any, Any]:
         """Grow one complete-heap tree from (sharded) data + grads.
         feature_mask (host bool [F], or None) excludes features from split
-        selection — cfg.colsample_bytree.
+        selection — cfg.colsample_bytree. `tree_id` is the absolute tree
+        index (round * n_classes + class) — the quantized-gradient
+        stochastic-rounding key on backends that honor cfg.grad_dtype
+        (ignored elsewhere).
 
         Returns (tree_handle, delta): a backend-opaque handle to the tree's
         node arrays (resolve with fetch_tree), and the per-row raw-score

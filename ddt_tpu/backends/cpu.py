@@ -43,6 +43,15 @@ class CPUDevice(DeviceBackend):
 
     def __init__(self, cfg: TrainConfig, use_native: bool | None = None):
         super().__init__(cfg)
+        if cfg.grad_dtype != "f32":
+            # This backend defines the f32 ground truth the quantized
+            # path's agreement contracts are measured against — running
+            # it quantized would be circular (and the numpy oracle has
+            # no integer histogram path). Refuse loudly.
+            raise NotImplementedError(
+                f"grad_dtype={cfg.grad_dtype!r} is not supported on the "
+                "CPU oracle backend; use backend='tpu' (runs on CPU XLA "
+                "too)")
         self._native = None          # histogram kernel
         self._native_split = None    # split-gain kernel (plain contract)
         self._native_split_full = None  # full contract (mask/missing/cat)
@@ -120,7 +129,10 @@ class CPUDevice(DeviceBackend):
         return g, h
 
     def grow_tree(self, data, g, h,
-                  feature_mask=None) -> tuple[HostTree, Any]:
+                  feature_mask=None, tree_id: int = 0) -> tuple[HostTree, Any]:
+        # tree_id is the quantized-gradient rounding key — unused here:
+        # this backend IS the f32 oracle (cfg.grad_dtype != "f32" is
+        # refused at construction).
         split_full = None
         if self._native_split_full is not None:
             def split_full(hist, fm, missing, cm):

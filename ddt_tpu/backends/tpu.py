@@ -223,6 +223,11 @@ class TPUDevice(DeviceBackend):
             self.split_comms if jax.process_count() == 1 else "allreduce")
         self.comms_slabs = comms_lib.resolve_comms_slabs(
             cfg.hist_comms_slabs, distributed=self.distributed)
+        # Quantized-gradient training (cfg.grad_dtype; ops/grad.py): one
+        # resolved bool every program builder below reads — the grow
+        # programs quantize in-trace, the streamed ops take per-round
+        # scales, and the byte models report the integer path.
+        self._grad_quant = cfg.grad_dtype != "f32"
         # Sticky position on the histogram OOM-degradation ladder
         # (build_histograms below): 0 = the configured impl.
         self._hist_degrade = 0
@@ -246,7 +251,9 @@ class TPUDevice(DeviceBackend):
             feature_partitions=self.feature_partitions,
             mode=self.stream_hist_comms if streamed else self.split_comms,
             comms_dtype=self.cfg.hist_comms_dtype,
-            subtraction=resolve_hist_subtraction(self.cfg.hist_subtraction),
+            subtraction=resolve_hist_subtraction(
+                self.cfg.hist_subtraction, integer_hists=self._grad_quant),
+            grad_dtype=self.cfg.grad_dtype,
         )
 
     # ------------------------------------------------------------------ #
@@ -586,12 +593,15 @@ class TPUDevice(DeviceBackend):
         cfg = self.cfg
         axis = self._row_axes if self.distributed else None
         faxis = FAXIS if self.feature_partitions > 1 else None
+        quant = self._grad_quant
         # Platform-resolved ONCE at program build (trace-time static) —
         # the fused and granular paths must agree or their bit-exactness
-        # contract breaks.
-        subtract = grow_ops.resolve_hist_subtraction(cfg.hist_subtraction)
+        # contract breaks. Integer hists (quantized grads) subtract
+        # exactly, so 'auto' resolves ON regardless of platform there.
+        subtract = grow_ops.resolve_hist_subtraction(
+            cfg.hist_subtraction, integer_hists=quant)
 
-        def grow(Xb, g, h, fmask=None):
+        def grow_full(Xb, g, h, fmask=None, tid=None):
             tree = grow_ops.grow_tree(
                 Xb, g, h,
                 max_depth=cfg.max_depth,
@@ -610,6 +620,9 @@ class TPUDevice(DeviceBackend):
                 split_comms=self.split_comms,
                 hist_comms_dtype=cfg.hist_comms_dtype,
                 comms_slabs=self.comms_slabs,
+                grad_dtype=cfg.grad_dtype,
+                quant_tree_id=tid,
+                quant_seed=cfg.seed,
             )
             delta = grow_ops.tree_predict_delta(tree, cfg.learning_rate)
             # Pack the tiny node arrays into ONE f32 array so the host
@@ -621,17 +634,28 @@ class TPUDevice(DeviceBackend):
             packed = _pack_tree(tree)
             return packed, delta
 
-        if not with_mask:
-            inner = grow
-
-            def grow(Xb, g, h):          # noqa: F811 — 3-arg jit signature
-                return inner(Xb, g, h, None)
+        # One positional jit signature per (mask?, quant?) combination:
+        # the quantized programs take the traced tree id (the stochastic-
+        # rounding key) as a real operand so tree k+1 never retraces.
+        if with_mask and quant:
+            grow = grow_full
+        elif with_mask:
+            def grow(Xb, g, h, fmask):
+                return grow_full(Xb, g, h, fmask, None)
+        elif quant:
+            def grow(Xb, g, h, tid):
+                return grow_full(Xb, g, h, None, tid)
+        else:
+            def grow(Xb, g, h):
+                return grow_full(Xb, g, h, None, None)
 
         if self.distributed:
             lay = self.layout
             in_specs = lay.specs("data", "grad", "hess")
             if with_mask:
                 in_specs = in_specs + lay.specs("mask")   # replicated
+            if quant:
+                in_specs = in_specs + lay.specs("scalar")  # tree id
             grow = mesh_lib.shard_map(
                 grow,
                 mesh=self.mesh,
@@ -657,17 +681,21 @@ class TPUDevice(DeviceBackend):
         return costed("grow", phase="grow")(jax.jit(grow))
 
     def grow_tree(self, data, g, h,
-                  feature_mask=None) -> tuple[Any, Any]:
+                  feature_mask=None, tree_id: int = 0) -> tuple[Any, Any]:
         """Returns (device packed-tree handle, delta) — no host sync here;
-        the Driver resolves the handle via fetch_tree one round later."""
+        the Driver resolves the handle via fetch_tree one round later.
+        `tree_id` (absolute tree index) keys the quantized-gradient
+        stochastic rounding when cfg.grad_dtype != 'f32' — a traced
+        operand, so every round shares one compiled program."""
+        tid = (np.int32(tree_id),) if self._grad_quant else ()
         if feature_mask is None:
-            return self._grow_fn(data, g, h)
+            return self._grow_fn(data, g, h, *tid)
         # Pad the host mask to the (padded, global) feature count; padded
         # columns stay masked out.
         Fg = data.shape[1]
         m = np.zeros(Fg, bool)
         m[: feature_mask.shape[0]] = feature_mask
-        return self._grow_masked_fn(data, g, h, jax.device_put(m))
+        return self._grow_masked_fn(data, g, h, jax.device_put(m), *tid)
 
     def sync(self, x) -> None:
         from ddt_tpu.utils.device import device_sync
@@ -782,7 +810,7 @@ class TPUDevice(DeviceBackend):
             fn = self._build_rounds_fn(n_rounds)
             self._rounds_fns[n_rounds] = fn
         args = (data, pred, y.y, y.valid)
-        if self.cfg.subsample < 1.0:
+        if self.cfg.subsample < 1.0 or self._grad_quant:
             args = args + (np.int32(first_round),)
         return fn(*args)
 
@@ -808,7 +836,7 @@ class TPUDevice(DeviceBackend):
             fn = self._build_rounds_fn(n_rounds, masked=True)
             self._rounds_masked_fns[n_rounds] = fn
         args = (data, pred, y.y, y.valid, m)
-        if self.cfg.subsample < 1.0:
+        if self.cfg.subsample < 1.0 or self._grad_quant:
             args = args + (np.int32(first_round),)
         return fn(*args)
 
@@ -842,7 +870,7 @@ class TPUDevice(DeviceBackend):
                 val_data, val_pred, val_y.y, val_y.valid)
         if fmasks is not None:
             args = args + (self._pad_fmasks(data, fmasks),)
-        if self.cfg.subsample < 1.0:
+        if self.cfg.subsample < 1.0 or self._grad_quant:
             args = args + (np.int32(first_round),)
         return fn(*args)
 
@@ -865,6 +893,11 @@ class TPUDevice(DeviceBackend):
 
         cfg = self.cfg
         bagging = cfg.subsample < 1.0
+        quant = self._grad_quant
+        # Quantized rounds need the absolute round id in-scan too (the
+        # stochastic-rounding key is (seed, round * C + class, row)),
+        # riding the same xs lane the bagging hash already uses.
+        need_rids = bagging or quant
         C = cfg.n_classes if cfg.loss == "softmax" else 1
         axis = self._row_axes if self.distributed else None
         faxis = FAXIS if self.feature_partitions > 1 else None
@@ -872,7 +905,8 @@ class TPUDevice(DeviceBackend):
         mfn = device_metric(eval_metric, n_classes=C) if eval_metric \
             else None
         missing = cfg.missing_policy == "learn"
-        subtract = grow_ops.resolve_hist_subtraction(cfg.hist_subtraction)
+        subtract = grow_ops.resolve_hist_subtraction(
+            cfg.hist_subtraction, integer_hists=quant)
 
         allreduce = _axis_allreduce(axis)
 
@@ -887,7 +921,7 @@ class TPUDevice(DeviceBackend):
 
         def rounds(data_a, pred0, ya, valid, *rest):
             rest = list(rest)
-            rnd0 = rest.pop() if bagging else None   # block's first round
+            rnd0 = rest.pop() if need_rids else None  # block's first round
             if masked:
                 fmasks = rest.pop()           # [K, C, Fg] bool, scan xs
             if mfn is not None:
@@ -901,7 +935,7 @@ class TPUDevice(DeviceBackend):
                 v = valid[:, None] if g.ndim == 2 else valid
                 g = g * v
                 h = h * v
-                if rid is not None:
+                if bagging:
                     # Counter-based bagging bit per (round, global row) —
                     # exactly the granular path's host-drawn mask
                     # (ops/sampling twins are bit-identical; 0/1 f32
@@ -936,6 +970,9 @@ class TPUDevice(DeviceBackend):
                         split_comms=self.split_comms,
                         hist_comms_dtype=cfg.hist_comms_dtype,
                         comms_slabs=self.comms_slabs,
+                        grad_dtype=cfg.grad_dtype,
+                        quant_tree_id=(rid * C + c) if quant else None,
+                        quant_seed=cfg.seed,
                     )
                     delta = grow_ops.tree_predict_delta(
                         tree, cfg.learning_rate)
@@ -959,25 +996,26 @@ class TPUDevice(DeviceBackend):
                     pred, ya, valid)
 
             # Scan xs: the round's colsample masks [C, Fg] and/or its
-            # absolute round id (the bagging hash key) — any combination
-            # composes, with or without in-scan eval.
-            rids = (jnp.arange(K, dtype=jnp.int32) + rnd0) if bagging \
+            # absolute round id (the bagging AND/OR grad-quant rounding
+            # hash key) — any combination composes, with or without
+            # in-scan eval.
+            rids = (jnp.arange(K, dtype=jnp.int32) + rnd0) if need_rids \
                 else None
-            if masked and bagging:
+            if masked and need_rids:
                 xs = (fmasks, rids)
             elif masked:
                 xs = fmasks
-            elif bagging:
+            elif need_rids:
                 xs = rids
             else:
                 xs = None
 
             def unpack(x):
-                if masked and bagging:
+                if masked and need_rids:
                     return x[0], x[1]
                 if masked:
                     return x, None
-                if bagging:
+                if need_rids:
                     return None, x
                 return None, None
 
@@ -1016,7 +1054,7 @@ class TPUDevice(DeviceBackend):
                 out_specs = out_specs + (pred_spec, lay.replicated())
             if masked:
                 in_specs = in_specs + lay.specs("fmasks")   # replicated
-            if bagging:
+            if need_rids:
                 in_specs = in_specs + lay.specs("scalar")   # rnd0 repl.
             rounds = mesh_lib.shard_map(
                 rounds,
@@ -1225,38 +1263,81 @@ class TPUDevice(DeviceBackend):
         # Bagging ops take 3 extra traced scalars — (round id, chunk row
         # base lo/hi) — and recompute the counter-based keep mask on
         # device per chunk (ops/sampling; O(chunk), no mask shipping).
+        # Quantized-gradient ops need the SAME scalars (the stochastic-
+        # rounding key is (seed, tree, global row)) plus the round's two
+        # host-reduced scales for hist/leaf builds.
         bagged = cfg.subsample < 1.0 and kind != "update"
+        quant = self._grad_quant and kind in ("hist", "leaf",
+                                              "roundstart", "gradstats")
+        takes_rnd = bagged or quant
+        takes_scales = quant and kind in ("hist", "leaf")
         hp_n = self.n_partitions
+        Cq = cfg.n_classes if softmax else 1
 
-        def row_keep_for(Xb, rnd, blo, bhi):
+        def parse_extra(extra):
+            """(rnd, blo, bhi, gscale, hscale) from the trailing traced
+            scalars — appended as (rnd, lo, hi[, gscale, hscale])."""
+            it = list(extra)
+            gsc = hsc = None
+            if takes_scales:
+                hsc = it.pop()
+                gsc = it.pop()
+            rnd = blo = bhi = None
+            if takes_rnd:
+                bhi = it.pop()
+                blo = it.pop()
+                rnd = it.pop()
+            return rnd, blo, bhi, gsc, hsc
+
+        def row_keep_for(n_rows, rnd, blo, bhi):
+            if not bagged:
+                return None
             return sampling_ops.row_keep_jax(
-                rnd, _local_row_offset(axis, hp_n, Xb.shape[0]),
-                Xb.shape[0], seed=cfg.seed, subsample=cfg.subsample,
+                rnd, _local_row_offset(axis, hp_n, n_rows),
+                n_rows, seed=cfg.seed, subsample=cfg.subsample,
                 row_start_lo=blo, row_start_hi=bhi)
+
+        def quantizer_for(n_rows, rnd, blo, bhi, gsc, hsc):
+            """The stream ops' quantize seam: this round's shared scales
+            + this chunk's global-row-id base (ops/grad — tree_id =
+            rnd * C + class keys the per-output-dim rounding)."""
+            def q(gv, hv):
+                return grad_ops.quantize_with_scales(
+                    gv, hv, gsc, hsc, grad_dtype=cfg.grad_dtype,
+                    tree_id=rnd * Cq + class_idx, seed=cfg.seed,
+                    local_offset=_local_row_offset(axis, hp_n, n_rows),
+                    row_start_lo=blo, row_start_hi=bhi)
+            return q
 
         def cat_vec_for(Xb):
             return split_ops.cat_feature_vec(cfg.cat_features, Xb.shape[1])
 
         if kind == "hist":
-            def f(Xb, pred, y, valid, feat, thr, leaf, dl, *bag):
+            def f(Xb, pred, y, valid, feat, thr, leaf, dl, *extra):
+                rnd, blo, bhi, gsc, hsc = parse_extra(extra)
                 return stream_ops.stream_level_hist(
                     Xb, pred, y, valid, feat, thr, leaf, dl,
                     depth=depth, n_bins=cfg.n_bins, loss=cfg.loss,
                     class_idx=class_idx, hist_impl=cfg.hist_impl,
                     input_dtype=self._input_dtype, axis_name=axis,
                     missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
-                    row_keep=row_keep_for(Xb, *bag) if bag else None,
+                    row_keep=row_keep_for(Xb.shape[0], rnd, blo, bhi),
                     comms_mode=comms_mode, comms_dtype=comms_dtype,
                     build_left=left,
+                    quantize=(quantizer_for(Xb.shape[0], rnd, blo, bhi,
+                                            gsc, hsc) if quant else None),
                 )
         elif kind == "leaf":
-            def f(Xb, pred, y, valid, feat, thr, leaf, dl, *bag):
+            def f(Xb, pred, y, valid, feat, thr, leaf, dl, *extra):
+                rnd, blo, bhi, gsc, hsc = parse_extra(extra)
                 return stream_ops.stream_leaf_gh(
                     Xb, pred, y, valid, feat, thr, leaf, dl,
                     max_depth=depth, loss=cfg.loss, class_idx=class_idx,
                     axis_name=axis,
                     missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
-                    row_keep=row_keep_for(Xb, *bag) if bag else None,
+                    row_keep=row_keep_for(Xb.shape[0], rnd, blo, bhi),
+                    quantize=(quantizer_for(Xb.shape[0], rnd, blo, bhi,
+                                            gsc, hsc) if quant else None),
                 )
         elif kind == "update":
             def f(Xb, pred, feat, thr, leaf, val, dl):
@@ -1266,13 +1347,23 @@ class TPUDevice(DeviceBackend):
                     class_idx=class_idx,
                     missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
                 )
+        elif kind == "gradstats":
+            # Quantized streaming's scale-derivation pass: resident
+            # pred/labels only — NO Xb operand, no chunk read.
+            def f(pred, y, valid, *extra):
+                rnd, blo, bhi, _, _ = parse_extra(extra)
+                return stream_ops.stream_grad_stats(
+                    pred, y, valid, loss=cfg.loss, n_classes=Cq,
+                    axis_name=axis,
+                    row_keep=row_keep_for(pred.shape[0], rnd, blo, bhi))
         elif kind == "roundstart":
             # `depth` carries the previous round's tree count (= C).
             n_prev = depth
 
             def f(Xb, pred, y, valid, *rest):
-                bag = rest[5 * n_prev:]
+                extra = rest[5 * n_prev:]
                 flat = rest[:5 * n_prev]
+                rnd, blo, bhi, _, _ = parse_extra(extra)
                 trees = tuple(
                     tuple(flat[5 * i: 5 * i + 5]) for i in range(n_prev))
                 return stream_ops.stream_round_start(
@@ -1283,8 +1374,9 @@ class TPUDevice(DeviceBackend):
                     hist_impl=cfg.hist_impl,
                     input_dtype=self._input_dtype, axis_name=axis,
                     missing_bin_value=missing_val, cat_vec=cat_vec_for(Xb),
-                    row_keep=row_keep_for(Xb, *bag) if bag else None,
+                    row_keep=row_keep_for(Xb.shape[0], rnd, blo, bhi),
                     comms_mode=comms_mode, comms_dtype=comms_dtype,
+                    grad_stats_classes=Cq if quant else 0,
                 )
         else:  # pragma: no cover
             raise ValueError(kind)
@@ -1298,25 +1390,34 @@ class TPUDevice(DeviceBackend):
             hist_spec = (lay.level_hist_scattered()
                          if self.stream_hist_comms == "reduce_scatter"
                          else lay.replicated())
-            bag_specs = lay.specs("scalar", "scalar", "scalar") \
-                if bagged else ()
+            extra_specs = ()
+            if takes_rnd:
+                extra_specs = lay.specs("scalar", "scalar", "scalar")
+            if takes_scales:
+                extra_specs = extra_specs + lay.specs("scalar", "scalar")
             pred_name = "pred" if softmax else "pred1d"
             pred_spec = lay.spec(pred_name)
             if kind == "update":
                 in_specs = lay.specs("data", pred_name) + \
                     lay.specs(*(["replicated"] * 5))
                 out_specs = pred_spec
+            elif kind == "gradstats":
+                in_specs = lay.specs(pred_name, "y", "valid") + extra_specs
+                out_specs = lay.replicated()
             elif kind == "roundstart":
                 in_specs = lay.specs("data", pred_name, "y", "valid") + \
-                    lay.specs(*(["replicated"] * (5 * depth))) + bag_specs
-                out_specs = (pred_spec, hist_spec)
+                    lay.specs(*(["replicated"] * (5 * depth))) + extra_specs
+                # Quantized roundstart returns tiny replicated stats,
+                # not a (possibly scattered) histogram.
+                out_specs = (pred_spec,
+                             lay.replicated() if quant else hist_spec)
             elif kind == "hist":
                 in_specs = lay.specs("data", pred_name, "y", "valid") + \
-                    lay.specs(*(["replicated"] * 4)) + bag_specs
+                    lay.specs(*(["replicated"] * 4)) + extra_specs
                 out_specs = hist_spec
             else:
                 in_specs = lay.specs("data", pred_name, "y", "valid") + \
-                    lay.specs(*(["replicated"] * 4)) + bag_specs
+                    lay.specs(*(["replicated"] * 4)) + extra_specs
                 out_specs = lay.replicated()
             f = mesh_lib.shard_map(f, mesh=self.mesh, in_specs=in_specs,
                               out_specs=out_specs)
@@ -1324,54 +1425,87 @@ class TPUDevice(DeviceBackend):
         # Cost registration per streamed program: op = the stream kind,
         # phase = the fit_streaming phase its dispatches run under
         # (roundstart is the fused round-start inside the hist pass;
-        # update applies finished trees to resident predictions — the
-        # device loop's predict phase).
+        # gradstats is the quantized path's scale pass under the same
+        # phase; update applies finished trees to resident predictions —
+        # the device loop's predict phase).
         stream_phase = {"hist": "hist", "leaf": "leaf",
-                        "roundstart": "hist", "update": "predict"}[kind]
+                        "roundstart": "hist", "gradstats": "hist",
+                        "update": "predict"}[kind]
         fn = costed(f"stream_{kind}", phase=stream_phase)(
             jax.jit(f, donate_argnums=donate))
         self._stream_cache[key] = fn
         return fn
 
     def _bag_args(self, rnd: int, row_start: int) -> tuple:
-        """Traced scalars for the streamed bagging hash: (round id, chunk
-        global-row base as a uint32 pair — 10B-row bases overflow
-        uint32). Empty when cfg.subsample == 1 (the compiled programs
-        take no such operands then)."""
-        if self.cfg.subsample >= 1.0:
+        """Traced scalars for the streamed bagging/rounding hashes:
+        (round id, chunk global-row base as a uint32 pair — 10B-row
+        bases overflow uint32). Empty when neither bagging nor
+        quantized gradients need them (the compiled programs take no
+        such operands then)."""
+        if self.cfg.subsample >= 1.0 and not self._grad_quant:
             return ()
         return (np.int32(rnd),
                 np.uint32(row_start & 0xFFFFFFFF),
                 np.uint32(row_start >> 32))
 
+    def _scale_args(self, quant_scales) -> tuple:
+        """The round's host-reduced quantization scales as traced f32
+        scalars (quantized streaming only — streaming.py derives them
+        from the round's gradstats pass)."""
+        if not self._grad_quant:
+            return ()
+        if quant_scales is None:
+            raise ValueError(
+                "grad_dtype != 'f32': the streamed hist/leaf ops need "
+                "the round's (gscale, hscale) — derive them from "
+                "stream_grad_stats first")
+        gs, hs = quant_scales
+        return (np.float32(gs), np.float32(hs))
+
     def stream_level_hist(self, data, pred, y: "LabelHandle", tree,
                           depth: int, class_idx: int = 0,
                           rnd: int = 0, row_start: int = 0,
-                          build_left: bool = False):
+                          build_left: bool = False, quant_scales=None):
         """Partial histogram [2^depth, F, B, 2] for one uploaded chunk
         (device handle; includes the cross-shard collective — psum, or
         the F/P reduce-scatter under split_comms=reduce_scatter, where
         the handle comes back F-sharded with zero pad columns the caller
         slices off). `tree` is the partial tree's host arrays (feature,
         threshold_bin, is_leaf, default_left). `rnd`/`row_start` feed
-        the counter-based bagging mask when cfg.subsample < 1 (ignored
-        otherwise). `build_left=True` is the streamed sibling-
+        the counter-based bagging mask when cfg.subsample < 1 and the
+        quantized-gradient rounding key when cfg.grad_dtype != 'f32'
+        (ignored otherwise). `build_left=True` is the streamed sibling-
         subtraction half-build: [2^(depth-1), F, B, 2] LEFT children
         keyed by parent slot (streaming._assemble_subtracted_level
-        recovers the right children)."""
+        recovers the right children). `quant_scales` = the round's
+        (gscale, hscale) under quantized gradients — the output is then
+        the RAW int32 partial (dequantize after the level's last
+        chunk)."""
         feat, thr, leaf, dl = tree
         return self._stream_fn("hist", depth, class_idx, left=build_left)(
             data, pred, y.y, y.valid, feat, thr, leaf, dl,
-            *self._bag_args(rnd, row_start))
+            *self._bag_args(rnd, row_start), *self._scale_args(quant_scales))
 
     def stream_leaf_gh(self, data, pred, y: "LabelHandle", tree,
                        max_depth: int, class_idx: int = 0,
-                       rnd: int = 0, row_start: int = 0):
-        """Final-level (G, H) aggregates [2^max_depth, 2] for one chunk."""
+                       rnd: int = 0, row_start: int = 0,
+                       quant_scales=None):
+        """Final-level (G, H) aggregates [2^max_depth, 2] for one chunk
+        (int32 under quantized gradients — see stream_level_hist)."""
         feat, thr, leaf, dl = tree
         return self._stream_fn("leaf", max_depth, class_idx)(
             data, pred, y.y, y.valid, feat, thr, leaf, dl,
-            *self._bag_args(rnd, row_start))
+            *self._bag_args(rnd, row_start), *self._scale_args(quant_scales))
+
+    def stream_grad_stats(self, pred, y: "LabelHandle",
+                          rnd: int = 0, row_start: int = 0):
+        """Per-class quantization stats [C, 4] (max|g|, sum|g|, max|h|,
+        sum|h|) for one chunk's resident state — quantized streaming's
+        scale-derivation pass (NO data operand: gradients need only
+        pred/labels). streaming.py max/sum-reduces the chunks and
+        derives the round's scales via ops/grad.quant_scale_np."""
+        return self._stream_fn("gradstats", 0, 0)(
+            pred, y.y, y.valid, *self._bag_args(rnd, row_start))
 
     def stream_update_pred(self, data, pred, tree_full, max_depth: int,
                            class_idx: int = 0):
@@ -1388,9 +1522,12 @@ class TPUDevice(DeviceBackend):
         """Fused round-start pass for one chunk: apply the previous
         round's finished class trees to the resident pred, then return the
         NEXT round's class-0 depth-0 histogram — one dispatch, one data
-        read (ops/stream.stream_round_start). Returns (new_pred, hist).
-        `rnd` is the NEW round (its bagging mask feeds the histogram; the
-        pred update applies to every row)."""
+        read (ops/stream.stream_round_start). Returns (new_pred, hist) —
+        or (new_pred, [C, 4] quantization stats) under cfg.grad_dtype !=
+        'f32' (the scales must exist before ANY of the round's builds, so
+        the depth-0 histogram becomes a normal quantized pass).
+        `rnd` is the NEW round (its bagging mask feeds the histogram/
+        stats; the pred update applies to every row)."""
         flat = [a for t in prev_trees for a in t]
         return self._stream_fn("roundstart", len(prev_trees), 0)(
             data, pred, y.y, y.valid, *flat,

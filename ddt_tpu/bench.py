@@ -505,6 +505,97 @@ def bench_hist_2d(
     }
 
 
+def bench_hist_quant_ab(
+    rows: int = 1_000_000,
+    features: int = 28,
+    bins: int = 255,
+    depth: int = 6,
+    iters: int = 4,
+    reps: int = 8,
+    seed: int = 0,
+    grad_dtype: str = "int8",
+) -> dict:
+    """PAIRED quantized-gradient A/B: the whole per-tree fused level
+    loop (ops/grow.grow_tree) with grad_dtype="f32" vs "int8" (or
+    "int16"), same data, same shape — the ISSUE 14 tentpole's wallclock
+    witness (docs/PERF.md "Quantized gradients"). Same statistic as
+    bench_hist_fused_ab: per-rep PAIRED ratio with the arm order
+    alternating every rep, median-of-ratios as the A/B evidence
+    (ratio_f32_over_quant > 1 means the integer path wins), min-of-reps
+    per-arm timing as the headline; throughputs are NOMINAL
+    hist-row-equivalents (rows x depth / sec) so the arms share a unit.
+
+    Both arms resolve their OWN sibling-subtraction default ('auto':
+    integer hists subtract exactly everywhere, f32 only on a real chip)
+    — the A/B measures the shipped configs, not a lab pairing. The
+    deterministic payload_ratio stamps the g/h HBM-stream byte model
+    (telemetry.counters.grad_stream_bytes — 4x int8, 2x int16): on CPU
+    the wallclock moves little (the interpreted kernel dominates), the
+    byte model is the invariant, and the chip floor
+    (HIST_QUANT_AB_FLOOR) guards the wallclock side where HBM bandwidth
+    is real."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddt_tpu.ops import grow as grow_ops
+    from ddt_tpu.utils.device import device_sync as sync
+
+    rng = np.random.default_rng(seed)
+    Xb = jnp.asarray(rng.integers(0, bins, size=(rows, features),
+                                  dtype=np.uint8))
+    g = jnp.asarray(rng.standard_normal(rows).astype(np.float32))
+    h = jnp.asarray((rng.random(rows) * 0.25).astype(np.float32))
+
+    def build(dt):
+        from ddt_tpu.ops.grow import resolve_hist_subtraction
+
+        return jax.jit(functools.partial(
+            grow_ops.grow_tree, max_depth=depth, n_bins=bins,
+            reg_lambda=1.0, min_child_weight=1e-3, min_split_gain=0.0,
+            hist_subtraction=resolve_hist_subtraction(
+                "auto", integer_hists=dt != "f32"),
+            grad_dtype=dt, quant_seed=seed))
+
+    fns = {}
+    for dt in ("f32", grad_dtype):
+        fns[dt] = build(dt)
+        sync(fns[dt](Xb, g, h).leaf_value)   # compile + first run
+
+    def bout(dt):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            tree = fns[dt](Xb, g, h)
+        sync(tree.leaf_value)
+        return (time.perf_counter() - t0) / iters
+
+    # ratio = dt_f32 / dt_quant: > 1 means the integer path wins.
+    dts, ratios = _paired_ab_reps(bout, "f32", grad_dtype, reps)
+    dt_q = min(dts[grad_dtype])
+    dt_f = min(dts["f32"])
+    bytes_f = tele_counters.grad_stream_bytes(rows, depth, "f32")
+    bytes_q = tele_counters.grad_stream_bytes(rows, depth, grad_dtype)
+    out = {
+        "kernel": "hist_quant_ab",
+        "rows": rows, "features": features, "bins": bins, "depth": depth,
+        "iters": iters, "reps": reps, "grad_dtype": grad_dtype,
+        "mrows_quant": rows * depth / dt_q / 1e6,
+        "mrows_f32": rows * depth / dt_f / 1e6,
+        "ratio_f32_over_quant": float(np.median(ratios)),
+        "grad_stream_bytes_f32": bytes_f,
+        "grad_stream_bytes_quant": bytes_q,
+        "payload_ratio": round(bytes_f / bytes_q, 3),
+    }
+    # Roofline stamp for the quantized arm: XLA's cost model at the
+    # measured per-tree wallclock (benchwatch bands the fractions; an
+    # integer path silently falling back to f32 streams shows up as an
+    # HBM-utilization jump even when wallclock drift hides it).
+    out.update(_roofline_util("hist_quant", fns[grad_dtype], (Xb, g, h),
+                              dt_q))
+    return out
+
+
 def bench_histogram_one_dispatch(
     rows: int = 1_000_000,
     features: int = 28,
@@ -1322,6 +1413,10 @@ def run_bench(kernel: str = "histogram", **kw) -> dict:
     if kernel == "hist_2d":
         keys = ("rows", "features", "bins", "depth", "iters", "seed")
         return bench_hist_2d(**{k: kw[k] for k in keys if k in kw})
+    if kernel == "hist_quant":
+        keys = ("rows", "features", "bins", "depth", "iters", "seed",
+                "grad_dtype")
+        return bench_hist_quant_ab(**{k: kw[k] for k in keys if k in kw})
     if kernel == "lut4":
         keys = ("rows", "features", "bins", "trees", "depth", "seed")
         return bench_predict_lut4_ab(

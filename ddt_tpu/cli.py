@@ -559,6 +559,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="feature slabs for the pipelined build+collective "
                          "overlap; 0 = auto (pipelined on a real TPU "
                          "mesh), 1 = off (TrainConfig.hist_comms_slabs)")
+    tp.add_argument("--grad-dtype", default="f32",
+                    choices=["f32", "int16", "int8"],
+                    help="quantized-gradient training (opt-in): g/h "
+                         "discretized once per round onto one shared "
+                         "grid with seeded stochastic "
+                         "rounding; histograms/merges run in exact "
+                         "int32 arithmetic — 4x (int8) / 2x (int16) "
+                         "less g/h HBM traffic, sibling subtraction "
+                         "exact everywhere (TrainConfig.grad_dtype)")
     tp.add_argument("--stream-chunks", type=int, default=0,
                     help="train via the streaming path (BASELINE config 5) "
                          "with the dataset split into this many chunks: "
@@ -733,7 +742,11 @@ def main(argv: list[str] | None = None) -> int:
     bp.add_argument("--kernel", default="histogram",
                     choices=["histogram", "train", "predict", "serve",
                              "registry", "hist_comms", "hist_2d",
-                             "lut4"])
+                             "hist_quant", "lut4"])
+    bp.add_argument("--grad-dtype", default=None,
+                    choices=["int8", "int16"],
+                    help="quantized arm for --kernel hist_quant "
+                         "(default int8)")
     bp.add_argument("--features", type=int, default=None,
                     help="feature count; default = each kernel's own "
                          "(28 for the narrow arms, 1024 for the wide "
@@ -872,6 +885,7 @@ def main(argv: list[str] | None = None) -> int:
             split_comms=args.split_comms,
             hist_comms_dtype=args.hist_comms_dtype,
             hist_comms_slabs=args.hist_comms_slabs,
+            grad_dtype=args.grad_dtype,
             missing_policy=args.missing,
             cat_features=cat_features,
             fused_block_rounds=args.fused_block_rounds,
@@ -1163,6 +1177,7 @@ def main(argv: list[str] | None = None) -> int:
             features=args.features, bins=args.bins, trees=args.trees,
             depth=args.depth, iters=args.iters, partitions=args.partitions,
             hist_impl=args.hist_impl, seed=args.seed,
+            grad_dtype=args.grad_dtype,
         )
         print(json.dumps(out))
         return 0

@@ -161,6 +161,24 @@ class TrainConfig:
     # MXU); this knob controls the one-hot matmul INPUT dtype — bfloat16 rides
     # the systolic array at full rate, float32 forces exact accumulation.
     matmul_input_dtype: str = "bfloat16"
+    # Quantized-gradient training (ops/grad.py; docs/PERF.md "Quantized
+    # gradients"; NEVER on by default): "int8"/"int16" discretize g/h
+    # once per (tree, output dim) onto a shared power-of-two grid —
+    # per-dim scale from psum'd max|g|/sum|g|, SEEDED stochastic
+    # rounding (unbiased, chaos-replayable: a pure function of (seed,
+    # tree, global row), never per retry attempt) — and the whole
+    # histogram pipeline then runs INTEGER: int32 VMEM accumulation,
+    # exact sibling subtraction (hist_subtraction 'auto' resolves ON
+    # everywhere — the f32-ULP caveat is gone), bit-stable int32
+    # cross-shard/chunk merges, one dequantize after the last merge.
+    # Cuts the per-level g/h HBM stream 4x (int8) / 2x (int16) and
+    # halves every level >= 1's collective payload on platforms where
+    # f32 subtraction was gated off. Split gains come from dequantized
+    # totals with a computed worst-case bound
+    # (ops/grad.grad_quant_error_bound — witnessed, not hoped).
+    # Composes with every mesh/streaming path EXCEPT the host-backend
+    # streaming loop (refused loudly) and the CPU oracle backend.
+    grad_dtype: str = "f32"     # f32 | int16 | int8
 
     # --- robustness (docs/ROBUSTNESS.md) ---
     # Path to a JSON fault-injection plan (robustness/faultplan.py); the
@@ -250,6 +268,26 @@ class TrainConfig:
             raise ValueError(
                 f"hist_comms_slabs must be >= 0 (0 = auto), got "
                 f"{self.hist_comms_slabs}"
+            )
+        if self.grad_dtype not in ("f32", "int16", "int8"):
+            raise ValueError(
+                f"grad_dtype must be f32|int16|int8, got "
+                f"{self.grad_dtype!r}"
+            )
+        if self.grad_dtype != "f32" and self.hist_comms_dtype != "f32":
+            # Refuse-loudly (ISSUE 14): quantized-gradient histograms are
+            # ALREADY integer partials on one shared grid — compressing
+            # the collective on top (bf16 rounding or int32_fixed's
+            # per-collective re-quantize) would DOUBLE-quantize, voiding
+            # the grad_quant error bound while buying nothing (the
+            # integer merge is bit-stable without help). Same guard at
+            # the wire in parallel/comms.hist_reduce.
+            raise ValueError(
+                f"grad_dtype={self.grad_dtype!r} with hist_comms_dtype="
+                f"{self.hist_comms_dtype!r} would double-quantize the "
+                "histogram collective: quantized-gradient partials are "
+                "integer values on one shared grid and merge bit-stably "
+                "as-is; keep hist_comms_dtype='f32'"
             )
         if self.predict_impl not in ("auto", "pallas", "onehot", "lut",
                                      "lut4"):

@@ -469,6 +469,12 @@ class Driver:
             # wire dtype, subtraction) — backends/tpu.py
             # collective_bytes_per_tree is the one home.
             coll_bytes_round = C * self.backend.collective_bytes_per_tree(F)
+        # Effective per-round g/h HBM stream (telemetry.counters
+        # grad_stream_bytes — the quantized-gradient byte win's witness:
+        # f32 and int8/int16 runs record their own dtype's model, so two
+        # run logs' counters carry the ratio).
+        self._grad_bytes_round = C * tele_counters.grad_stream_bytes(
+            R, cfg.max_depth, cfg.grad_dtype)
         # Per-partition attribution (the distributed flight recorder):
         # active only on mesh runs WITH a run log — it probes per-device
         # shard completion, which is a barrier on the observed handle.
@@ -580,7 +586,8 @@ class Driver:
                 tg0 = time.perf_counter()
                 with ph("grow"):
                     handle, delta = self.backend.grow_tree(
-                        data, gc, hc, feature_mask=fmask)
+                        data, gc, hc, feature_mask=fmask,
+                        tree_id=rnd * C + c)
                     self._psync(delta)
                 # Flight recorder: per-device completion of this tree's
                 # growth (hist + allreduce + gain + route). No-op unless
@@ -634,6 +641,9 @@ class Driver:
             dt = time.perf_counter() - t0
             if coll_bytes_round:
                 tele_counters.record_collective(coll_bytes_round)
+            tele_counters.record_grad_stream(self._grad_bytes_round)
+            if cfg.grad_dtype != "f32":
+                tele_counters.record_grad_quant_round()
 
             if val_score is not None:
                 if sign * val_score > best:
@@ -857,6 +867,9 @@ class Driver:
             tele_counters.record_d2h(trees.nbytes + losses.nbytes)
             if coll_bytes_round:
                 tele_counters.record_collective(coll_bytes_round * K)
+            tele_counters.record_grad_stream(self._grad_bytes_round * K)
+            if cfg.grad_dtype != "f32":
+                tele_counters.record_grad_quant_round(K)
             for k in range(K):
                 for c in range(C):
                     slot = (rnd + k) * C + c

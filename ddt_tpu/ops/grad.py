@@ -9,14 +9,94 @@ test is tests/test_ops.py::test_grad_hess_matches_oracle.
 Elementwise, so XLA fuses these into whatever consumes them; no Pallas needed.
 Internally computed in float32 (matching the NumPy oracle's effective
 precision for these formula shapes) and returned as float32.
+
+QUANTIZED GRADIENTS (cfg.grad_dtype, docs/PERF.md "Quantized gradients"):
+this module is also the one home of the per-round g/h discretization the
+fixed-point-training line (arXiv:1812.08295) and bandwidth-first GPU
+boosting (arXiv:1706.08359) motivate. Once per (tree, output dim) the
+f32 gradients round onto one shared grid:
+
+    scale = max(max|g| / qmax, 2^ceil(log2(sum|g| / 2^30)))
+    q     = clip(floor(g / scale + u), -qmax, qmax)     int8 / int16
+
+with `u` a per-(seed, tree, GLOBAL row) counter-hash uniform in [0, 1)
+(ops/sampling.uniform_jax/np — SEEDED stochastic rounding: the estimator
+is unbiased, E[q * scale] = g, and the draw is a pure function of its
+key, so chaos-harness retries and checkpoint resumes replay the exact
+bits; it can never differ per attempt). The scale terms:
+
+- max|g| / qmax keeps every row representable (|q| <= qmax by
+  construction; the clip is a no-op belt). It is taken EXACTLY — not
+  snapped to a power of two — so the full qmax range is always live
+  (a snap-up would cost as much as one effective bit, measurably
+  moving deep-node split agreement); the term is still bit-identical
+  across every trainer path because the max reduces exactly and the
+  f32 divide is IEEE-deterministic.
+- sum|g| / 2^30 caps the TOTAL quantized mass so every int32 histogram
+  accumulator, cross-chunk host accumulation, and cross-shard integer
+  merge is overflow-free BY CONSTRUCTION: floor(x + u) can overshoot
+  |x| by at most one grid step per row, so the hard worst case is
+  sum|q| <= sum|g|/scale + n_rows <= 2^30 + n_rows, which stays under
+  INT32_MAX (2^31 - 1) for any n_rows < 2^30 — and GRAD_ROW_LIMIT
+  enforces exactly that bound at quantization time (trace-time static
+  on the fused path, a loud host check on the streamed path), so no
+  DATA-dependent runtime overflow checks are needed. THIS term snaps
+  up to a power of two (frexp/ldexp, bit-identical between the jax
+  and numpy twins): f32 sums can differ by chunk/shard order ULPs
+  between paths, and the snap absorbs them (it engages only when the
+  mass term dominates — huge-row regimes).
+
+Exact-grid models (the structure-identity contract tests): pin the
+channel's max to qmax * 2^k with every value an integer multiple of
+2^k — the scale is then exactly 2^k, and quantize + dequantize are
+both exact (u < 1 strictly, so floor(int + u) never rounds; the
+power-of-two multiply is lossless for integer sums below 2^24).
+
+Downstream, histograms/node totals/leaf sums accumulate the INTEGER q's
+(ops/histogram.py int32 paths) and dequantize exactly once after the
+merge — integer sums commute, so sibling subtraction and N-way shard or
+chunk merges are bit-exact where the f32 path was ULP-tolerant.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ddt_tpu.telemetry.annotations import op_scope
+
+#: cfg.grad_dtype values (config.py validates; "f32" = quantization off).
+GRAD_DTYPES = ("f32", "int16", "int8")
+#: Symmetric quantized range per dtype (the -qmax..qmax grid; the most
+#: negative two's-complement value is deliberately unused).
+GRAD_QMAX = {"int16": 32767, "int8": 127}
+#: Bytes per quantized g (or h) value — the effective-bytes models in
+#: telemetry/counters.py read this (one home).
+GRAD_ITEMSIZE = {"f32": 4, "int16": 2, "int8": 1}
+#: int32 headroom: scale is floored so the GLOBAL sum of |q| cannot
+#: exceed this PLUS one stochastic-rounding step per row — every
+#: integer accumulator/merge in the pipeline is overflow-free by
+#: construction given GRAD_ROW_LIMIT (see module docstring).
+GRAD_SUM_CAP = 1 << 30
+#: Global-row ceiling for the overflow proof: sum|q| <= GRAD_SUM_CAP +
+#: n_rows < 2^31 - 1 requires n_rows < 2^30 (~1.07B rows — above the
+#: ISSUE 14 design envelope). quantize_gradients asserts it at trace
+#: time; the streamed scale pass checks it loudly on host.
+GRAD_ROW_LIMIT = 1 << 30
+# Per-channel seed salts for the stochastic-rounding draw: g and h (and
+# the bagging mask, which salts nothing) must not share rounding bits.
+_G_SALT = 0x67AD5C01
+_H_SALT = 0x48E55CA3
+
+
+def grad_quant_dtype(grad_dtype: str):
+    """jnp dtype for a quantized-gradient mode (validates the name)."""
+    if grad_dtype not in GRAD_QMAX:
+        raise ValueError(
+            f"grad_dtype must be one of {GRAD_DTYPES[1:]} here, got "
+            f"{grad_dtype!r}")
+    return jnp.int8 if grad_dtype == "int8" else jnp.int16
 
 
 def base_score(y: jax.Array, loss: str) -> jax.Array:
@@ -81,3 +161,209 @@ def grad_hess(
         onehot = jax.nn.one_hot(y, pred_raw.shape[1], dtype=jnp.float32)
         return p - onehot, p * (1.0 - p)
     raise ValueError(loss)
+
+
+@op_scope("leaf")
+def leaf_gh_sums(idx, active, g, h, n_last: int) -> jax.Array:
+    """[n_last, 2] per-leaf (G, H) sums via the one-hot contraction —
+    the ONE home of ops/grow's final level and ops/stream's leaf pass
+    (four call-site twins before this existed). One-hot matmul rather
+    than segment_sum: the scatter path costs ~2x20 ms at 1M rows on
+    TPU, the single [n, R]@[R, 2] matmul ~7 ms. Dtype-dispatched like
+    the histogram impls: f32 operands contract on the MXU at HIGHEST
+    precision (summation order differs from the CPU twin's row-order
+    adds by ULPs only — leaf VALUES are tolerance-compared everywhere);
+    integer (quantized-gradient) operands contract with an int32
+    accumulator — exact, order-invariant, the caller dequantizes once
+    after its collective."""
+    if jnp.issubdtype(g.dtype, jnp.integer):
+        zero = jnp.zeros((), g.dtype)
+        ga = jnp.where(active, g, zero)
+        ha = jnp.where(active, h, zero)
+        leaf_oh = (
+            idx[:, None] == jnp.arange(n_last, dtype=jnp.int32)[None, :]
+        ).astype(g.dtype)                                   # [R, n_last]
+        gh = jnp.stack([ga, ha], axis=1)                    # [R, 2]
+        return jax.lax.dot_general(
+            leaf_oh, gh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )                                                   # [n_last, 2]
+    ga = jnp.where(active, g, 0.0)
+    ha = jnp.where(active, h, 0.0)
+    leaf_oh = (
+        idx[:, None] == jnp.arange(n_last, dtype=jnp.int32)[None, :]
+    ).astype(jnp.float32)                                   # [R, n_last]
+    gh = jnp.stack([ga, ha], axis=1)                        # [R, 2]
+    return jax.lax.dot_general(
+        leaf_oh, gh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                       # [n_last, 2]
+
+
+# --------------------------------------------------------------------- #
+# quantized gradients (cfg.grad_dtype — see module docstring)
+# --------------------------------------------------------------------- #
+
+@op_scope("grad_quant")
+def quant_scale(max_abs, sum_abs, grad_dtype: str):
+    """Quantization step (traced f32 scalar) for values bounded by
+    `max_abs` with total mass `sum_abs` — the jax twin of quant_scale_np
+    (bit-identical: exact max reduce, IEEE f32 divide, frexp/ldexp on
+    the snapped overflow-cap term; see the module docstring for why the
+    range term is exact and only the cap term snaps). All-zero channels
+    (max_abs == 0 and sum_abs == 0) get scale 1.0 — every q is 0."""
+    qmax = GRAD_QMAX[grad_dtype]
+    base = jnp.asarray(max_abs, jnp.float32) / jnp.float32(qmax)
+    raw_cap = jnp.asarray(sum_abs, jnp.float32) / jnp.float32(GRAD_SUM_CAP)
+    m, e = jnp.frexp(raw_cap)
+    # ceil(log2(x)): frexp gives x = m * 2^e with m in [0.5, 1);
+    # m == 0.5 (x an exact power of two) snaps to e - 1 = log2(x).
+    e = e - (m == jnp.float32(0.5))
+    cap = jnp.where(raw_cap > 0, jnp.ldexp(jnp.float32(1.0), e),
+                    jnp.float32(0.0))
+    scale = jnp.maximum(base, cap)
+    return jnp.where(scale > 0, scale, jnp.float32(1.0))
+
+
+def quant_scale_np(max_abs: float, sum_abs: float,
+                   grad_dtype: str) -> np.float32:
+    """Host twin of quant_scale (the streaming trainers derive the
+    round's scale from chunk-reduced stats here; tests cross-check)."""
+    qmax = GRAD_QMAX[grad_dtype]
+    base = np.float32(max_abs) / np.float32(qmax)
+    raw_cap = np.float32(sum_abs) / np.float32(GRAD_SUM_CAP)
+    cap = np.float32(0.0)
+    if raw_cap > 0:
+        m, e = np.frexp(raw_cap)
+        cap = np.ldexp(np.float32(1.0), int(e) - int(m == np.float32(0.5)))
+    scale = np.maximum(base, cap)
+    return scale if scale > 0 else np.float32(1.0)
+
+
+@op_scope("grad_quant")
+def grad_abs_stats(g, h, allreduce=lambda x: x, allmax=lambda x: x):
+    """(max|g|, sum|g|, max|h|, sum|h|) as traced f32 scalars, reduced
+    over the row mesh by the caller-bound collectives (identity on one
+    shard). max is exact under any reduction order; the f32 sum's
+    shard/chunk order can differ between trainer paths by ULPs, which
+    the power-of-two snap absorbs except at exact frexp boundaries
+    (documented in docs/PERF.md "Quantized gradients")."""
+    ag = jnp.abs(g.astype(jnp.float32))
+    ah = jnp.abs(h.astype(jnp.float32))
+    return (allmax(jnp.max(ag)), allreduce(jnp.sum(ag)),
+            allmax(jnp.max(ah)), allreduce(jnp.sum(ah)))
+
+
+@op_scope("grad_quant")
+def quantize_with_scales(g, h, gscale, hscale, *, grad_dtype: str,
+                         tree_id, seed: int, local_offset,
+                         row_start_lo=None, row_start_hi=None):
+    """(qg, qh) int8/int16 [R] from f32 gradients and a PRE-DERIVED pair
+    of scales (quant_scale) — the streamed trainers' entry point (their
+    scale is host-reduced across chunks; the fused path's
+    quantize_gradients derives it in-trace and calls this).
+
+    Stochastic rounding: q = floor(g / scale + u) with u the
+    per-(seed ^ channel salt, tree_id, GLOBAL row) counter-hash uniform
+    (ops/sampling.uniform_jax) — unbiased, replayable, shard-layout
+    invariant (row ids are global, so resharding/rotation changes no
+    bit). `tree_id` is the traced ABSOLUTE tree index (round * n_classes
+    + class — the per-output-dim key); `local_offset`/`row_start_lo/hi`
+    follow the sampling-hash conventions. On-grid values (g an exact
+    integer multiple of scale) quantize exactly: u < 1 strictly, so
+    floor(int + u) == int — the exact-grid contract's mechanism."""
+    from ddt_tpu.ops import sampling
+
+    qmax = GRAD_QMAX[grad_dtype]
+    dt = grad_quant_dtype(grad_dtype)
+    n = g.shape[0]
+    ug = sampling.uniform_jax(tree_id, local_offset, n,
+                              seed=seed ^ _G_SALT,
+                              row_start_lo=row_start_lo,
+                              row_start_hi=row_start_hi)
+    uh = sampling.uniform_jax(tree_id, local_offset, n,
+                              seed=seed ^ _H_SALT,
+                              row_start_lo=row_start_lo,
+                              row_start_hi=row_start_hi)
+    fq = jnp.float32(qmax)
+    qg = jnp.clip(jnp.floor(g.astype(jnp.float32) / gscale + ug), -fq, fq)
+    qh = jnp.clip(jnp.floor(h.astype(jnp.float32) / hscale + uh), -fq, fq)
+    return qg.astype(dt), qh.astype(dt)
+
+
+def quantize_gradients(g, h, *, grad_dtype: str, tree_id, seed: int,
+                       local_offset, row_start_lo=None, row_start_hi=None,
+                       allreduce=lambda x: x, allmax=lambda x: x,
+                       n_rows_global: "int | None" = None):
+    """One tree's full quantization step, in-trace (the fused/granular
+    grow path — ops/grow.grow_tree): per-output-dim scales from the
+    psum'd/pmax'd |g|,|h| stats, then seeded stochastic rounding.
+    Returns (qg, qh, gscale, hscale); dequantize any integer aggregate A
+    of the q's as A * scale — exactly once, after every merge.
+    `n_rows_global` (static; defaults to the local row count) feeds the
+    overflow proof's row ceiling — past GRAD_ROW_LIMIT the sum-cap no
+    longer guarantees int32 headroom, so we refuse at trace time."""
+    if n_rows_global is None:
+        n_rows_global = g.shape[0]
+    if n_rows_global >= GRAD_ROW_LIMIT:
+        raise ValueError(
+            f"quantized gradients over {n_rows_global} rows exceed the "
+            f"int32 overflow proof's row ceiling ({GRAD_ROW_LIMIT}): "
+            "sum|q| <= 2^30 + n_rows must stay under INT32_MAX (see "
+            "ops/grad.py); shard the rows or use grad_dtype='f32'")
+    mg, sg, mh, sh = grad_abs_stats(g, h, allreduce=allreduce,
+                                    allmax=allmax)
+    gscale = quant_scale(mg, sg, grad_dtype)
+    hscale = quant_scale(mh, sh, grad_dtype)
+    qg, qh = quantize_with_scales(
+        g, h, gscale, hscale, grad_dtype=grad_dtype, tree_id=tree_id,
+        seed=seed, local_offset=local_offset,
+        row_start_lo=row_start_lo, row_start_hi=row_start_hi)
+    return qg, qh, gscale, hscale
+
+
+def quantize_gradients_np(g: np.ndarray, h: np.ndarray, *,
+                          grad_dtype: str, tree_id: int, seed: int,
+                          row_start: int = 0,
+                          gscale=None, hscale=None):
+    """Host twin of quantize_gradients/quantize_with_scales (reference
+    for the bit-identity tests; scales derived from this array's stats
+    when not given). Returns (qg, qh, gscale, hscale)."""
+    from ddt_tpu.ops import sampling
+
+    qmax = GRAD_QMAX[grad_dtype]
+    npdt = np.int8 if grad_dtype == "int8" else np.int16
+    g = np.asarray(g, np.float32)
+    h = np.asarray(h, np.float32)
+    if gscale is None:
+        gscale = quant_scale_np(np.max(np.abs(g), initial=0.0),
+                                np.sum(np.abs(g)), grad_dtype)
+    if hscale is None:
+        hscale = quant_scale_np(np.max(np.abs(h), initial=0.0),
+                                np.sum(np.abs(h)), grad_dtype)
+    n = g.shape[0]
+    ug = sampling.uniform_np(seed ^ _G_SALT, tree_id, row_start, n)
+    uh = sampling.uniform_np(seed ^ _H_SALT, tree_id, row_start, n)
+    fq = np.float32(qmax)
+    qg = np.clip(np.floor(g / np.float32(gscale) + ug), -fq, fq)
+    qh = np.clip(np.floor(h / np.float32(hscale) + uh), -fq, fq)
+    return qg.astype(npdt), qh.astype(npdt), gscale, hscale
+
+
+def grad_quant_error_bound(grad_dtype: str, max_abs: float,
+                           sum_abs: float, n_rows: int) -> float:
+    """Worst-case ABSOLUTE error any integer aggregate of quantized
+    gradients (a histogram entry, node total, or leaf sum over up to
+    `n_rows` rows) can carry vs the exact f32 sum — the predict_lut
+    pattern: a COMPUTED bound the contract tests hold measured
+    deviations under, not a hope.
+
+    Each row's stochastic rounding lands within ONE grid step of its
+    value (floor(x + u) in (x - 1, x + 1)), steps sum exactly in the
+    integer domain, and the single dequantize multiply rounds once in
+    f32 — so: n_rows * scale from the rounding, plus eps_f32 times the
+    worst-case dequantized magnitude (sum_abs + n_rows * scale)."""
+    scale = float(quant_scale_np(max_abs, sum_abs, grad_dtype))
+    rounding = n_rows * scale
+    return rounding + 2.0 ** -23 * (float(sum_abs) + rounding)

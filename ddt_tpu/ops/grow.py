@@ -49,6 +49,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ddt_tpu.ops import grad as grad_ops
 from ddt_tpu.ops import histogram as H
 from ddt_tpu.ops import split as S
 from ddt_tpu.parallel import comms
@@ -64,7 +65,8 @@ from ddt_tpu.telemetry.annotations import traced_scope
 # runtime cost — named scopes are HLO metadata, not ops.
 
 
-def resolve_hist_subtraction(flag: str, platform: str | None = None) -> bool:
+def resolve_hist_subtraction(flag: str, platform: str | None = None,
+                             integer_hists: bool = False) -> bool:
     """cfg.hist_subtraction ('auto'|'on'|'off') -> bool for this platform.
 
     'auto' enables the sibling-subtraction trick only on a real TPU chip:
@@ -73,7 +75,14 @@ def resolve_hist_subtraction(flag: str, platform: str | None = None) -> bool:
     the bf16 gain rounding in almost every decision, but would break the
     streamed == in-memory BITWISE contracts the CPU fixed-seed suites
     assert (ops/split.py's determinism-boundary notes). Off-chip runs and
-    oracles therefore default off; tests opt in with 'on'."""
+    oracles therefore default off; tests opt in with 'on'.
+
+    `integer_hists=True` (the quantized-gradient path, cfg.grad_dtype):
+    parent - left is EXACT in the int32 domain — the f32-ULP caveat that
+    forced the platform gate does not exist there — so 'auto' resolves
+    ON everywhere: half the kernel work and half the collective payload
+    per level >= 1, with the streamed == in-memory contracts intact
+    ('off' still forces it off)."""
     if flag == "on":
         return True
     if flag == "off":
@@ -81,6 +90,8 @@ def resolve_hist_subtraction(flag: str, platform: str | None = None) -> bool:
     if flag != "auto":
         raise ValueError(
             f"hist_subtraction must be auto|on|off, got {flag!r}")
+    if integer_hists:
+        return True
     if platform is None:
         platform = jax.default_backend()
     return platform == "tpu"
@@ -185,8 +196,11 @@ def level_histograms(
     hist_left = build_reduced(li, half)
     with traced_scope("hist:subtract"):
         gate = parent_split.reshape(half, 1, 1, 1)
+        # Dtype-generic zero: on the quantized path the carry and the
+        # left build are int32 and the subtraction is EXACT (integer
+        # adds commute) — the f32-ULP right-child caveat vanishes.
         hist_right = jnp.where(gate, parent_hist - hist_left,
-                               jnp.float32(0.0))
+                               jnp.zeros((), hist_left.dtype))
         # Interleave [half, {left,right}, F, B, 2] -> level order
         # (left child = 2p, right child = 2p + 1).
         hist = jnp.stack([hist_left, hist_right], axis=1)
@@ -250,6 +264,17 @@ def grow_tree(
     #   into this many feature slabs so slab k+1's kernels overlap slab
     #   k's wire time. 1 = monolithic; f32/bf16 phasing is bit-identical
     #   either way (int32_fixed: see level_histograms).
+    grad_dtype: str = "f32",         # cfg.grad_dtype: "int8"/"int16"
+    #   quantizes g/h ONCE per tree onto a shared power-of-two grid
+    #   (ops/grad.quantize_gradients — per-output-dim scale from psum'd
+    #   |g|,|h| stats, seeded stochastic rounding) and runs the whole
+    #   level loop in the integer domain: int32 histograms, exact
+    #   sibling subtraction, bit-stable integer merges, ONE dequantize
+    #   per level just before the gain epilogue.
+    quant_tree_id=None,              # traced int32 ABSOLUTE tree index
+    #   (round * n_classes + class) — the stochastic-rounding key's
+    #   per-tree component; None = 0 (single-shot callers/benches).
+    quant_seed: int = 0,             # cfg.seed (static rounding key part)
 ) -> TreeArrays:
     """Grow one complete-heap tree. Trace under jit (and shard_map if
     axis_name is set). Matches reference/numpy_trainer.grow_tree decisions.
@@ -300,6 +325,26 @@ def grow_tree(
             hs, axis_name,
             mode="reduce_scatter" if rs else "allreduce",
             comms_dtype=hist_comms_dtype, scatter_dim=1)
+
+    # Quantized gradients (cfg.grad_dtype; docs/PERF.md "Quantized
+    # gradients"): ONE in-trace quantization per tree — per-output-dim
+    # scales from psum'd/pmax'd |g|,|h| stats (ops/grad.quant_scale),
+    # then seeded stochastic rounding keyed by (seed, tree, GLOBAL row
+    # id) so chaos retries, resharding and resumes replay identical
+    # bits. Every consumer below (histograms, node totals, leaf sums)
+    # accumulates the INTEGER q's and dequantizes exactly once after
+    # its merge.
+    quant = grad_dtype != "f32"
+    gscale = hscale = scale2 = None
+    if quant:
+        tid = quant_tree_id if quant_tree_id is not None else jnp.int32(0)
+        g, h, gscale, hscale = grad_ops.quantize_gradients(
+            g, h, grad_dtype=grad_dtype, tree_id=tid, seed=quant_seed,
+            local_offset=comms.flat_axis_index(axis_name) * R,
+            allreduce=allreduce,
+            allmax=lambda x: comms.pmax(x, axis_name),
+            n_rows_global=R * comms.axis_size(axis_name))
+        scale2 = jnp.stack([gscale, hscale])      # [..., 2] dequant vector
 
     # Local->global column map of this shard's reduce-scattered slab:
     # slab s of width w contributes wp/P_row contiguous columns per
@@ -354,19 +399,43 @@ def grow_tree(
             )
             if feature_axis_name is None and not rs:
                 G, Hh = S.node_totals(hist)
+                if quant:
+                    # Integer bin sums, dequantized ONCE — exact.
+                    G = G.astype(jnp.float32) * gscale
+                    Hh = Hh.astype(jnp.float32) * hscale
             else:
                 # Node totals from the row vectors, not the histogram:
                 # local histograms hold different COLUMNS per shard, so
                 # their bin sums agree only up to float add order — this
                 # form is bit-identical (and provably feature-axis-
-                # invariant) on every shard.
+                # invariant) on every shard. On the quantized path the
+                # segment sums run int32 (exact under ANY order, so the
+                # histogram form would agree too — this one stays for
+                # symmetry with the f32 path).
                 act = node_index >= 0
                 seg = jnp.clip(node_index, 0, n_level - 1)
-                G = allreduce(jax.ops.segment_sum(
-                    jnp.where(act, g, 0.0), seg, num_segments=n_level))
-                Hh = allreduce(jax.ops.segment_sum(
-                    jnp.where(act, h, 0.0), seg, num_segments=n_level))
+                if quant:
+                    zq = jnp.zeros((), g.dtype)
+                    G = allreduce(jax.ops.segment_sum(
+                        jnp.where(act, g, zq).astype(jnp.int32), seg,
+                        num_segments=n_level)).astype(jnp.float32) * gscale
+                    Hh = allreduce(jax.ops.segment_sum(
+                        jnp.where(act, h, zq).astype(jnp.int32), seg,
+                        num_segments=n_level)).astype(jnp.float32) * hscale
+                else:
+                    G = allreduce(jax.ops.segment_sum(
+                        jnp.where(act, g, 0.0), seg, num_segments=n_level))
+                    Hh = allreduce(jax.ops.segment_sum(
+                        jnp.where(act, h, 0.0), seg, num_segments=n_level))
             with traced_scope("gain"):
+                # The ONE dequantize per level (quantized path): the
+                # int32 histogram — post-collective, post-subtraction —
+                # becomes f32 only here, feeding the gain epilogue; the
+                # sibling-subtraction carry below keeps the INTEGER
+                # form so next level's parent - left stays exact.
+                hist_q = hist
+                if quant:
+                    hist = hist.astype(jnp.float32) * scale2
                 if rs:
                     # Slab-local split finding: masks gather down to this
                     # shard's columns (padded ids >= F are invalid), the
@@ -507,35 +576,31 @@ def grow_tree(
                                     2 * node_id + 1 + go_right, node_id)
                 frozen = frozen | ~split_here
 
-        # Carry for the next level's sibling subtraction.
+        # Carry for the next level's sibling subtraction (the integer
+        # form on the quantized path — subtraction must stay exact).
         if hist_subtraction:
-            prev_hist = hist
+            prev_hist = hist_q if quant else hist
             prev_split = do_split
 
-    # Final level: leaf values from per-terminal-node (G, H) aggregates —
-    # via one-hot matmul (MXU, f32 HIGHEST) rather than segment_sum: the
-    # scatter path costs ~2x20 ms at 1M rows on TPU, the single [n, R]@[R, 2]
-    # matmul ~7 ms. Summation order differs from the CPU twin's row-order
-    # adds by ULPs only; leaf VALUES are tolerance-compared everywhere
-    # (tree STRUCTURE never depends on this level).
+    # Final level: leaf values from per-terminal-node (G, H) aggregates
+    # via the shared one-hot contraction (grad_ops.leaf_gh_sums — the
+    # one home; rationale and numerics notes live on it). On the
+    # quantized path the contraction is an exact int32 sum, the psum an
+    # exact integer merge, and the dequantize happens once after it —
+    # leaf (G, H) are bitwise shard- and order-invariant where the f32
+    # form differed from the CPU twin by ULPs.
     with traced_scope("leaf"):
         offset = (1 << max_depth) - 1
         n_last = 1 << max_depth
         active = ~frozen
         idx = jnp.clip(node_id - offset, 0, n_last - 1)
-        ga = jnp.where(active, g, 0.0)
-        ha = jnp.where(active, h, 0.0)
-        leaf_oh = (
-            idx[:, None] == jnp.arange(n_last, dtype=jnp.int32)[None, :]
-        ).astype(jnp.float32)                                   # [R, n_last]
-        gh = jnp.stack([ga, ha], axis=1)                        # [R, 2]
-        GH = jax.lax.dot_general(
-            leaf_oh, gh, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                       # [n_last, 2]
-        Gl = allreduce(GH[:, 0])
-        Hl = allreduce(GH[:, 1])
+        GH = grad_ops.leaf_gh_sums(idx, active, g, h, n_last)
+        if quant:
+            Gl = allreduce(GH[:, 0]).astype(jnp.float32) * gscale
+            Hl = allreduce(GH[:, 1]).astype(jnp.float32) * hscale
+        else:
+            Gl = allreduce(GH[:, 0])
+            Hl = allreduce(GH[:, 1])
         vals = jnp.where(Hl > 0, -Gl / (Hl + reg_lambda), 0.0)
         sl = slice(offset, offset + n_last)
         is_leaf = is_leaf.at[sl].set(True)

@@ -29,9 +29,26 @@ on-chip:
       flushed to the output block (ONE HBM write per feature slab) at the
       final grid step.
 
-HBM traffic per build: R x F uint8 + 12 bytes/row of g/h/ni + the [N, F,
-B, 2] output — nothing else. No prologue materialisation, no per-slab
-re-stream of row-sized state (chunked slabs re-read only g/h/ni).
+HBM traffic per build: R x F uint8 + (2 * grad itemsize + 4) bytes/row
+of g/h/ni + the [N, F, B, 2] output — nothing else. No prologue
+materialisation, no per-slab re-stream of row-sized state (chunked
+slabs re-read only g/h/ni). The g/h itemsize is DTYPE-PARAMETERIZED
+(ISSUE 14): f32 gradients stream 12 B/row of g/h/ni; quantized int16
+streams 8 B/row and int8 6 B/row — the pallas_fits budget and the
+CostEstimate read the actual operand dtypes, never a hard-coded 12.
+
+INTEGER ACCUMULATION (cfg.grad_dtype, docs/PERF.md "Quantized
+gradients"): when g/h arrive QUANTIZED (int8/int16 from
+ops/grad.quantize_gradients), the whole kernel runs in the integer
+domain — A and the bin one-hot are built in the gradient dtype, the
+dot_general accumulates with preferred_element_type=int32 into an int32
+VMEM scratch (s8 x s8 -> s32 is MXU-native), and the flushed output is
+the RAW int32 histogram. Integer adds commute, so the result is
+bitwise independent of tile order, feature chunking, sibling
+subtraction, and shard merge order; the caller dequantizes exactly once
+(hist * scale) after the last merge. The scratch/output itemsize is
+unchanged (int32 == f32 at 4 B), so the VMEM budget arithmetic is
+shared with the f32 path.
 
 Two kernel forms (dispatch on the padded bin width, sweep-9/10 measured):
 row-major (`_hist_kernel`, bins_pad >= 256) builds OH [T, F*Bp] with bins
@@ -103,33 +120,56 @@ def pallas_fits(
     n_bins: int,
     tile_r: int | None = None,
     input_bytes: int = 2,
+    grad_bytes: int = 4,
+    acc_bytes: int = 4,
 ) -> bool:
     """Whether the kernel's VMEM working set fits at this shape (the shape
     guard behind hist_impl='auto' — ops/histogram.resolve_hist_impl).
     tile_r=None sizes for the tile the dispatcher will actually run.
-    input_bytes is the one-hot operand itemsize (2 bf16, 4 f32)."""
+
+    The budget is computed from the ACTUAL operand itemsizes, never
+    hard-coded f32 (ISSUE 14): `input_bytes` is the one-hot/A operand
+    itemsize (2 bf16, 4 f32; 1/2 on the quantized int8/int16 path),
+    `grad_bytes` the streamed g/h row itemsize (4 f32, 2 int16, 1 int8),
+    `acc_bytes` the scratch/output accumulator itemsize (4 for both f32
+    and the quantized path's int32 — asserted, not assumed)."""
+    assert acc_bytes == 4, (
+        "the VMEM accumulator is f32 or int32 — both 4 B; a new "
+        "accumulator dtype must re-derive this budget")
     if tile_r is None:
         tile_r = _default_tile_r(n_bins)
     fbp = n_features * _bins_pad(n_bins)
     oh_bytes = tile_r * fbp * input_bytes
+    # Streamed per-tile row operands (g, h, ni blocks) — tiny next to
+    # the one-hot, but dtype-parameterized like everything else.
+    row_bytes = tile_r * (2 * grad_bytes + 4)
     # Scratch accumulator + the output block it flushes into: both live
     # in VMEM for the whole kernel.
-    acc_bytes = 2 * (2 * n_nodes * fbp * 4)
-    return oh_bytes + acc_bytes <= _VMEM_BUDGET_BYTES
+    acc_total = 2 * (2 * n_nodes * fbp * acc_bytes)
+    return oh_bytes + row_bytes + acc_total <= _VMEM_BUDGET_BYTES
 
 
 def _weighted_node_onehot(ni, g, h, n_nodes: int, input_dtype):
     """A [T, 2N]: node one-hot weighted by g then h, built on the VPU.
     ni = -1 (frozen / pad rows) matches no column — the masking prologue
-    the old kernel needed is free here."""
+    the old kernel needed is free here. Dtype-generic: on the quantized
+    path g/h are int8/int16 and A stays in that dtype (the weights fit
+    by the |q| <= qmax construction), so the dot runs integer."""
     tile_r = ni.shape[0]
     noh = ni[:, None] == jax.lax.broadcasted_iota(
         jnp.int32, (tile_r, n_nodes), 1)
-    zero = jnp.float32(0.0)
+    zero = jnp.zeros((), g.dtype)
     return jnp.concatenate(
         [jnp.where(noh, g[:, None], zero), jnp.where(noh, h[:, None], zero)],
         axis=1,
     ).astype(input_dtype)                                 # [T, 2N]
+
+
+def _acc_dtype(input_dtype):
+    """Accumulator dtype for an operand dtype: int32 on the quantized
+    integer path (exact adds), f32 otherwise (the MXU's native form)."""
+    return (jnp.int32 if jnp.issubdtype(jnp.dtype(input_dtype), jnp.integer)
+            else jnp.float32)
 
 
 def _hist_kernel(xb_ref, g_ref, h_ref, ni_ref, out_ref, acc_ref, *,
@@ -163,7 +203,7 @@ def _hist_kernel(xb_ref, g_ref, h_ref, ni_ref, out_ref, acc_ref, *,
     acc_ref[:] += jax.lax.dot_general(
         A, oh,
         (((0,), (0,)), ((), ())),                         # contract rows
-        preferred_element_type=jnp.float32,
+        preferred_element_type=_acc_dtype(input_dtype),
     )
 
     @pl.when(step == pl.num_programs(0) - 1)
@@ -205,7 +245,7 @@ def _hist_kernel_t(xt_ref, g_ref, h_ref, ni_ref, out_ref, acc_ref, *,
     acc_ref[:] += jax.lax.dot_general(
         oh, A,
         (((1,), (0,)), ((), ())),                         # contract rows
-        preferred_element_type=jnp.float32,
+        preferred_element_type=_acc_dtype(input_dtype),
     )
 
     @pl.when(step == pl.num_programs(0) - 1)
@@ -215,16 +255,18 @@ def _hist_kernel_t(xt_ref, g_ref, h_ref, ni_ref, out_ref, acc_ref, *,
 
 def feature_chunks_for(n_nodes: int, n_features: int, n_bins: int,
                        tile_r: int | None = None,
-                       input_bytes: int = 2) -> int | None:
+                       input_bytes: int = 2,
+                       grad_bytes: int = 4) -> int | None:
     """Smallest number of feature chunks whose per-chunk working set fits
     the kernel's VMEM budget, or None if even one feature does not fit
     (then the caller must use the matmul path). input_bytes is the one-hot
-    operand's itemsize (2 for bfloat16, 4 for float32)."""
+    operand's itemsize (2 bfloat16, 4 float32, 1/2 quantized int8/int16);
+    grad_bytes the g/h row itemsize (see pallas_fits)."""
     if tile_r is None:
         tile_r = _default_tile_r(n_bins)
     for k in range(1, n_features + 1):
         if pallas_fits(n_nodes, -(-n_features // k), n_bins, tile_r,
-                       input_bytes):
+                       input_bytes, grad_bytes):
             return k
     return None
 
@@ -240,28 +282,35 @@ def build_histograms_pallas(
     interpret: bool | None = None,
     input_dtype=jnp.bfloat16,
 ) -> jax.Array:
-    """Pallas HistogramBuilder: [n_nodes, F, n_bins, 2] float32.
+    """Pallas HistogramBuilder: [n_nodes, F, n_bins, 2] float32 — or RAW
+    int32 when g/h arrive quantized (int8/int16; the caller dequantizes
+    once after the last merge — see the module docstring's integer
+    section).
 
     interpret=None auto-selects Pallas interpreter mode off-TPU (CPU tests
     exercise the identical kernel logic; the compiled path needs a real
     chip). input_dtype is the A/one-hot operand dtype: bfloat16 rides the MXU
     at full rate; float32 buys exact accumulation at reduced rate (same knob
-    as the matmul path — cfg.matmul_input_dtype).
+    as the matmul path — cfg.matmul_input_dtype). Quantized g/h OVERRIDE it
+    with their own dtype (s8/s16 operands, s32 accumulation — exact).
 
     Shapes whose VMEM working set overflows the budget (deep levels:
     n_nodes >= 32 at 255 bins) are feature-CHUNKED: one pallas_call per
     column slab, outputs concatenated — exact (columns are independent),
-    and since the rewrite a slab re-reads only its own Xb columns plus the
-    12 bytes/row of g/h/ni, so chunking stays far above the matmul
-    fallback.
+    and since the rewrite a slab re-reads only its own Xb columns plus
+    2 * grad-itemsize + 4 bytes/row of g/h/ni, so chunking stays far
+    above the matmul fallback.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if tile_r is None:
         tile_r = _default_tile_r(n_bins)
-    dt = jnp.dtype(input_dtype)
+    quant = jnp.issubdtype(jnp.dtype(g.dtype), jnp.integer)
+    dt = jnp.dtype(g.dtype) if quant else jnp.dtype(input_dtype)
     F = Xb.shape[1]
-    k = feature_chunks_for(n_nodes, F, n_bins, tile_r, dt.itemsize)
+    grad_bytes = dt.itemsize if quant else 4
+    k = feature_chunks_for(n_nodes, F, n_bins, tile_r, dt.itemsize,
+                           grad_bytes)
     if k is None:
         raise ValueError(
             f"histogram shape (n_nodes={n_nodes}, n_bins={n_bins}) exceeds "
@@ -294,16 +343,20 @@ def _build_histograms_pallas(
 ) -> jax.Array:
     R, F = Xb.shape
     bins_pad = _bins_pad(n_bins)
+    quant = jnp.issubdtype(jnp.dtype(input_dtype), jnp.integer)
+    acc_dtype = _acc_dtype(input_dtype)
 
     # Stream prologue (XLA, cheap): pad rows to a tile multiple and fold
     # the per-row vectors to [n_tiles, tile_r] blocks. Pad rows carry
     # ni = -1, so they match no node column in-kernel — no weighted
     # one-hot, no int32 input copy, nothing row-sized materialises.
+    # Quantized g/h keep their narrow dtype on the stream (the whole
+    # point: 1-2 bytes/row instead of 4 per channel).
     n_tiles = -(-R // tile_r)
     pad = n_tiles * tile_r - R
     Xp = Xb
-    gz = g.astype(jnp.float32)
-    hz = h.astype(jnp.float32)
+    gz = g if quant else g.astype(jnp.float32)
+    hz = h if quant else h.astype(jnp.float32)
     ni = node_index.astype(jnp.int32)
     if pad:
         Xp = jnp.pad(Xp, ((0, pad), (0, 0)))
@@ -319,9 +372,13 @@ def _build_histograms_pallas(
 
     def slab(Xs):
         Fs = Xs.shape[1]
+        # bytes_accessed from the ACTUAL operand dtypes: uint8 Xb, g/h at
+        # their streamed itemsize (4 f32, 2 int16, 1 int8), int32 ni, and
+        # the 4 B/entry (f32 or int32) output — never a hard-coded 12.
+        row_bytes = 2 * jnp.dtype(gz.dtype).itemsize + 4
         cost = pl.CostEstimate(
             flops=2 * 2 * n_nodes * Fs * bins_pad * n_tiles * tile_r,
-            bytes_accessed=R * Fs + R * 12
+            bytes_accessed=R * Fs + R * row_bytes
             + 2 * n_nodes * Fs * bins_pad * 4,
             transcendentals=0,
         )
@@ -344,10 +401,10 @@ def _build_histograms_pallas(
                         memory_space=pltpu.VMEM,
                     ),
                     out_shape=jax.ShapeDtypeStruct(
-                        (Fs * bins_pad, 2 * n_nodes), jnp.float32),
+                        (Fs * bins_pad, 2 * n_nodes), acc_dtype),
                     scratch_shapes=[
                         pltpu.VMEM((Fs * bins_pad, 2 * n_nodes),
-                                   jnp.float32),
+                                   acc_dtype),
                     ],
                     cost_estimate=cost,
                     interpret=interpret,
@@ -374,9 +431,9 @@ def _build_histograms_pallas(
                     memory_space=pltpu.VMEM,
                 ),
                 out_shape=jax.ShapeDtypeStruct((2 * n_nodes, Fs * bins_pad),
-                                               jnp.float32),
+                                               acc_dtype),
                 scratch_shapes=[
-                    pltpu.VMEM((2 * n_nodes, Fs * bins_pad), jnp.float32),
+                    pltpu.VMEM((2 * n_nodes, Fs * bins_pad), acc_dtype),
                 ],
                 cost_estimate=cost,
                 interpret=interpret,

@@ -29,6 +29,17 @@ implementations (SURVEY.md §7 "hard parts (a)"):
 
 All return bit-identical shapes and (up to float addition order) the same
 values; parity vs the NumPy oracle is tests/test_ops.py.
+
+QUANTIZED INTEGER PATH (cfg.grad_dtype, docs/PERF.md "Quantized
+gradients"): int8/int16 g/h (ops/grad.quantize_gradients) dispatch the
+same three implementations in the INTEGER domain — int32 accumulators,
+s8/s16 operands on the MXU path — and return the RAW int32 histogram.
+Integer adds commute, so all three impls are bitwise IDENTICAL to each
+other (not merely up to addition order) and to any chunked/sharded
+merge of themselves; the caller dequantizes exactly once (hist * scale)
+after its last merge. Overflow is impossible by the quantizer's
+sum-cap construction plus its enforced row ceiling
+(ops/grad.GRAD_SUM_CAP / GRAD_ROW_LIMIT).
 """
 
 from __future__ import annotations
@@ -45,9 +56,14 @@ from ddt_tpu.telemetry.costmodel import costed
 def _mask_inactive(
     g: jax.Array, h: jax.Array, node_index: jax.Array
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Zero out frozen rows (node_index < 0) and clamp their index to 0."""
+    """Zero out frozen rows (node_index < 0) and clamp their index to 0.
+    Dtype-preserving on the quantized integer path (int8/int16 g/h stay
+    narrow — the whole point of the stream); floats normalize to f32."""
     active = node_index >= 0
     idx = jnp.where(active, node_index, 0).astype(jnp.int32)
+    if jnp.issubdtype(g.dtype, jnp.integer):
+        zero = jnp.zeros((), g.dtype)
+        return jnp.where(active, g, zero), jnp.where(active, h, zero), idx
     gz = jnp.where(active, g, 0.0).astype(jnp.float32)
     hz = jnp.where(active, h, 0.0).astype(jnp.float32)
     return gz, hz, idx
@@ -69,6 +85,13 @@ def build_histograms_segment(
     n_bins: int,
 ) -> jax.Array:
     gz, hz, idx = _mask_inactive(g, h, node_index)
+    if jnp.issubdtype(gz.dtype, jnp.integer):
+        # Quantized path: widen to the int32 accumulator FIRST (a
+        # segment_sum in int8/int16 would wrap) — the scatter-adds are
+        # then exact and order-independent; output is the RAW int32
+        # histogram the caller dequantizes after its last merge.
+        gz = gz.astype(jnp.int32)
+        hz = hz.astype(jnp.int32)
     keys = idx[:, None] * n_bins + Xb.astype(jnp.int32)       # [R, F]
     num = n_nodes * n_bins
 
@@ -95,7 +118,34 @@ def _hist_chunk_matmul(
     n_bins: int,
     input_dtype: jnp.dtype,
 ) -> jax.Array:
-    """One row-chunk's histogram via outer-product matmuls: [F, 2N, B] f32."""
+    """One row-chunk's histogram via outer-product matmuls: [F, 2N, B]
+    f32 — int32 on the quantized integer path (exact adds; the caller
+    dequantizes after its last merge)."""
+    if jnp.issubdtype(gz.dtype, jnp.integer):
+        # Quantized path: A and the bin one-hot in the gradient dtype
+        # (|q| <= qmax fits), dot with an int32 accumulator — exact and
+        # order-independent where the f32 form was ULP-tolerant. The
+        # input_dtype/bf16-emulation knobs are float-path concerns.
+        qdt = gz.dtype
+        noh = (idx[:, None]
+               == jnp.arange(n_nodes, dtype=jnp.int32)[None, :])
+        zero = jnp.zeros((), qdt)
+        A = jnp.concatenate(
+            [jnp.where(noh, gz[:, None], zero),
+             jnp.where(noh, hz[:, None], zero)], axis=1)      # [r, 2N]
+
+        def per_feature_q(xcol):                              # [r] uint8
+            bins_oh = (
+                xcol[:, None]
+                == jnp.arange(n_bins, dtype=jnp.uint8)[None, :]
+            ).astype(qdt)                                     # [r, B]
+            return jax.lax.dot_general(
+                A, bins_oh,
+                (((0,), (0,)), ((), ())),                     # contract rows
+                preferred_element_type=jnp.int32,
+            )                                                 # [2N, B] i32
+
+        return jax.vmap(per_feature_q, in_axes=1)(Xb_c)       # [F, 2N, B]
     node_oh = jax.nn.one_hot(idx, n_nodes, dtype=jnp.float32)     # [r, N]
     # A stacks g-weighted and h-weighted node one-hots: [r, 2N].
     A = jnp.concatenate(
@@ -153,6 +203,8 @@ def build_histograms_matmul(
 ) -> jax.Array:
     R, F = Xb.shape
     gz, hz, idx = _mask_inactive(g, h, node_index)
+    acc_dtype = (jnp.int32 if jnp.issubdtype(gz.dtype, jnp.integer)
+                 else jnp.float32)
 
     if R <= row_chunk:
         out = _hist_chunk_matmul(Xb, gz, hz, idx, n_nodes, n_bins, input_dtype)
@@ -171,7 +223,7 @@ def build_histograms_matmul(
                 xc, gc, hc, ic, n_nodes, n_bins, input_dtype
             ), None
 
-        acc0 = jnp.zeros((F, 2 * n_nodes, n_bins), jnp.float32)
+        acc0 = jnp.zeros((F, 2 * n_nodes, n_bins), acc_dtype)
         out, _ = jax.lax.scan(
             body,
             acc0,
@@ -198,6 +250,8 @@ def resolve_hist_impl(
     n_nodes: int | None = None,
     n_features: int | None = None,
     n_bins: int | None = None,
+    input_bytes: int = 2,
+    grad_bytes: int = 4,
 ) -> str:
     """'auto' -> the right implementation for the platform (and shape).
 
@@ -206,6 +260,11 @@ def resolve_hist_impl(
     chunked matmul. Other accelerators: matmul (the Pallas kernel is
     TPU-only; off-TPU it would silently run interpreted, orders of magnitude
     slower). Shape args omitted -> optimistic TPU answer ("pallas").
+    `input_bytes`/`grad_bytes` are the one-hot operand and g/h row
+    itemsizes (pallas_fits' budget terms): build_histograms passes the
+    ACTUAL gradient dtype's sizes, so quantized int8/int16 shapes chunk
+    against their own — smaller — working set instead of the f32
+    defaults silently forcing the matmul fallback at deep levels.
     """
     if hist_impl != "auto":
         return hist_impl
@@ -220,11 +279,14 @@ def resolve_hist_impl(
 
         # The kernel feature-chunks itself for deep levels. Since the
         # VMEM-streaming rewrite a slab re-reads only its own uint8
-        # columns plus 12 bytes/row of g/h/ni (the old form re-streamed
-        # the [R, 2N] weighted one-hot per slab, which capped k at 4), so
-        # chunking stays ahead of the matmul fallback until the slab
-        # count itself is pathological.
-        k = feature_chunks_for(n_nodes, n_features, n_bins)
+        # columns plus 2 * grad-itemsize + 4 bytes/row of g/h/ni — 12
+        # for f32 gradients, 8/6 for quantized int16/int8 (the old form
+        # re-streamed the [R, 2N] weighted one-hot per slab, which
+        # capped k at 4) — so chunking stays ahead of the matmul
+        # fallback until the slab count itself is pathological.
+        k = feature_chunks_for(n_nodes, n_features, n_bins,
+                               input_bytes=input_bytes,
+                               grad_bytes=grad_bytes)
         if k is None or k > 8:
             return "matmul"
     return "pallas"
@@ -242,8 +304,14 @@ def build_histograms(
     input_dtype: jnp.dtype = jnp.bfloat16,
 ) -> jax.Array:
     """Dispatching HistogramBuilder; see module docstring for impls."""
+    quant = jnp.issubdtype(jnp.dtype(g.dtype), jnp.integer)
+    gb = jnp.dtype(g.dtype).itemsize if quant else 4
     impl = resolve_hist_impl(
-        impl, n_nodes=n_nodes, n_features=Xb.shape[1], n_bins=n_bins
+        impl, n_nodes=n_nodes, n_features=Xb.shape[1], n_bins=n_bins,
+        # Quantized one-hot operands are built in the gradient dtype
+        # (1/2 B); the f32 path's one-hot rides cfg.matmul_input_dtype
+        # (bf16 = 2 B, the historical resolver assumption).
+        input_bytes=gb if quant else 2, grad_bytes=gb,
     )
     if impl == "segment":
         return build_histograms_segment(Xb, g, h, node_index, n_nodes, n_bins)

@@ -73,23 +73,33 @@ def _mix32_np(x: np.ndarray) -> np.ndarray:
     return x
 
 
-def row_keep_np(seed: int, rnd: int, row_start: int, n: int,
-                subsample: float) -> np.ndarray:
-    """bool [n]: keep bits for global rows [row_start, row_start + n)."""
+def uniform_np(seed: int, rnd: int, row_start: int, n: int) -> np.ndarray:
+    """f32 [n] uniforms in [0, 1) for global rows [row_start, row_start
+    + n) — the generic counter-hash draw behind row_keep_np, exposed so
+    other per-(seed, round, row) randomness (the grad-quant stochastic
+    rounding, ops/grad.py — which salts the seed per channel) shares the
+    one hash and its 24-bit-exact-in-f32 property. Strictly < 1 (the top
+    24 bits over 2^-24), so floor(x + u) of an on-grid x never rounds."""
     ids = np.arange(row_start, row_start + n, dtype=np.uint64)
     lo = (ids & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     hi = (ids >> np.uint64(32)).astype(np.uint32)
     key = np.uint32(round_key(seed, rnd))
     bits = _mix32_np(lo ^ _mix32_np(hi ^ key))
-    u = (bits >> np.uint32(8)).astype(np.float32) * np.float32(2.0 ** -24)
-    return u < np.float32(subsample)
+    return (bits >> np.uint32(8)).astype(np.float32) * np.float32(2.0 ** -24)
+
+
+def row_keep_np(seed: int, rnd: int, row_start: int, n: int,
+                subsample: float) -> np.ndarray:
+    """bool [n]: keep bits for global rows [row_start, row_start + n)."""
+    return uniform_np(seed, rnd, row_start, n) < np.float32(subsample)
 
 
 @op_scope("sample")
-def row_keep_jax(rnd, local_offset, n: int, *, seed: int,
-                 subsample: float, row_start_lo=None, row_start_hi=None):
-    """f32 [n] 0/1 keep mask, traceable under jit/shard_map — the device
-    twin of row_keep_np (bit-identical by construction).
+def uniform_jax(rnd, local_offset, n: int, *, seed: int,
+                row_start_lo=None, row_start_hi=None):
+    """f32 [n] uniforms in [0, 1), traceable under jit/shard_map — the
+    device twin of uniform_np (bit-identical by construction; the shared
+    draw behind row_keep_jax and the grad-quant stochastic rounding).
 
     `rnd` and `local_offset` are traced int32 scalars (`local_offset` =
     this shard's first row within the padded global batch, typically
@@ -124,7 +134,19 @@ def row_keep_jax(rnd, local_offset, n: int, *, seed: int,
         carry = (lo < base_lo).astype(jnp.uint32)   # loc < 2^31 => exact
         hi = jnp.uint32(row_start_hi) + carry
     bits = mix(lo ^ mix(hi ^ key))
-    u = (bits >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+    return (bits >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+
+
+@op_scope("sample")
+def row_keep_jax(rnd, local_offset, n: int, *, seed: int,
+                 subsample: float, row_start_lo=None, row_start_hi=None):
+    """f32 [n] 0/1 keep mask, traceable under jit/shard_map — the device
+    twin of row_keep_np (bit-identical by construction; see uniform_jax
+    for the id/key conventions)."""
+    import jax.numpy as jnp
+
+    u = uniform_jax(rnd, local_offset, n, seed=seed,
+                    row_start_lo=row_start_lo, row_start_hi=row_start_hi)
     return (u < jnp.float32(subsample)).astype(jnp.float32)
 
 

@@ -47,7 +47,9 @@ def _hist_collective(out, axis_name, comms_mode: str, comms_dtype: str):
     under split_comms=reduce_scatter — an F-slab scatter (the caller's
     out_specs shard the feature axis; the host reassembles at D2H time,
     so only the WIRE pays the slab cost). F pads to the shard count;
-    callers slice the zero pad columns off after fetch."""
+    callers slice the zero pad columns off after fetch. Integer partials
+    (quantized gradients) merge natively — hist_reduce refuses
+    compression for them (they are already on one shared grid)."""
     if axis_name is None:
         return out
     if comms_mode == "reduce_scatter":
@@ -166,13 +168,19 @@ def stream_level_hist(
     #   accumulator recovers right children as parent - left (streaming.
     #   _assemble_subtracted_level), halving the streamed collective
     #   payload exactly like the fused rounds' level_histograms.
+    quantize=None,              # quantized-gradient seam (cfg.grad_dtype):
+    #   a (g, h) -> (qg, qh) closure built by the backend around
+    #   ops/grad.quantize_with_scales with this ROUND's host-reduced
+    #   scales and this chunk's global-row-id base — the histogram then
+    #   builds INTEGER (int32 partials, exact cross-chunk/shard merges).
 ) -> jax.Array:
     """One chunk's level-`depth` partial histogram [2^depth, F, B, 2]
     (collected over row shards when axis_name is set — psum, or the F/P
     reduce-scatter under split_comms=reduce_scatter). `row_keep` is the
     round's counter-based bagging mask (ops/sampling) — 0/1 f32, exact
     under multiplication, so masked grads match the in-memory trainers
-    bitwise."""
+    bitwise. With `quantize` the output is the RAW int32 partial — the
+    host accumulator dequantizes once after the level's last chunk."""
     ni = partial_node_index(
         Xb, feature, threshold_bin, is_leaf, depth, default_left,
         missing_bin_value=missing_bin_value, cat_vec=cat_vec)
@@ -185,6 +193,8 @@ def stream_level_hist(
     if row_keep is not None:
         valid = valid * row_keep
     g, h = chunk_grads(pred, y, valid, loss, class_idx)
+    if quantize is not None:
+        g, h = quantize(g, h)
     out = H.build_histograms(
         Xb, g, h, ni, n_nodes, n_bins,
         impl=hist_impl, input_dtype=input_dtype,
@@ -210,32 +220,65 @@ def stream_leaf_gh(
     missing_bin_value: int = -1,
     cat_vec: jax.Array | None = None,
     row_keep: jax.Array | None = None,   # f32 [R] 0/1 bagging mask
+    quantize=None,                       # see stream_level_hist
 ) -> jax.Array:
     """Final-level (G, H) aggregates for one chunk: f32 [2^max_depth, 2]
-    via the one-hot matmul formulation (ops/grow.py's final level)."""
+    via the one-hot matmul formulation (ops/grow.py's final level) —
+    int32 under `quantize` (the host dequantizes after the last chunk;
+    leaf sums then merge bit-exactly across chunks AND shards)."""
     ni = partial_node_index(
         Xb, feature, threshold_bin, is_leaf, max_depth, default_left,
         missing_bin_value=missing_bin_value, cat_vec=cat_vec)
     if row_keep is not None:
         valid = valid * row_keep
     g, h = chunk_grads(pred, y, valid, loss, class_idx)
+    if quantize is not None:
+        g, h = quantize(g, h)
     n_last = 1 << max_depth
     act = ni >= 0
-    ga = jnp.where(act, g, 0.0)
-    ha = jnp.where(act, h, 0.0)
     idx = jnp.clip(ni, 0, n_last - 1)
-    leaf_oh = (
-        idx[:, None] == jnp.arange(n_last, dtype=jnp.int32)[None, :]
-    ).astype(jnp.float32)
-    gh = jnp.stack([ga, ha], axis=1)
-    GH = jax.lax.dot_general(
-        leaf_oh, gh, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
+    # The shared one-hot contraction (grad_ops.leaf_gh_sums — one home
+    # with ops/grow's final level; int32-exact on the quantized path).
+    GH = grad_ops.leaf_gh_sums(idx, act, g, h, n_last)
     # Tiny [2^d, 2] aggregate: always the exact psum (scattering or
     # compressing it would save nothing and cost exactness).
     return comms.psum(GH, axis_name)
+
+
+@op_scope("grad_quant")
+def stream_grad_stats(
+    pred: jax.Array,
+    y: jax.Array,
+    valid: jax.Array,
+    *,
+    loss: str,
+    n_classes: int,
+    axis_name=None,
+    row_keep: jax.Array | None = None,
+) -> jax.Array:
+    """Per-class quantization stats [n_classes, 4] = (max|g|, sum|g|,
+    max|h|, sum|h|) for one chunk's ROUND-START gradients — the cheap
+    scale-derivation pass of quantized-gradient streaming (cfg.
+    grad_dtype): no Xb read, just resident pred/labels. The host maxes/
+    sums the per-chunk values (exact for the maxes; the f32 sums'
+    chunk order is absorbed by the power-of-two scale snap — ops/grad),
+    derives the round's per-output-dim scales once, and every level/leaf
+    pass of the round quantizes onto that ONE shared grid — which is
+    what makes the cross-chunk and cross-shard integer merges exact.
+    Maxes ride pmax and sums psum over the row mesh."""
+    if row_keep is not None:
+        valid = valid * row_keep
+    rows = []
+    for c in range(n_classes):
+        g, h = chunk_grads(pred, y, valid, loss, c)
+        ag = jnp.abs(g)
+        ah = jnp.abs(h)
+        rows.append(jnp.stack([jnp.max(ag), jnp.sum(ag),
+                               jnp.max(ah), jnp.sum(ah)]))
+    st = jnp.stack(rows)                                  # [C, 4]
+    mx = comms.pmax(st[:, 0::2], axis_name)
+    sm = comms.psum(st[:, 1::2], axis_name)
+    return jnp.stack([mx[:, 0], sm[:, 0], mx[:, 1], sm[:, 1]], axis=1)
 
 
 @op_scope("route")
@@ -348,6 +391,13 @@ def stream_round_start(
     #   the NEW round's histogram (the pred update is never masked)
     comms_mode: str = "allreduce",
     comms_dtype: str = "f32",
+    grad_stats_classes: int = 0,   # quantized-gradient mode (> 0): the
+    #   NEW round's scales are not derivable until the previous trees
+    #   land in pred, so this pass returns per-class quantization STATS
+    #   (stream_grad_stats, [C, 4]) instead of a depth-0 histogram — the
+    #   depth-0 build then runs as a normal quantized hist pass. One
+    #   extra dispatch per round, zero extra Xb reads (the stats read
+    #   only resident state).
 ) -> tuple[jax.Array, jax.Array]:
     """Fused round-start pass (round-2 verdict item 6): apply the PREVIOUS
     round's finished trees to pred, then compute class-0 gradients and the
@@ -357,7 +407,8 @@ def stream_round_start(
     dataset re-read per round (~1/(max_depth+2) of total passes).
 
     Returns (updated pred, [1, F, B, 2] depth-0 histogram, psum'd over row
-    shards when axis_name is set)."""
+    shards when axis_name is set) — or (updated pred, [C, 4] quant
+    stats) when `grad_stats_classes` > 0 (see the param note)."""
     for cls, (feat, thr, leaf, val, dl) in enumerate(prev_trees):
         pred = apply_tree_pred(
             Xb, pred, feat, thr, leaf, val, dl,
@@ -365,6 +416,10 @@ def stream_round_start(
             class_idx=cls, missing_bin_value=missing_bin_value,
             cat_vec=cat_vec,
         )
+    if grad_stats_classes > 0:
+        return pred, stream_grad_stats(
+            pred, y, valid, loss=loss, n_classes=grad_stats_classes,
+            axis_name=axis_name, row_keep=row_keep)
     g, h = chunk_grads(
         pred, y, valid if row_keep is None else valid * row_keep, loss, 0)
     ni = jnp.zeros(Xb.shape[0], jnp.int32)     # depth 0: every row at root

@@ -215,11 +215,32 @@ def hist_reduce(hist, axis_name, *, mode: str = "allreduce",
       vs the monolithic call; f32/bf16 are elementwise and slab-
       invariant).
 
+    INTEGER partials (the quantized-gradient path, cfg.grad_dtype —
+    ops/grad.py): int32 histograms already live on ONE shared
+    fixed-point grid (the scale is derived from psum'd/pmax'd global
+    stats before quantization), so the merge is a plain integer psum /
+    reduce-scatter — order-independent bit-stable WITHOUT int32_fixed's
+    per-collective scale carve-out, and overflow-free by the quantizer's
+    sum-cap construction. Compression is REFUSED for them rather than
+    silently double-quantizing (config.py raises at TrainConfig
+    construction; this is the backstop for direct callers).
+
     Single-shard traces (axis_name None) skip compression entirely —
     there is no wire, so there must be no rounding."""
     if comms_dtype not in COMMS_DTYPES:
         raise ValueError(
             f"comms_dtype must be one of {COMMS_DTYPES}, got {comms_dtype!r}")
+    if jnp.issubdtype(hist.dtype, jnp.integer):
+        if comms_dtype != "f32":
+            raise ValueError(
+                f"hist_comms_dtype={comms_dtype!r} cannot compress integer "
+                "(quantized-gradient) histogram partials: they already "
+                "live on one shared fixed-point grid, so re-quantizing "
+                "per collective would DOUBLE-quantize and void the "
+                "grad_quant error bound; keep hist_comms_dtype='f32' "
+                "(the integer merge is already bit-stable and needs no "
+                "compression for order-independence)")
+        return _reduce(hist, axis_name, mode, scatter_dim)
     if axis_name is None or comms_dtype == "f32":
         return _reduce(hist, axis_name, mode, scatter_dim)
     if comms_dtype == "bf16":

@@ -237,13 +237,15 @@ def _assemble_subtracted_level(
     child = parent - left, gated to exactly zero for children of parents
     that did NOT split (a frozen parent's phantom right child would
     otherwise inherit the full parent mass), interleaved back to level
-    order (left = 2p, right = 2p + 1)."""
+    order (left = 2p, right = 2p + 1). Dtype-generic: quantized-gradient
+    levels carry int32 accumulations, where the subtraction is EXACT
+    (the f32-ULP right-child seam does not exist on that path)."""
     half = 1 << (depth - 1)
     offset = half - 1
     gate = ~is_leaf[offset:offset + half]
     right = np.where(gate[:, None, None, None],
-                     parent_hist - left, np.float32(0.0))
-    out = np.empty((2 * half,) + left.shape[1:], np.float32)
+                     parent_hist - left, left.dtype.type(0))
+    out = np.empty((2 * half,) + left.shape[1:], left.dtype)
     out[0::2] = left
     out[1::2] = right
     return out
@@ -576,6 +578,16 @@ def _fit_streaming_impl(
         backend = get_backend(cfg)
 
     device = hasattr(backend, "stream_level_hist")
+    if cfg.grad_dtype != "f32" and not device:
+        # The quantized path's per-round scale pass and integer builds
+        # are device ops (backends/tpu.py stream_grad_stats /
+        # stream_level_hist); the host loop's numpy builders have no
+        # integer twin. Refuse loudly — a silently-f32 "quantized" run
+        # is worse than an error (backend='tpu' runs on CPU XLA too).
+        raise NotImplementedError(
+            f"grad_dtype={cfg.grad_dtype!r} streaming requires a device "
+            "backend exposing the stream_* surface (backend='tpu'); the "
+            "host streaming loop has no integer histogram path")
 
     # Telemetry prologue — BEFORE pass 0 so the transfer counters see the
     # label uploads; host-side bookkeeping only (no device syncs), and
@@ -933,6 +945,9 @@ def _fit_streaming_impl(
 
         if coll_bytes_round:
             tele_counters.record_collective(coll_bytes_round)
+        tele_counters.record_grad_stream(
+            C * tele_counters.grad_stream_bytes(
+                int(y_cnt), cfg.max_depth, cfg.grad_dtype))
         stop = False
         if ev is not None:
             with ph("eval"):
@@ -975,6 +990,22 @@ def _fit_streaming_impl(
 
     checkpoint.maybe_save(checkpoint_dir, ens, cfg, cfg.n_trees)
     return _finish(ens)
+
+
+def _merge_quant_stats(acc, st):
+    """Host reduction of per-chunk quantization stats [C, 4] (max|g|,
+    sum|g|, max|h|, sum|h|): maxes max exactly, sums accumulate in f64
+    (the f32 cast happens once inside quant_scale_np; chunk-order ULPs
+    are absorbed by the power-of-two scale snap — ops/grad)."""
+    st = np.asarray(st, np.float64)
+    if acc is None:
+        return st
+    out = acc.copy()
+    out[:, 0] = np.maximum(acc[:, 0], st[:, 0])
+    out[:, 2] = np.maximum(acc[:, 2], st[:, 2])
+    out[:, 1] = acc[:, 1] + st[:, 1]
+    out[:, 3] = acc[:, 3] + st[:, 3]
+    return out
 
 
 def _fit_streaming_device(
@@ -1070,11 +1101,15 @@ def _fit_streaming_device(
 
     n_feat = ens.n_features
 
-    def passes(tree, depth, kind, class_idx, rnd, build_left=False):
+    def passes(tree, depth, kind, class_idx, rnd, build_left=False,
+               scales=None):
         """One full pass over the chunks; yields per-chunk device outputs
         with the next read/upload already in flight. Histogram outputs
         are sliced back to the real feature count (reduce-scatter mode
-        pads F to the shard count with zero columns)."""
+        pads F to the shard count with zero columns). `scales` is the
+        round's (gscale, hscale) under quantized gradients — outputs
+        are then RAW int32 partials the caller accumulates exactly and
+        dequantizes once per level."""
         data = chunks.get(0)
         for c in range(n_chunks):
             tc0 = time.perf_counter()
@@ -1082,11 +1117,12 @@ def _fit_streaming_device(
                 out = backend.stream_level_hist(
                     data, pred_dev[c], y_dev[c], tree, depth, class_idx,
                     rnd=rnd, row_start=int(chunk_starts[c]),
-                    build_left=build_left)
+                    build_left=build_left, quant_scales=scales)
             else:
                 out = backend.stream_leaf_gh(
                     data, pred_dev[c], y_dev[c], tree, depth, class_idx,
-                    rnd=rnd, row_start=int(chunk_starts[c]))
+                    rnd=rnd, row_start=int(chunk_starts[c]),
+                    quant_scales=scales)
             if c + 1 < n_chunks:        # prefetch: overlap H2D with compute
                 data = chunks.get(c + 1)
             # Flight recorder: per-device completion of this chunk's pass
@@ -1107,7 +1143,9 @@ def _fit_streaming_device(
     # (pred is dead after the last gradients — same as the old loop, which
     # skipped its trailing update pass).
     prev_trees = None
-    subtract = resolve_hist_subtraction(cfg.hist_subtraction)
+    quant = cfg.grad_dtype != "f32"
+    subtract = resolve_hist_subtraction(cfg.hist_subtraction,
+                                        integer_hists=quant)
     coll_bytes_round = 0
     if getattr(backend, "distributed", False):
         coll_bytes_round = C * n_chunks * backend.collective_bytes_per_tree(
@@ -1116,6 +1154,52 @@ def _fit_streaming_device(
         if window is not None:                # xprof window: start edge
             window.round_start(rnd)
         t_round = time.perf_counter()
+        # Quantized gradients (cfg.grad_dtype): the round's per-class
+        # scales must exist BEFORE any histogram build, so the round
+        # opens with a stats pass — FUSED into the previous round's
+        # tree application (stream_round_start returns [C, 4] stats
+        # instead of a depth-0 histogram; the depth-0 build then runs
+        # as a normal quantized pass below) or, when there are no trees
+        # to apply yet, a chunk-read-free gradstats pass over resident
+        # pred/labels. One shared grid per (round, class) is what makes
+        # every cross-chunk/cross-shard integer merge of the round
+        # bit-exact.
+        round_scales = None
+        if quant:
+            from ddt_tpu.ops.grad import GRAD_ROW_LIMIT, quant_scale_np
+
+            if int(chunk_starts[-1]) >= GRAD_ROW_LIMIT:
+                # The int32 overflow proof's row ceiling (ops/grad.py:
+                # sum|q| <= 2^30 + n_rows must stay under INT32_MAX).
+                raise ValueError(
+                    f"quantized streaming over {int(chunk_starts[-1])} "
+                    f"rows exceeds the overflow proof's row ceiling "
+                    f"({GRAD_ROW_LIMIT}); use grad_dtype='f32'")
+            acc = None
+            if prev_trees is not None:
+                data = chunks.get(0)
+                for c in range(n_chunks):
+                    tc0 = time.perf_counter()
+                    pred_dev[c], st = backend.stream_round_start(
+                        data, pred_dev[c], y_dev[c], prev_trees,
+                        rnd=rnd, row_start=int(chunk_starts[c]))
+                    if c + 1 < n_chunks:
+                        data = chunks.get(c + 1)
+                    part_rec.observe("roundstart", st, tc0)
+                    acc = _merge_quant_stats(acc, np.asarray(st))
+            else:
+                for c in range(n_chunks):
+                    acc = _merge_quant_stats(acc, np.asarray(
+                        backend.stream_grad_stats(
+                            pred_dev[c], y_dev[c], rnd=rnd,
+                            row_start=int(chunk_starts[c]))))
+            round_scales = [
+                (quant_scale_np(acc[c_, 0], acc[c_, 1], cfg.grad_dtype),
+                 quant_scale_np(acc[c_, 2], acc[c_, 3], cfg.grad_dtype))
+                for c_ in range(C)]
+            log.debug("streaming: round %d grad-quant scales %s", rnd,
+                      round_scales)
+            tele_counters.record_grad_quant_round()
         # Gradients for EVERY class tree of a round come from the
         # round-start preds (the Driver computes grad_hess once per round,
         # then grows C trees from its columns) — so pred updates are
@@ -1137,16 +1221,20 @@ def _fit_streaming_device(
             default_left = np.zeros(cfg.n_nodes_total, bool)
             tree = (feature, threshold_bin, is_leaf, default_left)
 
+            sc = round_scales[cls] if quant else None
             prev_hist = None
             for depth in range(cfg.max_depth):
                 sub = subtract and depth >= 1 and prev_hist is not None
                 hist = None
                 with ph("hist"):
-                    if depth == 0 and cls == 0 and prev_trees is not None:
+                    if (depth == 0 and cls == 0 and prev_trees is not None
+                            and not quant):
                         # Fused round-start: apply the previous round's
                         # trees to the resident preds AND build this
                         # tree's depth-0 histogram (the NEW round's
                         # bagging mask) in one dispatch per chunk.
+                        # (Quantized rounds consumed this pass for
+                        # scale stats above — depth 0 streams normally.)
                         data = chunks.get(0)
                         for c in range(n_chunks):
                             tc0 = time.perf_counter()
@@ -1165,25 +1253,40 @@ def _fit_streaming_device(
                         # LEFT-child histograms — half the per-chunk
                         # device work and half the collective payload.
                         for part in passes(tree, depth, "hist", cls, rnd,
-                                           build_left=sub):
+                                           build_left=sub, scales=sc):
                             hist = part if hist is None else hist + part
                 if sub:
                     hist = _assemble_subtracted_level(prev_hist, hist,
                                                       is_leaf, depth)
+                # Quantized levels accumulate int32 — cross-chunk adds
+                # and the subtraction above are EXACT; dequantize once
+                # per level, feeding the shared split-decision home.
+                histf = hist
+                if quant:
+                    histf = hist.astype(np.float32) * np.array(
+                        [sc[0], sc[1]], np.float32)
                 with ph("gain"):
-                    _apply_level_splits(hist, cfg, depth, feature,
+                    _apply_level_splits(histf, cfg, depth, feature,
                                         threshold_bin, is_leaf, leaf_value,
                                         split_gain, default_left,
                                         feature_mask=fmask)
                 prev_hist = hist if subtract else None
 
-            # Final level: streamed (G, H) aggregates.
+            # Final level: streamed (G, H) aggregates (int32 under
+            # quantized gradients — dequantized after the last chunk).
             GH = None
             with ph("leaf"):
-                for part in passes(tree, cfg.max_depth, "leaf", cls, rnd):
+                for part in passes(tree, cfg.max_depth, "leaf", cls, rnd,
+                                   scales=sc):
                     GH = part if GH is None else GH + part
-                _apply_final_leaves(GH[:, 0], GH[:, 1], cfg, is_leaf,
-                                    leaf_value)
+                if quant:
+                    _apply_final_leaves(
+                        GH[:, 0].astype(np.float32) * np.float32(sc[0]),
+                        GH[:, 1].astype(np.float32) * np.float32(sc[1]),
+                        cfg, is_leaf, leaf_value)
+                else:
+                    _apply_final_leaves(GH[:, 0], GH[:, 1], cfg, is_leaf,
+                                        leaf_value)
 
             round_trees.append(
                 (feature, threshold_bin, is_leaf, leaf_value,
@@ -1200,6 +1303,9 @@ def _fit_streaming_device(
         prev_trees = round_trees
         if coll_bytes_round:
             tele_counters.record_collective(coll_bytes_round)
+        tele_counters.record_grad_stream(
+            C * tele_counters.grad_stream_bytes(
+                int(chunk_starts[-1]), cfg.max_depth, cfg.grad_dtype))
 
         stop = False
         if ev is not None:

@@ -83,6 +83,18 @@ _c = {
     # serve_express/serve_requests is the lifetime share of traffic
     # that skipped the admission window (== idle-regime traffic).
     "serve_express": 0,
+    # EFFECTIVE per-round g/h HBM stream bytes (grad_stream_bytes below;
+    # recorded by the Driver and the streaming trainers every round) —
+    # the quantized-gradient win's in-process witness: an f32 run and an
+    # int8 run of the same shape record 4x different values here, read
+    # back from their run logs' counters events (ISSUE 14).
+    "grad_stream_bytes_est": 0,
+    # Rounds that ran the quantized-gradient path (scale derivation +
+    # stochastic rounding) — nonzero iff cfg.grad_dtype != "f32"
+    # actually armed (the "is the integer path live" observability
+    # counter; the per-round scales themselves are in-trace values, so
+    # they surface via debug logs, not counters).
+    "grad_quant_rounds": 0,
 }
 _listener_installed = False
 # When truthy, the compile listener drops events: the cost observatory's
@@ -169,6 +181,14 @@ def record_serve_express() -> None:
     _c["serve_express"] += 1
 
 
+def record_grad_stream(nbytes: int) -> None:
+    _c["grad_stream_bytes_est"] += int(nbytes)
+
+
+def record_grad_quant_round(n: int = 1) -> None:
+    _c["grad_quant_rounds"] += int(n)
+
+
 def snapshot() -> dict:
     """Point-in-time copy of the monotonic counters."""
     return dict(_c)
@@ -219,11 +239,66 @@ def host_peak_rss_bytes() -> int | None:
     return int(ru) if sys.platform == "darwin" else int(ru) * 1024
 
 
+def hist_allreduce_bytes_by_level(
+        max_depth: int, n_features: int, n_bins: int,
+        *, partitions: int = 1, mode: str = "allreduce",
+        subtraction: bool = False, comms_dtype: str = "f32",
+        feature_partitions: int = 1,
+        grad_dtype: str = "f32") -> "list[int]":
+    """Per-LEVEL effective collective payload (levels 0..max_depth-1;
+    the leaf-aggregate term is hist_allreduce_bytes' extra). The
+    quantized-gradient acceptance contract reads this form: under
+    integer hists subtraction is unconditionally exact, so every level
+    >= 1 moves exactly HALF the f32-with-subtraction-off baseline's
+    entries — a per-level >= 2x wire reduction the counters witness
+    (docs/PERF.md "Quantized gradients"; whole-tree the ratio
+    asymptotes to 2 from below because depth 0 has no parent).
+    `grad_dtype` != "f32" means int32 partials on the wire (4 B/value —
+    same as f32; the win is the halved entry count plus bit-stable
+    merges without int32_fixed) and refuses compressed comms_dtype like
+    the wire itself does (parallel/comms.hist_reduce)."""
+    from ddt_tpu.parallel.comms import COMMS_DTYPE_BYTES
+
+    if grad_dtype != "f32" and comms_dtype != "f32":
+        raise ValueError(
+            f"grad_dtype={grad_dtype!r} with comms_dtype={comms_dtype!r}: "
+            "integer histogram partials refuse compression (the "
+            "double-quantization hazard — config.py/comms.hist_reduce)")
+    # int32 partials and f32 both move 4 B/value; the dict keeps the
+    # spelling honest if a narrower integer wire ever lands.
+    val_bytes = 4 if grad_dtype != "f32" else COMMS_DTYPE_BYTES[comms_dtype]
+    per_entry = val_bytes * 2                            # (g, h) pairs
+    P = max(1, partitions)
+    Pf = max(1, feature_partitions)
+    f_dev = -(-n_features // Pf)
+    out = []
+    for d in range(max_depth):
+        nodes = 1 << d
+        if subtraction and d >= 1:
+            nodes //= 2                   # left children only
+        if mode == "reduce_scatter":
+            f_pad = -(-f_dev // P) * P
+            total = nodes * (f_pad // P) * n_bins * per_entry
+            # Winner combine: gain/feat/bin/dl x [n_level] from every
+            # shard that owns a distinct slab (Pr row shards x Pf
+            # feature shards on the 2D mesh).
+            total += P * Pf * (1 << d) * 4 * 4
+        else:
+            total = nodes * f_dev * n_bins * per_entry
+            if Pf > 1:
+                # Column-sharded allreduce mode still combines winners
+                # across the feature axis (tiny tuples per level).
+                total += Pf * (1 << d) * 4 * 4
+        out.append(total)
+    return out
+
+
 def hist_allreduce_bytes(max_depth: int, n_features: int, n_bins: int,
                          *, partitions: int = 1, mode: str = "allreduce",
                          subtraction: bool = False,
                          comms_dtype: str = "f32",
-                         feature_partitions: int = 1) -> int:
+                         feature_partitions: int = 1,
+                         grad_dtype: str = "f32") -> int:
     """EFFECTIVE per-device collective payload estimate for ONE tree's
     histogram phases (parallel/comms.py is the wire this models; the
     two must change together).
@@ -250,34 +325,35 @@ def hist_allreduce_bytes(max_depth: int, n_features: int, n_bins: int,
       (plus the O(Pr·Pf·nodes) winner term, which then gathers over
       both axes).
 
+    - `grad_dtype` — the quantized-gradient path (int8/int16): partials
+      ride the wire as int32 (4 B/value, like f32 — the wire win there
+      is the unconditionally-exact subtraction halving every level >= 1
+      plus bit-stable merges with no int32_fixed carve-out); the
+      per-level form (hist_allreduce_bytes_by_level) is the acceptance
+      contract's witness surface. Leaf aggregates stay 4 B/value
+      either way (f32 psum or exact int32 psum).
+
     An estimate because the collective lives inside a fused device
     program where the host cannot observe the wire; shapes are static
     per config, so it is exact up to XLA's own reduction scheduling."""
-    from ddt_tpu.parallel.comms import COMMS_DTYPE_BYTES
+    levels = hist_allreduce_bytes_by_level(
+        max_depth, n_features, n_bins, partitions=partitions, mode=mode,
+        subtraction=subtraction, comms_dtype=comms_dtype,
+        feature_partitions=feature_partitions, grad_dtype=grad_dtype)
+    return sum(levels) + (1 << max_depth) * 4 * 2   # leaf aggregates: psum
 
-    per_entry = COMMS_DTYPE_BYTES[comms_dtype] * 2   # (g, h) pairs
-    P = max(1, partitions)
-    Pf = max(1, feature_partitions)
-    # Per-device column count: the feature axis slices columns FIRST
-    # (upload pads F to a multiple of Pf), then reduce_scatter sub-slabs
-    # that slice over the row shards.
-    f_dev = -(-n_features // Pf)
-    total = 0
-    for d in range(max_depth):
-        nodes = 1 << d
-        if subtraction and d >= 1:
-            nodes //= 2                   # left children only
-        if mode == "reduce_scatter":
-            f_pad = -(-f_dev // P) * P
-            total += nodes * (f_pad // P) * n_bins * per_entry
-            # Winner combine: gain/feat/bin/dl x [n_level] from every
-            # shard that owns a distinct slab (Pr row shards x Pf
-            # feature shards on the 2D mesh).
-            total += P * Pf * (1 << d) * 4 * 4
-        else:
-            total += nodes * f_dev * n_bins * per_entry
-            if Pf > 1:
-                # Column-sharded allreduce mode still combines winners
-                # across the feature axis (tiny tuples per level).
-                total += Pf * (1 << d) * 4 * 4
-    return total + (1 << max_depth) * 4 * 2   # leaf aggregates: f32 psum
+
+def grad_stream_bytes(rows: int, max_depth: int,
+                      grad_dtype: str = "f32") -> int:
+    """EFFECTIVE per-tree g/h HBM stream estimate: every histogram pass
+    (max_depth levels + the leaf pass) re-reads both gradient rows at
+    their STORED itemsize — 8 B/row/pass for f32, 4 for int16, 2 for
+    int8 (ops/grad.GRAD_ITEMSIZE is the one home; node_index's 4 B/row
+    is dtype-invariant and excluded so the ratio is the g/h story).
+    The Driver and streaming trainers record this per round into
+    `grad_stream_bytes_est` — the quantized path's >= 2x (int16) / 4x
+    (int8) per-level byte cut, witnessed in-process from run-log
+    counters rather than merely computed (ISSUE 14)."""
+    from ddt_tpu.ops.grad import GRAD_ITEMSIZE
+
+    return (max_depth + 1) * rows * 2 * GRAD_ITEMSIZE[grad_dtype]

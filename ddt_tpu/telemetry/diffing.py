@@ -33,6 +33,11 @@ COUNTER_DIRECTIONS: dict[str, str] = {
     "h2d_bytes": "lower",
     "d2h_bytes": "lower",
     "collective_bytes_est": "lower",
+    # Quantized gradients (ISSUE 14): the effective g/h HBM-stream
+    # model — an f32 run diffed against an int8 run of the same shape
+    # shows the 4x drop here; a quantized run regressing UP means the
+    # integer path silently fell back to f32 streams.
+    "grad_stream_bytes_est": "lower",
     "device_peak_bytes": "lower",
     "host_peak_rss_bytes": "lower",
     "compiled_ensemble_cache_hits": "higher",

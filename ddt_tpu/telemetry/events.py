@@ -243,10 +243,18 @@ def comms_manifest_fields(backend) -> dict:
     configuration (ISSUE 10; schema extras only, no version bump —
     absent on single-device backends and in every pre-existing log, and
     report treats them as optional). The one home the Driver's and the
-    streaming trainers' manifests share."""
+    streaming trainers' manifests share. ISSUE 14 extra: `grad_dtype`
+    appears whenever the quantized-gradient path is armed (absent =
+    f32), single-device runs included — the effective-bytes counters'
+    byte model keys on it."""
+    out = {}
+    cfg = getattr(backend, "cfg", None)
+    if cfg is not None and getattr(cfg, "grad_dtype", "f32") != "f32":
+        out["grad_dtype"] = cfg.grad_dtype
     if not getattr(backend, "distributed", False):
-        return {}
+        return out
     return {
+        **out,
         "split_comms": getattr(backend, "split_comms", "allreduce"),
         "hist_comms_dtype": backend.cfg.hist_comms_dtype,
         "hist_comms_slabs": int(getattr(backend, "comms_slabs", 1)),

@@ -18,6 +18,13 @@ The verdict for every arm is the same: the final ensemble is
 bit-identical to an undisturbed run, and the run log tells the whole
 fault story (injected / retry / checkpoint_fallback /
 checkpoint_resume / straggler_detected events). Exit 0 = all hold.
+
+The streamed arms (1-2) run with --grad-dtype int8 ARMED (ISSUE 14):
+quantized-gradient stochastic rounding is a pure function of (seed,
+tree, global row), so a chunk-read retry re-quantizes the identical
+bits and a torn-checkpoint resume replays the identical integer
+histograms — bit-identical recovery must hold UNDER quantization, not
+just beside it. Arm 3 keeps the f32 straggler coverage.
 """
 
 import json
@@ -72,8 +79,10 @@ def _assert_same(a, b, label):
 def main() -> int:
     n_chunks = 4
     Xb, y = _dataset()
+    # --grad-dtype int8 armed (ISSUE 14): the streamed chaos arms must
+    # recover bit-exactly THROUGH the quantized-gradient path.
     cfg = TrainConfig(n_trees=8, max_depth=3, n_bins=29, backend="tpu",
-                      seed=3)
+                      seed=3, grad_dtype="int8")
     chunk_fn = _chunk_fn(Xb, y, n_chunks)
     out = {"cmd": "chaos_smoke"}
 

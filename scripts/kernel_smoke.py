@@ -11,9 +11,14 @@ on, checked three ways:
    named scopes (ddt:fused_round, ddt:hist:subtract, and the kernel's
    ddt:hist:{stream,flush}) so Perfetto captures stay attributable;
 3. run-log round trip — the telemetry run renders through `report` with
-   the expected phases present.
+   the expected phases present;
+4. quantized arm (ISSUE 14) — the interpret-mode Pallas kernel on int8
+   gradients must match the segment path BITWISE (integer accumulation
+   commutes), and a 2-round --grad-dtype int8 fused train must produce
+   a valid run log whose manifest carries grad_dtype and whose counters
+   carry the quantized g/h stream bytes.
 
-Exit 0 iff all three hold. tests/test_hist_fused.py runs main()
+Exit 0 iff all four hold. tests/test_hist_fused.py runs main()
 in-process (the telemetry/trace/profile smoke pattern).
 """
 
@@ -97,8 +102,41 @@ def main() -> int:
             print(f"kernel smoke: fused phases missing from the run log "
                   f"(got {sorted(phases)})", file=sys.stderr)
             return 1
+
+        # Quantized arm (ISSUE 14): interpret-mode int8 kernel parity —
+        # pallas == segment BITWISE on integer gradients — plus a
+        # 2-round int8 train's run-log smoke.
+        from ddt_tpu.ops import histogram as hist_ops
+        from ddt_tpu.ops.hist_pallas import build_histograms_pallas
+
+        qg = jnp.asarray(rng.integers(-127, 128, size=300, dtype=np.int8))
+        qh = jnp.asarray(rng.integers(0, 128, size=300, dtype=np.int8))
+        ni = jnp.asarray(rng.integers(-1, 4, size=300).astype(np.int32))
+        pal = build_histograms_pallas(Xs, qg, qh, ni, 4, 31, interpret=True)
+        seg = hist_ops.build_histograms_segment(Xs, qg, qh, ni, 4, 31)
+        if pal.dtype != jnp.int32 or not bool((pal == seg).all()):
+            print("kernel smoke: quantized pallas/segment parity broke "
+                  f"(dtype {pal.dtype})", file=sys.stderr)
+            return 1
+        qlog = os.path.join(td, "run_q.jsonl")
+        cfg_q = cfg.replace(grad_dtype="int8")
+        api.train(Xb, y, cfg_q, binned=True, log_every=10**9, run_log=qlog)
+        qevents = report.read_events(qlog)
+        man = next(e for e in qevents if e["event"] == "run_manifest")
+        if man.get("grad_dtype") != "int8":
+            print("kernel smoke: run manifest lost grad_dtype",
+                  file=sys.stderr)
+            return 1
+        cnt = next(e for e in qevents if e["event"] == "counters")
+        if cnt.get("grad_quant_rounds", 0) < 1 or \
+                cnt.get("grad_stream_bytes_est", 0) <= 0:
+            print("kernel smoke: quantized counters missing from the run "
+                  f"log (got {cnt})", file=sys.stderr)
+            return 1
         print(json.dumps({"smoke": "kernel", "ok": True,
-                          "spans": spans, "phases": sorted(phases)}))
+                          "spans": spans, "phases": sorted(phases),
+                          "quant": {"pallas_bitwise": True,
+                                    "grad_dtype": man["grad_dtype"]}}))
     return 0
 
 

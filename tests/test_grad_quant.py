@@ -1,0 +1,689 @@
+"""Quantized-gradient training (ISSUE 14, cfg.grad_dtype): the int8/
+int16 g/h pipeline's contracts.
+
+What the suite pins (docs/PERF.md "Quantized gradients"):
+
+- the jax/np quantizer TWINS are bit-identical (64-bit row bases
+  included), on-grid values quantize exactly, zeros stay zero, |q| is
+  bounded by qmax, and the draw is a pure function of its key;
+- the three histogram impls (pallas interpret / matmul / segment) are
+  bitwise IDENTICAL on integer gradients, sibling subtraction is exact
+  in the integer domain (fused and streamed assembly), and cross-shard
+  merges are order-independent;
+- quantized trees are STRUCTURE-IDENTICAL to f32 on exact-grid models
+  across n_classes {1, 3} x missing x categorical x (Pr, Pf) meshes,
+  and split agreement on random-value models meets the Higgs-shape
+  acceptance bar;
+- streamed == in-memory STRUCTURE is fully bitwise under quantization
+  (the f32 path's chunked-summation bf16-tie seam does not exist;
+  leaf values keep the usual device-vs-host 1-ULP arithmetic seam);
+- grad_quant_error_bound holds end-to-end (witnessed, not hoped);
+- stochastic rounding replays identically under injected chaos retries
+  and across checkpoint resume;
+- the refuse-loudly config validation and the effective-bytes counters
+  (per-level wire >= 2x for levels >= 1, g/h stream 4x/2x) hold —
+  witnessed in-process from run-log counters, not just computed.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddt_tpu import api, streaming
+from ddt_tpu.backends import get_backend
+from ddt_tpu.config import TrainConfig
+from ddt_tpu.data.datasets import synthetic_binary, synthetic_multiclass
+from ddt_tpu.data.quantizer import quantize
+from ddt_tpu.driver import Driver
+from ddt_tpu.ops import grad as grad_ops
+from ddt_tpu.ops import histogram as hist_ops
+from ddt_tpu.ops.grow import resolve_hist_subtraction
+from ddt_tpu.ops.hist_pallas import build_histograms_pallas
+from ddt_tpu.telemetry import counters as tele_counters
+
+
+def _binary(rows=3000, features=8, bins=63, seed=3):
+    X, y = synthetic_binary(rows, n_features=features, seed=seed)
+    Xb, _ = quantize(X, n_bins=bins, seed=seed)
+    return Xb, y
+
+
+def _struct_equal(a, b):
+    return (np.array_equal(a.feature, b.feature)
+            and np.array_equal(a.threshold_bin, b.threshold_bin)
+            and np.array_equal(a.is_leaf, b.is_leaf))
+
+
+# --------------------------------------------------------------------- #
+# quantizer unit contracts
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("grad_dtype", ["int8", "int16"])
+def test_quantize_twins_bit_identical(grad_dtype):
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal(2000).astype(np.float32)
+    h = (rng.random(2000) * 0.25).astype(np.float32)
+    qg, qh, gs, hs = grad_ops.quantize_gradients(
+        jnp.asarray(g), jnp.asarray(h), grad_dtype=grad_dtype,
+        tree_id=jnp.int32(5), seed=11, local_offset=jnp.int32(0))
+    qg2, qh2, gs2, hs2 = grad_ops.quantize_gradients_np(
+        g, h, grad_dtype=grad_dtype, tree_id=5, seed=11, row_start=0)
+    assert float(gs) == float(gs2) and float(hs) == float(hs2)
+    assert np.array_equal(np.asarray(qg), qg2)
+    assert np.array_equal(np.asarray(qh), qh2)
+    qmax = grad_ops.GRAD_QMAX[grad_dtype]
+    assert np.abs(qg2.astype(np.int64)).max() <= qmax
+    # Determinism: the identical key reproduces the identical bits.
+    qg3, _, _, _ = grad_ops.quantize_gradients_np(
+        g, h, grad_dtype=grad_dtype, tree_id=5, seed=11, row_start=0)
+    assert np.array_equal(qg2, qg3)
+    # A different tree id moves the rounding bits (off-grid values).
+    qg4, _, _, _ = grad_ops.quantize_gradients_np(
+        g, h, grad_dtype=grad_dtype, tree_id=6, seed=11, row_start=0)
+    assert not np.array_equal(qg2, qg4)
+
+
+def test_quantize_64bit_row_base_twins():
+    """The streaming trainers key rows above 2^32 via (hi, lo) pairs —
+    the jax carry path must match the np uint64 path bitwise."""
+    rng = np.random.default_rng(1)
+    g = rng.standard_normal(500).astype(np.float32)
+    h = (rng.random(500) + 0.1).astype(np.float32)
+    base = (1 << 33) + 0xFFFFFF00          # forces a lo-word carry
+    qg_np, qh_np, gs, hs = grad_ops.quantize_gradients_np(
+        g, h, grad_dtype="int8", tree_id=2, seed=9, row_start=base)
+    qg_j, qh_j = grad_ops.quantize_with_scales(
+        jnp.asarray(g), jnp.asarray(h), jnp.float32(gs), jnp.float32(hs),
+        grad_dtype="int8", tree_id=jnp.int32(2), seed=9,
+        local_offset=jnp.int32(0),
+        row_start_lo=jnp.uint32(base & 0xFFFFFFFF),
+        row_start_hi=jnp.uint32(base >> 32))
+    assert np.array_equal(np.asarray(qg_j), qg_np)
+    assert np.array_equal(np.asarray(qh_j), qh_np)
+
+
+def test_quantize_exact_grid_and_zeros():
+    """On-grid values quantize exactly (u < 1 strictly), zeros stay
+    exactly zero (masked/pad rows must contribute nothing), and the
+    power-of-two scale makes dequantization exact."""
+    scale = np.float32(2.0 ** -6)
+    g = (np.arange(-127, 128).astype(np.float32)) * scale
+    h = np.abs(g) + scale
+    qg, qh, gs, hs = grad_ops.quantize_gradients_np(
+        g, h, grad_dtype="int8", tree_id=0, seed=0)
+    assert np.array_equal(qg.astype(np.float32) * gs, g)
+    z = np.zeros(64, np.float32)
+    qz, qzh, zs, _ = grad_ops.quantize_gradients_np(
+        z, z, grad_dtype="int16", tree_id=3, seed=1)
+    assert not qz.any() and not qzh.any() and zs == np.float32(1.0)
+
+
+def test_quant_scale_sum_cap_engages():
+    """When the mass term dominates, the scale coarsens so the global
+    sum of |q| stays under the int32 headroom — overflow-free merges by
+    construction, not by runtime checks."""
+    max_abs, sum_abs = 1.0, float(2 ** 34)
+    s = grad_ops.quant_scale_np(max_abs, sum_abs, "int16")
+    assert s >= np.float32(sum_abs / grad_ops.GRAD_SUM_CAP)
+    assert sum_abs / float(s) <= grad_ops.GRAD_SUM_CAP
+    # And it matches the traced twin bit-for-bit.
+    sj = grad_ops.quant_scale(jnp.float32(max_abs), jnp.float32(sum_abs),
+                              "int16")
+    assert float(sj) == float(s)
+
+
+# --------------------------------------------------------------------- #
+# integer histogram kernels
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("grad_dtype,bins", [("int8", 31), ("int8", 255),
+                                             ("int16", 64)])
+def test_integer_hist_impls_bitwise_identical(grad_dtype, bins):
+    rng = np.random.default_rng(2)
+    R, F, N = 2500, 5, 4
+    npdt = np.int8 if grad_dtype == "int8" else np.int16
+    Xb = jnp.asarray(rng.integers(0, bins, size=(R, F), dtype=np.uint8))
+    qmax = grad_ops.GRAD_QMAX[grad_dtype]
+    qg = jnp.asarray(rng.integers(-qmax, qmax + 1, size=R).astype(npdt))
+    qh = jnp.asarray(rng.integers(0, qmax + 1, size=R).astype(npdt))
+    ni = jnp.asarray(rng.integers(-1, N, size=R).astype(np.int32))
+    seg = hist_ops.build_histograms_segment(Xb, qg, qh, ni, N, bins)
+    mm = hist_ops.build_histograms_matmul(Xb, qg, qh, ni, N, bins,
+                                          row_chunk=600)
+    pal = build_histograms_pallas(Xb, qg, qh, ni, N, bins, interpret=True)
+    assert seg.dtype == mm.dtype == pal.dtype == jnp.int32
+    assert bool((seg == mm).all()) and bool((seg == pal).all())
+    # Chunked == monolithic: integer adds commute exactly.
+    mm1 = hist_ops.build_histograms_matmul(Xb, qg, qh, ni, N, bins,
+                                           row_chunk=10 ** 6)
+    assert bool((mm == mm1).all())
+
+
+def test_integer_sibling_subtraction_bitwise_device():
+    """level_histograms' integer path: right = parent - left recovered
+    bitwise vs a direct full build — the f32-ULP caveat is gone."""
+    import functools
+
+    from ddt_tpu.ops import grow as grow_ops
+
+    rng = np.random.default_rng(4)
+    R, F, bins = 3000, 6, 31
+    Xb = jnp.asarray(rng.integers(0, bins, size=(R, F), dtype=np.uint8))
+    g = jnp.asarray(rng.standard_normal(R).astype(np.float32))
+    h = jnp.asarray((rng.random(R) * 0.25 + 0.01).astype(np.float32))
+    kw = dict(max_depth=4, n_bins=bins, reg_lambda=1.0,
+              min_child_weight=1e-3, min_split_gain=0.0,
+              grad_dtype="int8", quant_seed=7)
+    t_on = jax.jit(functools.partial(
+        grow_ops.grow_tree, hist_subtraction=True, **kw))(Xb, g, h)
+    t_off = jax.jit(functools.partial(
+        grow_ops.grow_tree, hist_subtraction=False, **kw))(Xb, g, h)
+    # Integer subtraction is exact, so the WHOLE tree — leaf values
+    # included — must be bitwise invariant to the trick.
+    assert _struct_equal(t_on, t_off)
+    assert np.array_equal(np.asarray(t_on.leaf_value),
+                          np.asarray(t_off.leaf_value))
+
+
+def test_streamed_subtraction_assembly_integer_exact():
+    from ddt_tpu.streaming import _assemble_subtracted_level
+
+    rng = np.random.default_rng(5)
+    parent = rng.integers(-1000, 1000, size=(2, 3, 8, 2)).astype(np.int32)
+    left = rng.integers(-500, 500, size=(2, 3, 8, 2)).astype(np.int32)
+    is_leaf = np.zeros(15, bool)
+    is_leaf[2] = True                       # parent slot 2 froze
+    out = _assemble_subtracted_level(parent, left, is_leaf, 2)
+    assert out.dtype == np.int32
+    assert np.array_equal(out[0::2], left)
+    assert np.array_equal(out[1], parent[0] - left[0])
+    assert not out[3].any()                 # frozen parent's right child
+
+
+def test_resolve_hist_subtraction_integer_on_everywhere():
+    assert resolve_hist_subtraction("auto", platform="cpu",
+                                    integer_hists=True) is True
+    assert resolve_hist_subtraction("off", platform="cpu",
+                                    integer_hists=True) is False
+    assert resolve_hist_subtraction("auto", platform="cpu") is False
+
+
+# --------------------------------------------------------------------- #
+# structure identity / agreement
+# --------------------------------------------------------------------- #
+
+def _exact_grid_gh(rng, R, grad_dtype):
+    """Crafted per-row g/h whose quantization AND dequantization are
+    EXACT (the ops/grad module docstring's recipe): integer values with
+    the channel max PINNED to qmax — the scale is then exactly 1.0 —
+    and total integer mass under 2^24, so the single int32 -> f32
+    dequantize cast of any node total is exact too (past 2^24 the one
+    dequantize rounds once — inside the bound, but not grid-exact —
+    docs/PERF.md 'Quantized gradients')."""
+    qmax = grad_ops.GRAD_QMAX[grad_dtype]
+    g = rng.integers(-64, 65, size=R).astype(np.float32)
+    h = rng.integers(1, 65, size=R).astype(np.float32)
+    g[0] = qmax          # pins gscale = qmax/qmax = 1.0 exactly
+    h[0] = qmax
+    return g, h
+
+
+@pytest.mark.parametrize("grad_dtype", ["int8", "int16"])
+@pytest.mark.parametrize("mesh,variant", [
+    # Every mesh on the plain variant; the missing/categorical routing
+    # variants on the single-device and full-2D corners (routing is
+    # layout-independent by the mesh suite's own contracts — repeating
+    # every cross term would only re-buy compile time).
+    ((1, 1), "plain"), ((2, 1), "plain"), ((2, 2), "plain"),
+    ((1, 4), "plain"),
+    ((1, 1), "missing"), ((2, 2), "missing"),
+    ((1, 1), "categorical"), ((2, 2), "categorical"),
+])
+def test_exact_grid_structure_identity_meshes(grad_dtype, mesh, variant):
+    """Quantized trees == f32 trees on exact-grid gradients at every
+    (Pr, Pf), with missing-bin and categorical routing in the mix — the
+    acceptance criterion's core. Crafted on-grid g/h isolate the
+    quantization step (real losses rarely land on the grid; the
+    end-to-end exact-grid constructions are below)."""
+    rng = np.random.default_rng(8)
+    # R kept under the 2^24-mass exactness condition for int16's finer
+    # grid (see _exact_grid_gh).
+    R, F, bins = 1000, 6, 31
+    Xb = rng.integers(0, bins, size=(R, F), dtype=np.uint8)
+    g, h = _exact_grid_gh(rng, R, grad_dtype)
+    pr, pf = mesh
+    kw = dict(n_trees=1, max_depth=3, n_bins=bins, backend="tpu",
+              n_partitions=pr, feature_partitions=pf)
+    if variant == "missing":
+        kw["missing_policy"] = "learn"
+        Xb = Xb.copy()
+        Xb[rng.random(R) < 0.1] = bins - 1   # NaN-bin rows
+    elif variant == "categorical":
+        kw["cat_features"] = (1, 4)
+    trees = {}
+    for dt in ("f32", grad_dtype):
+        cfg = TrainConfig(grad_dtype=dt, **kw)
+        be = get_backend(cfg)
+        data = be.upload(Xb)
+        gd = be._put_rows(g)
+        hd = be._put_rows(h)
+        handle, _ = be.grow_tree(data, gd, hd, tree_id=0)
+        trees[dt] = be.fetch_tree(handle)
+    for field in ("feature", "threshold_bin", "is_leaf", "default_left"):
+        assert np.array_equal(trees["f32"][field],
+                              trees[grad_dtype][field]), (field, mesh)
+    np.testing.assert_allclose(trees["f32"]["leaf_value"],
+                               trees[grad_dtype]["leaf_value"],
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("loss,n_classes", [("mse", 2), ("logloss", 2),
+                                            ("softmax", 3)])
+def test_exact_grid_end_to_end_first_round(loss, n_classes):
+    """End-to-end exact-grid constructions through the REAL loss: mse on
+    y in {-1, +1} with mean 0 gives g in {-/+1}, h = 1; balanced logloss
+    gives g in {-/+0.5}, h = 0.25 — all exact powers of two on the
+    snapped grid, so round 1's quantized tree must equal f32's exactly.
+    Softmax gradients are never on-grid (p = 1/3...), so that arm pins
+    the AGREEMENT contract instead of identity."""
+    rng = np.random.default_rng(12)
+    R, F, bins = 3000, 8, 63
+    Xb = rng.integers(0, bins, size=(R, F), dtype=np.uint8)
+    if loss == "mse":
+        y = np.tile([-1.0, 1.0], R // 2).astype(np.float32)
+    elif loss == "logloss":
+        y = np.tile([0.0, 1.0], R // 2).astype(np.float32)
+    else:
+        y = rng.integers(0, n_classes, size=R).astype(np.int32)
+    cfg = TrainConfig(n_trees=1, max_depth=4, n_bins=bins, backend="tpu",
+                      loss=loss, n_classes=n_classes)
+    ens_f = api.train(Xb, y, cfg, binned=True).ensemble
+    ens_q = api.train(Xb, y, cfg.replace(grad_dtype="int8"),
+                      binned=True).ensemble
+    if loss == "softmax":
+        agree = np.mean(ens_f.feature == ens_q.feature)
+        assert agree >= 0.95, agree
+    else:
+        assert _struct_equal(ens_f, ens_q)
+        np.testing.assert_allclose(ens_f.leaf_value, ens_q.leaf_value,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_split_agreement_higgs_shape():
+    """The acceptance bar: int8 split agreement >= 0.985 vs f32 at the
+    Higgs-shape bench config (28 features, 255 bins, depth 6) over REAL
+    logloss gradients.
+
+    Protocol (docs/PERF.md "Quantized gradients"): PER-ROUND — each
+    round's quantized tree is grown from the SAME f32 boosting state as
+    its f32 twin, and agreement is the fraction of node slots whose
+    feature choice matches. This isolates the quantizer's per-decision
+    flip rate; a compounded two-trajectory comparison conflates it with
+    model divergence (one early near-tie flip relabels a whole subtree
+    and every later round — both models remain valid GBDTs). Rows are a
+    tier-1-sized slice of the 1M bench shape; measured agreement holds
+    comfortably above the floor across slice sizes (1.0 at 100k, 0.994
+    at 400k — docs/PERF.md 'Quantized gradients'); the tier-1 run uses
+    the 100k slice."""
+    X, y = synthetic_binary(100_000, n_features=28, seed=42)
+    Xb, _ = quantize(X, n_bins=255, seed=42)
+    cfg_f = TrainConfig(n_trees=3, max_depth=6, n_bins=255, backend="tpu")
+    be_f = get_backend(cfg_f)
+    be_q = get_backend(cfg_f.replace(grad_dtype="int8"))
+    data_f = be_f.upload(Xb)
+    data_q = be_q.upload(Xb)
+    yh = be_f.upload_labels(y.astype(np.float32))
+    pred = be_f.init_pred(yh, float(np.log(y.mean() / (1 - y.mean()))))
+    same = tot = 0
+    for rnd in range(cfg_f.n_trees):
+        g, h = be_f.grad_hess(pred, yh)
+        hf, delta = be_f.grow_tree(data_f, g, h, tree_id=rnd)
+        hq, _ = be_q.grow_tree(data_q, g, h, tree_id=rnd)
+        tf = be_f.fetch_tree(hf)
+        tq = be_q.fetch_tree(hq)
+        same += int((tf["feature"] == tq["feature"]).sum())
+        tot += tf["feature"].size
+        pred = be_f.apply_delta(pred, delta, 0)
+    agree = same / tot
+    assert agree >= 0.985, f"int8 split agreement {agree:.4f} < 0.985"
+
+
+def test_fused_equals_granular_quantized():
+    Xb, y = _binary()
+    for dt in ("int8", "int16"):
+        cfg = TrainConfig(n_trees=3, max_depth=3, n_bins=63,
+                          backend="tpu", grad_dtype=dt,
+                          subsample=0.8, colsample_bytree=0.9)
+        fused = api.train(Xb, y, cfg, binned=True).ensemble
+        gran = Driver(get_backend(cfg), cfg, log_every=10 ** 9,
+                      profile=True).fit(Xb, y)
+        assert _struct_equal(fused, gran), dt
+        np.testing.assert_allclose(fused.leaf_value, gran.leaf_value,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_quantized_with_inscan_eval_and_early_stop():
+    """The fused-rounds eval composition: quantized rounds thread their
+    round ids through the same scan lane as eval + colsample — the
+    in-scan validation scoring and early stopping must work unchanged
+    (and match the f32 arm's plumbing, not its scores)."""
+    Xb, y = _binary(rows=2400, seed=31)
+    cfg = TrainConfig(n_trees=6, max_depth=3, n_bins=63, backend="tpu",
+                      grad_dtype="int8", colsample_bytree=0.9)
+    res = api.train(Xb[:2000], y[:2000], cfg, binned=True,
+                    eval_set=(Xb[2000:], y[2000:]),
+                    eval_metric="logloss", early_stopping_rounds=3)
+    assert res.ensemble.n_trees >= 1
+    assert any("valid_logloss" in h for h in res.history)
+
+
+def test_mesh_structure_identity_full_train():
+    """Whole quantized TRAINS are structure-identical across mesh
+    layouts — the integer merge is order-independent, so (Pr, Pf)
+    cannot perturb anything."""
+    Xb, y = _binary()
+    base = TrainConfig(n_trees=2, max_depth=3, n_bins=63, backend="tpu",
+                       grad_dtype="int8")
+    single = api.train(Xb, y, base, binned=True).ensemble
+    for pr, pf in [(2, 2), (1, 4)]:
+        m = api.train(Xb, y,
+                      base.replace(n_partitions=pr, feature_partitions=pf),
+                      binned=True).ensemble
+        assert _struct_equal(single, m), (pr, pf)
+
+
+# --------------------------------------------------------------------- #
+# streamed == in-memory, chaos, resume
+# --------------------------------------------------------------------- #
+
+def _chunk_fn(Xb, y, n_chunks):
+    bounds = np.linspace(0, len(y), n_chunks + 1).astype(np.int64)
+
+    def f(c):
+        return Xb[bounds[c]:bounds[c + 1]], y[bounds[c]:bounds[c + 1]]
+
+    return f
+
+
+@pytest.mark.parametrize("grad_dtype", ["int8", "int16"])
+def test_streamed_equals_in_memory_bitwise(grad_dtype):
+    """Under quantization streamed == in-memory STRUCTURE is fully
+    BITWISE — integer chunk merges commute and the rounding is keyed by
+    global row id, so the f32 path's documented bf16-tie seam (chunked
+    summation order flipping near-tie splits) does not exist here. Leaf
+    VALUES share the f32 suite's device-vs-host arithmetic seam (the
+    final -G/(H+lambda) runs fused on device in-memory, numpy on host
+    streamed): 1-ULP tolerance, same as test_streaming."""
+    Xb, y = _binary(rows=4000, seed=7)
+    cfg = TrainConfig(n_trees=3, max_depth=3, n_bins=63, backend="tpu",
+                      grad_dtype=grad_dtype, subsample=0.85)
+    mem = api.train(Xb, y, cfg, binned=True).ensemble
+    st = streaming.fit_streaming(_chunk_fn(Xb, y, 5), 5, cfg,
+                                 backend=get_backend(cfg))
+    assert _struct_equal(mem, st)
+    np.testing.assert_allclose(mem.leaf_value, st.leaf_value,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_streamed_softmax_quantized():
+    X, y = synthetic_multiclass(3000, n_features=6, n_classes=3, seed=5)
+    Xb, _ = quantize(X, n_bins=31, seed=5)
+    cfg = TrainConfig(n_trees=2, max_depth=3, n_bins=31, backend="tpu",
+                      loss="softmax", n_classes=3, grad_dtype="int8")
+    mem = api.train(Xb, y, cfg, binned=True).ensemble
+    st = streaming.fit_streaming(_chunk_fn(Xb, y, 4), 4, cfg,
+                                 backend=get_backend(cfg))
+    assert _struct_equal(mem, st)
+    np.testing.assert_allclose(mem.leaf_value, st.leaf_value,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_host_streaming_loop_refuses_quantized():
+    Xb, y = _binary(rows=1000)
+    cfg = TrainConfig(n_trees=1, max_depth=2, n_bins=63, backend="cpu",
+                      grad_dtype="int8")
+    with pytest.raises(NotImplementedError, match="grad_dtype"):
+        # Config construction succeeds; the CPU backend (and the host
+        # loop) refuse. Build the backend indirectly via fit_streaming.
+        streaming.fit_streaming(_chunk_fn(Xb, y, 2), 2, cfg)
+
+
+def test_chaos_retry_replays_identical_bits():
+    """Stochastic-rounding determinism under an injected retry: a
+    chunk-read fault forces a re-read + re-quantize mid-train; the
+    ensemble must be bit-identical to an undisturbed run (the rounding
+    is a pure function of (seed, tree, row), never of the attempt)."""
+    from ddt_tpu.robustness import faultplan
+
+    Xb, y = _binary(rows=2400, seed=13)
+    cfg = TrainConfig(n_trees=4, max_depth=3, n_bins=63, backend="tpu",
+                      grad_dtype="int8", seed=13)
+    clean = streaming.fit_streaming(_chunk_fn(Xb, y, 4), 4, cfg,
+                                    backend=get_backend(cfg))
+    plan = faultplan.load_plan({"faults": [
+        {"site": "stream.chunk_read", "chunk": 1, "times": 2},
+        {"site": "stream.chunk_read", "chunk": 3, "times": 1},
+    ]})
+    prev = faultplan.activate(plan)
+    try:
+        chaos = streaming.fit_streaming(_chunk_fn(Xb, y, 4), 4, cfg,
+                                        backend=get_backend(cfg))
+    finally:
+        faultplan.deactivate(prev)
+    assert _struct_equal(clean, chaos)
+    assert np.array_equal(clean.leaf_value, chaos.leaf_value)
+
+
+def test_checkpoint_resume_bit_identical_quantized(tmp_path):
+    from ddt_tpu.robustness import faultplan
+
+    Xb, y = _binary(rows=2400, seed=17)
+    cfg = TrainConfig(n_trees=6, max_depth=3, n_bins=63, backend="tpu",
+                      grad_dtype="int8", seed=17)
+    ck = str(tmp_path / "ck")
+    clean = streaming.fit_streaming(
+        _chunk_fn(Xb, y, 3), 3, cfg, backend=get_backend(cfg),
+        checkpoint_dir=str(tmp_path / "ck0"), checkpoint_every=2)
+    plan = faultplan.load_plan({"faults": [
+        {"site": "ckpt.save.between", "round": 4}]})
+    prev = faultplan.activate(plan)
+    died = False
+    try:
+        streaming.fit_streaming(_chunk_fn(Xb, y, 3), 3, cfg,
+                                backend=get_backend(cfg),
+                                checkpoint_dir=ck, checkpoint_every=2)
+    except faultplan.InjectedCrash:
+        died = True
+    finally:
+        faultplan.deactivate(prev)
+    assert died
+    resumed = streaming.fit_streaming(_chunk_fn(Xb, y, 3), 3, cfg,
+                                      backend=get_backend(cfg),
+                                      checkpoint_dir=ck,
+                                      checkpoint_every=2)
+    assert _struct_equal(clean, resumed)
+    assert np.array_equal(clean.leaf_value, resumed.leaf_value)
+
+
+# --------------------------------------------------------------------- #
+# error bound
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("grad_dtype", ["int8", "int16"])
+def test_error_bound_held_end_to_end(grad_dtype):
+    """Every dequantized histogram entry (and node total) lands within
+    grad_quant_error_bound of the exact f32 value — computed, then
+    WITNESSED against real kernels."""
+    rng = np.random.default_rng(21)
+    R, F, bins, N = 4000, 6, 31, 4
+    Xb = rng.integers(0, bins, size=(R, F), dtype=np.uint8)
+    g = rng.standard_normal(R).astype(np.float32)
+    h = (rng.random(R) * 0.25).astype(np.float32)
+    ni = rng.integers(0, N, size=R).astype(np.int32)
+    qg, qh, gs, hs = grad_ops.quantize_gradients_np(
+        g, h, grad_dtype=grad_dtype, tree_id=0, seed=3)
+    hq = np.asarray(hist_ops.build_histograms_segment(
+        jnp.asarray(Xb), jnp.asarray(qg), jnp.asarray(qh),
+        jnp.asarray(ni), N, bins))
+    hf = np.zeros((N, F, bins, 2), np.float64)
+    for f in range(F):
+        np.add.at(hf[:, f, :, 0], (ni, Xb[:, f]), g)
+        np.add.at(hf[:, f, :, 1], (ni, Xb[:, f]), h)
+    bg = grad_ops.grad_quant_error_bound(
+        grad_dtype, np.abs(g).max(), np.abs(g).sum(), R)
+    bh = grad_ops.grad_quant_error_bound(
+        grad_dtype, np.abs(h).max(), np.abs(h).sum(), R)
+    dg = np.abs(hq[..., 0].astype(np.float64) * gs - hf[..., 0]).max()
+    dh = np.abs(hq[..., 1].astype(np.float64) * hs - hf[..., 1]).max()
+    assert dg <= bg and dh <= bh, (dg, bg, dh, bh)
+    # int16's grid is finer: its realized error must undercut int8's
+    # bound by a wide margin.
+    if grad_dtype == "int16":
+        b8 = grad_ops.grad_quant_error_bound(
+            "int8", np.abs(g).max(), np.abs(g).sum(), R)
+        assert bg < b8
+
+
+# --------------------------------------------------------------------- #
+# refuse-loudly config validation + comms backstop
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("grad_dtype", ["int8", "int16"])
+@pytest.mark.parametrize("comms_dtype", ["bf16", "int32_fixed"])
+def test_config_refuses_double_quantization(grad_dtype, comms_dtype):
+    # Both orderings: whichever knob the user reaches for second, the
+    # constructor names the hazard.
+    with pytest.raises(ValueError, match="double-quantize"):
+        TrainConfig(grad_dtype=grad_dtype, hist_comms_dtype=comms_dtype)
+    with pytest.raises(ValueError, match="double-quantize"):
+        TrainConfig(hist_comms_dtype=comms_dtype, grad_dtype=grad_dtype)
+    # Either knob alone is fine.
+    TrainConfig(grad_dtype=grad_dtype)
+    TrainConfig(hist_comms_dtype=comms_dtype)
+    with pytest.raises(ValueError, match="grad_dtype"):
+        TrainConfig(grad_dtype="int4")
+
+
+def test_hist_reduce_refuses_compressed_integer_partials():
+    from ddt_tpu.parallel import comms
+
+    hq = jnp.ones((2, 4, 8, 2), jnp.int32)
+    with pytest.raises(ValueError, match="(?i)double-quantize"):
+        comms.hist_reduce(hq, None, comms_dtype="bf16")
+    # f32 comms on integer partials is the exact identity single-shard.
+    out = comms.hist_reduce(hq, None, comms_dtype="f32")
+    assert out.dtype == jnp.int32
+
+
+def test_cpu_backend_refuses_quantized():
+    with pytest.raises(NotImplementedError, match="grad_dtype"):
+        get_backend(TrainConfig(backend="cpu", grad_dtype="int8"),
+                    use_cache=False)
+
+
+def test_backend_cache_key_separates_grad_dtype():
+    cfg_f = TrainConfig(backend="tpu", n_bins=31)
+    cfg_q = cfg_f.replace(grad_dtype="int8")
+    assert get_backend(cfg_f) is not get_backend(cfg_q)
+    # seed is trace-relevant under quantization (the rounding key).
+    assert get_backend(cfg_q) is not get_backend(cfg_q.replace(seed=1))
+
+
+# --------------------------------------------------------------------- #
+# effective-bytes counters: computed model + in-process witness
+# --------------------------------------------------------------------- #
+
+def test_per_level_wire_bytes_at_least_2x():
+    """The acceptance criterion's wire half: under int8 every level >= 1
+    moves >= 2x fewer bytes than the f32 baseline (exact subtraction is
+    unconditional on the integer path), per level — whole-tree the
+    ratio asymptotes to 2 from below (depth 0 has no parent;
+    docs/PERF.md). The g/h HBM stream halves at least 2x (int16) / 4x
+    (int8) at every level."""
+    for dt, stream_floor in (("int8", 4.0), ("int16", 2.0)):
+        sub = resolve_hist_subtraction("auto", platform="cpu",
+                                       integer_hists=True)
+        lv_f = tele_counters.hist_allreduce_bytes_by_level(
+            6, 28, 255, partitions=2,
+            subtraction=resolve_hist_subtraction("auto", platform="cpu"))
+        lv_q = tele_counters.hist_allreduce_bytes_by_level(
+            6, 28, 255, partitions=2, subtraction=sub, grad_dtype=dt)
+        assert all(f / q >= 2.0 for f, q in zip(lv_f[1:], lv_q[1:]))
+        assert lv_f[0] == lv_q[0]          # depth 0 has no parent
+        gf = tele_counters.grad_stream_bytes(10 ** 6, 6, "f32")
+        gq = tele_counters.grad_stream_bytes(10 ** 6, 6, dt)
+        assert gf / gq >= stream_floor
+    with pytest.raises(ValueError, match="double-quantiz"):
+        tele_counters.hist_allreduce_bytes(6, 28, 255, grad_dtype="int8",
+                                           comms_dtype="bf16")
+
+
+def test_effective_bytes_witnessed_in_process(tmp_path):
+    """The counters are WITNESSED from real run logs, not just computed:
+    an f32 and an int8 2-partition train of the same shape record
+    collective + grad-stream counters whose ratios meet the bars."""
+    Xb, y = _binary(rows=2400, seed=23)
+    logs = {}
+    for dt in ("f32", "int8"):
+        cfg = TrainConfig(n_trees=2, max_depth=4, n_bins=63,
+                          backend="tpu", n_partitions=2, grad_dtype=dt)
+        path = str(tmp_path / f"run_{dt}.jsonl")
+        api.train(Xb, y, cfg, binned=True, log_every=10 ** 9,
+                  run_log=path)
+        with open(path) as f:
+            events = [json.loads(ln) for ln in f]
+        logs[dt] = next(e for e in events if e["event"] == "counters")
+        man = next(e for e in events if e["event"] == "run_manifest")
+        if dt == "int8":
+            assert man.get("grad_dtype") == "int8"
+        else:
+            assert "grad_dtype" not in man
+    gf = logs["f32"]["grad_stream_bytes_est"]
+    gq = logs["int8"]["grad_stream_bytes_est"]
+    assert gf > 0 and gq > 0 and gf / gq >= 4.0
+    cf = logs["f32"]["collective_bytes_est"]
+    cq = logs["int8"]["collective_bytes_est"]
+    # Whole-tree wire: subtraction-on integer vs subtraction-off f32
+    # (CPU platform) — 63/32 entries at depth 6, ~1.9x; the >= 2x
+    # PER-LEVEL criterion is the model test above.
+    assert cf > cq and cf / cq >= 1.8, (cf, cq)
+    assert logs["int8"]["grad_quant_rounds"] == 2
+    assert logs["f32"]["grad_quant_rounds"] == 0
+
+
+# --------------------------------------------------------------------- #
+# bench + CLI surfaces
+# --------------------------------------------------------------------- #
+
+def test_bench_hist_quant_ab_smoke():
+    from ddt_tpu.bench import bench_hist_quant_ab, run_bench
+
+    out = bench_hist_quant_ab(rows=2000, features=4, bins=31, depth=2,
+                              iters=1, reps=2)
+    assert out["kernel"] == "hist_quant_ab"
+    assert out["payload_ratio"] == 4.0
+    assert out["ratio_f32_over_quant"] > 0
+    out16 = run_bench(kernel="hist_quant", rows=1500, features=4,
+                      bins=31, depth=2, iters=1, seed=1,
+                      grad_dtype="int16")
+    assert out16["grad_dtype"] == "int16" and out16["payload_ratio"] == 2.0
+
+
+def test_cli_grad_dtype_flag(tmp_path):
+    from ddt_tpu import cli
+
+    Xb, y = _binary(rows=800, seed=29)
+    data = str(tmp_path / "d.npz")
+    np.savez(data, X=Xb.astype(np.float32), y=y)
+    out = str(tmp_path / "m.npz")
+    rc = cli.main(["train", "--data", data, "--trees", "1", "--depth",
+                   "2", "--bins", "31", "--backend", "tpu",
+                   "--grad-dtype", "int8", "--out", out,
+                   "--valid-frac", "0"])
+    assert rc in (0, None)
+    assert os.path.exists(out)

@@ -71,6 +71,12 @@ METRICS: dict[str, str] = {
     "hist_2d_ab_ratio": "higher",
     "hist_2d_mrows_per_sec": "higher",
     "hist_2d_payload_ratio": "higher",
+    # Quantized-gradient A/B (ISSUE 14): paired f32/int8 wallclock
+    # ratio, the quantized arm's throughput, and the deterministic g/h
+    # HBM-stream byte ratio — all better when higher.
+    "hist_quant_ab_ratio": "higher",
+    "hist_quant_mrows_per_sec": "higher",
+    "hist_quant_payload_ratio": "higher",
     "e2e_train_s": "lower",
     "e2e_ms_per_tree": "lower",
     "e2e_implied_hist_mrows": "higher",
