@@ -60,6 +60,8 @@ kernel-smoke:
 # injected stream-read IOErrors (retry seam), injected straggler
 # (watchdog detection) — asserting the recovered ensemble is
 # BIT-IDENTICAL to an undisturbed run and the run log tells the story.
+# Arm 4 (ISSUE 15): a real `cli serve` subprocess SIGKILLed mid-storm
+# and restarted on the same port, with every client recovering.
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py
 
@@ -67,7 +69,10 @@ chaos-smoke:
 # front end on CPU — 100 concurrent requests with a mid-flight hot
 # swap (zero failures, old-or-new responses only), admission
 # coalescing witnessed, serve_latency SLO event lands in the run log
-# and renders through `cli report`.
+# and renders through `cli report`. Fleet arm (ISSUE 15): 3 registry
+# models of mixed tiers behind one engine, LRU eviction + reload
+# mid-storm, 0 steady-state jit compiles, `report fleet` rollup, and
+# saturated single-model p99 within 1.5x of the plain engine.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py
 

@@ -653,11 +653,33 @@ def main(argv: list[str] | None = None) -> int:
              "device-resident compiled model, admission-batched request "
              "coalescing, zero-downtime hot swap, serve_latency SLO "
              "telemetry")
-    sv.add_argument("--model", required=True,
+    sv.add_argument("--model", default=None,
                     help="model artifact to serve: an api.save_model "
                          ".npz path, or — with --registry — a registry "
                          "reference (name, name@version, name@tag, or "
-                         "digest); hot-swap later via POST /swap")
+                         "digest); hot-swap later via POST /swap. "
+                         "Required unless a FLEET is configured via "
+                         "--models/--fleet-config")
+    sv.add_argument("--models", default=None,
+                    help="FLEET mode (docs/SERVING.md \"Fleet\"): "
+                         "comma-separated model entries, each "
+                         "ref[:key=value]* — e.g. "
+                         "'a@prod,b@canary:weight=3,c@v2:tier=int4'. "
+                         "Keys: name, weight, tier, max_batch, raw. "
+                         "Refs resolve through --registry (or are "
+                         ".npz paths); duplicate names and unknown "
+                         "refs fail loudly at boot")
+    sv.add_argument("--fleet-config", default=None,
+                    help="FLEET mode: JSON fleet config file "
+                         "({\"models\": [{name, ref, weight, tier, "
+                         "max_batch, raw}, ...]}); combines with "
+                         "--models (duplicate names across the two "
+                         "fail loudly)")
+    sv.add_argument("--max-resident", type=_positive_int, default=None,
+                    help="fleet LRU budget: at most this many models "
+                         "resident at once — cold models demote to "
+                         "their AOT artifacts and reload zero-downtime "
+                         "on next request (default: all resident)")
     sv.add_argument("--registry", default=None,
                     help="registry root directory (docs/REGISTRY.md): "
                          "resolve --model and /swap bodies as registry "
@@ -771,6 +793,12 @@ def main(argv: list[str] | None = None) -> int:
     rp.add_argument("--slowest", type=_positive_int, default=5,
                     help="how many slowest rounds to list")
     rsub = rp.add_subparsers(dest="report_cmd")
+    rsub.add_parser(
+        "fleet",
+        help="render the fleet rollup only: one row per model joining "
+             "its serve_latency windows, serving tier, eviction/reload "
+             "counts, and artifact provenance (docs/OBSERVABILITY.md); "
+             "fails loudly on a log with no fleet data")
     dp = rsub.add_parser(
         "diff",
         help="align two run logs by phase and counter and flag adverse "
@@ -996,6 +1024,63 @@ def main(argv: list[str] | None = None) -> int:
         from ddt_tpu.serve.engine import TIER_IMPL, ServeEngine
         from ddt_tpu.serve.http import serve_forever
 
+        if args.models or args.fleet_config:
+            # FLEET mode (ISSUE 15): N registry-resolved models behind
+            # one engine — parse/validate/resolve loudly at boot
+            # (SystemExit-clean like the registry group), then serve.
+            from ddt_tpu.registry import RegistryError
+            from ddt_tpu.serve import control as fleet_control
+
+            if args.model is not None:
+                raise SystemExit(
+                    "serve: --model conflicts with --models/"
+                    "--fleet-config (put it in the fleet instead)")
+            if args.quantized is not None or args.raw \
+                    or args.max_batch != 256:
+                # Silently dropping these would serve every model at
+                # its default tier/ladder while the operator believes
+                # otherwise — loud like the --model conflict above.
+                raise SystemExit(
+                    "serve: --quantized/--raw/--max-batch apply to "
+                    "single-model servers; fleets set them per entry "
+                    "(tier= / raw= / max_batch= in --models or the "
+                    "fleet config)")
+            try:
+                specs = []
+                if args.fleet_config:
+                    specs += fleet_control.load_fleet_config(
+                        args.fleet_config)
+                if args.models:
+                    specs += fleet_control.parse_models_arg(args.models)
+                engine = fleet_control.build_fleet(
+                    specs, registry=args.registry, backend=args.backend,
+                    max_wait_ms=args.max_wait_ms,
+                    max_resident=args.max_resident,
+                    run_log=args.run_log,
+                    express_lane=not args.no_express_lane)
+            except (fleet_control.FleetConfigError, RegistryError,
+                    ValueError, OSError) as e:
+                raise SystemExit(f"serve fleet: {e}") from e
+            print(json.dumps({
+                "cmd": "serve", "fleet": True,
+                "models": {s.name: {"ref": s.ref, "weight": s.weight,
+                                    "tier": s.tier,
+                                    "max_batch": s.max_batch}
+                           for s in specs},
+                "max_resident": args.max_resident,
+                "host": args.host, "port": args.port,
+                "max_wait_ms": args.max_wait_ms,
+                "express_lane": not args.no_express_lane,
+                "registry": args.registry,
+            }), flush=True)
+            serve_forever(engine, host=args.host, port=args.port)
+            return 0
+
+        if args.model is None:
+            raise SystemExit(
+                "serve: --model is required (or configure a fleet "
+                "with --models/--fleet-config)")
+
         mode = "file"
         digest = None
         if args.registry is not None and not os.path.exists(args.model):
@@ -1144,8 +1229,17 @@ def main(argv: list[str] | None = None) -> int:
         try:
             events = tele_merge.merge_paths(args.log)
             summary = tele_report.summarize(events, slowest=args.slowest)
-            out_text = (json.dumps(summary) if args.json
-                        else tele_report.render(summary))
+            if getattr(args, "report_cmd", None) == "fleet":
+                # `report --log L fleet`: just the per-model rollup
+                # (render_fleet raises on a log with no fleet data —
+                # caught below into the clean SystemExit; the --json
+                # form validates through it too).
+                out_text = tele_report.render_fleet(summary)
+                if args.json:
+                    out_text = json.dumps(summary["fleet"])
+            else:
+                out_text = (json.dumps(summary) if args.json
+                            else tele_report.render(summary))
         except (OSError, ValueError, TypeError, KeyError) as e:
             # summarize/render stay inside the guard: a schema-valid log
             # with wrong field TYPES (hand-edited/corrupted) must exit

@@ -14,10 +14,14 @@ same engine the tests and bench drive in-process.
 from ddt_tpu.serve.batcher import (MicroBatcher, PendingRequest,
                                    ShuttingDown)
 from ddt_tpu.serve.engine import (ServableModel, ServeEngine, ServeStats,
-                                  bucket_for, default_buckets, proba_np)
+                                  bucket_for, default_buckets,
+                                  dispatch_batch, proba_np)
+from ddt_tpu.serve.fleet import (FleetEngine, ModelUnavailableError,
+                                 UnknownModelError)
 
 __all__ = [
     "MicroBatcher", "PendingRequest", "ShuttingDown",
-    "ServableModel", "ServeEngine", "ServeStats",
-    "bucket_for", "default_buckets", "proba_np",
+    "ServableModel", "ServeEngine", "ServeStats", "FleetEngine",
+    "ModelUnavailableError", "UnknownModelError",
+    "bucket_for", "default_buckets", "dispatch_batch", "proba_np",
 ]

@@ -98,6 +98,13 @@ class PendingRequest:
             raise self._error
         return self._result
 
+    def exception(self) -> "BaseException | None":
+        """The delivered error without raising it (None while pending or
+        on success) — the fleet's express path inspects this to turn an
+        evicted-mid-express race into a reload-and-requeue instead of a
+        client-visible failure (ddt_tpu/serve/fleet.py)."""
+        return self._error
+
 
 class MicroBatcher:
     """The admission queue + dispatcher thread.
@@ -112,7 +119,8 @@ class MicroBatcher:
     submitter hangs."""
 
     def __init__(self, dispatch, max_wait_ms: float = 1.0,
-                 max_batch: int = 256, clock=None):
+                 max_batch: int = 256, clock=None, cv=None,
+                 own_thread: bool = True):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
@@ -128,15 +136,24 @@ class MicroBatcher:
         self._clock = clock if clock is not None else time.perf_counter
         self._q: collections.deque[PendingRequest] = collections.deque()
         self._cv = threading.Condition()
+        if cv is not None:
+            # DRIVEN mode (ddt_tpu/serve/fleet.py): the fleet engine
+            # shares ONE Condition across every model's batcher so its
+            # single dispatcher thread can park on all queues at once;
+            # submit()/express() notify through it and the *_locked
+            # driver surface below is called with it held.
+            self._cv = cv
         # Held around EVERY dispatch (batch loop and express lane): an
         # express dispatch and a batch dispatch never overlap on the
         # device, and the express lane only opens when nothing is
         # mid-flight (its tail-latency-never-regresses contract).
         self._gate = threading.Lock()
         self._closed = False
-        self._thread = threading.Thread(
-            target=self._loop, name="ddt-serve-batcher", daemon=True)
-        self._thread.start()
+        self._thread = None
+        if own_thread:
+            self._thread = threading.Thread(
+                target=self._loop, name="ddt-serve-batcher", daemon=True)
+            self._thread.start()
 
     def submit(self, rows, n: int) -> PendingRequest:
         """Enqueue one request (`rows` is the request's row block, `n`
@@ -193,11 +210,69 @@ class MicroBatcher:
         return req
 
     def close(self, timeout: float = 5.0) -> None:
-        """Stop admitting, drain what is queued, join the dispatcher."""
+        """Stop admitting, drain what is queued, join the dispatcher
+        (driven batchers have no thread of their own — the fleet loop
+        observes `_closed` and drains)."""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        self._thread.join(timeout)
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # ------------------------------------------------------------------ #
+    # fleet-driver surface (ddt_tpu/serve/fleet.py)
+    # ------------------------------------------------------------------ #
+    # The *_locked methods are called by the fleet's single dispatcher
+    # thread WITH the shared Condition held (the cv= injected at
+    # construction); they never take locks themselves.
+
+    def backlog_rows_locked(self) -> int:
+        return sum(r.n for r in self._q)
+
+    def head_deadline_locked(self) -> "float | None":
+        """Admission deadline of the OLDEST queued request (the same
+        pinned-to-the-head-never-re-armed deadline `_loop` uses), or
+        None on an empty queue."""
+        if not self._q:
+            return None
+        return self._q[0].t_submit + self.max_wait_s
+
+    def ready_locked(self, now: float) -> bool:
+        """True when a batch should close NOW: the head request's
+        window expired, or the row budget is already full."""
+        if not self._q:
+            return False
+        if self._q[0].t_submit + self.max_wait_s <= now:
+            return True
+        return self.backlog_rows_locked() >= self.max_batch
+
+    def admit_locked(self) -> "tuple[list[PendingRequest], int]":
+        """Pop the next micro-batch for the external driver (same FIFO
+        never-split-never-reordered admission as the owned loop)."""
+        return self._admit_locked()
+
+    def fail_pending_locked(self, err: BaseException) -> int:
+        """Fail every queued request with `err` (the fleet control
+        plane's remove path); returns how many waiters were failed."""
+        n = 0
+        while self._q:
+            self._q.popleft().set_error(err)
+            n += 1
+        return n
+
+    def dispatch_under_gate(self, fn, batch, depth: int) -> None:
+        """Run one admitted batch through `fn(batch, depth)` under the
+        dispatch gate — the fleet driver's batch seam. Same contracts
+        as `_loop`: the gate means this never overlaps an express
+        dispatch on the same model, and a raising `fn` fails the
+        batch's waiters instead of killing the driver thread."""
+        try:
+            with self._gate:
+                fn(batch, depth)
+        except Exception as e:  # ddtlint: disable=broad-except
+            for req in batch:
+                if not req.done():
+                    req.set_error(e)
 
     # ------------------------------------------------------------------ #
     # dispatcher thread

@@ -1,0 +1,282 @@
+"""Fleet control plane: declarative config + registry-backed loading.
+
+The configuration half of the fleet tier (ISSUE 15; fleet.py is the
+dispatch half). Three jobs, all of them OFF the request hot loop:
+
+- **parse** the two fleet config surfaces into `FleetSpec`s — the CLI's
+  `--models a@prod,b@canary:weight=3` shorthand and the `--fleet-config
+  fleet.json` file ({"models": [{"name", "ref", "weight", "tier",
+  "max_batch", "raw"}, ...]} or a bare list) — with loud errors on
+  duplicate names, unknown keys, and malformed values (the CLI wraps
+  them SystemExit-clean like the registry group);
+- **resolve** every reference at boot (registry name index or an
+  artifact file on disk) so an unknown ref fails the `cli serve`
+  command, not the first request hours later;
+- **load**: `make_loader` builds the injected callable FleetEngine
+  calls on handler threads — a registry ref restores through the
+  zero-retrace AOT loader (ddt_tpu/registry/loader.py: eviction is
+  cheap BECAUSE reload is a bounded cold-load, never a retrace), a
+  file path builds a plain ServableModel (full prologue, documented as
+  the non-registry mode).
+
+This module does file I/O by design — it is the cli/http-layer side of
+the serve-blocking-io contract, and FleetEngine only ever invokes the
+loader on caller threads with no fleet lock held.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from ddt_tpu.serve.engine import TIER_IMPL, normalize_quantize
+from ddt_tpu.serve.fleet import FleetEngine
+
+
+class FleetConfigError(ValueError):
+    """Malformed fleet configuration (duplicate name, unknown key,
+    unresolvable reference, bad value) — always loud, always at boot
+    or at the control-plane call, never at dispatch time."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSpec:
+    """One fleet member, fully declarative: the registry reference (or
+    artifact path), its dispatch weight, serving tier, and admission
+    ladder. `tier=None` FOLLOWS the artifact (a quantized export serves
+    its exported tier, an f32 export serves f32) — mixed-tier fleets
+    come free from mixed artifacts."""
+
+    name: str
+    ref: str
+    weight: float = 1.0
+    tier: "str | None" = None
+    max_batch: int = 256
+    raw: bool = False
+
+    def __post_init__(self):
+        if not self.name:
+            raise FleetConfigError("fleet entry has an empty name")
+        if self.weight <= 0:
+            raise FleetConfigError(
+                f"model {self.name!r}: weight must be > 0, got "
+                f"{self.weight}")
+        if self.max_batch < 1:
+            raise FleetConfigError(
+                f"model {self.name!r}: max_batch must be >= 1, got "
+                f"{self.max_batch}")
+
+
+_SPEC_KEYS = {"name", "ref", "model", "weight", "tier", "max_batch",
+              "raw"}
+
+
+def _default_name(ref: str) -> str:
+    """`a@prod` -> `a`; a file path -> its stem (`/x/model_b.npz` ->
+    `model_b`)."""
+    base = ref.split("@", 1)[0]
+    if os.sep in base or base.endswith(".npz"):
+        base = os.path.splitext(os.path.basename(base))[0]
+    return base
+
+
+def _coerce_bool(v, where: str, key: str) -> bool:
+    """Strict flag parsing for the string surfaces (`--models
+    m:raw=false` and POST /models JSON strings): bool('false') is True,
+    so a naive cast would make every spelling ENABLE the flag."""
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "on"):
+        return True
+    if s in ("false", "0", "no", "off", ""):
+        return False
+    raise FleetConfigError(
+        f"{where}: {key} must be a boolean (true/false), got {v!r}")
+
+
+def coerce_spec(d: dict, where: str) -> FleetSpec:
+    unknown = set(d) - _SPEC_KEYS
+    if unknown:
+        raise FleetConfigError(
+            f"{where}: unknown fleet entry key(s) "
+            f"{', '.join(sorted(unknown))} (have: "
+            f"{', '.join(sorted(_SPEC_KEYS - {'model'}))})")
+    ref = d.get("ref") or d.get("model")
+    if not ref:
+        raise FleetConfigError(f"{where}: fleet entry needs a 'ref' "
+                               "(registry reference or artifact path)")
+    tier = d.get("tier")
+    try:
+        tier = normalize_quantize(tier) if tier is not None else None
+    except ValueError as e:
+        raise FleetConfigError(f"{where}: {e}") from e
+    try:
+        return FleetSpec(
+            name=str(d.get("name") or _default_name(str(ref))),
+            ref=str(ref),
+            weight=float(d.get("weight", 1.0)),
+            tier=tier,
+            max_batch=int(d.get("max_batch", 256)),
+            raw=_coerce_bool(d.get("raw", False), where, "raw"))
+    except (TypeError, ValueError) as e:
+        raise FleetConfigError(f"{where}: {e}") from e
+
+
+def parse_models_arg(arg: str) -> "list[FleetSpec]":
+    """`--models` shorthand: comma-separated entries, each
+    `ref[:key=value]*` — e.g. `a@prod,b@canary:weight=3,
+    c@v2:tier=int4:max_batch=64:name=tiny`. The ref's name part (before
+    `@`) is the model name unless `name=` overrides it."""
+    specs = []
+    for i, entry in enumerate(s.strip() for s in arg.split(",")):
+        if not entry:
+            raise FleetConfigError(
+                f"--models entry {i} is empty (stray comma?)")
+        parts = entry.split(":")
+        d: dict = {"ref": parts[0]}
+        for kv in parts[1:]:
+            if "=" not in kv:
+                raise FleetConfigError(
+                    f"--models entry {parts[0]!r}: expected key=value "
+                    f"after ':', got {kv!r}")
+            k, v = kv.split("=", 1)
+            d[k.strip()] = v.strip()
+        specs.append(coerce_spec(d, f"--models entry {parts[0]!r}"))
+    return specs
+
+
+def load_fleet_config(path: str) -> "list[FleetSpec]":
+    """`--fleet-config` file: JSON — either {"models": [...]} (extra
+    top-level keys refused loudly) or a bare list of entries."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise FleetConfigError(f"fleet config {path}: {e}") from e
+    if isinstance(doc, dict):
+        unknown = set(doc) - {"models"}
+        if unknown:
+            raise FleetConfigError(
+                f"fleet config {path}: unknown top-level key(s) "
+                f"{', '.join(sorted(unknown))} (expected 'models')")
+        entries = doc.get("models")
+    else:
+        entries = doc
+    if not isinstance(entries, list) or not entries:
+        raise FleetConfigError(
+            f"fleet config {path}: 'models' must be a non-empty list")
+    out = []
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict):
+            raise FleetConfigError(
+                f"fleet config {path}: entry {i} must be an object")
+        out.append(coerce_spec(e, f"{path} entry {i}"))
+    return out
+
+
+def validate_specs(specs: "list[FleetSpec]") -> "list[FleetSpec]":
+    if not specs:
+        raise FleetConfigError(
+            "fleet has no models (pass --models and/or --fleet-config)")
+    seen: dict = {}
+    for s in specs:
+        if s.name in seen:
+            raise FleetConfigError(
+                f"duplicate model name {s.name!r} "
+                f"({seen[s.name].ref!r} vs {s.ref!r}); give one of "
+                "them an explicit name=")
+        seen[s.name] = s
+    return specs
+
+
+def resolve_specs(specs, registry_root: "str | None") -> dict:
+    """Resolve every ref at boot — {name: digest | "file"} — so unknown
+    references fail the command, not the first request. Registry refs
+    need `registry_root`; artifact paths just need to exist."""
+    out = {}
+    for spec in specs:
+        if os.path.exists(spec.ref):
+            out[spec.name] = "file"
+            continue
+        if registry_root is None:
+            raise FleetConfigError(
+                f"model {spec.name!r}: ref {spec.ref!r} is not a file, "
+                "and no --registry was given so registry references "
+                "cannot resolve")
+        from ddt_tpu.registry import Registry, RegistryError
+
+        try:
+            out[spec.name] = Registry(registry_root).resolve(spec.ref)
+        except RegistryError as e:
+            raise FleetConfigError(
+                f"model {spec.name!r}: {e}") from e
+    return out
+
+
+def make_loader(registry_root: "str | None", backend_name: str,
+                run_log=None):
+    """The FleetEngine `loader(spec)` callable: registry refs restore
+    through the zero-retrace AOT loader (artifact events land in the
+    shared run log), file refs build a plain ServableModel. Always runs
+    on a caller/handler thread — never the dispatcher."""
+
+    def loader(spec: FleetSpec):
+        if os.path.exists(spec.ref):
+            from ddt_tpu import api
+            from ddt_tpu.backends import get_backend
+            from ddt_tpu.config import TrainConfig
+            from ddt_tpu.serve.engine import ServableModel, default_buckets
+
+            bundle = api.load_model(spec.ref)
+            cfg = TrainConfig(
+                backend=backend_name, loss=bundle.ensemble.loss,
+                n_classes=max(bundle.ensemble.n_classes, 2),
+                predict_impl=TIER_IMPL.get(spec.tier, "auto"))
+            return ServableModel(
+                bundle, get_backend(cfg), quantize=spec.tier,
+                buckets=default_buckets(spec.max_batch), raw=spec.raw)
+        if registry_root is None:
+            raise FleetConfigError(
+                f"model {spec.name!r}: ref {spec.ref!r} is not a file "
+                "and this fleet has no registry")
+        from ddt_tpu.registry import loader as reg_loader
+
+        report = reg_loader.load_servable(
+            registry_root, spec.ref, quantize=spec.tier,
+            raw=spec.raw, backend=backend_name, run_log=run_log)
+        return report.model
+
+    return loader
+
+
+def build_fleet(specs, *, registry: "str | None" = None,
+                backend: str = "tpu", max_wait_ms: float = 1.0,
+                max_resident: "int | None" = None, run_log=None,
+                express_lane: bool = True,
+                preload: bool = True) -> FleetEngine:
+    """Specs -> a running FleetEngine: validate, resolve every ref
+    loudly, build the loader over the registry, and (by default) make
+    the first `max_resident` models resident so boot-time failures are
+    boot-time errors. ONE RunLog is shared by the loader's artifact
+    events and the engine's serving events (per-log monotonic seq —
+    the merge invariant)."""
+    from ddt_tpu.telemetry.events import RunLog
+
+    run_log = RunLog.coerce(run_log)
+    specs = validate_specs(list(specs))
+    resolve_specs(specs, registry)
+    engine = FleetEngine(
+        specs, make_loader(registry, backend, run_log=run_log),
+        max_wait_ms=max_wait_ms, max_resident=max_resident,
+        run_log=run_log, express_lane=express_lane)
+    if preload:
+        budget = len(specs) if max_resident is None else max_resident
+        try:
+            for spec in specs[:budget]:
+                engine.n_features_for(spec.name)   # load + warm, loudly
+        except BaseException:
+            engine.close()                         # don't leak the thread
+            raise
+    return engine

@@ -329,6 +329,82 @@ class ServeStats:
             }
 
 
+def coerce_rows(rows) -> np.ndarray:
+    """Submit-side row normalization shared by ServeEngine and the
+    fleet engine: [F] promotes to [1, F], anything but 2-D is refused,
+    and non-uint8 input becomes contiguous f32 (the transform path's
+    dtype; uint8 rows are pre-binned and pass through untouched)."""
+    rows = np.asarray(rows)
+    if rows.ndim == 1:
+        rows = rows[None, :]
+    if rows.ndim != 2:
+        raise ValueError(f"rows must be [n, F], got {rows.shape}")
+    if rows.dtype != np.uint8:
+        rows = np.ascontiguousarray(rows, np.float32)
+    return rows
+
+
+def dispatch_batch(model, batch, queue_depth: int, stats) -> None:
+    """Score ONE admitted micro-batch against `model` and deliver every
+    result/error — the per-batch body shared by ServeEngine._dispatch
+    and the fleet engine's per-model dispatch (ddt_tpu/serve/fleet.py).
+    The caller read the model reference ONCE (hot-swap/eviction
+    atomicity: every request in the batch is scored by exactly this
+    version); this function never touches engine state beyond `stats`.
+
+    Raw float requests bin HERE, under the same model that scores them —
+    binning at submit time could pair model A's bins with model B's
+    trees across a swap. Transform failures are PER-REQUEST: a malformed
+    submission fails its own waiter only, never the valid requests that
+    happened to share its admission window."""
+    good, blocks = [], []
+    for r in batch:
+        # Feature-count check against the model ACTUALLY scoring this
+        # batch (submit-time validation saw the pre-swap model; a swap
+        # to a different-width model must fail only the stale-width
+        # requests, never the valid ones sharing their window).
+        if r.rows.shape[1] != model.n_features:
+            r.set_error(ValueError(
+                f"rows have {r.rows.shape[1]} features; the "
+                f"serving model expects {model.n_features}"))
+            continue
+        if r.rows.dtype == np.uint8:
+            good.append(r)
+            blocks.append(r.rows)
+            continue
+        try:
+            blocks.append(model.transform(r.rows))
+            good.append(r)
+        # Delivered to this request's own waiter; co-batched requests
+        # proceed.
+        except Exception as e:  # ddtlint: disable=broad-except
+            r.set_error(e)
+    if not good:
+        return
+    Xb = blocks[0] if len(blocks) == 1 else np.concatenate(blocks)
+    scores = model.score_binned(Xb)
+    done = time.perf_counter()
+    lats = [(done - r.t_submit) * 1e3 for r in good]
+    express = bool(good and good[0].express)
+    # Stats land BEFORE any waiter wakes: a caller that resets the
+    # stats window the moment result() returns must find this batch in
+    # the window it completed in, and never see it leak into the next
+    # one (bench_serve_latency's per-QPS arms do exactly that).
+    tele_counters.record_serve_requests(len(good))
+    tele_counters.record_serve_batch()
+    if express:
+        tele_counters.record_serve_express()
+    stats.record_batch(len(good), queue_depth, lats, express=express)
+    off = 0
+    for req in good:
+        # Attribution BEFORE the result event fires: a waiter that
+        # wakes on set_result must already see which version scored it
+        # (hot-swap attribution — PendingRequest.model_token).
+        req.model_token = model.token
+        req.set_result(scores[off:off + req.n])
+        off += req.n
+
+
 class ServeEngine:
     """The persistent scoring process's core (transport-agnostic: the
     HTTP front end, the CLI, tests, and the bench all drive this same
@@ -345,10 +421,17 @@ class ServeEngine:
                  backend=None, max_wait_ms: float = 1.0,
                  max_batch: int = 256, quantize=False,
                  raw: bool = False, run_log=None,
-                 express_lane: bool = True):
+                 express_lane: bool = True,
+                 model_name: "str | None" = None):
         from ddt_tpu.telemetry.events import RunLog
 
         self.cfg = cfg if cfg is not None else TrainConfig()
+        # Optional fleet-style identity (ISSUE 15): when set, every
+        # serve_latency window, hot_swap event, and /healthz payload
+        # carries the model_name dimension — schema-additive, absent on
+        # anonymous single-model servers so old logs/consumers are
+        # untouched.
+        self.model_name = model_name
         self.quantize_tier = normalize_quantize(quantize)
         want_impl = TIER_IMPL.get(self.quantize_tier)
         if want_impl is not None and self.cfg.predict_impl != want_impl:
@@ -425,10 +508,13 @@ class ServeEngine:
             # (not just which content token) is serving before/after —
             # the digest is how an operator joins a swap to `registry
             # list` and to the training run's own log (docs/REGISTRY.md).
+            extra = ({"model_name": self.model_name}
+                     if self.model_name is not None else {})
             self.run_log.emit("fault", kind="hot_swap", old=old,
                               new=new.token,
                               old_artifact=old_digest,
-                              new_artifact=new.artifact_digest)
+                              new_artifact=new.artifact_digest,
+                              **extra)
         log.info("hot-swapped model %s -> %s", old[:12], new.token[:12])
         return {"old": old, "new": new.token}
 
@@ -437,17 +523,11 @@ class ServeEngine:
     # ------------------------------------------------------------------ #
 
     def predict_async(self, rows: np.ndarray) -> PendingRequest:
-        rows = np.asarray(rows)
-        if rows.ndim == 1:
-            rows = rows[None, :]
-        if rows.ndim != 2:
-            raise ValueError(f"rows must be [n, F], got {rows.shape}")
+        rows = coerce_rows(rows)
         if rows.shape[1] != self._model.n_features:
             raise ValueError(
                 f"rows have {rows.shape[1]} features; the served model "
                 f"expects {self._model.n_features}")
-        if rows.dtype != np.uint8:
-            rows = np.ascontiguousarray(rows, np.float32)
         if self.express_lane and rows.shape[0] == 1:
             # Express lane (ISSUE 12): with an empty queue and no batch
             # mid-dispatch, a single-row request scores RIGHT HERE on
@@ -466,63 +546,11 @@ class ServeEngine:
 
     def _dispatch(self, batch, queue_depth: int) -> None:
         # ONE model reference per micro-batch: every request in it is
-        # scored by exactly this version (hot-swap atomicity).
+        # scored by exactly this version (hot-swap atomicity); the
+        # per-batch body lives in dispatch_batch (shared with the fleet
+        # engine's per-model dispatch).
         model = self._model
-        # Raw float requests bin HERE, under the same model that scores
-        # them — binning at submit time could pair model A's bins with
-        # model B's trees across a swap. Transform failures are
-        # PER-REQUEST: a malformed submission (float rows on a
-        # mapperless server, NaN-free contract violations, ...) fails
-        # its own waiter only — never the valid requests that happened
-        # to share its admission window.
-        good, blocks = [], []
-        for r in batch:
-            # Feature-count check against the model ACTUALLY scoring
-            # this batch (submit-time validation saw the pre-swap
-            # model; a swap to a different-width model must fail only
-            # the stale-width requests, never the valid ones sharing
-            # their admission window).
-            if r.rows.shape[1] != model.n_features:
-                r.set_error(ValueError(
-                    f"rows have {r.rows.shape[1]} features; the "
-                    f"serving model expects {model.n_features}"))
-                continue
-            if r.rows.dtype == np.uint8:
-                good.append(r)
-                blocks.append(r.rows)
-                continue
-            try:
-                blocks.append(model.transform(r.rows))
-                good.append(r)
-            # Delivered to this request's own waiter; co-batched
-            # requests proceed.
-            except Exception as e:  # ddtlint: disable=broad-except
-                r.set_error(e)
-        if not good:
-            return
-        Xb = blocks[0] if len(blocks) == 1 else np.concatenate(blocks)
-        scores = model.score_binned(Xb)
-        done = time.perf_counter()
-        lats = [(done - r.t_submit) * 1e3 for r in good]
-        express = bool(good and good[0].express)
-        # Stats land BEFORE any waiter wakes: a caller that resets the
-        # stats window the moment result() returns must find this batch
-        # in the window it completed in, and never see it leak into the
-        # next one (bench_serve_latency's per-QPS arms do exactly that).
-        tele_counters.record_serve_requests(len(good))
-        tele_counters.record_serve_batch()
-        if express:
-            tele_counters.record_serve_express()
-        self.stats.record_batch(len(good), queue_depth, lats,
-                                express=express)
-        off = 0
-        for req in good:
-            # Attribution BEFORE the result event fires: a waiter that
-            # wakes on set_result must already see which version scored
-            # it (hot-swap attribution — PendingRequest.model_token).
-            req.model_token = model.token
-            req.set_result(scores[off:off + req.n])
-            off += req.n
+        dispatch_batch(model, batch, queue_depth, self.stats)
 
     # ------------------------------------------------------------------ #
     # telemetry
@@ -537,6 +565,8 @@ class ServeEngine:
             return None
         m = self._model
         summary["model_token"] = m.token
+        if self.model_name is not None:
+            summary["model_name"] = self.model_name
         # The tier ACTUALLY serving (satellite fix, ISSUE 12): a vmem
         # guard that silently degraded lut4 -> lut -> f32 shows up in
         # every telemetry window, not only in debug logs.
@@ -551,6 +581,7 @@ class ServeEngine:
         m = self._model
         return {
             "ok": True,
+            "model_name": self.model_name,
             "model_token": m.token,
             "quantized": m.quantized,
             "quantize_tier": getattr(m, "quantize_tier", None),

@@ -1,0 +1,679 @@
+"""FleetEngine: multi-model tenancy over one device (ISSUE 15).
+
+The serving tier's fleet half (docs/SERVING.md "Fleet"). One engine
+holds N registry-resolved models resident concurrently:
+
+- **per-model admission queues** — every model gets its own
+  `MicroBatcher` in DRIVEN mode (no thread of its own; all batchers
+  share the fleet's ONE Condition), so per-model coalescing, the
+  pinned-to-the-head admission deadline, and the express lane all
+  carry over unchanged from the single-model engine;
+- **a single dispatcher thread** running weighted deficit-round-robin
+  over the queues: each cycle a model with backlog earns
+  `weight x max_batch` rows of credit and dispatches micro-batches
+  until the credit runs out — under saturation a weight-3 model gets
+  ~3x the device time of a weight-1 model, and an idle model costs
+  nothing. One dispatcher thread means the single-model invariants
+  hold PER MODEL: the model reference for a batch is read once at
+  admission (old-or-new-never-a-mix under reload/retag), and the
+  per-model dispatch gate keeps express and batch dispatches from
+  overlapping on the same model;
+- **LRU eviction + zero-downtime reload** — with `max_resident` set,
+  publishing model N+1 demotes the least-recently-used idle model to
+  its artifact (a reference drop: the AOT artifact in the registry IS
+  the demoted form — the zero-retrace loader makes reloading it a
+  bounded cold-load, never a retrace on the dispatcher thread). The
+  next request for an evicted model reloads it on the CALLER's thread
+  (handler threads own file I/O, the serve-blocking-io contract) and
+  then queues normally: eviction is invisible to clients except as
+  one request's cold-load latency;
+- **a control plane** — `add_model`/`remove_model`/`retag` mutate the
+  fleet without restart (the HTTP front end's `POST /models`), and
+  per-model `serve_latency` windows (model_name dimension), the
+  `fleet_evictions`/`fleet_reloads` counters, and `fault` events
+  (kind=fleet_eviction/fleet_reload) feed `cli report`'s fleet rollup.
+
+HOT-LOOP MODULE (the ddtlint serve-blocking-io + thread-model rules):
+no file I/O anywhere in here — model loading is the injected `loader`
+callable's job (ddt_tpu/serve/control.py builds it over the registry),
+and it is only ever invoked on caller/handler threads with no fleet
+lock held.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ddt_tpu.serve.batcher import MicroBatcher, PendingRequest, ShuttingDown
+from ddt_tpu.serve.engine import ServeStats, coerce_rows, dispatch_batch
+from ddt_tpu.telemetry import counters as tele_counters
+
+
+class UnknownModelError(KeyError):
+    """Request routed to a model name the fleet does not serve — the
+    HTTP layer renders this as a structured 404 (never a bare 500)."""
+
+    def __init__(self, name, known=()):
+        self.name = name
+        self.known = sorted(known)
+        super().__init__(name)
+
+    def __str__(self):
+        return (f"no model named {self.name!r} in the fleet "
+                f"(serving: {', '.join(self.known) or 'none'})")
+
+
+class ModelUnavailableError(RuntimeError):
+    """The model exists but cannot serve right now (evicted and its
+    reload failed, or the residency race could not settle) — the HTTP
+    layer renders this as a structured 503."""
+
+    def __init__(self, name, reason):
+        self.name = name
+        self.reason = reason
+        super().__init__(f"model {name!r} is unavailable: {reason}")
+
+
+class _EvictedInFlight(RuntimeError):
+    """Internal: an express dispatch found its slot evicted between the
+    residency check and the gate (a tiny race window) — the submit path
+    catches this, reloads, and requeues, so the CLIENT never sees it."""
+
+
+class FleetSlot:
+    """One fleet member: its spec, admission queue, residency state,
+    and telemetry. Pure state — the engine owns every transition (all
+    mutable fields are touched under the fleet Condition, except
+    `model`, which is a single-reference publish read once per
+    dispatch, the hot-swap idiom)."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.name = spec.name
+        self.weight = float(spec.weight)
+        self.stats = ServeStats()
+        self.model = None            # resident ServableModel | None
+        self.loading = False
+        self.load_error = None
+        self.ever_resident = False
+        self.last_used = 0           # fleet LRU clock (monotonic int)
+        self.evictions = 0
+        self.reloads = 0
+        self.deficit = 0.0           # DRR credit, in rows
+        self.batcher = None          # bound by FleetEngine._make_slot
+
+
+class FleetEngine:
+    """N models, one device, one dispatcher thread (module doc).
+
+    `specs` is a sequence of fleet specs (ddt_tpu/serve/control.py's
+    FleetSpec: name/ref/weight/tier/max_batch/raw); `loader(spec)` must
+    return a warmed-or-warmable ServableModel — it is called on caller
+    threads only, never on the dispatcher. `max_resident=None` keeps
+    every model resident (no eviction). `autostart=False` +
+    `start()` is the test seam for deterministic backlog setup;
+    `on_dispatch(name, rows)` observes the dispatch order (fairness
+    tests); `clock` is the injectable admission clock shared with every
+    batcher."""
+
+    #: the HTTP front end branches on this (fleet routing + /models).
+    fleet = True
+
+    def __init__(self, specs, loader, *, max_wait_ms: float = 1.0,
+                 max_resident: "int | None" = None, run_log=None,
+                 express_lane: bool = True, clock=None,
+                 on_dispatch=None, autostart: bool = True):
+        from ddt_tpu.telemetry.events import RunLog
+
+        if max_resident is not None and max_resident < 1:
+            raise ValueError(
+                f"max_resident must be >= 1, got {max_resident}")
+        self._loader = loader
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_resident = max_resident
+        self.express_lane = bool(express_lane)
+        self.run_log = RunLog.coerce(run_log)
+        self._clock = clock if clock is not None else time.perf_counter
+        self._on_dispatch = on_dispatch
+        self._cv = threading.Condition()
+        self._slots: dict[str, FleetSlot] = {}
+        self._order: list[str] = []      # DRR rotation; mutated under _cv
+        self._rr = 0
+        self._use_seq = 0
+        self._closed = False
+        # Lifecycle events the DISPATCHER settled (evictions on queue
+        # drain): buffered here and flushed to the run log by the next
+        # handler-thread touchpoint (health/emit_latency/reload) — the
+        # dispatcher thread never does file I/O (serve-blocking-io).
+        self._pending_events: list = []
+        for spec in specs:
+            if spec.name in self._slots:
+                raise ValueError(
+                    f"duplicate model name {spec.name!r} in the fleet")
+            self._make_slot_locked(spec)
+        self._thread = threading.Thread(
+            target=self._loop, name="ddt-fleet-dispatcher", daemon=True)
+        if autostart:
+            self._thread.start()
+
+    def start(self) -> None:
+        """Start the dispatcher (only meaningful after
+        `autostart=False` — the deterministic-backlog test seam)."""
+        if not self._thread.is_alive():
+            self._thread.start()
+
+    # ------------------------------------------------------------------ #
+    # slots & residency
+    # ------------------------------------------------------------------ #
+
+    def _make_slot_locked(self, spec) -> FleetSlot:
+        slot = FleetSlot(spec)
+        # DRIVEN batcher: shares the fleet Condition (one dispatcher
+        # thread parks on every queue), and its express dispatch is a
+        # slot-bound closure so the lane works exactly as on the
+        # single-model engine — same gate, same error containment.
+        slot.batcher = MicroBatcher(
+            self._express_fn(slot), max_wait_ms=self.max_wait_ms,
+            max_batch=spec.max_batch, clock=self._clock, cv=self._cv,
+            own_thread=False)
+        self._slots[spec.name] = slot
+        self._order.append(spec.name)
+        return slot
+
+    def _express_fn(self, slot):
+        def dispatch(batch, depth):
+            # The express lane reads the slot's model itself (there is
+            # no admission step to capture it at). An eviction landing
+            # in the tiny window between the caller's residency check
+            # and this read surfaces as _EvictedInFlight, which
+            # predict_async turns into reload-and-requeue — never a
+            # client-visible failure.
+            model = slot.model
+            if model is None:
+                raise _EvictedInFlight(slot.name)
+            dispatch_batch(model, batch, depth, slot.stats)
+        return dispatch
+
+    def _slot(self, name) -> FleetSlot:
+        with self._cv:
+            slot = self._slots.get(name)
+            if slot is None:
+                raise UnknownModelError(name, self._slots)
+            return slot
+
+    def _next_use_locked(self) -> int:
+        self._use_seq += 1
+        return self._use_seq
+
+    def _ensure_resident(self, slot: FleetSlot) -> None:
+        """Make `slot` resident, loading on THIS (caller) thread if it
+        was evicted; concurrent callers coalesce on one load. No fleet
+        lock is held across the load itself."""
+        with self._cv:
+            while slot.loading and not self._closed:
+                self._cv.wait()
+            if self._closed:
+                raise ShuttingDown("fleet engine is shut down")
+            if slot.model is not None:
+                return
+            slot.loading = True
+            slot.load_error = None
+        try:
+            model = self._loader(slot.spec)
+            # Publish-side guarantee (ServeEngine._build's contract): no
+            # live request ever pays a compile — on an already-warm
+            # model this is a handful of cached dispatches.
+            model.warmup()
+        except Exception as e:  # ddtlint: disable=broad-except
+            with self._cv:
+                slot.loading = False
+                slot.load_error = f"{type(e).__name__}: {e}"
+                self._cv.notify_all()
+            raise ModelUnavailableError(slot.name, slot.load_error) from e
+        with self._cv:
+            slot.loading = False
+            slot.model = model
+            slot.last_used = self._next_use_locked()
+            reloaded = slot.ever_resident
+            slot.ever_resident = True
+            if reloaded:
+                slot.reloads += 1
+            victims = self._evict_locked(keep=slot)
+            self._cv.notify_all()
+        # Telemetry OUTSIDE the lock (the run log's append is file I/O).
+        self._flush_events()
+        if reloaded:
+            tele_counters.record_fleet_reload()
+            self._emit_lifecycle("fleet_reload", slot)
+        for v in victims:
+            tele_counters.record_fleet_eviction()
+            self._emit_lifecycle("fleet_eviction", v)
+
+    def _evict_locked(self, keep: "FleetSlot | None") -> list:
+        """LRU demotion down to `max_resident` (called with the fleet
+        Condition held — after a publish, and by the dispatcher each
+        cycle so an over-budget fleet SETTLES once queues drain). Only
+        IDLE models are candidates — empty queue, not mid-load, not the
+        one just published; while everything is busy the fleet
+        overshoots its budget temporarily rather than failing live
+        traffic."""
+        if self.max_resident is None:
+            return []
+        victims = []
+        while True:
+            resident = [s for s in self._slots.values()
+                        if s.model is not None]
+            if len(resident) <= self.max_resident:
+                break
+            cands = [s for s in resident
+                     if s is not keep and not s.loading
+                     and not s.batcher.backlog_rows_locked()]
+            if not cands:
+                break
+            victim = min(cands, key=lambda s: s.last_used)
+            # Demotion IS a reference drop: the registry artifact is
+            # the cold form, and any batch/express dispatch that
+            # already read this reference keeps scoring with it.
+            victim.model = None
+            victim.evictions += 1
+            victims.append(victim)
+        return victims
+
+    def _emit_lifecycle(self, kind: str, slot: FleetSlot) -> None:
+        """Emit one lifecycle fault event NOW (handler threads only —
+        callers hold no fleet lock)."""
+        if self.run_log is None:
+            return
+        self.run_log.emit(
+            "fault", kind=kind, model_name=slot.name,
+            artifact_digest=getattr(slot.model, "artifact_digest", None)
+            if slot.model is not None else None,
+            evictions=slot.evictions, reloads=slot.reloads)
+
+    def _queue_eviction_events_locked(self, victims) -> None:
+        """Record dispatcher-settled evictions: counters move now
+        (plain int adds), the run-log events wait for a handler thread
+        (_flush_events) — the dispatcher never touches the log file."""
+        for v in victims:
+            tele_counters.record_fleet_eviction()
+            self._pending_events.append(
+                ("fleet_eviction", v.name, v.evictions, v.reloads))
+
+    def _flush_events(self) -> None:
+        """Drain dispatcher-buffered lifecycle events into the run log
+        (handler threads: health, emit_latency, reload)."""
+        with self._cv:
+            pending, self._pending_events[:] = \
+                list(self._pending_events), []
+        if self.run_log is None:
+            return
+        for kind, name, evictions, reloads in pending:
+            self.run_log.emit("fault", kind=kind, model_name=name,
+                              artifact_digest=None,
+                              evictions=evictions, reloads=reloads)
+
+    # ------------------------------------------------------------------ #
+    # request path
+    # ------------------------------------------------------------------ #
+
+    @property
+    def default_model(self) -> "str | None":
+        """The implicit routing target: the fleet's single model when
+        there is exactly one, else None (requests must name one)."""
+        with self._cv:
+            return self._order[0] if len(self._order) == 1 else None
+
+    def _resolve_name(self, model: "str | None") -> str:
+        """Routed name -> fleet member name, applying the single-model
+        default (one resolution shared by predict_async and the raw
+        wire path's width lookup, so the two cannot disagree)."""
+        name = model if model is not None else self.default_model
+        if name is None:
+            with self._cv:
+                known = list(self._slots)
+            raise UnknownModelError(
+                model if model is not None else "(unrouted)", known)
+        return name
+
+    def n_features_for(self, name: "str | None" = None) -> int:
+        """Feature width of the routed model (loads it if evicted —
+        the raw wire path needs the width before it can decode a body;
+        `None` resolves the single-model default like predict_async)."""
+        name = self._resolve_name(name)
+        slot = self._slot(name)
+        self._ensure_resident(slot)
+        model = slot.model
+        if model is None:
+            raise ModelUnavailableError(name, "evicted during lookup")
+        return model.n_features
+
+    def predict_async(self, rows, model: "str | None" = None
+                      ) -> PendingRequest:
+        name = self._resolve_name(model)
+        rows = coerce_rows(rows)
+        slot = self._slot(name)
+        # Residency + enqueue retry loop: an eviction can land between
+        # the load and the enqueue (or mid-express) — each lap reloads
+        # and tries again; the bound is defensive, in practice one lap.
+        for _ in range(8):
+            self._ensure_resident(slot)
+            if self.express_lane and rows.shape[0] == 1:
+                req = slot.batcher.express(rows, 1)
+                if req is not None:
+                    if isinstance(req.exception(), _EvictedInFlight):
+                        continue          # raced an eviction: reload
+                    with self._cv:
+                        slot.last_used = self._next_use_locked()
+                    return req
+            with self._cv:
+                if self._closed:
+                    raise ShuttingDown("fleet engine is shut down")
+                # A remove_model racing this request deletes the slot
+                # AFTER our lookup: enqueueing into the orphaned slot
+                # would hang forever (the dispatcher rotates over
+                # _order, which no longer lists it) — re-check
+                # membership under the same lock the removal holds.
+                if self._slots.get(name) is not slot:
+                    raise UnknownModelError(name, self._slots)
+                # Enqueue ATOMICALLY with the residency check (the
+                # Condition's lock is reentrant): eviction requires an
+                # empty queue under this same lock, so once enqueued
+                # the model cannot be demoted until the queue drains.
+                if slot.model is not None:
+                    slot.last_used = self._next_use_locked()
+                    return slot.batcher.submit(rows, rows.shape[0])
+        raise ModelUnavailableError(
+            name, "could not win the residency race (reload storm?)")
+
+    def predict(self, rows, model: "str | None" = None,
+                timeout: "float | None" = 30.0):
+        return self.predict_async(rows, model=model).result(timeout)
+
+    # ------------------------------------------------------------------ #
+    # dispatcher thread: weighted deficit round robin
+    # ------------------------------------------------------------------ #
+
+    def _rotation_locked(self, start: int) -> list:
+        """Slots in DRR rotation order beginning at index `start` (the
+        loop passes its own rotation pointer — every `self._rr` access
+        stays inside the two Condition-guarded methods that own it)."""
+        order = self._order
+        if not order:
+            return []
+        i = start % len(order)
+        return [self._slots[n] for n in order[i:] + order[:i]]
+
+    def _backlog_locked(self) -> int:
+        return sum(s.batcher.backlog_rows_locked()
+                   for s in self._slots.values())
+
+    def _loop(self) -> None:
+        while True:
+            admitted = []       # (slot, model, batch, depth)
+            with self._cv:
+                while True:
+                    if self._closed and not self._backlog_locked():
+                        return
+                    now = self._clock()
+                    ready = [s for s in self._rotation_locked(self._rr)
+                             if (s.batcher.ready_locked(now)
+                                 or (self._closed and s.batcher
+                                     .backlog_rows_locked()))]
+                    if ready:
+                        break
+                    timeout = None
+                    for s in self._slots.values():
+                        dl = s.batcher.head_deadline_locked()
+                        if dl is not None:
+                            t = max(0.0, dl - now)
+                            timeout = t if timeout is None \
+                                else min(timeout, t)
+                    # cv.wait(timeout) parks the thread — no
+                    # sleep-polling (the serve-blocking-io contract).
+                    self._cv.wait(timeout)
+                for slot in ready:
+                    # DRR: earn weight x max_batch rows of credit
+                    # (capped — credit never banks across idle spells),
+                    # then admit micro-batches until it runs out. The
+                    # model reference is captured HERE, under the lock
+                    # that eviction runs under: every admitted batch is
+                    # scored by exactly the version it was admitted
+                    # against (old-or-new-never-a-mix, per model).
+                    quantum = slot.weight * slot.batcher.max_batch
+                    slot.deficit = min(slot.deficit + quantum, quantum)
+                    while (slot.deficit > 0
+                           and (slot.batcher.ready_locked(self._clock())
+                                or self._closed)):
+                        batch, depth = slot.batcher.admit_locked()
+                        if not batch:
+                            break
+                        slot.deficit -= sum(r.n for r in batch)
+                        admitted.append(
+                            (slot, slot.model, batch, depth))
+                    if not slot.batcher.backlog_rows_locked():
+                        slot.deficit = 0.0
+                if self._order:
+                    self._rr = (self._rr + 1) % len(self._order)
+                # Over-budget settlement: a storm can make EVERY model
+                # busy at publish time (eviction skips busy slots), so
+                # the fleet overshoots max_resident; the dispatcher
+                # settles it back as soon as queues drain — a pure
+                # reference drop, nothing blocking (events are buffered
+                # for the next handler thread to flush).
+                self._queue_eviction_events_locked(
+                    self._evict_locked(keep=None))
+            for slot, model, batch, depth in admitted:
+                if self._on_dispatch is not None:
+                    self._on_dispatch(slot.name,
+                                      sum(r.n for r in batch))
+                if model is None:
+                    # Defensive: enqueue-under-lock makes this
+                    # unreachable (eviction needs an empty queue), but
+                    # a hung waiter would be strictly worse than a loud
+                    # per-request error if the invariant ever breaks.
+                    for req in batch:
+                        req.set_error(ModelUnavailableError(
+                            slot.name, "evicted with queued work"))
+                    continue
+                slot.batcher.dispatch_under_gate(
+                    self._batch_fn(model, slot), batch, depth)
+
+    def _batch_fn(self, model, slot):
+        def dispatch(batch, depth):
+            dispatch_batch(model, batch, depth, slot.stats)
+        return dispatch
+
+    # ------------------------------------------------------------------ #
+    # control plane (add / remove / retag) — caller threads only
+    # ------------------------------------------------------------------ #
+
+    def add_model(self, spec, *, load: bool = True) -> dict:
+        """Add a model to the fleet without restart. Loud on duplicate
+        names; `load=True` makes it resident now (evicting LRU models
+        past the budget), else it stays cold until first request. A
+        FAILED load rolls the slot back out — the HTTP add path has no
+        boot-time ref resolution, and a half-added broken member would
+        both 503 every routed request and block the corrected retry
+        with 'already in the fleet'."""
+        with self._cv:
+            if self._closed:
+                raise ShuttingDown("fleet engine is shut down")
+            if spec.name in self._slots:
+                raise ValueError(
+                    f"model {spec.name!r} is already in the fleet "
+                    "(remove it first, or retag it)")
+            slot = self._make_slot_locked(spec)
+            self._cv.notify_all()
+        if load:
+            try:
+                self._ensure_resident(slot)
+            except BaseException:
+                with self._cv:
+                    if self._slots.get(spec.name) is slot:
+                        del self._slots[spec.name]
+                        self._order.remove(spec.name)
+                        self._rr = 0
+                        slot.batcher.fail_pending_locked(
+                            UnknownModelError(spec.name, self._slots))
+                        self._cv.notify_all()
+                raise
+        return {"name": slot.name, "resident": slot.model is not None,
+                "weight": slot.weight}
+
+    def remove_model(self, name) -> dict:
+        """Remove a model: queued requests fail loudly (UnknownModel),
+        in-flight batches finish with the reference they hold."""
+        with self._cv:
+            slot = self._slots.get(name)
+            if slot is None:
+                raise UnknownModelError(name, self._slots)
+            failed = slot.batcher.fail_pending_locked(
+                UnknownModelError(name, set(self._slots) - {name}))
+            del self._slots[name]
+            self._order.remove(name)
+            self._rr = 0
+            slot.model = None
+            self._cv.notify_all()
+        if self.run_log is not None:
+            self.run_log.emit("fault", kind="fleet_remove",
+                              model_name=name, failed_requests=failed)
+        return {"name": name, "failed_requests": failed}
+
+    def spec_for(self, name):
+        """The current spec of fleet member `name` (the HTTP control
+        plane's retag path derives the replacement spec from it)."""
+        return self._slot(name).spec
+
+    def retag(self, name, spec) -> dict:
+        """Re-point an existing fleet member at a new reference and hot
+        swap it in — the per-model zero-downtime swap (the model
+        reference for each batch is read at admission, so requests see
+        exactly the old or the new version, never a mix)."""
+        slot = self._slot(name)
+        new = self._loader(spec)
+        new.warmup()
+        with self._cv:
+            if name not in self._slots:
+                raise UnknownModelError(name, self._slots)
+            old = slot.model
+            slot.spec = spec
+            slot.model = new
+            slot.ever_resident = True
+            slot.last_used = self._next_use_locked()
+            victims = self._evict_locked(keep=slot)
+            self._cv.notify_all()
+        tele_counters.record_serve_hot_swap()
+        old_token = old.token if old is not None else None
+        if self.run_log is not None:
+            self.run_log.emit(
+                "fault", kind="hot_swap", model_name=name,
+                old=old_token, new=new.token,
+                old_artifact=getattr(old, "artifact_digest", None),
+                new_artifact=new.artifact_digest)
+        for v in victims:
+            tele_counters.record_fleet_eviction()
+            self._emit_lifecycle("fleet_eviction", v)
+        return {"name": name, "old": old_token, "new": new.token,
+                "ref": spec.ref}
+
+    # ------------------------------------------------------------------ #
+    # telemetry
+    # ------------------------------------------------------------------ #
+
+    def _slot_health_locked(self, slot: FleetSlot) -> dict:
+        model = slot.model
+        out = {
+            "resident": model is not None,
+            "weight": slot.weight,
+            "max_batch": slot.batcher.max_batch,
+            "ref": slot.spec.ref,
+            "tier": slot.spec.tier,
+            "evictions": slot.evictions,
+            "reloads": slot.reloads,
+            "queued_rows": slot.batcher.backlog_rows_locked(),
+            "load_error": slot.load_error,
+        }
+        if model is not None:
+            out.update(model_token=model.token,
+                       predict_impl=model.predict_impl,
+                       artifact_digest=model.artifact_digest,
+                       n_features=model.n_features)
+        return out
+
+    def health(self) -> dict:
+        self._flush_events()
+        with self._cv:
+            models = {name: self._slot_health_locked(s)
+                      for name, s in sorted(self._slots.items())}
+            resident = sum(1 for s in self._slots.values()
+                           if s.model is not None)
+        return {
+            "ok": True,
+            "fleet": True,
+            "models": models,
+            "resident": resident,
+            "max_resident": self.max_resident,
+            "express_lane": self.express_lane,
+            "evictions": sum(m["evictions"] for m in models.values()),
+            "reloads": sum(m["reloads"] for m in models.values()),
+        }
+
+    def models(self) -> dict:
+        """GET /models payload (the health table, without the envelope)."""
+        return self.health()["models"]
+
+    def window_summaries(self, reset: bool = False) -> dict:
+        """{model_name: current-window latency summary} for /stats."""
+        with self._cv:
+            slots = list(self._slots.values())
+        out = {}
+        for slot in slots:
+            s = slot.stats.window_summary(reset=reset)
+            if s["requests"] == 0 and not reset:
+                continue
+            s["model_name"] = slot.name
+            out[slot.name] = s
+        return out
+
+    def emit_latency(self, reset: bool = True,
+                     only: "str | None" = None) -> dict:
+        """Emit one `serve_latency` event PER MODEL with traffic this
+        window (the model_name dimension — schema-additive); returns
+        {model_name: payload} for the models that emitted. `only`
+        restricts emission (and the window reset) to ONE model — the
+        per-model `/models/<name>/stats?emit=1` surface must not
+        silently discard every OTHER model's window."""
+        self._flush_events()
+        with self._cv:
+            slots = list(self._slots.values())
+        out = {}
+        for slot in slots:
+            if only is not None and slot.name != only:
+                continue
+            summary = slot.stats.window_summary(reset=reset)
+            if summary["requests"] == 0:
+                continue
+            summary["model_name"] = slot.name
+            model = slot.model
+            if model is not None:
+                summary["model_token"] = model.token
+                summary["predict_impl"] = model.predict_impl
+                if model.artifact_digest is not None:
+                    summary["artifact_digest"] = model.artifact_digest
+            if self.run_log is not None:
+                self.run_log.emit("serve_latency", **summary)
+            out[slot.name] = summary
+        return out
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            for slot in self._slots.values():
+                slot.batcher.close()      # no own thread: marks closed
+            self._cv.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(10.0)
+        self.emit_latency(reset=True)
+        if self.run_log is not None:
+            self.run_log.close()

@@ -37,6 +37,29 @@ Endpoints (all JSON):
                   resets the window
 - POST /shutdown  -> drains and stops the server
 
+FLEET servers (`cli serve --models/--fleet-config`, ISSUE 15 —
+docs/SERVING.md "Fleet") add per-model routing and a control plane:
+
+- POST /models/<name>/predict   route by URL path (binned=raw works
+                  here too — the raw body decodes against THAT
+                  model's width, reloading it on this handler thread
+                  if it was evicted);
+- POST /predict + header `X-DDT-Model: <name>`   route by header;
+- GET  /models                 the fleet table (residency, weights,
+                  tiers, eviction/reload counts, queue depths);
+- POST /models    {"action": "add"|"remove"|"retag", ...} — mutate
+                  the fleet without restart (add takes a fleet-config
+                  entry; retag takes {"name", "ref"[, "tier"]});
+- GET  /models/<name>/stats    that model's current window;
+- GET  /stats[?emit=1]         every model's windows (emit = one
+                  serve_latency event per model, model_name stamped).
+
+An unknown model name is a STRUCTURED 404 ({"error", "model",
+"models"}); a model whose eviction-reload fails is a structured 503
+({"error", "model", "reason"}) — never a bare 500 from the handler
+thread (the ISSUE 15 bugfix). /swap on a fleet is a 400 pointing at
+POST /models.
+
 File I/O note: model loading (api.load_model) happens HERE, on the
 swap/boot path — never in the engine or batcher hot-loop modules (the
 ddtlint serve-blocking-io rule).
@@ -52,8 +75,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from ddt_tpu.serve.batcher import ShuttingDown
+from ddt_tpu.serve.fleet import ModelUnavailableError, UnknownModelError
 
 log = logging.getLogger("ddt_tpu.serve.http")
+
+#: request header that routes a /predict to a fleet model (the URL
+#: path form is /models/<name>/predict — both work, binned=raw
+#: included).
+MODEL_HEADER = "X-DDT-Model"
 
 
 def _swap(engine, ref: str) -> dict:
@@ -120,7 +149,51 @@ def decode_raw_rows(body: bytes, n_features: int,
     return np.frombuffer(body, dtype=np.uint8).reshape(-1, n_features)
 
 
+def _models_post(engine, req: dict) -> dict:
+    """POST /models control plane (fleet servers only): add / remove /
+    retag without restart. The spec coercion reuses the fleet-config
+    grammar, so the wire and the config file cannot drift."""
+    import dataclasses
+
+    from ddt_tpu.serve import control as fleet_control
+
+    action = req.get("action")
+    if action == "add":
+        d = {k: v for k, v in req.items() if k != "action"}
+        return engine.add_model(
+            fleet_control.coerce_spec(d, "POST /models add"))
+    if action == "remove":
+        if "name" not in req:
+            raise ValueError("POST /models remove needs a 'name'")
+        return engine.remove_model(req["name"])
+    if action == "retag":
+        if "name" not in req or "ref" not in req:
+            raise ValueError(
+                "POST /models retag needs 'name' and 'ref' (the new "
+                "registry reference the model should serve)")
+        spec = dataclasses.replace(engine.spec_for(req["name"]),
+                                   ref=str(req["ref"]))
+        if "tier" in req:
+            from ddt_tpu.serve.engine import normalize_quantize
+
+            spec = dataclasses.replace(
+                spec, tier=normalize_quantize(req["tier"]))
+        return engine.retag(req["name"], spec)
+    raise ValueError(
+        f"POST /models: unknown action {action!r} (expected add, "
+        "remove, or retag)")
+
+
+def _unknown_model_body(e: UnknownModelError) -> dict:
+    """The ONE structured 404 body for an unaddressable model (shared
+    by the GET and POST error boundaries — the two surfaces cannot
+    drift)."""
+    return {"error": str(e), "model": e.name, "models": e.known}
+
+
 def _make_handler(engine, server_box: dict):
+    fleet = bool(getattr(engine, "fleet", False))
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -140,22 +213,66 @@ def _make_handler(engine, server_box: dict):
             raw = self.rfile.read(n) if n else b"{}"
             return json.loads(raw or b"{}")
 
+        def _route_model(self) -> "tuple[str, str | None]":
+            """Split the URL into (root path, routed model name):
+            `/models/<name>/predict` routes by path, anything else by
+            the X-DDT-Model header (None = unrouted). A routed request
+            against a single-model server is a structured 404 — the
+            fleet surface simply does not exist there (the ISSUE 15
+            bugfix: never a bare 500 for an unroutable request)."""
+            path = self.path.split("?", 1)[0]
+            name = self.headers.get(MODEL_HEADER)
+            if path.startswith("/models/"):
+                parts = path.split("/", 3)
+                if len(parts) == 4:
+                    name = parts[2]
+                    path = "/" + parts[3]
+            if name is not None and not fleet:
+                raise UnknownModelError(name, [])
+            return path, name
+
         def do_GET(self):
-            if self.path == "/healthz":
-                return self._send(200, engine.health())
-            if self.path.startswith("/stats"):
-                emit = "emit=1" in self.path
-                if emit:
-                    out = engine.emit_latency(reset=True) or {
-                        "requests": 0}
-                else:
-                    out = engine.stats.window_summary(reset=False)
-                return self._send(200, out)
-            return self._send(404, {"error": f"no route {self.path}"})
+            try:
+                path, name = self._route_model()
+                if path == "/healthz":
+                    return self._send(200, engine.health())
+                if path == "/models" and fleet:
+                    return self._send(200, {"models": engine.models()})
+                if path == "/stats":
+                    emit = "emit=1" in self.path
+                    if fleet:
+                        if name is not None:
+                            # Unknown names are the same structured
+                            # 404 as /predict — a monitoring typo must
+                            # not read healthy zeros forever.
+                            engine.spec_for(name)
+                        if emit:
+                            # Per-model emit resets ONLY that model's
+                            # window (`only=`); the unrouted form
+                            # emits every model.
+                            out = engine.emit_latency(reset=True,
+                                                      only=name)
+                        else:
+                            out = engine.window_summaries(reset=False)
+                        if name is not None:
+                            out = out.get(name) or {"requests": 0,
+                                                    "model_name": name}
+                        return self._send(200, out)
+                    if emit:
+                        out = engine.emit_latency(reset=True) or {
+                            "requests": 0}
+                    else:
+                        out = engine.stats.window_summary(reset=False)
+                    return self._send(200, out)
+                return self._send(404,
+                                  {"error": f"no route {self.path}"})
+            except UnknownModelError as e:
+                return self._send(404, _unknown_model_body(e))
 
         def do_POST(self):
             try:
-                if self.path.split("?", 1)[0] == "/predict":
+                path, name = self._route_model()
+                if path == "/predict":
                     qs = self.path.partition("?")[2]
                     ctype = self.headers.get("Content-Type", "")
                     if ("binned=raw" in qs.split("&")
@@ -163,9 +280,11 @@ def _make_handler(engine, server_box: dict):
                                 "application/octet-stream")):
                         # Zero-copy binned wire path (module doc): the
                         # body bytes become the row array directly —
-                        # width derived from the CURRENT model (a swap
+                        # width derived from the routed model (a swap
                         # race is caught again at dispatch, like every
-                        # other request).
+                        # other request). On a fleet this may reload an
+                        # evicted model HERE, on the handler thread —
+                        # never the dispatcher's.
                         n = self.headers.get("Content-Length")
                         declared = int(n) if n is not None else None
                         if declared is not None and declared < 0:
@@ -176,8 +295,9 @@ def _make_handler(engine, server_box: dict):
                                 f">= 0, got {declared}")
                         body = self.rfile.read(declared) \
                             if declared else b""
-                        rows = decode_raw_rows(
-                            body, engine.n_features, declared)
+                        width = (engine.n_features_for(name) if fleet
+                                 else engine.n_features)
+                        rows = decode_raw_rows(body, width, declared)
                     else:
                         req = self._body()
                         rows = np.asarray(req["rows"])
@@ -199,15 +319,26 @@ def _make_handler(engine, server_box: dict):
                     # ACTUALLY scored the batch — reading engine.
                     # model_token here instead races the hot swap and
                     # mis-attributes responses that straddle it.
-                    pending = engine.predict_async(rows)
+                    if fleet:
+                        pending = engine.predict_async(rows, model=name)
+                    else:
+                        pending = engine.predict_async(rows)
                     scores = pending.result(30.0)
                     return self._send(200, {
                         "scores": np.asarray(scores).tolist(),
                         "model": pending.model_token})
-                if self.path == "/swap":
+                if path == "/models" and fleet:
+                    return self._send(200,
+                                      _models_post(engine, self._body()))
+                if path == "/swap":
+                    if fleet:
+                        raise ValueError(
+                            "fleet servers manage models via POST "
+                            "/models (action add/remove/retag), not "
+                            "/swap")
                     req = self._body()
                     return self._send(200, _swap(engine, req["model"]))
-                if self.path == "/shutdown":
+                if path == "/shutdown":
                     self._send(200, {"ok": True})
                     threading.Thread(
                         target=server_box["server"].shutdown,
@@ -217,13 +348,24 @@ def _make_handler(engine, server_box: dict):
             # The handler IS the error boundary: every failure must
             # become a JSON response on the open connection, never an
             # unwound handler (= connection reset with no body). Order
-            # matters: TimeoutError is an OSError subclass.
+            # matters: TimeoutError is an OSError subclass, and the
+            # fleet routing errors subclass KeyError/RuntimeError — the
+            # STRUCTURED 404/503 bodies must win over the generic
+            # 400/500 (the ISSUE 15 bugfix: an unknown or
+            # evicted-and-reload-failing model is an addressed,
+            # machine-readable refusal, not a bare 500).
             except TimeoutError as e:
                 return self._send(504, {"error": f"{type(e).__name__}: "
                                                  f"{e}"})
             except ShuttingDown as e:
                 return self._send(503, {"error": f"{type(e).__name__}: "
                                                  f"{e}"})
+            except UnknownModelError as e:
+                return self._send(404, _unknown_model_body(e))
+            except ModelUnavailableError as e:
+                return self._send(503, {
+                    "error": str(e), "model": e.name,
+                    "reason": e.reason})
             except (KeyError, ValueError, TypeError, OSError) as e:
                 return self._send(400, {"error": f"{type(e).__name__}: "
                                                  f"{e}"})
@@ -259,8 +401,12 @@ def serve_forever(engine, host: str = "127.0.0.1", port: int = 8199,
     # an ephemeral (port=0) binding without racing serve_forever's
     # blocking loop (scripts/serve_smoke.py).
     engine.http_port = bound
-    log.info("serving on %s:%d (model %s)", host, bound,
-             engine.model_token[:12])
+    if getattr(engine, "fleet", False):
+        log.info("serving fleet on %s:%d (%d model(s))", host, bound,
+                 len(engine.models()))
+    else:
+        log.info("serving on %s:%d (model %s)", host, bound,
+                 engine.model_token[:12])
     if ready_event is not None:
         ready_event.set()
     try:

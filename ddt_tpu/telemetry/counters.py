@@ -83,6 +83,14 @@ _c = {
     # serve_express/serve_requests is the lifetime share of traffic
     # that skipped the admission window (== idle-regime traffic).
     "serve_express": 0,
+    # Fleet tenancy (ddt_tpu/serve/fleet.py, ISSUE 15): LRU demotions
+    # of cold models to their AOT artifacts, and reloads of previously
+    # evicted models on their next request. A fleet whose reloads track
+    # its evictions 1:1 is thrashing (max_resident too small for the
+    # live working set); per-model attribution lives in the
+    # fault(kind=fleet_eviction/fleet_reload) events, not here.
+    "fleet_evictions": 0,
+    "fleet_reloads": 0,
     # EFFECTIVE per-round g/h HBM stream bytes (grad_stream_bytes below;
     # recorded by the Driver and the streaming trainers every round) —
     # the quantized-gradient win's in-process witness: an f32 run and an
@@ -179,6 +187,14 @@ def record_serve_hot_swap() -> None:
 
 def record_serve_express() -> None:
     _c["serve_express"] += 1
+
+
+def record_fleet_eviction() -> None:
+    _c["fleet_evictions"] += 1
+
+
+def record_fleet_reload() -> None:
+    _c["fleet_reloads"] += 1
 
 
 def record_grad_stream(nbytes: int) -> None:

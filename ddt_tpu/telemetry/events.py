@@ -39,6 +39,13 @@ import time
 # plus the optional `artifact_digest` extra on serve_latency and the
 # `old_artifact`/`new_artifact` extras on hot_swap faults. v1-v4 logs
 # remain readable (tests/test_registry.py pins the v4 round trip).
+# ISSUE 15 extras (schema-ADDITIVE, no version bump — the fleet tier):
+# `model_name` on serve_latency and hot_swap faults (the multi-model
+# dimension `report`'s fleet rollup groups on; absent on single-model
+# logs, which render exactly as before), the fleet lifecycle fault
+# kinds fleet_eviction / fleet_reload / fleet_remove (model_name +
+# artifact_digest + running eviction/reload counts as extras), and the
+# fleet_evictions / fleet_reloads process counters.
 SCHEMA_VERSION = 5
 
 #: event type -> REQUIRED payload fields (extras are allowed and common:
@@ -74,7 +81,10 @@ EVENT_FIELDS: dict[str, set] = {
     # (utils/retry.py, with seam + attempt); injected (the chaos
     # harness, robustness/faultplan.py, with site); hist_oom_degrade
     # (backends/tpu.py); straggler_detected / repartition
-    # (robustness/watchdog.py via the trainers).
+    # (robustness/watchdog.py via the trainers); hot_swap
+    # (serve/engine.py + fleet retag, with old/new tokens and the
+    # ISSUE 15 model_name extra); fleet_eviction / fleet_reload /
+    # fleet_remove (serve/fleet.py, with model_name + artifact_digest).
     "fault": {"kind"},
     # Device-counter deltas over the run (telemetry.counters).
     "counters": {"jit_compiles", "h2d_bytes", "d2h_bytes",
@@ -101,9 +111,11 @@ EVENT_FIELDS: dict[str, set] = {
     # `predict_impl` (the quantization tier ACTUALLY serving the window
     # — "lut4"/"lut"/"f32"; a silent VMEM-guard fallback is visible
     # here, not only in debug logs) and `express` (requests the express
-    # lane dispatched without an admission window). Consumed by
-    # `report`'s serving section and banded (via the bench stamps) by
-    # benchwatch.
+    # lane dispatched without an admission window). Additive ISSUE 15
+    # extra: `model_name` (the fleet tier emits one window per resident
+    # model — `report`'s fleet rollup groups on it; absent on
+    # single-model logs). Consumed by `report`'s serving section and
+    # banded (via the bench stamps) by benchwatch.
     "serve_latency": {"requests", "p50_ms", "p99_ms"},
     # Last record of a completed run.
     "run_end": {"completed_rounds", "wallclock_s"},

@@ -194,6 +194,16 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
         # summaries render exactly as before.
         "serving": _serving_summary(
             [e for e in events if e["event"] == "serve_latency"]),
+        # Fleet rollup (ISSUE 15): per-model join of serve_latency
+        # windows (the model_name dimension), eviction/reload lifecycle
+        # faults, and artifact provenance — None unless some window
+        # carries model_name, so single-model and pre-fleet logs render
+        # exactly as before. `cli report --log L fleet` renders just
+        # this table.
+        "fleet": _fleet_summary(
+            [e for e in events if e["event"] == "serve_latency"],
+            [e for e in events if e["event"] == "fault"],
+            [e for e in events if e["event"] == "artifact"]),
         # Registry provenance (schema v5): artifact push/load events,
         # each cross-referenced against THIS run's id when they carry
         # one — None on pre-v5 logs.
@@ -257,6 +267,66 @@ def _serving_summary(serve_ev: list[dict]) -> dict | None:
     }
 
 
+def _fleet_summary(serve_ev: list[dict], fault_ev: list[dict],
+                   artifact_ev: list[dict]) -> dict | None:
+    """Per-model fleet rollup: every model's serve_latency windows,
+    the tier that actually served its last window, eviction/reload
+    counts (fleet_eviction/fleet_reload faults), and the artifact each
+    model served (joined to the artifact events' name@version/run_id
+    provenance by digest). None unless the log carries the model_name
+    dimension — pre-fleet logs summarize exactly as before."""
+    named = [e for e in serve_ev if e.get("model_name")]
+    if not named:
+        return None
+    models: dict = {}
+
+    def rec(name) -> dict:
+        return models.setdefault(name, {
+            "windows": 0, "requests": 0, "express": 0,
+            "p50_ms": None, "p99_ms": None, "worst_p99_ms": None,
+            "tier": None, "model_token": None, "artifact_digest": None,
+            "evictions": 0, "reloads": 0, "artifact": None,
+        })
+
+    for e in named:
+        m = rec(e["model_name"])
+        m["windows"] += 1
+        m["requests"] += e["requests"]
+        m["express"] += e.get("express", 0) or 0
+        m["p50_ms"] = e["p50_ms"]            # last window's quantiles
+        m["p99_ms"] = e["p99_ms"]
+        m["worst_p99_ms"] = max(m["worst_p99_ms"] or 0.0, e["p99_ms"])
+        m["tier"] = e.get("predict_impl") or m["tier"]
+        m["model_token"] = e.get("model_token") or m["model_token"]
+        m["artifact_digest"] = (e.get("artifact_digest")
+                                or m["artifact_digest"])
+    for f in fault_ev:
+        name = f.get("model_name")
+        if not name:
+            continue
+        if f.get("kind") == "fleet_eviction":
+            rec(name)["evictions"] += 1
+        elif f.get("kind") == "fleet_reload":
+            rec(name)["reloads"] += 1
+    # Provenance join: the artifact event stream knows name@version,
+    # run_id, and restore mode per digest — attach each model's.
+    by_digest = {}
+    for a in artifact_ev:
+        d = a.get("digest")
+        if d:
+            by_digest[d] = {
+                "name": a.get("name"), "version": a.get("version"),
+                "run_id": a.get("run_id"), "mode": a.get("mode")}
+    for m in models.values():
+        if m["artifact_digest"]:
+            m["artifact"] = by_digest.get(m["artifact_digest"])
+    return {
+        "models": dict(sorted(models.items())),
+        "evictions": sum(m["evictions"] for m in models.values()),
+        "reloads": sum(m["reloads"] for m in models.values()),
+    }
+
+
 def _registry_summary(artifact_ev: list[dict],
                       log_run_id) -> dict | None:
     """Reduce a run's artifact events for the report: one record per
@@ -296,6 +366,45 @@ def _fmt_bytes(n) -> str:
             return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
         n /= 1024
     return f"{n}"
+
+
+def render_fleet(summary: dict) -> str:
+    """The `report fleet` rollup: one row per model joining its SLO
+    windows, serving tier, eviction/reload counts, and artifact
+    provenance (docs/OBSERVABILITY.md). Raises ValueError when the log
+    carries no fleet data (no model_name-dimensioned windows)."""
+    fl = summary.get("fleet")
+    if not fl:
+        raise ValueError(
+            "log carries no fleet serve_latency windows (no model_name "
+            "dimension) — is this a single-model serve log?")
+    out = [f"fleet: {len(fl['models'])} model(s), "
+           f"{fl['evictions']} eviction(s), {fl['reloads']} reload(s)"]
+    out.append(
+        f"  {'model':<12} {'reqs':>7} {'win':>4} {'p50_ms':>8} "
+        f"{'p99_ms':>8} {'worst_p99':>9} {'tier':<5} {'evic':>4} "
+        f"{'reld':>4}  artifact")
+    def ms(v) -> str:
+        # A model can enter the rollup through lifecycle faults alone
+        # (evicted before it ever served a window) — its quantiles are
+        # honestly absent, not zero.
+        return f"{v:>8.3f}" if v is not None else f"{'-':>8}"
+
+    for name, m in fl["models"].items():
+        art = m.get("artifact_digest") or "-"
+        prov = m.get("artifact")
+        if prov and prov.get("name") and prov.get("version") is not None:
+            art += f" ({prov['name']}@{prov['version']}"
+            if prov.get("mode"):
+                art += f", {prov['mode']}"
+            art += ")"
+        out.append(
+            f"  {name:<12} {m['requests']:>7} {m['windows']:>4} "
+            f"{ms(m['p50_ms'])} {ms(m['p99_ms'])} "
+            f"{ms(m['worst_p99_ms']):>9} "
+            f"{(m['tier'] or '-'):<5} {m['evictions']:>4} "
+            f"{m['reloads']:>4}  {art}")
+    return "\n".join(out)
 
 
 def render(summary: dict) -> str:
@@ -396,6 +505,9 @@ def render(summary: dict) -> str:
         if s.get("model_tokens"):
             out.append("  models served: "
                        + ", ".join(s["model_tokens"]))
+
+    if summary.get("fleet"):
+        out.append(render_fleet(summary))
 
     if summary.get("registry"):
         r = summary["registry"]

@@ -1,7 +1,7 @@
 """Chaos smoke (`make chaos-smoke`): a small CPU run under a multi-fault
 plan asserting BIT-EXACT recovery (docs/ROBUSTNESS.md).
 
-Three arms, all in one process, all on the CPU platform:
+Four arms, all on the CPU platform (the first three in one process):
 
 1. **Torn checkpoint write** — a streamed training run dies (injected
    crash between the checkpoint pair's two os.replace calls, leaving
@@ -13,6 +13,10 @@ Three arms, all in one process, all on the CPU platform:
 3. **Injected straggler** — a 2-partition in-memory run with a run log
    gets one lane's observed times inflated; the watchdog must detect it
    (fault events in the log) while the trained model stays untouched.
+4. **Serving process kill/restart** (ISSUE 15) — a real `cli serve`
+   subprocess is SIGKILLed mid-storm and restarted on the same port;
+   every concurrent client recovers by retrying, all requests
+   eventually succeed, and every response matches the offline answer.
 
 The verdict for every arm is the same: the final ensemble is
 bit-identical to an undisturbed run, and the run log tells the whole
@@ -153,9 +157,121 @@ def main() -> int:
     assert "straggler_detected" in kinds2, kinds2
     out["straggler_detected"] = True
 
+    # Arm 4 (ISSUE 15): kill/restart the SERVING process mid-storm —
+    # the `cli serve` process is SIGKILLed while concurrent clients
+    # are in flight, restarted on the same port, and every client
+    # RECOVERS by retrying: all requests eventually succeed and every
+    # response bit-matches the offline answer (the serving tier's
+    # process-death story, complementing the training arms above).
+    serve_chaos(out, res_ref, Xb, cfg2)
+
     out["ok"] = True
     print(json.dumps(out))
     return 0
+
+
+def serve_chaos(out: dict, res, Xb, cfg) -> None:
+    import socket
+    import subprocess
+    import threading
+    import time
+    import urllib.request
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ref = np.asarray(api.predict(res.ensemble, Xb[:64], cfg=cfg,
+                                 binned=True))
+    with tempfile.TemporaryDirectory() as td:
+        model = os.path.join(td, "serve_chaos.npz")
+        res.save(model)
+        # a port that is free NOW and reusable after the SIGKILL
+        # (HTTPServer sets allow_reuse_address)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        def spawn():
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ddt_tpu.cli", "serve",
+                 "--model", model, "--backend", "tpu",
+                 "--port", str(port), "--max-wait-ms", "2"],
+                cwd=repo, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"serve process exited rc={proc.returncode}")
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=2)
+                    return proc
+                except OSError:
+                    time.sleep(0.25)
+            raise RuntimeError("serve process never came up")
+
+        proc = spawn()
+        n_clients, per_client = 8, 6
+        done = [0]
+        done_lock = threading.Lock()
+        errs = []
+
+        def client(ci):
+            for k in range(per_client):
+                lo = (ci * per_client + k) % 48
+                body = json.dumps({
+                    "rows": Xb[lo:lo + 2].tolist(),
+                    "binned": True}).encode()
+                deadline = time.time() + 150
+                while True:           # the RECOVERY loop: retry until
+                    try:              # a (possibly new) process answers
+                        req = urllib.request.Request(
+                            f"http://127.0.0.1:{port}/predict",
+                            data=body,
+                            headers={"Content-Type": "application/json"},
+                            method="POST")
+                        with urllib.request.urlopen(req, timeout=10) as r:
+                            scores = json.loads(r.read())["scores"]
+                        np.testing.assert_allclose(
+                            np.asarray(scores, np.float32),
+                            ref[lo:lo + 2].astype(np.float32),
+                            rtol=1e-5, atol=1e-6)
+                        with done_lock:
+                            done[0] += 1
+                        break
+                    except AssertionError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 — retried
+                        if time.time() > deadline:
+                            errs.append((ci, k, repr(e)))
+                            return
+                        time.sleep(0.3)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        # let the storm make progress, then KILL the server dead
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            with done_lock:
+                if done[0] >= 8:
+                    break
+            time.sleep(0.05)
+        proc.kill()
+        proc.wait(30)
+        out["serve_killed_after"] = done[0]
+        # restart on the SAME port: in-flight and queued client
+        # requests fail at the socket and RETRY into the new process
+        proc = spawn()
+        for t in threads:
+            t.join(300)
+        proc.kill()
+        proc.wait(30)
+        assert not errs, f"clients failed to recover: {errs[:5]}"
+        assert done[0] == n_clients * per_client, done[0]
+        out["serve_restart_recovered"] = done[0]
 
 
 if __name__ == "__main__":

@@ -23,7 +23,19 @@ One process, five assertions:
    response BIT-matches the offline answer of the tier that actually
    served it (predict_impl='lut4', verified from /healthz), raw and
    JSON bodies agree bitwise, the express counter moved, and the
-   malformed-width raw body 400s loudly.
+   malformed-width raw body 400s loudly;
+7. (ISSUE 15 FLEET arm) three registry-pushed models of MIXED tiers
+   (f32 / int8 / int4) behind ONE fleet engine with max_resident=2:
+   a concurrent storm across all three (path + header routing,
+   binned=raw included) with LRU evictions + zero-downtime reloads
+   forced MID-STORM — zero failures, every response bit-identical to
+   the offline `api.predict` answer OF THE TIER/ARTIFACT that served
+   it, `/healthz` witnesses evictions>=1 and reloads>=1, a
+   steady-state window over the resident models records 0 jit
+   compiles, the run log's per-model serve_latency windows render
+   through `report fleet`, and a saturated single-model A/B holds the
+   fleet p99 within 1.5x of the plain single-engine baseline on the
+   same run.
 
 Exit 0 = all hold.
 """
@@ -261,6 +273,198 @@ def main() -> int:
     out["int4_express_hits"] = stats4["express"]
     _post(port4, "/shutdown", {})
     th4.join(30)
+
+    # --- ISSUE 15 FLEET arm: mixed-tier registry fleet, LRU eviction +
+    # reload mid-storm, per-model SLO windows, saturated p99 A/B.
+    import concurrent.futures
+
+    from ddt_tpu.registry.loader import push_servable
+    from ddt_tpu.serve.control import FleetSpec, build_fleet
+    from ddt_tpu.telemetry import counters as tele_counters
+
+    tele_counters.install_jax_listener()
+    with tempfile.TemporaryDirectory() as td:
+        reg = os.path.join(td, "registry")
+        fleet_log = os.path.join(td, "fleet.jsonl")
+        # three artifacts, three tiers (max_batch=32 keeps export quick)
+        push_servable(reg, api.ModelBundle(ensemble=res_a.ensemble,
+                                           mapper=res_a.mapper),
+                      name="alpha", max_batch=32, quantize=False)
+        push_servable(reg, api.ModelBundle(ensemble=res_b.ensemble,
+                                           mapper=res_b.mapper),
+                      name="beta", max_batch=32, quantize="int8")
+        push_servable(reg, api.ModelBundle(ensemble=res4.ensemble,
+                                           mapper=res4.mapper),
+                      name="gamma", max_batch=32, quantize="int4")
+        # offline references THROUGH THE TIER each artifact carries
+        ref_fleet = {
+            "alpha": want[res_a.ensemble.compile().token],
+            "beta": np.asarray(api.predict(
+                res_b.ensemble, X, mapper=res_b.mapper,
+                cfg=TrainConfig(backend="tpu", n_bins=31,
+                                predict_impl="lut"))),
+            "gamma": ref4,
+        }
+        rows_for = {"alpha": X, "beta": X, "gamma": X4}
+        engine_f = build_fleet(
+            [FleetSpec(name="alpha", ref="alpha@latest", max_batch=32),
+             FleetSpec(name="beta", ref="beta@latest", max_batch=32),
+             FleetSpec(name="gamma", ref="gamma@latest", max_batch=32)],
+            registry=reg, backend="tpu", max_wait_ms=2.0,
+            max_resident=2, run_log=fleet_log)
+        ready_f = threading.Event()
+        th_f = threading.Thread(
+            target=serve_forever, args=(engine_f,),
+            kwargs=dict(port=0, ready_event=ready_f), daemon=True)
+        th_f.start()
+        assert ready_f.wait(60), "fleet server never came up"
+        pf = engine_f.http_port
+
+        h = _get(pf, "/healthz")
+        assert h["fleet"] and set(h["models"]) == {"alpha", "beta",
+                                                   "gamma"}
+        assert h["resident"] == 2, h     # budget respected at boot
+
+        # THE STORM: concurrent traffic across all three models —
+        # gamma starts cold, so its first requests force an LRU
+        # eviction + zero-downtime reload MID-STORM. Routing mixes the
+        # URL-path and header forms; gamma additionally rides the
+        # zero-copy binned=raw wire path.
+        Xb_gamma = res4.mapper.transform(X4)
+        errs_f = []
+
+        def fleet_worker(i):
+            name = ("alpha", "beta", "gamma")[i % 3]
+            lo = 2 * i
+            try:
+                if name == "gamma":
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{pf}/models/gamma/predict"
+                        "?binned=raw",
+                        data=Xb_gamma[lo:lo + 2].tobytes(),
+                        headers={"Content-Type":
+                                 "application/octet-stream"},
+                        method="POST")
+                elif i % 2:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{pf}/models/{name}/predict",
+                        data=json.dumps(
+                            {"rows":
+                             rows_for[name][lo:lo + 2].tolist()}
+                        ).encode(),
+                        headers={"Content-Type": "application/json"},
+                        method="POST")
+                else:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{pf}/predict",
+                        data=json.dumps(
+                            {"rows":
+                             rows_for[name][lo:lo + 2].tolist()}
+                        ).encode(),
+                        headers={"Content-Type": "application/json",
+                                 "X-DDT-Model": name},
+                        method="POST")
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    scores = json.loads(r.read())["scores"]
+                np.testing.assert_array_equal(
+                    np.asarray(scores, np.float32),
+                    ref_fleet[name][lo:lo + 2].astype(np.float32))
+            except Exception as e:       # noqa: BLE001 — smoke verdict
+                errs_f.append((i, name, repr(e)))
+
+        with concurrent.futures.ThreadPoolExecutor(24) as pool:
+            list(pool.map(fleet_worker, range(36)))
+        assert not errs_f, f"fleet storm failures: {errs_f[:5]}"
+
+        # eviction + reload witnessed (gamma's cold load overflowed the
+        # budget; the dispatcher settled it back; evicted models were
+        # re-requested and reloaded — all mid-storm, zero failures)
+        h = _get(pf, "/healthz")
+        assert h["evictions"] >= 1, h
+        # every model answers post-storm; at least one reloads to do so
+        for name in ("alpha", "beta", "gamma"):
+            r = _post(pf, f"/models/{name}/predict",
+                      {"rows": rows_for[name][:2].tolist()})
+            np.testing.assert_array_equal(
+                np.asarray(r["scores"], np.float32),
+                ref_fleet[name][:2].astype(np.float32))
+        h = _get(pf, "/healthz")
+        assert h["reloads"] >= 1, h
+        out["fleet_evictions"] = h["evictions"]
+        out["fleet_reloads"] = h["reloads"]
+
+        # steady state on the RESIDENT pair: zero jit compiles across
+        # a fresh storm (the zero-retrace dispatch-path witness)
+        resident = [n for n, m in h["models"].items() if m["resident"]]
+        assert len(resident) == 2, h
+        for name in resident:            # warm the buckets in use
+            _post(pf, f"/models/{name}/predict",
+                  {"rows": rows_for[name][:2].tolist()})
+        c0 = tele_counters.snapshot()
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            list(pool.map(
+                lambda i: _post(
+                    pf,
+                    f"/models/{resident[i % 2]}/predict",
+                    {"rows":
+                     rows_for[resident[i % 2]][:2].tolist()}),
+                range(24)))
+        steady = tele_counters.delta(c0)["jit_compiles"]
+        assert steady == 0, \
+            f"{steady} jit compiles during steady-state fleet serving"
+        out["fleet_steady_state_jit_compiles"] = steady
+
+        _post(pf, "/shutdown", {})
+        th_f.join(30)
+
+        # per-model SLO windows land and the fleet rollup renders
+        events = tele_report.read_events(fleet_log)
+        names = {e.get("model_name") for e in events
+                 if e["event"] == "serve_latency"}
+        assert {"alpha", "beta", "gamma"} <= names, names
+        summary = tele_report.summarize(events)
+        assert set(summary["fleet"]["models"]) == {"alpha", "beta",
+                                                   "gamma"}
+        assert summary["fleet"]["evictions"] >= 1
+        rollup = tele_report.render_fleet(summary)
+        assert "fleet:" in rollup and "alpha" in rollup
+        out["fleet_report_models"] = sorted(summary["fleet"]["models"])
+
+    # --- saturated single-model A/B: fleet p99 within 1.5x of the
+    # plain single-engine baseline, same process, same load pattern.
+    def _saturate(submit):
+        def worker(i):
+            submit(X[i % 64:i % 64 + 1])
+
+        with concurrent.futures.ThreadPoolExecutor(16) as pool:
+            list(pool.map(worker, range(192)))
+
+    bundle_ab = api.ModelBundle(ensemble=res_a.ensemble,
+                                mapper=res_a.mapper)
+    single = ServeEngine(bundle_ab, cfg, max_wait_ms=2.0, max_batch=64)
+    _saturate(lambda rows: single.predict(rows, timeout=60.0))
+    single.stats.window_summary(reset=True)      # measured window
+    _saturate(lambda rows: single.predict(rows, timeout=60.0))
+    p99_single = single.stats.window_summary()["p99_ms"]
+    single.close()
+    with tempfile.TemporaryDirectory() as td_ab:
+        model_a = os.path.join(td_ab, "a.npz")
+        res_a.save(model_a)
+        fleet1 = build_fleet([FleetSpec(name="solo", ref=model_a,
+                                        max_batch=64)],
+                             backend="tpu", max_wait_ms=2.0)
+        _saturate(lambda rows: fleet1.predict(rows, model="solo",
+                                              timeout=60.0))
+        fleet1.window_summaries(reset=True)      # measured window
+        _saturate(lambda rows: fleet1.predict(rows, model="solo",
+                                              timeout=60.0))
+        p99_fleet = fleet1.window_summaries()["solo"]["p99_ms"]
+        fleet1.close()
+    out["p99_single_ms"] = p99_single
+    out["p99_fleet_ms"] = p99_fleet
+    assert p99_fleet <= 1.5 * max(p99_single, 1.0), (
+        f"fleet saturated p99 {p99_fleet:.2f} ms vs single-engine "
+        f"{p99_single:.2f} ms (> 1.5x)")
 
     out["ok"] = True
     print(json.dumps(out))

@@ -216,6 +216,7 @@ def test_thread_model_real_serve_tier():
 
     trees, sources = {}, {}
     for rel in ("ddt_tpu/serve/batcher.py", "ddt_tpu/serve/engine.py",
+                "ddt_tpu/serve/fleet.py", "ddt_tpu/serve/control.py",
                 "ddt_tpu/serve/http.py", "ddt_tpu/robustness/watchdog.py"):
         sources[rel] = _read_repo(rel)
         trees[rel] = ast_mod.parse(sources[rel])
@@ -228,6 +229,15 @@ def test_thread_model_real_serve_tier():
     assert loop.roles == {"dispatcher"}
     assert ("ServeEngine", "_model") in m.published
     assert ("MicroBatcher", "_closed") in m.guarded
+    # the fleet tier (ISSUE 15): its single dispatcher thread is a
+    # thread root, the shared per-batch body carries both roles, and
+    # the fleet's cross-role state is Condition-guarded throughout
+    fl = m.methods[("ddt_tpu/serve/fleet.py", "FleetEngine", "_loop")]
+    assert fl.roles == {"dispatcher"}
+    shared = m.methods[("ddt_tpu/serve/engine.py", "", "dispatch_batch")]
+    assert shared.roles == {"dispatcher", "handler"}
+    assert m.guarded[("FleetEngine", "_closed")] == "_cv"
+    assert m.guarded[("FleetEngine", "_rr")] == "_cv"
     # watchdog: single-role, no locks — nothing inferred, nothing flagged
     assert not any(c.locks for c in m.classes.values()
                    if c.path.endswith("watchdog.py"))
@@ -387,7 +397,8 @@ def test_serving_doc_thread_model_in_sync():
 
     trees, sources = {}, {}
     for rel in ("ddt_tpu/serve/__init__.py", "ddt_tpu/serve/batcher.py",
-                "ddt_tpu/serve/engine.py", "ddt_tpu/serve/http.py",
+                "ddt_tpu/serve/engine.py", "ddt_tpu/serve/fleet.py",
+                "ddt_tpu/serve/control.py", "ddt_tpu/serve/http.py",
                 "ddt_tpu/robustness/watchdog.py"):
         sources[rel] = _read_repo(rel)
         trees[rel] = ast_mod.parse(sources[rel])
