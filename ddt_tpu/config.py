@@ -29,7 +29,7 @@ class TrainConfig:
     """
 
     # --- model ---
-    n_trees: int = 100          # boosting rounds (x n_classes trees for softmax)
+    n_trees: int = 100          # boosting rounds (x n_classes trees for softmax)  # ddtlint: trace-inert — host-side loop bound only: every round traces the same program, and resume replays to the recorded round regardless of the target
     max_depth: int = 6          # levels of splits; complete heap tree layout
     n_bins: int = 255           # [BASELINE] "255 bins named explicitly"
     learning_rate: float = 0.1
@@ -77,7 +77,7 @@ class TrainConfig:
     # n_partitions/feature_partitions raises — two sources of truth
     # for the mesh shape is a silent-wrong-mesh bug, not a
     # convenience.
-    mesh_shape: "Optional[tuple]" = None
+    mesh_shape: "Optional[tuple]" = None  # ddtlint: trace-inert — describes the machine, not the model: the backend cache is process-local (one live mesh per process) and checkpoints must resume on a different topology
     host_partitions: int = 1    # cross-slice "hosts" mesh axis (DCN): row
     #   shards span hosts x rows; histogram psum phases ICI-first then DCN.
     #   Total devices used = host_partitions x n_partitions x
@@ -184,7 +184,7 @@ class TrainConfig:
     # Path to a JSON fault-injection plan (robustness/faultplan.py); the
     # chaos harness. None (the default) compiles every injection seam to
     # a single module-global read — the telemetry disabled-path bar.
-    fault_plan: Optional[str] = None
+    fault_plan: Optional[str] = None  # ddtlint: trace-inert — chaos-harness knob: injected faults must be invisible to config identity so an injected run's checkpoints resume clean
     # Act on the straggler watchdog: when the flight recorder's
     # per-round partition attribution shows one device persistently past
     # the skew threshold, rotate the row-shard -> device assignment at
@@ -193,12 +193,12 @@ class TrainConfig:
     # on telemetry mesh runs; this flag gates the ACTION, and it forces
     # the granular Driver path (repartitioning needs round-boundary
     # control a fused block does not yield).
-    straggler_repartition: bool = False
+    straggler_repartition: bool = False  # ddtlint: trace-inert — host-side scheduling action (shard->device rotation); the model is unchanged by construction, so no contract may key on it
     # Watchdog trip point: a device whose per-round phase total exceeds
     # the MEDIAN OF THE OTHER lanes by this factor is a straggler
     # candidate (excluding the candidate keeps the default meaningful
     # even on a 2-lane mesh — robustness/watchdog.py).
-    straggler_skew_threshold: float = 2.0
+    straggler_skew_threshold: float = 2.0  # ddtlint: trace-inert — watchdog trip point on the detection side only; never read inside a trace and never shapes the trained model
 
     def __post_init__(self) -> None:
         if self.loss not in LOSSES:

@@ -24,9 +24,15 @@ from __future__ import annotations
 REL_FLOOR = 0.20
 
 #: counter -> the direction whose GAIN is adverse. "lower" = an increase
-#: flags; "higher" = a decrease flags. Unknown numeric counters are
-#: reported but never flagged (benchwatch's unknown-metric rule: a
-#: guessed direction can invert the gate).
+#: flags; "higher" = a decrease flags; "neutral" = declared
+#: workload-shape, never banded (request mix, fleet churn — a move in
+#: either direction is a different workload, not a regression). EVERY
+#: registered counter must appear here: a counter absent from this table
+#: renders with a loud `direction=?` marker (and fails ddtlint's
+#: counter-direction-missing rule) because an unknown direction silently
+#: exempts the counter from the gate. Unknown numeric counters are still
+#: reported, never flagged (benchwatch's unknown-metric rule: a guessed
+#: direction can invert the gate).
 COUNTER_DIRECTIONS: dict[str, str] = {
     "jit_compiles": "lower",
     "jit_compile_seconds": "lower",
@@ -41,6 +47,21 @@ COUNTER_DIRECTIONS: dict[str, str] = {
     "device_peak_bytes": "lower",
     "host_peak_rss_bytes": "lower",
     "compiled_ensemble_cache_hits": "higher",
+    # Robustness counters: any uptick means the fault path fired — a
+    # chaos run is EXPECTED to move these, but an ordinary A/B diff that
+    # shows retries or OOM degradations appearing is a regression.
+    "fault_retries": "lower",
+    "hist_oom_degrades": "lower",
+    # Workload-shape counters: request mix and fleet churn track what
+    # was ASKED of the system, not how well it did — deliberately
+    # "neutral" so a bigger replay never reads as a regression.
+    "serve_requests": "neutral",
+    "serve_batches": "neutral",
+    "serve_hot_swaps": "neutral",
+    "serve_express": "neutral",
+    "fleet_evictions": "neutral",
+    "fleet_reloads": "neutral",
+    "grad_quant_rounds": "neutral",
 }
 
 #: flag floor for near-zero baselines (a 0 -> 3 ms phase is noise, a
@@ -116,13 +137,18 @@ def diff_summaries(sa: dict, sb: dict, threshold: float = REL_FLOOR,
         if not all(isinstance(v, (int, float)) and not isinstance(v, bool)
                    for v in (va, vb) if v is not None):
             continue
-        rec = {"counter": key, "a": va, "b": vb, "flag": None}
         direction = COUNTER_DIRECTIONS.get(key)
+        # "?" marks a counter missing from COUNTER_DIRECTIONS — loud in
+        # both the JSON record and the text rendering so the gap is
+        # visible at the point of use, not just in the lint gate.
+        rec = {"counter": key, "a": va, "b": vb, "flag": None,
+               "direction": direction or "?"}
         # A zero/absent baseline has no band to measure against — the
         # benchwatch rule (metrics with no usable history are reported,
         # never guessed at): a single-chip baseline's
         # collective_bytes_est=0 vs a pod run's N must not fail --check.
-        if va and vb is not None and direction is not None:
+        # "neutral" (and unknown) directions are reported, never banded.
+        if va and vb is not None and direction in ("lower", "higher"):
             delta = vb - va
             adverse = delta if direction == "lower" else -delta
             if adverse > threshold * abs(va) and adverse > 0:
@@ -191,7 +217,14 @@ def render_diff(d: dict, label_a: str = "A", label_b: str = "B") -> str:
         out.append("counters (A -> B):")
         for c in changed:
             flag = "  [worse]" if c["flag"] else ""
-            out.append(f"  {c['counter']:<28} {c['a']} -> {c['b']}{flag}")
+            # Loud marker: this counter has no registered direction, so
+            # it can NEVER flag — the gate is silently blind to it until
+            # COUNTER_DIRECTIONS (and the lint contract) learn it.
+            unknown = ("  direction=? (unregistered counter — add it to "
+                       "COUNTER_DIRECTIONS)"
+                       if c.get("direction") == "?" else "")
+            out.append(f"  {c['counter']:<28} {c['a']} -> {c['b']}"
+                       f"{flag}{unknown}")
     bloat = [c for c in d["cost"] if c["bytes_ratio"] not in (None, 1.0)]
     if bloat:
         out.append("cost-analysis bytes accessed per phase (A -> B):")

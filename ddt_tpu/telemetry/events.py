@@ -121,6 +121,79 @@ EVENT_FIELDS: dict[str, set] = {
     "run_end": {"completed_rounds", "wallclock_s"},
 }
 
+#: event type -> DECLARED optional extras (fnmatch globs allowed:
+#: `round` records carry `valid_<metric>` keys named by the run's
+#: metric). Extras stay runtime-optional — validate_event does not
+#: require them — but they are no longer informal: ddtlint's
+#: telemetry-contract pass (tools/ddtlint/telemetrycontract.py) checks
+#: every literal emit-site keyword against this catalog, and
+#: docs/OBSERVABILITY.md embeds the derived contract. Growing this dict
+#: is the schema-ADDITIVE move (no version bump); growing a kind's
+#: REQUIRED set is not (event-schema-additivity).
+EVENT_EXTRAS: dict[str, tuple] = {
+    "run_manifest": (
+        # v1 shape facts + v2 merge keys.
+        "n_bins", "n_classes", "seed", "distributed", "run_id", "host",
+        # Streaming runs (n_chunks) and the resolved comms config
+        # (comms_manifest_fields — ISSUE 10/11/14 extras).
+        "n_chunks", "grad_dtype", "split_comms", "hist_comms_dtype",
+        "hist_comms_slabs", "mesh_layout",
+        # v3 xprof cross-reference (telemetry/profiler.py).
+        "xprof_dir", "xprof_rounds",
+    ),
+    "round": ("train_loss", "valid_*"),
+    "phase_timings": (),
+    "partition_phases": ("rounds",),
+    "partition_skew": ("n_partitions",),
+    "early_stop": (),
+    # The union of every fault kind's extras — the catalog table mapping
+    # kind -> extras lives in docs/OBSERVABILITY.md; report reads them
+    # per kind, the schema only promises they are declared names.
+    "fault": (
+        "round", "rotation", "device", "skew", "streak",      # stragglers
+        "seam", "attempt", "error", "message", "deadline_s",  # retries
+        "site",                                               # injected
+        "from_impl", "to_impl", "row_chunk",                  # OOM degrade
+        "old", "new", "old_artifact", "new_artifact",         # hot swap
+        "model_name", "artifact_digest", "evictions",         # fleet
+        "reloads", "failed_requests",
+        "candidate", "reason",                                # checkpoints
+    ),
+    # Everything counters.delta() / the finish_run_log epilogue may
+    # publish beyond the required four — kept in sync with the `_c`
+    # registry by the undeclared-event-extra cross-check.
+    "counters": (
+        "jit_compile_seconds", "compiled_ensemble_cache_hits",
+        "fault_retries", "hist_oom_degrades",
+        "serve_requests", "serve_batches", "serve_hot_swaps",
+        "serve_express", "fleet_evictions", "fleet_reloads",
+        "grad_stream_bytes_est", "grad_quant_rounds",
+        "device_peak_bytes", "host_peak_rss_bytes",
+    ),
+    "cost_analysis": ("phase", "calls", "platform", "signature",
+                      "arg_bytes", "output_bytes", "temp_bytes"),
+    "artifact": ("name", "version", "kind", "run_id", "model_token",
+                 "mode"),
+    "serve_latency": ("batches", "window_s", "p999_ms", "max_ms",
+                      "coalesce_mean", "coalesce_max", "queue_depth_max",
+                      "express", "model_token", "model_name",
+                      "predict_impl", "artifact_digest"),
+    "run_end": (),
+}
+
+#: every `fault` event kind any emitter may use — the undeclared-event-
+#: kind rule checks literal kinds against this tuple, so a typo'd kind
+#: is a lint finding, not a fault event report silently cannot group.
+#: The per-kind extras table lives in docs/OBSERVABILITY.md.
+FAULT_KINDS = (
+    "checkpoint_resume", "checkpoint_corrupt", "checkpoint_fallback",
+    "checkpoint_unrecoverable",
+    "retry", "retry_exhausted", "retry_deadline",
+    "injected", "hist_oom_degrade",
+    "straggler_detected", "repartition",
+    "hot_swap", "fleet_eviction", "fleet_reload", "fleet_remove",
+)
+
 ENVELOPE_FIELDS = ("event", "schema", "t", "seq")
 
 

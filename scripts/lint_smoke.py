@@ -1,16 +1,23 @@
-"""lint_smoke: end-to-end drive of ddtlint v2's two new passes.
+"""lint_smoke: end-to-end drive of ddtlint's flow-aware passes.
 
 Builds a throwaway mini-repo (real serve/batcher.py + backends/tpu.py +
-parallel/mesh.py copies) with every ISSUE-13 hazard seeded — lock-order
-inversion, unguarded cross-role write, blocking-under-gate, acquire
-without try/finally, hand-built PartitionSpec, literal axis name,
-uncovered layout-rule operand, stale atomic-publish annotation — then
+parallel/mesh.py copies, and — since ddtlint v3 — the real contract
+anchors config.py / backends/__init__.py / utils/checkpoint.py /
+telemetry/{events,counters,diffing}.py) with every ISSUE-13 and
+ISSUE-16 hazard seeded — lock-order inversion, unguarded cross-role
+write, blocking-under-gate, acquire without try/finally, hand-built
+PartitionSpec, literal axis name, uncovered layout-rule operand, stale
+atomic-publish annotation, uncovered jit-traced cfg read, contract-less
+config field, stale fingerprint exclude, reason-less trace-inert
+annotation, typo'd event kind, undeclared event extra, direction-less
+counter, required-field growth under a pinned schema version — then
 runs the REAL CLI (`python -m tools.ddtlint --format json`) against it
 and asserts each hazard is detected with the expected rule id at the
 expected location. This is the tier the fixture unit tests cannot
 cover: the walker, project-context resolution (mesh axes + rule table
-from the copied mesh.py), the JSON output contract, and the exit code,
-all through the subprocess boundary `make lint` itself uses.
+from the copied mesh.py, contract anchors from the copied catalogs),
+the JSON output contract, and the exit code, all through the subprocess
+boundary `make lint` itself uses.
 
 Usage: python scripts/lint_smoke.py      (also: make lint-smoke)
 """
@@ -76,6 +83,55 @@ class SmokeStale:
         return x
 """
 
+# --- ddtlint v3 (ISSUE 16) seeds -------------------------------------- #
+# config.py: one field in NO contract (the checkpoint copy below pops it
+# out of the fingerprint), one reason-less trace-inert annotation.
+CONFIG_ANCHOR = "    straggler_skew_threshold: float = 2.0"
+CONFIG_APPENDIX_FIELDS = (
+    f"    smoke_orphan_knob: int = 0  {MARKER} config-field-orphan\n"
+    "    smoke_quiet_knob: int = 1  # ddtlint: trace-inert  "
+    f"{MARKER} suppression-hygiene\n")
+
+# backends/__init__.py: a jit-traced read of a field the cache key does
+# not cover (n_trees is deliberately trace-inert at its DECLARATION, but
+# an actual read inside a trace is exactly the PR 14 hazard).
+BACKENDS_APPENDIX = f"""
+
+def _smoke_make(cfg):
+    import jax
+
+    def _grow(x):
+        return x * cfg.n_trees  {MARKER} jit-cache-key-coverage
+    return jax.jit(_grow)
+"""
+
+# utils/checkpoint.py: a stale exclude entry naming no current field,
+# plus the pop that orphans smoke_orphan_knob.
+CHECKPOINT_TARGET = 'for k in ("n_trees",'
+CHECKPOINT_MUTANT = (
+    f'for k in ("zz_smoke_renamed",  {MARKER} fingerprint-field-coverage\n'
+    '              "smoke_orphan_knob", "n_trees",')
+
+# telemetry/events.py: required-set growth under the pinned schema
+# version, a typo'd kind, and an undeclared extra.
+EVENTS_TARGET = '    "round": {"round", "ms_per_round"},'
+EVENTS_MUTANT = ('    "round": {"round", "ms_per_round", "smoke_now"},  '
+                 f'{MARKER} event-schema-additivity')
+EVENTS_APPENDIX = f"""
+
+def _smoke_emits(log):
+    log.emit("runmanifest", trainer="x")  {MARKER} undeclared-event-kind
+    log.emit("run_end", completed_rounds=1, wallclock_s=1.0,
+             smoke_vibes=3)  {MARKER} undeclared-event-extra
+"""
+
+# telemetry/counters.py: a published counter with no
+# COUNTER_DIRECTIONS entry (the copied diffing.py is the real table).
+COUNTERS_TARGET = "_c = {"
+COUNTERS_MUTANT = ("_c = {\n"
+                   f'    "smoke_counter": 0,  {MARKER} '
+                   "counter-direction-missing")
+
 
 def _expected(src: str, path: str) -> set:
     out = set()
@@ -110,6 +166,39 @@ def main() -> int:
                   encoding="utf-8") as f:
             plant("ddt_tpu/backends/tpu.py", f.read() + TPU_APPENDIX)
         plant("ddt_tpu/serve/stale_smoke.py", STALE_PUBLISH_MODULE)
+
+        # ddtlint v3: the config-flow + telemetry contract hazards ride
+        # copies of the REAL anchor files so the analyzers resolve the
+        # same contracts the gate does.
+        def _read(rel: str) -> str:
+            with open(os.path.join(REPO, rel), encoding="utf-8") as f:
+                return f.read()
+
+        config = _read("ddt_tpu/config.py")
+        i = config.index(CONFIG_ANCHOR)
+        eol = config.index("\n", i)
+        plant("ddt_tpu/config.py",
+              config[:eol + 1] + CONFIG_APPENDIX_FIELDS + config[eol + 1:])
+        plant("ddt_tpu/backends/__init__.py",
+              _read("ddt_tpu/backends/__init__.py") + BACKENDS_APPENDIX)
+        ckpt = _read("ddt_tpu/utils/checkpoint.py")
+        assert CHECKPOINT_TARGET in ckpt, \
+            "checkpoint.py exclude-list shape moved; update lint_smoke.py"
+        plant("ddt_tpu/utils/checkpoint.py",
+              ckpt.replace(CHECKPOINT_TARGET, CHECKPOINT_MUTANT))
+        events = _read("ddt_tpu/telemetry/events.py")
+        assert EVENTS_TARGET in events, \
+            "events.py round entry shape moved; update lint_smoke.py"
+        plant("ddt_tpu/telemetry/events.py",
+              events.replace(EVENTS_TARGET, EVENTS_MUTANT)
+              + EVENTS_APPENDIX)
+        counters = _read("ddt_tpu/telemetry/counters.py")
+        assert COUNTERS_TARGET in counters, \
+            "counters.py registry shape moved; update lint_smoke.py"
+        plant("ddt_tpu/telemetry/counters.py",
+              counters.replace(COUNTERS_TARGET, COUNTERS_MUTANT, 1))
+        plant("ddt_tpu/telemetry/diffing.py",
+              _read("ddt_tpu/telemetry/diffing.py"))
         # Project context: axis names + the SpecLayout rule table come
         # from the scanned tree's own mesh.py, exactly like the gate.
         shutil.copytree(os.path.join(REPO, "ddt_tpu/parallel"),

@@ -1,0 +1,37 @@
+"""jit-cache-key-coverage negative: every cfg read inside the traced
+body is either a _JIT_FIELDS member, a _cache_key return-expression
+term, or annotated trace-inert with a reason; reads in host-side
+builder code (outside the jit closure) are not traced reads at all."""
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    max_depth: int = 6
+    n_bins: int = 255
+    subsample: float = 1.0
+    seed: int = 0
+    row_tile: int = 128
+    verbose: bool = False
+
+
+_JIT_FIELDS = ("max_depth", "n_bins", "subsample", "row_tile")
+
+
+def _cache_key(cfg):
+    seed_live = cfg.subsample < 1.0
+    return tuple(getattr(cfg, f) for f in _JIT_FIELDS) + (
+        cfg.seed if seed_live else 0,
+    )
+
+
+def make_grow(cfg):
+    verbose = cfg.verbose          # host-side read: not in the trace
+    def grow(x):
+        depth = x * cfg.max_depth
+        if verbose and cfg.verbose:  # ddtlint: trace-inert — constant-folded at trace time: gates a host-only debug callback, never shapes the compiled program
+            depth = depth + 0
+        return depth + cfg.row_tile
+    return jax.jit(grow)

@@ -1,0 +1,35 @@
+"""jit-cache-key-coverage positive: `cfg.row_tile` is read inside the
+jit-traced grow body but `row_tile` is in neither _JIT_FIELDS nor the
+return expression of _cache_key — a cached backend compiled under one
+tile size would be silently reused for another. The mini-contract
+anchors (TrainConfig, _JIT_FIELDS, _cache_key) are embedded so the
+single-file fixture model resolves."""
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    max_depth: int = 6
+    n_bins: int = 255
+    subsample: float = 1.0
+    seed: int = 0
+    row_tile: int = 128
+
+
+_JIT_FIELDS = ("max_depth", "n_bins", "subsample")
+
+
+def _cache_key(cfg):
+    seed_live = cfg.subsample < 1.0
+    return tuple(getattr(cfg, f) for f in _JIT_FIELDS) + (
+        cfg.seed if seed_live else 0,
+    )
+
+
+def make_grow(cfg):
+    def grow(x):
+        depth = x * cfg.max_depth
+        return depth + cfg.row_tile  # LINT: jit-cache-key-coverage
+    return jax.jit(grow)

@@ -1,0 +1,39 @@
+"""config-field-orphan negative: every field is in the cache key, in
+the fingerprint (asdict minus excludes), a _cache_key return-expression
+term, or annotated trace-inert with a reason; the derive_run_id site
+uses the `**dataclasses.asdict(cfg)` idiom (full coverage by
+construction)."""
+import dataclasses
+import hashlib
+import json
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    max_depth: int = 6
+    n_bins: int = 255
+    checkpoint_every: int = 0  # ddtlint: trace-inert — host-side checkpoint cadence: resume replays to the recorded round whatever the cadence was, deliberately contract-less
+    seed: int = 0
+
+
+_JIT_FIELDS = ("max_depth", "n_bins")
+
+
+def _cache_key(cfg):
+    return tuple(getattr(cfg, f) for f in _JIT_FIELDS) + (cfg.seed,)
+
+
+def _cfg_fingerprint(cfg):
+    d = dataclasses.asdict(cfg)
+    for k in ("checkpoint_every",):
+        d.pop(k, None)
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True).encode()).hexdigest()
+
+
+def derive_run_id(**fields):
+    return hashlib.sha256(repr(sorted(fields.items())).encode()).hexdigest()
+
+
+def start_run(cfg):
+    return derive_run_id(**dataclasses.asdict(cfg))

@@ -1,0 +1,39 @@
+"""config-field-orphan positive, both arms: `checkpoint_every` is in no
+contract (not in _JIT_FIELDS, popped out of the fingerprint, not
+annotated), and a derive_run_id call enumerates kwargs explicitly but
+leaves fields out. `log_every` shows the legal escape hatch."""
+import dataclasses
+import hashlib
+import json
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    max_depth: int = 6
+    n_bins: int = 255
+    checkpoint_every: int = 0  # LINT: config-field-orphan
+    log_every: int = 50  # ddtlint: trace-inert — logging cadence only: shapes neither the compiled program nor the trained model, deliberately contract-less
+
+
+_JIT_FIELDS = ("max_depth", "n_bins")
+
+
+def _cache_key(cfg):
+    return tuple(getattr(cfg, f) for f in _JIT_FIELDS)
+
+
+def _cfg_fingerprint(cfg):
+    d = dataclasses.asdict(cfg)
+    for k in ("checkpoint_every", "log_every"):
+        d.pop(k, None)
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True).encode()).hexdigest()
+
+
+def derive_run_id(**fields):
+    return hashlib.sha256(repr(sorted(fields.items())).encode()).hexdigest()
+
+
+def start_run(cfg):
+    return derive_run_id(  # LINT: config-field-orphan
+        max_depth=cfg.max_depth, n_bins=cfg.n_bins)

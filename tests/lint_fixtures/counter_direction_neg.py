@@ -1,0 +1,36 @@
+"""counter-direction-missing negative: every published counter —
+registry keys and the epilogue's subscript-added key alike — carries a
+valid COUNTER_DIRECTIONS entry ("neutral" is the declared-but-unbanded
+direction for workload-shape counters)."""
+
+EVENT_FIELDS = {
+    "counters": ("jit_compiles",),
+}
+EVENT_EXTRAS = {
+    "counters": ("h2d_bytes", "serve_requests", "device_peak_bytes"),
+}
+SCHEMA_VERSION = 5
+
+_c = {
+    "jit_compiles": 0,
+    "h2d_bytes": 0,
+    "serve_requests": 0,
+}
+
+COUNTER_DIRECTIONS = {
+    "jit_compiles": "lower",
+    "h2d_bytes": "lower",
+    "serve_requests": "neutral",
+    "device_peak_bytes": "lower",
+}
+
+
+class Log:
+    def emit(self, kind, **fields):
+        pass
+
+
+def finish(log):
+    d = dict(_c)
+    d["device_peak_bytes"] = 1
+    log.emit("counters", **d)

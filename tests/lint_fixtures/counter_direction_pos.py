@@ -1,0 +1,37 @@
+"""counter-direction-missing positive: a `_c` counter with no
+COUNTER_DIRECTIONS entry, one with an invalid direction value, and an
+epilogue counter (subscript-assigned then splatted into
+`emit("counters", **d)`) the directions table never learned."""
+
+EVENT_FIELDS = {
+    "counters": ("jit_compiles",),
+}
+EVENT_EXTRAS = {
+    "counters": ("h2d_bytes", "serve_requests", "sideways_counter",
+                 "device_peak_bytes"),
+}
+SCHEMA_VERSION = 5
+
+_c = {
+    "jit_compiles": 0,
+    "h2d_bytes": 0,
+    "serve_requests": 0,  # LINT: counter-direction-missing
+    "sideways_counter": 0,  # LINT: counter-direction-missing
+}
+
+COUNTER_DIRECTIONS = {
+    "jit_compiles": "lower",
+    "h2d_bytes": "lower",
+    "sideways_counter": "diagonal",
+}
+
+
+class Log:
+    def emit(self, kind, **fields):
+        pass
+
+
+def finish(log):
+    d = dict(_c)
+    d["device_peak_bytes"] = 1  # LINT: counter-direction-missing
+    log.emit("counters", **d)

@@ -1,0 +1,30 @@
+"""undeclared-event-extra negative: every emit keyword is required or a
+declared extra (including a `valid_*` glob match), and every `_c`
+counter is declared on the `counters` event."""
+
+EVENT_FIELDS = {
+    "round": ("round", "ms_per_round"),
+    "counters": ("jit_compiles",),
+}
+EVENT_EXTRAS = {
+    "round": ("train_loss", "valid_*"),
+    "counters": ("h2d_bytes", "stray_counter"),
+}
+SCHEMA_VERSION = 5
+
+_c = {
+    "jit_compiles": 0,
+    "h2d_bytes": 0,
+    "stray_counter": 0,
+}
+
+
+class Log:
+    def emit(self, kind, **fields):
+        pass
+
+
+def run(log, payload):
+    log.emit("round", round=1, ms_per_round=2.0, train_loss=0.5,
+             valid_auc=0.93)
+    log.emit("round", round=2, ms_per_round=2.0, **payload)  # splat: skipped

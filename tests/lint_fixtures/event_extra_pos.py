@@ -1,0 +1,31 @@
+"""undeclared-event-extra positive: an emit-site keyword that is
+neither a required field nor a declared extra, and a `_c` registry
+counter the `counters` event never declares."""
+
+EVENT_FIELDS = {
+    "round": ("round", "ms_per_round"),
+    "counters": ("jit_compiles",),
+}
+EVENT_EXTRAS = {
+    "round": ("train_loss", "valid_*"),
+    "counters": ("h2d_bytes",),
+}
+SCHEMA_VERSION = 5
+
+_c = {
+    "jit_compiles": 0,
+    "h2d_bytes": 0,
+    "stray_counter": 0,  # LINT: undeclared-event-extra
+}
+
+
+class Log:
+    def emit(self, kind, **fields):
+        pass
+
+
+def run(log):
+    log.emit("round", round=1, ms_per_round=2.0, train_loss=0.5,
+             valid_auc=0.93)
+    log.emit("round", round=2, ms_per_round=2.0,
+             tree_bytes=1024)  # LINT: undeclared-event-extra

@@ -1,0 +1,29 @@
+"""undeclared-event-kind negative: every literal kind is catalogued;
+variable kinds and splatted payloads are deliberately skipped (missed
+findings over false positives)."""
+
+EVENT_FIELDS = {
+    "round": ("round", "ms_per_round"),
+    "fault": ("kind",),
+}
+EVENT_EXTRAS = {
+    "round": ("train_loss",),
+    "fault": ("round", "error"),
+}
+FAULT_KINDS = ("retry", "injected")
+SCHEMA_VERSION = 5
+
+
+class Log:
+    def emit(self, kind, **fields):
+        pass
+
+    def emit_fault(self, kind, **fields):
+        self.emit("fault", kind=kind, **fields)
+
+
+def run(log, dynamic_kind):
+    log.emit("round", round=1, ms_per_round=3.5, train_loss=0.4)
+    log.emit("fault", kind="retry", round=2)
+    log.emit_fault("injected", round=3)
+    log.emit(dynamic_kind, round=4)          # non-literal kind: skipped

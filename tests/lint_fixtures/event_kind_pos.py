@@ -1,0 +1,30 @@
+"""undeclared-event-kind positive: a typo'd event kind at an emit site,
+a fault `kind=` literal outside FAULT_KINDS, and an emit_fault() call
+with an uncatalogued kind. The mini-catalogs are embedded so the
+single-file fixture model resolves."""
+
+EVENT_FIELDS = {
+    "round": ("round", "ms_per_round"),
+    "fault": ("kind",),
+}
+EVENT_EXTRAS = {
+    "round": ("train_loss",),
+    "fault": ("round", "error"),
+}
+FAULT_KINDS = ("retry", "injected")
+SCHEMA_VERSION = 5
+
+
+class Log:
+    def emit(self, kind, **fields):
+        pass
+
+    def emit_fault(self, kind, **fields):
+        self.emit("fault", kind=kind, **fields)
+
+
+def run(log):
+    log.emit("round", round=1, ms_per_round=3.5)
+    log.emit("rond", round=2)  # LINT: undeclared-event-kind
+    log.emit("fault", kind="retyr")  # LINT: undeclared-event-kind
+    log.emit_fault("cosmic_ray", round=3)  # LINT: undeclared-event-kind

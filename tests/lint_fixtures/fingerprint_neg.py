@@ -1,0 +1,24 @@
+"""fingerprint-field-coverage negative: the asdict + exclude-list idiom
+with every exclusion naming a live TrainConfig field."""
+import dataclasses
+import hashlib
+import json
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    max_depth: int = 6
+    n_bins: int = 255
+    verbose: bool = False
+    log_every: int = 50
+
+
+def _cfg_fingerprint(cfg):
+    d = dataclasses.asdict(cfg)
+    for k in (
+        "verbose",
+        "log_every",
+    ):
+        d.pop(k, None)
+    blob = json.dumps(d, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()

@@ -1,0 +1,25 @@
+"""fingerprint-field-coverage positive: the exclude list names
+`log_every`, which is no current TrainConfig field — a renamed field
+left a stale exclusion behind, and whatever replaced it is being
+fingerprinted (or excluded) by accident."""
+import dataclasses
+import hashlib
+import json
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    max_depth: int = 6
+    n_bins: int = 255
+    verbose: bool = False
+
+
+def _cfg_fingerprint(cfg):
+    d = dataclasses.asdict(cfg)
+    for k in (
+        "verbose",
+        "log_every",  # LINT: fingerprint-field-coverage
+    ):
+        d.pop(k, None)
+    blob = json.dumps(d, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()

@@ -1,0 +1,15 @@
+"""event-schema-additivity negative: the same `loss_now` growth done
+the additive way — as an EVENT_EXTRAS entry — under the pinned version;
+required sets match the v5 snapshot exactly."""
+
+SCHEMA_VERSION = 5
+
+EVENT_FIELDS = {
+    "round": ("round", "ms_per_round"),
+    "run_end": ("completed_rounds", "wallclock_s"),
+    "trace_replay": ("path",),
+}
+
+EVENT_EXTRAS = {
+    "round": ("loss_now",),
+}

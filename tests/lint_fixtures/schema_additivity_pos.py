@@ -1,0 +1,12 @@
+"""event-schema-additivity positive: a required field added to an
+existing event kind while SCHEMA_VERSION still says 5 — old logs lack
+`loss_now` and read-side validation now rejects them. A brand-new kind
+is additive and free."""
+
+SCHEMA_VERSION = 5
+
+EVENT_FIELDS = {
+    "round": ("round", "ms_per_round", "loss_now"),  # LINT: event-schema-additivity
+    "run_end": ("completed_rounds", "wallclock_s"),
+    "trace_replay": ("path",),
+}

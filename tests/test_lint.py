@@ -22,6 +22,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 from tools.ddtlint import callgraph, checkers, runner, shardspec  # noqa: E402
+from tools.ddtlint import configflow, telemetrycontract  # noqa: E402
 from tools.ddtlint import threadmodel  # noqa: E402
 from tools.ddtlint import tsan_audit  # noqa: E402
 from tools.ddtlint.findings import assign_fingerprints  # noqa: E402
@@ -96,6 +97,24 @@ CASES = [
      "ddt_tpu/ops/fixture_mod.py"),
     ("layout-rule-coverage", "layout_coverage_pos.py",
      "layout_coverage_neg.py", "ddt_tpu/backends/fixture_mod.py"),
+    # ddtlint v3 (ISSUE 16): the config-flow contract pass (fixtures
+    # embed their own mini-contract anchors so the single-file model
+    # resolves)...
+    ("jit-cache-key-coverage", "cache_key_pos.py", "cache_key_neg.py",
+     "ddt_tpu/backends/fixture_mod.py"),
+    ("fingerprint-field-coverage", "fingerprint_pos.py",
+     "fingerprint_neg.py", "ddt_tpu/utils/fixture_mod.py"),
+    ("config-field-orphan", "config_orphan_pos.py", "config_orphan_neg.py",
+     "ddt_tpu/fixture_mod.py"),
+    # ...and the mechanized telemetry-schema contract.
+    ("undeclared-event-kind", "event_kind_pos.py", "event_kind_neg.py",
+     "ddt_tpu/telemetry/fixture_mod.py"),
+    ("undeclared-event-extra", "event_extra_pos.py", "event_extra_neg.py",
+     "ddt_tpu/telemetry/fixture_mod.py"),
+    ("counter-direction-missing", "counter_direction_pos.py",
+     "counter_direction_neg.py", "ddt_tpu/telemetry/fixture_mod.py"),
+    ("event-schema-additivity", "schema_additivity_pos.py",
+     "schema_additivity_neg.py", "ddt_tpu/telemetry/fixture_mod.py"),
 ]
 
 
@@ -422,6 +441,298 @@ def test_explain_threads_cli():
     assert proc.returncode == 0, proc.stderr
     assert "lock-order edges:" in proc.stdout
     assert "MicroBatcher._gate" in proc.stdout
+
+
+# --------------------------------------------------------------------- #
+# configflow pass: the real contract tree + mutation-style acceptance
+# --------------------------------------------------------------------- #
+def _configflow_sources(mutate=None):
+    """The real contract anchors (config.py, checkpoint.py) plus every
+    TRACE_SCOPE file, parsed; `mutate` maps relpath -> callable(src) ->
+    src for mutation-style tests."""
+    import ast as ast_mod
+
+    rels = ["ddt_tpu/config.py", "ddt_tpu/utils/checkpoint.py"]
+    for dirpath, dirnames, fns in os.walk(os.path.join(REPO, "ddt_tpu")):
+        dirnames[:] = [d for d in dirnames if d not in runner.SKIP_DIRS]
+        for fn in fns:
+            rel = os.path.relpath(os.path.join(dirpath, fn),
+                                  REPO).replace(os.sep, "/")
+            if rel.endswith(".py") and configflow.in_trace_scope(rel):
+                rels.append(rel)
+    trees, sources = {}, {}
+    for rel in rels:
+        src = _read_repo(rel)
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+            assert src is not None
+        sources[rel] = src
+        trees[rel] = ast_mod.parse(src)
+    return trees, sources
+
+
+def test_config_model_real_tree_clean_and_resolved():
+    """The analyzer's model of the ACTUAL contracts: all three anchors
+    resolve, the clean tree carries zero config-flow findings, the
+    cache-key trailing term is exactly `seed`, and the five
+    deliberately contract-less fields are annotation-covered (their
+    trace-inert annotations suppressed a would-be orphan — so they are
+    `used`, not stale)."""
+    trees, sources = _configflow_sources()
+    m = configflow.build(trees, sources)
+    assert m.resolved
+    assert m.findings == [], [f"{f.rule} {f.path}:{f.line}"
+                              for f in m.findings]
+    assert "grad_dtype" in m.covered and "subsample" in m.covered
+    assert m.cache_reads == {"seed"}
+    assert m.traced_reads, "no jit-reachable cfg reads found — the " \
+        "cache-key rule went blind"
+    inert = {name for name, site in m.fields.items() if site in m.used}
+    assert inert == {"n_trees", "mesh_shape", "fault_plan",
+                     "straggler_repartition", "straggler_skew_threshold"}
+
+
+def test_jit_fields_removal_replay_detected():
+    """ACCEPTANCE (ISSUE 16): replay the PR 14 grad_dtype bug — delete
+    `"grad_dtype"` from a copy of the real _JIT_FIELDS tuple and the
+    cache-key rule must fire at real traced read sites."""
+    def drop_grad_dtype(src):
+        out = src.replace('\n    "grad_dtype",\n', "\n")
+        assert out != src
+        return out
+
+    trees, sources = _configflow_sources(
+        {"ddt_tpu/backends/__init__.py": drop_grad_dtype})
+    m = configflow.build(trees, sources)
+    hits = [f for f in m.findings if f.rule == "jit-cache-key-coverage"]
+    assert hits, "grad_dtype removal from _JIT_FIELDS went undetected"
+    assert all("grad_dtype" in f.message for f in hits)
+    assert {f.path for f in hits} == {"ddt_tpu/backends/tpu.py"}
+
+
+def test_mutated_config_contractless_field_detected():
+    """Mutation-style acceptance: a new TrainConfig field that joins no
+    contract (not in _JIT_FIELDS, popped out of the fingerprint,
+    unannotated) fires config-field-orphan at the injected declaration
+    in a copy of the real config.py."""
+    def add_field(src):
+        anchor = "    straggler_skew_threshold: float = 2.0"
+        i = src.index(anchor)
+        eol = src.index("\n", i)
+        return (src[:eol + 1]
+                + "    mut_orphan_knob: int = 0  # MUT-HAZARD\n"
+                + src[eol + 1:])
+
+    def pop_field(src):
+        out = src.replace('for k in ("n_trees",',
+                          'for k in ("mut_orphan_knob", "n_trees",')
+        assert out != src
+        return out
+
+    trees, sources = _configflow_sources({
+        "ddt_tpu/config.py": add_field,
+        "ddt_tpu/utils/checkpoint.py": pop_field,
+    })
+    m = configflow.build(trees, sources)
+    hits = [f for f in m.findings if f.rule == "config-field-orphan"]
+    want = _mut_lines(sources["ddt_tpu/config.py"], "# MUT-HAZARD")
+    assert {(f.path, f.line) for f in hits} == \
+        {("ddt_tpu/config.py", ln) for ln in want}, \
+        [f"{f.rule} {f.path}:{f.line}" for f in m.findings]
+
+
+def test_mutated_checkpoint_stale_exclude_detected():
+    """A fingerprint exclude entry naming no current field (the renamed-
+    field hazard) fires at the injected tuple element in a copy of the
+    real checkpoint.py."""
+    def stale(src):
+        out = src.replace(
+            'for k in ("n_trees",',
+            'for k in ("zz_renamed_knob",  # MUT-HAZARD\n'
+            '              "n_trees",')
+        assert out != src
+        return out
+
+    trees, sources = _configflow_sources(
+        {"ddt_tpu/utils/checkpoint.py": stale})
+    m = configflow.build(trees, sources)
+    hits = [f for f in m.findings if f.rule == "fingerprint-field-coverage"]
+    want = _mut_lines(sources["ddt_tpu/utils/checkpoint.py"],
+                      "# MUT-HAZARD")
+    assert {f.line for f in hits} == want, \
+        [f"{f.rule} {f.path}:{f.line}" for f in m.findings]
+    assert all(f.path == "ddt_tpu/utils/checkpoint.py" for f in hits)
+
+
+def test_fingerprint_explicit_enumeration_must_be_total():
+    """The non-asdict arm: a fingerprint that enumerates fields by hand
+    must enumerate all of them (or exclude the rest)."""
+    src = ("import dataclasses\n\n\n"
+           "@dataclasses.dataclass(frozen=True)\n"
+           "class TrainConfig:\n"
+           "    max_depth: int = 6\n"
+           "    n_bins: int = 255\n"
+           "    seed: int = 0\n\n\n"
+           "def _cfg_fingerprint(cfg):\n"
+           "    return {'max_depth': cfg.max_depth}\n")
+    fs = _lint_src("ddt_tpu/utils/fixture_mod.py", src,
+                   "fingerprint-field-coverage")
+    fp_line = src.splitlines().index("def _cfg_fingerprint(cfg):") + 1
+    assert [f.line for f in fs] == [fp_line], [f.render() for f in fs]
+    assert "n_bins" in fs[0].message and "seed" in fs[0].message
+
+
+def test_trace_inert_annotation_hygiene():
+    """The annotation grammar's two failure shapes: a reason-less
+    `# ddtlint: trace-inert` always flags (unreviewable exemption), and
+    one that suppresses nothing flags as stale once the contract model
+    fully resolves — both under suppression-hygiene, like every other
+    annotation."""
+    import re as re_mod
+
+    base = _fixture_src("config_orphan_neg.py")
+    reasonless = re_mod.sub(r"# ddtlint: trace-inert — [^\n]*",
+                            "# ddtlint: trace-inert", base, count=1)
+    fs = _lint_src("ddt_tpu/fixture_mod.py", reasonless,
+                   "suppression-hygiene")
+    assert len(fs) == 1 and "without a" in fs[0].message, \
+        [f.render() for f in fs]
+    stale = base.replace(
+        "    seed: int = 0",
+        "    seed: int = 0  # ddtlint: trace-inert — seed already keys "
+        "the cache")
+    fs = _lint_src("ddt_tpu/fixture_mod.py", stale, "suppression-hygiene")
+    assert len(fs) == 1 and "stale" in fs[0].message, \
+        [f.render() for f in fs]
+
+
+# --------------------------------------------------------------------- #
+# telemetrycontract pass: the real catalogs + mutation-style acceptance
+# --------------------------------------------------------------------- #
+def _telemetry_trees(mutate=None):
+    import ast as ast_mod
+
+    trees = {}
+    for rel in runner._walk_py(["ddt_tpu/"], REPO):
+        if not (rel.endswith(".py") and telemetrycontract.in_scope(rel)):
+            continue
+        src = _read_repo(rel)
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        trees[rel] = ast_mod.parse(src)
+        if mutate and rel in mutate:
+            trees[rel]._mut_src = src
+    return trees
+
+
+def test_telemetry_model_real_tree_clean():
+    """The real catalogs resolve, every emit site checks clean, the
+    epilogue counters are seen, and every published counter has a valid
+    direction (the converted state this PR establishes)."""
+    m = telemetrycontract.build(_telemetry_trees())
+    assert m.findings == [], [f"{f.rule} {f.path}:{f.line}"
+                              for f in m.findings]
+    assert m.schema_version == telemetrycontract.PINNED_SCHEMA_VERSION
+    assert set(m.required) == set(telemetrycontract.PINNED_REQUIRED)
+    assert "device_peak_bytes" in m.counter_lines
+    assert "host_peak_rss_bytes" in m.counter_lines
+    assert set(m.counter_lines) <= set(m.directions)
+    assert all(v in telemetrycontract.VALID_DIRECTIONS
+               for v in m.directions.values())
+
+
+#: (rule, mutation appended to a copy of telemetry/events.py)
+_EVENTS_MUTATIONS = [
+    ("undeclared-event-kind", (
+        "\n\n"
+        "def _mut_typo_kind(log):\n"
+        '    log.emit("runmanifest", trainer="x")  # MUT-HAZARD\n')),
+    ("undeclared-event-extra", (
+        "\n\n"
+        "def _mut_undeclared_extra(log):\n"
+        '    log.emit("round", round=1, ms_per_round=1.0,\n'
+        "             vibes=3)  # MUT-HAZARD\n")),
+]
+
+
+@pytest.mark.parametrize("rule,appendix", _EVENTS_MUTATIONS,
+                         ids=[m[0] for m in _EVENTS_MUTATIONS])
+def test_mutated_events_hazards_detected(rule, appendix):
+    """Mutation-style acceptance: each schema hazard seeded into a copy
+    of the real telemetry/events.py fires the expected rule at the
+    injected line (and only there — the real emit sites are clean)."""
+    src = _read_repo("ddt_tpu/telemetry/events.py") + appendix
+    want = _mut_lines(src, "# MUT-HAZARD")
+    assert want
+    findings = _lint_src("ddt_tpu/telemetry/events.py", src, rule)
+    got = {f.line for f in findings}
+    assert got == want, (rule, sorted(got), sorted(want),
+                         [f.render() for f in findings])
+
+
+def test_mutated_counter_registry_detected():
+    """A counter added to the `_c` registry without declaring it on the
+    `counters` event or in COUNTER_DIRECTIONS trips BOTH rules at the
+    injected registry line (cross-file: catalogs live in events.py and
+    diffing.py)."""
+    def add_counter(src):
+        out = src.replace(
+            "_c = {", '_c = {\n    "mut_counter": 0,  # MUT-HAZARD', 1)
+        assert out != src
+        return out
+
+    trees = _telemetry_trees(
+        {"ddt_tpu/telemetry/counters.py": add_counter})
+    m = telemetrycontract.build(trees)
+    src = trees["ddt_tpu/telemetry/counters.py"]._mut_src
+    want = _mut_lines(src, "# MUT-HAZARD")
+    by_rule = {}
+    for f in m.findings:
+        by_rule.setdefault(f.rule, set()).add((f.path, f.line))
+    expect = {("ddt_tpu/telemetry/counters.py", ln) for ln in want}
+    assert by_rule.get("undeclared-event-extra") == expect, by_rule
+    assert by_rule.get("counter-direction-missing") == expect, by_rule
+
+
+def test_schema_version_bump_retires_additivity_pin():
+    """Growing a required set IS legal once SCHEMA_VERSION moves past
+    the pin (the rule skips until re-pinned in the same PR)."""
+    grown = ('SCHEMA_VERSION = 6\n'
+             'EVENT_FIELDS = {\n'
+             '    "round": ("round", "ms_per_round", "loss_now"),\n'
+             '}\n')
+    fs = _lint_src("ddt_tpu/telemetry/fixture_mod.py", grown,
+                   "event-schema-additivity")
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_observability_doc_telemetry_contract_in_sync():
+    """docs/OBSERVABILITY.md embeds the analyzer's derived contract
+    between ddtlint:telemetry-contract markers; a telemetry change that
+    moves the contract must regenerate the doc block — that diff is the
+    review artifact ISSUE 16 asks for (the SERVING.md pattern)."""
+    import re as re_mod
+
+    block = telemetrycontract.explain(
+        telemetrycontract.build(_telemetry_trees())).strip()
+    doc = _read_repo("docs/OBSERVABILITY.md")
+    mm = re_mod.search(
+        r"<!-- ddtlint:telemetry-contract:begin -->\s*```\n(.*?)```\s*"
+        r"<!-- ddtlint:telemetry-contract:end -->", doc, re_mod.DOTALL)
+    assert mm, "OBSERVABILITY.md lost its telemetry-contract markers"
+    assert mm.group(1).strip() == block, (
+        "docs/OBSERVABILITY.md telemetry-contract block is out of date "
+        "— regenerate with `python -m tools.ddtlint --explain-telemetry`")
+
+
+def test_explain_telemetry_cli():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.ddtlint", "--explain-telemetry"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "schema: v5" in proc.stdout
+    assert "fault kinds:" in proc.stdout
+    assert "grad_quant_rounds: neutral" in proc.stdout
 
 
 # --------------------------------------------------------------------- #

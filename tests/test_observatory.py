@@ -443,6 +443,30 @@ def test_diff_directionality_and_structure():
     assert all(c["flag"] is None for c in d["cost"])
 
 
+def test_diff_unknown_and_neutral_counter_directions():
+    """ISSUE 16 satellite: a counter missing from COUNTER_DIRECTIONS is
+    reported with a loud direction=? marker (text AND --json record) but
+    never flagged; a declared-"neutral" counter is banded in NO
+    direction — a 10x workload-shape move stays quiet."""
+    a = {"phases": [], "counters": {"mystery_counter": 10,
+                                    "serve_requests": 10},
+         "cost_events": [], "completed_rounds": 1, "wallclock_s": 1.0}
+    b = {"phases": [], "counters": {"mystery_counter": 100,
+                                    "serve_requests": 100},
+         "cost_events": [], "completed_rounds": 1, "wallclock_s": 1.0}
+    d = diffing.diff_summaries(a, b)
+    by = {c["counter"]: c for c in d["counters"]}
+    assert by["mystery_counter"]["direction"] == "?"
+    assert by["mystery_counter"]["flag"] is None
+    assert by["serve_requests"]["direction"] == "neutral"
+    assert by["serve_requests"]["flag"] is None
+    assert d["flagged"] == []
+    text = diffing.render_diff(d)
+    assert "mystery_counter" in text and "serve_requests" in text
+    # exactly one marker: the unregistered counter, not the neutral one
+    assert text.count("direction=?") == 1
+
+
 # --------------------------------------------------------------------- #
 # profiler capture window
 # --------------------------------------------------------------------- #

@@ -10,7 +10,7 @@ import json
 import os
 import sys
 
-from tools.ddtlint import checkers, runner, threadmodel
+from tools.ddtlint import checkers, runner, telemetrycontract, threadmodel
 
 ALL_RULES = sorted(
     {r for c in checkers.AST_CHECKERS for r in c.rule_set()}
@@ -64,6 +64,12 @@ def main(argv: list[str] | None = None) -> int:
                          "(roles, locks, publish points, lock-order "
                          "edges) instead of linting — reviewers diff "
                          "this across serve PRs (docs/SERVING.md)")
+    ap.add_argument("--explain-telemetry", action="store_true",
+                    help="dump the derived telemetry contract (event "
+                         "kinds, extras, fault kinds, counter "
+                         "directions) instead of linting — "
+                         "docs/OBSERVABILITY.md embeds this block and "
+                         "the doc-sync test keeps the two aligned")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="summary line only")
     args = ap.parse_args(argv)
@@ -87,6 +93,20 @@ def main(argv: list[str] | None = None) -> int:
             trees[rel] = runner._parse(sources[rel])
         model = threadmodel.build(trees, sources)
         print(threadmodel.explain(model), end="")
+        return 0
+
+    if args.explain_telemetry:
+        files = runner._walk_py(args.paths or ["ddt_tpu/"], root)
+        trees = {}
+        for rel in files:
+            if not telemetrycontract.in_scope(rel) \
+                    or not rel.endswith(".py"):
+                continue
+            with open(os.path.join(root, rel), encoding="utf-8",
+                      errors="replace") as f:
+                trees[rel] = runner._parse(f.read())
+        model = telemetrycontract.build(trees)
+        print(telemetrycontract.explain(model), end="")
         return 0
 
     rules = None

@@ -18,7 +18,8 @@ class CheckContext:
                  mesh_axes: set[str] | None = None,
                  reachable: set[str] | None = None,
                  layout_rules: "list[str] | None" = None,
-                 thread_model=None):
+                 thread_model=None, config_model=None,
+                 telemetry_model=None):
         self.path = path                      # repo-relative, fwd slashes
         self.source = source
         self.lines = source.splitlines()
@@ -31,6 +32,10 @@ class CheckContext:
         #: package-wide threadmodel.ThreadModel for the serve tier; None
         #: = build a single-file model on demand (fixture tests).
         self.thread_model = thread_model
+        #: package-wide configflow.ConfigModel / telemetrycontract.
+        #: TelemetryModel; None = single-file on demand (fixture tests).
+        self.config_model = config_model
+        self.telemetry_model = telemetry_model
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):

@@ -12,7 +12,8 @@ from __future__ import annotations
 import ast
 import re
 
-from tools.ddtlint import callgraph, shardspec, threadmodel
+from tools.ddtlint import (callgraph, configflow, shardspec,
+                           telemetrycontract, threadmodel)
 from tools.ddtlint.base import Checker, CheckContext  # noqa: F401 — the
 # base moved to tools/ddtlint/base.py so the flow-aware pass modules can
 # subclass it without an import cycle; re-exported here for callers.
@@ -844,6 +845,10 @@ AST_CHECKERS = [
     # contract and the serve-tier thread/lock-discipline analysis.
     *shardspec.CHECKERS,
     threadmodel.ThreadModelChecker,
+    # ddtlint v3 contract passes (ISSUE 16): config-flow cache-key /
+    # fingerprint coverage and the mechanized telemetry schema.
+    *configflow.CHECKERS,
+    *telemetrycontract.CHECKERS,
 ]
 
 

@@ -1,0 +1,463 @@
+"""Config-flow contract analysis (ddtlint v3, ISSUE 16).
+
+The backend-by-flag contract means one TrainConfig must deterministically
+select one traced program — yet PR 14 found the exact failure mode
+reachable: `_JIT_FIELDS` missed `grad_dtype`, so a cached f32 backend
+silently served a quantized config. This pass mechanizes the audit that
+found it, so the NEXT trace-shaping field cannot drift out of the
+contracts:
+
+* `jit-cache-key-coverage` — every `cfg.<field>` read reachable inside a
+  jit trace (callgraph.py's roots + closure, over ddt_tpu/backends/,
+  ddt_tpu/ops/, ddt_tpu/streaming.py) must be covered by the backend
+  cache key: the `_JIT_FIELDS` tuple plus the explicit trailing terms
+  `_cache_key` itself reads (seed under bagging/quantization). An
+  uncovered read means a cached instance compiled under a DIFFERENT
+  value of that field can be silently reused — the PR 14 bug, as a lint
+  finding at the read site citing the tuple it should join.
+* `fingerprint-field-coverage` — the checkpoint resume gate
+  (`utils/checkpoint._cfg_fingerprint`) must place every TrainConfig
+  field in exactly one of {fingerprinted, excluded-with-reason}: an
+  exclude-list entry naming no current field is stale (a renamed field
+  silently rejoined the fingerprint — or never left it), and a
+  non-asdict fingerprint that enumerates fields must enumerate all of
+  them.
+* `config-field-orphan` — (a) a TrainConfig field covered by NO
+  contract (not in the cache key, excluded from the fingerprint, and
+  not annotated trace-inert at its declaration) is invisible to every
+  mechanism that keys on config identity; (b) a `derive_run_id(...)`
+  call site must cover every field (`**dataclasses.asdict(cfg)` or an
+  explicit full enumeration) — the run id is the cross-host merge key
+  and "no field may be left out" is its documented contract.
+
+The one escape hatch is `# ddtlint: trace-inert — <why>` (the reason is
+REQUIRED): on a read line it asserts the read never shapes the traced
+program (e.g. a host-side branch outside the trace the callgraph
+over-approximates into it); on a config.py declaration line it asserts
+the field deliberately belongs to no contract. Annotations that
+suppress nothing (the line has no uncovered read / the field already
+has a contract) are flagged under the existing suppression-hygiene rule
+— an annotation that outlives its hazard exempts whatever lands on the
+line next.
+
+Every contract input is read STATICALLY out of the parsed trees by
+anchor name (class TrainConfig, the `_JIT_FIELDS` tuple, the
+`_cache_key` / `_cfg_fingerprint` defs), so fixture files can embed a
+self-contained mini-contract; when an anchor cannot be found the rules
+that need it skip rather than guess (the shardspec precedent).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from tools.ddtlint import callgraph
+from tools.ddtlint.base import Checker
+from tools.ddtlint.findings import Finding
+
+#: files the checker emits on (the contract spans the whole package).
+SCOPE = (r"^ddt_tpu/",)
+#: files whose jit-reachable cfg reads the cache-key rule audits — the
+#: tracing roots (backends), the traced bodies (ops), and the streaming
+#: driver whose helpers feed scan/fori bodies.
+TRACE_SCOPE = (r"^ddt_tpu/backends/", r"^ddt_tpu/ops/",
+               r"^ddt_tpu/streaming\.py$")
+
+RULE_CACHE_KEY = "jit-cache-key-coverage"
+RULE_FINGERPRINT = "fingerprint-field-coverage"
+RULE_ORPHAN = "config-field-orphan"
+#: stale / reason-less trace-inert annotations report under the existing
+#: suppression-hygiene rule (an annotation is a suppression).
+RULE_STALE = "suppression-hygiene"
+
+RULES = (RULE_CACHE_KEY, RULE_FINGERPRINT, RULE_ORPHAN, RULE_STALE)
+
+#: `# ddtlint: trace-inert — <why>`; the reason group is None when
+#: missing (itself a suppression-hygiene finding — an unexplained
+#: exemption is unreviewable).
+TRACE_INERT_RE = re.compile(
+    r"#\s*ddtlint:\s*trace-inert(?:\s*(?:—|–|--|-)\s*(\S.*))?")
+
+
+def in_scope(path: str) -> bool:
+    return any(re.search(p, path) for p in SCOPE)
+
+
+def in_trace_scope(path: str) -> bool:
+    return any(re.search(p, path) for p in TRACE_SCOPE)
+
+
+def _recv_is_cfg(node: ast.Attribute) -> bool:
+    """True for `cfg.x` / `self.cfg.x` / `be.cfg.x` — any receiver chain
+    whose last segment is the `cfg` idiom the codebase uses for the
+    frozen TrainConfig."""
+    d = callgraph.dotted(node.value)
+    return d is not None and d.split(".")[-1] == "cfg"
+
+
+def _cfg_reads(fn: ast.AST) -> set[str]:
+    """Field names read off a cfg receiver anywhere inside `fn`."""
+    return {n.attr for n in ast.walk(fn)
+            if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load)
+            and _recv_is_cfg(n)}
+
+
+@dataclass
+class ConfigModel:
+    """Statically-read contract state + computed findings."""
+
+    fields: dict = field(default_factory=dict)       # name -> (path, line)
+    config_path: "str | None" = None
+    jit_fields: set = field(default_factory=set)
+    jit_site: "tuple | None" = None                  # (path, line)
+    cache_reads: set = field(default_factory=set)    # _cache_key return-expr reads
+    fp_path: "str | None" = None
+    fp_line: int = 0
+    fp_asdict: bool = False
+    fp_excluded: dict = field(default_factory=dict)  # name -> line (fp_path)
+    fp_reads: set = field(default_factory=set)       # explicit enumeration
+    #: path -> {line: reason-or-None} trace-inert annotations
+    annotations: dict = field(default_factory=dict)
+    used: set = field(default_factory=set)           # (path, line) that suppressed
+    traced_reads: list = field(default_factory=list)  # (path, node, fieldname)
+    runid_calls: list = field(default_factory=list)   # (path, Call)
+    findings: list = field(default_factory=list)      # Finding (no line_text)
+
+    @property
+    def covered(self) -> set:
+        """Fields the backend cache key accounts for."""
+        return self.jit_fields | self.cache_reads
+
+    @property
+    def fingerprinted(self) -> set:
+        if self.fp_asdict:
+            return set(self.fields) - set(self.fp_excluded)
+        return set(self.fp_reads)
+
+    @property
+    def resolved(self) -> bool:
+        """All three anchors found — the orphan audit and annotation
+        staleness are only decidable with the full contract picture."""
+        return bool(self.fields) and self.jit_site is not None \
+            and self.fp_path is not None
+
+
+def _emit(m: ConfigModel, rule: str, path: str, node, message: str) -> None:
+    m.findings.append(Finding(
+        rule=rule, path=path, line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1, message=message))
+
+
+class _Line:
+    """Position shim for findings anchored to a source LINE (annotation
+    hygiene) rather than an AST node."""
+
+    def __init__(self, lineno: int, col_offset: int = 0):
+        self.lineno = lineno
+        self.col_offset = col_offset
+
+
+# --------------------------------------------------------------------- #
+# anchor extraction
+# --------------------------------------------------------------------- #
+def _read_fingerprint(m: ConfigModel, path: str, fn: ast.AST) -> None:
+    m.fp_path, m.fp_line = path, fn.lineno
+    m.fp_reads = _cfg_reads(fn)
+    pop_loop_vars: dict[str, list] = {}
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            d = callgraph.dotted(n.func)
+            last = d.split(".")[-1] if d else None
+            if last == "asdict":
+                m.fp_asdict = True
+            elif last == "pop" and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                m.fp_excluded[n.args[0].value] = n.args[0].lineno
+            elif last == "pop" and n.args \
+                    and isinstance(n.args[0], ast.Name):
+                pop_loop_vars.setdefault(n.args[0].id, [])
+    # `for k in ("a", "b", ...): d.pop(k, ...)` — the exclude-list idiom
+    for n in ast.walk(fn):
+        if isinstance(n, ast.For) and isinstance(n.target, ast.Name) \
+                and n.target.id in pop_loop_vars \
+                and isinstance(n.iter, (ast.Tuple, ast.List)):
+            for e in n.iter.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    m.fp_excluded[e.value] = e.lineno
+
+
+def _read_anchors(m: ConfigModel, trees: dict) -> None:
+    for path, tree in sorted(trees.items()):
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) \
+                    and node.name == "TrainConfig" and not m.fields:
+                m.config_path = path
+                for ch in ast.iter_child_nodes(node):
+                    if isinstance(ch, ast.AnnAssign) \
+                            and isinstance(ch.target, ast.Name):
+                        m.fields[ch.target.id] = (path, ch.lineno)
+            elif isinstance(node, ast.Assign) and m.jit_site is None \
+                    and any(isinstance(t, ast.Name) and t.id == "_JIT_FIELDS"
+                            for t in node.targets) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = {e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+                if vals:
+                    m.jit_fields = vals
+                    m.jit_site = (path, node.lineno)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name == "_cache_key":
+                    # Only reads in the RETURN expression join the key.
+                    # A read that merely gates another term (seed_live's
+                    # `cfg.grad_dtype != "f32"` test) does not make the
+                    # key distinguish values of that field — treating it
+                    # as covered would have hidden the PR 14 bug.
+                    for st in ast.walk(node):
+                        if isinstance(st, ast.Return) \
+                                and st.value is not None:
+                            m.cache_reads |= _cfg_reads(st.value)
+                elif node.name == "_cfg_fingerprint" and m.fp_path is None:
+                    _read_fingerprint(m, path, node)
+            elif isinstance(node, ast.Call):
+                d = callgraph.dotted(node.func)
+                if d is not None and d.split(".")[-1] == "derive_run_id":
+                    m.runid_calls.append((path, node))
+
+
+def _set_annotations(m: ConfigModel, sources: dict) -> None:
+    for path, src in sorted(sources.items()):
+        per: dict = {}
+        for i, line in enumerate(src.splitlines(), start=1):
+            hit = TRACE_INERT_RE.search(line)
+            if hit:
+                per[i] = hit.group(1)
+        if per:
+            m.annotations[path] = per
+
+
+class _TracedReadVisitor(ast.NodeVisitor):
+    """cfg-field reads + their enclosing function qualname, matching
+    callgraph._Collector's qualname convention (class names included) so
+    the reachability sets line up."""
+
+    def __init__(self, m: ConfigModel, path: str, reachable: set):
+        self.m = m
+        self.path = path
+        self.reachable = reachable
+        self.stack: list[str] = []
+        self.fn_stack: list[str] = []
+
+    def _visit_func(self, node):
+        qual = ".".join(self.stack + [node.name])
+        self.stack.append(node.name)
+        self.fn_stack.append(qual)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.ctx, ast.Load) and node.attr in self.m.fields \
+                and _recv_is_cfg(node) and self.fn_stack \
+                and self.fn_stack[-1] in self.reachable:
+            self.m.traced_reads.append((self.path, node, node.attr))
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------- #
+# model construction + findings
+# --------------------------------------------------------------------- #
+def build(trees: dict, sources: "dict | None" = None,
+          reachable: "dict | None" = None) -> ConfigModel:
+    """{relpath: parsed ast.Module} -> the package-wide config-flow model
+    with findings computed. `sources` (same keys) resolves trace-inert
+    annotation lines; `reachable` reuses the runner's callgraph result
+    ({relpath: jit-reachable qualnames}) — computed here when absent
+    (fixture tests), from the SAME trees (no re-parse)."""
+    m = ConfigModel()
+    sources = sources or {}
+    _set_annotations(m, sources)
+    _read_anchors(m, trees)
+
+    if m.fields:
+        if reachable is None:
+            reachable = callgraph.build(
+                {p: sources.get(p, "") for p in trees}, trees=trees)
+        for path, tree in sorted(trees.items()):
+            if tree is None or not in_trace_scope(path):
+                continue
+            _TracedReadVisitor(m, path, reachable.get(path, set())).visit(tree)
+
+    _find_cache_key(m)
+    _find_fingerprint(m)
+    _find_orphans(m)
+    _find_runid(m)
+    _find_annotation_hygiene(m)
+    return m
+
+
+def _annotated(m: ConfigModel, path: str, line: int) -> bool:
+    return line in m.annotations.get(path, {})
+
+
+def _find_cache_key(m: ConfigModel) -> None:
+    if not m.fields or m.jit_site is None:
+        return
+    jp, jl = m.jit_site
+    for path, node, fname in m.traced_reads:
+        if fname in m.covered:
+            continue
+        if _annotated(m, path, node.lineno):
+            m.used.add((path, node.lineno))
+            continue
+        _emit(m, RULE_CACHE_KEY, path, node, (
+            f"`cfg.{fname}` is read inside a jit-traced region but is "
+            "not part of the backend cache key — a cached backend "
+            f"compiled under a different {fname} would be silently "
+            f"reused (the PR 14 grad_dtype bug); add {fname!r} to "
+            f"_JIT_FIELDS ({jp}:{jl}) or, if the read provably never "
+            "shapes the trace, annotate it "
+            "`# ddtlint: trace-inert — <why>` "
+            "(docs/ANALYSIS.md jit-cache-key-coverage)"))
+
+
+def _find_fingerprint(m: ConfigModel) -> None:
+    if not m.fields or m.fp_path is None:
+        return
+    cpath = m.config_path or "ddt_tpu/config.py"
+    for name, line in sorted(m.fp_excluded.items()):
+        if name not in m.fields:
+            _emit(m, RULE_FINGERPRINT, m.fp_path, _Line(line), (
+                f"fingerprint exclude entry {name!r} names no current "
+                "TrainConfig field — a renamed or removed field left a "
+                "stale exclusion behind, and the field that replaced it "
+                "is being fingerprinted (or excluded) by accident; "
+                f"update the exclude list to match {cpath} "
+                "(docs/ANALYSIS.md fingerprint-field-coverage)"))
+    if not m.fp_asdict:
+        missing = sorted(set(m.fields) - m.fp_reads - set(m.fp_excluded))
+        if missing:
+            _emit(m, RULE_FINGERPRINT, m.fp_path, _Line(m.fp_line), (
+                "_cfg_fingerprint enumerates fields explicitly but "
+                f"omits {', '.join(missing)} — every TrainConfig field "
+                "must be fingerprinted or excluded-with-reason, or a "
+                "checkpoint resumes under a silently different config; "
+                "use dataclasses.asdict(cfg) + an exclude list "
+                "(docs/ANALYSIS.md fingerprint-field-coverage)"))
+
+
+def _find_orphans(m: ConfigModel) -> None:
+    if not m.resolved:
+        return
+    jp, jl = m.jit_site
+    fingerprinted = m.fingerprinted
+    for name, (cpath, cline) in sorted(m.fields.items()):
+        if name in m.covered or name in fingerprinted:
+            continue
+        if _annotated(m, cpath, cline):
+            m.used.add((cpath, cline))
+            continue
+        _emit(m, RULE_ORPHAN, cpath, _Line(cline), (
+            f"TrainConfig field {name!r} belongs to NO config contract: "
+            f"not in the backend cache key (_JIT_FIELDS, {jp}:{jl}) and "
+            f"excluded from the checkpoint fingerprint ({m.fp_path}:"
+            f"{m.fp_line}) — no mechanism that keys on config identity "
+            "can see it change; wire it into a contract or annotate the "
+            "declaration `# ddtlint: trace-inert — <why>` "
+            "(docs/ANALYSIS.md config-field-orphan)"))
+
+
+def _find_runid(m: ConfigModel) -> None:
+    """derive_run_id call sites must cover every TrainConfig field —
+    `**dataclasses.asdict(cfg)` (the idiom) always does; an explicit
+    kwarg enumeration is checked field-by-field; an opaque `**other` is
+    statically unresolvable and skipped (missed findings over false
+    positives)."""
+    if not m.fields:
+        return
+    for path, call in m.runid_calls:
+        starred = [k for k in call.keywords if k.arg is None]
+        if starred:
+            if any(isinstance(k.value, ast.Call)
+                   and (d := callgraph.dotted(k.value.func)) is not None
+                   and d.split(".")[-1] == "asdict" for k in starred):
+                continue                      # full coverage by construction
+            continue                          # opaque **kwargs: unresolvable
+        explicit = {k.arg for k in call.keywords if k.arg}
+        missing = sorted(set(m.fields) - explicit)
+        if missing:
+            shown = ", ".join(missing[:4]) + \
+                (f", ... ({len(missing)} total)" if len(missing) > 4 else "")
+            _emit(m, RULE_ORPHAN, path, call, (
+                f"derive_run_id call leaves out TrainConfig field(s) "
+                f"{shown} — the run id is the cross-host merge key and "
+                "two configs differing in ANY field must derive "
+                "different ids; pass `**dataclasses.asdict(cfg)` "
+                "(docs/ANALYSIS.md config-field-orphan)"))
+
+
+def _find_annotation_hygiene(m: ConfigModel) -> None:
+    """Reason-less annotations always flag; annotations that suppressed
+    nothing flag only when the full contract picture resolved (a partial
+    model cannot tell stale from load-bearing)."""
+    for path, per in sorted(m.annotations.items()):
+        for line, reason in sorted(per.items()):
+            if reason is None:
+                _emit(m, RULE_STALE, path, _Line(line), (
+                    "`# ddtlint: trace-inert` annotation without a "
+                    "reason — the grammar is `# ddtlint: trace-inert — "
+                    "<why>`; an unexplained exemption is unreviewable "
+                    "(docs/ANALYSIS.md config-field-orphan)"))
+            elif m.resolved and (path, line) not in m.used:
+                _emit(m, RULE_STALE, path, _Line(line), (
+                    "stale `# ddtlint: trace-inert` annotation — this "
+                    "line has no uncovered traced cfg read and declares "
+                    "no contract-less field, so the annotation exempts "
+                    "nothing today and would silently exempt whatever "
+                    "lands here next; delete it "
+                    "(docs/ANALYSIS.md config-field-orphan)"))
+
+
+# --------------------------------------------------------------------- #
+# the checker (runner wiring)
+# --------------------------------------------------------------------- #
+class ConfigFlowChecker(Checker):
+    """Emits this file's slice of the package-wide config-flow model's
+    findings (runner builds ONE model over the default scope so the
+    contract anchors, the traced reads, and the declarations resolve
+    across files; fixture tests get a single-file model built on demand
+    — fixtures embed their own mini-contract anchors)."""
+
+    rule = RULE_CACHE_KEY
+    rules = RULES
+    path_scope = SCOPE
+
+    def run(self):
+        m = self.ctx.config_model
+        if m is None:
+            m = build({self.ctx.path: self.ctx.tree},
+                      {self.ctx.path: self.ctx.source})
+        for f in m.findings:
+            if f.path != self.ctx.path:
+                continue
+            self.findings.append(Finding(
+                rule=f.rule, path=f.path, line=f.line, col=f.col,
+                message=f.message,
+                line_text=self.ctx.line_text(f.line)))
+        return self.findings
+
+
+CHECKERS = [ConfigFlowChecker]

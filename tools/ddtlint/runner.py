@@ -8,7 +8,8 @@ import os
 import re
 import subprocess
 
-from tools.ddtlint import callgraph, checkers, shardspec, threadmodel
+from tools.ddtlint import (callgraph, checkers, configflow, shardspec,
+                           telemetrycontract, threadmodel)
 from tools.ddtlint.base import CheckContext
 from tools.ddtlint.findings import Finding, assign_fingerprints
 
@@ -163,7 +164,8 @@ def run_on_source(path: str, source: str, mesh_axes: set[str] | None = None,
                   rules: set[str] | None = None,
                   tree: "ast.AST | None" = None,
                   layout_rules: "list[str] | None" = None,
-                  thread_model=None) -> list[Finding]:
+                  thread_model=None, config_model=None,
+                  telemetry_model=None) -> list[Finding]:
     """Lint one in-memory python source. For .supp content use
     checkers.check_suppressions directly. `tree` reuses an AST the
     caller already parsed (lint_paths parses each file exactly once and
@@ -189,7 +191,9 @@ def run_on_source(path: str, source: str, mesh_axes: set[str] | None = None,
             continue
         ctx = CheckContext(path, source, tree, mesh_axes, reachable,
                            layout_rules=layout_rules,
-                           thread_model=thread_model)
+                           thread_model=thread_model,
+                           config_model=config_model,
+                           telemetry_model=telemetry_model)
         out.extend(cls(ctx).run())
     if rules is not None:
         # Multi-rule checkers emit their whole catalogue; keep only the
@@ -241,6 +245,20 @@ def lint_paths(paths: list[str], root: str | None = None,
     tmodel = threadmodel.build(
         {p: trees[p] for p in tm_files},
         {p: py_sources[p] for p in tm_files}) if tm_files else None
+    # ONE config-flow model and ONE telemetry model, both over every
+    # scanned in-scope file (contract anchors + reads span the package)
+    # and both reusing the shared trees — and, for configflow, the
+    # already-built call graph (the single-parse contract).
+    cf_files = {p for p in py_sources
+                if configflow.in_scope(p) and trees.get(p) is not None}
+    cmodel = configflow.build(
+        {p: trees[p] for p in cf_files},
+        {p: py_sources[p] for p in cf_files},
+        reachable=reach) if cf_files else None
+    tc_files = {p for p in py_sources
+                if telemetrycontract.in_scope(p) and trees.get(p) is not None}
+    tele_model = telemetrycontract.build(
+        {p: trees[p] for p in tc_files}) if tc_files else None
 
     findings: list[Finding] = []
     for rel in emit_files:
@@ -252,7 +270,8 @@ def lint_paths(paths: list[str], root: str | None = None,
             findings.extend(run_on_source(
                 rel, src, mesh_axes=axes, reachable=reach.get(rel, set()),
                 rules=rules, tree=trees.get(rel),
-                layout_rules=layout_rules, thread_model=tmodel))
+                layout_rules=layout_rules, thread_model=tmodel,
+                config_model=cmodel, telemetry_model=tele_model))
     return assign_fingerprints(findings)
 
 

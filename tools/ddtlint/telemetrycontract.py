@@ -1,0 +1,457 @@
+"""Mechanized telemetry-schema contract (ddtlint v3, ISSUE 16).
+
+The run log's schema is enforced at emit time only for REQUIRED fields
+(telemetry/events.validate_event); extras — the additive growth
+mechanism every version bump note leans on — were convention. This pass
+reads the catalogs statically out of the parsed trees and turns the
+convention into lint findings:
+
+* `undeclared-event-kind` — an `.emit("<kind>", ...)` with a literal
+  kind not in EVENT_FIELDS (today a runtime ValueError on the first
+  emit — this moves it to lint time), and a fault kind (the literal in
+  `emit_fault("<kind>", ...)` or `.emit("fault", kind="<kind>", ...)`)
+  not in the FAULT_KINDS catalog — a typo'd kind is a fault event every
+  report query silently misses.
+* `undeclared-event-extra` — a literal keyword at an emit site that is
+  neither a required field nor a declared extra (EVENT_EXTRAS, fnmatch
+  globs like "valid_*" allowed): undeclared extras are schema drift no
+  reader knows to look for. The counter registry cross-check rides the
+  same rule: every counter the run log publishes (the `_c` dict plus
+  the epilogue's peak-memory keys) must be declared on the `counters`
+  event.
+* `counter-direction-missing` — every published counter must have a
+  COUNTER_DIRECTIONS entry ("lower"/"higher"/"neutral"): `report diff`
+  can only flag an adverse move when it knows which direction adverse
+  IS, and an unregistered counter was silently un-banded (the satellite
+  runtime fix marks those `direction=?` — this rule makes the state
+  unreachable).
+* `event-schema-additivity` — a required field ADDED to an existing
+  kind under an unchanged schema version breaks every reader of old
+  logs (they lack the field and read-side validation rejects them);
+  the pinned v5 snapshot below is the comparison base. New kinds and
+  new extras are additive and free; a version bump retires the pin.
+
+Emit sites with non-literal kinds or `**kwargs` payloads are skipped —
+missed findings over false positives, the ratchet's standing bias.
+Variable-kind fault emits are covered at the catalog end instead: the
+kind string must exist SOMEWHERE in FAULT_KINDS for report to group it.
+
+`python -m tools.ddtlint --explain-telemetry` dumps the derived
+contract; docs/OBSERVABILITY.md embeds it between
+`ddtlint:telemetry-contract` markers and tests/test_lint.py keeps the
+two in sync (the SERVING.md thread-model pattern from PR 13).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from fnmatch import fnmatchcase
+
+from tools.ddtlint import callgraph
+from tools.ddtlint.base import Checker
+from tools.ddtlint.findings import Finding
+
+SCOPE = (r"^ddt_tpu/",)
+
+RULE_KIND = "undeclared-event-kind"
+RULE_EXTRA = "undeclared-event-extra"
+RULE_DIRECTION = "counter-direction-missing"
+RULE_ADDITIVITY = "event-schema-additivity"
+
+RULES = (RULE_KIND, RULE_EXTRA, RULE_DIRECTION, RULE_ADDITIVITY)
+
+VALID_DIRECTIONS = ("higher", "lower", "neutral")
+
+#: The schema-v5 required-field sets, PINNED at the version this rule
+#: shipped under. Additivity is checked against this snapshot: growing a
+#: kind's required set without bumping SCHEMA_VERSION is the finding.
+#: When SCHEMA_VERSION moves past 5 the pin retires (the rule skips) and
+#: the snapshot should be re-pinned at the new version in the same PR.
+PINNED_SCHEMA_VERSION = 5
+PINNED_REQUIRED = {
+    "run_manifest": frozenset({"trainer", "backend", "loss", "n_trees",
+                               "max_depth", "rows", "features"}),
+    "round": frozenset({"round", "ms_per_round"}),
+    "phase_timings": frozenset({"phases"}),
+    "partition_phases": frozenset({"round", "partitions"}),
+    "partition_skew": frozenset({"phases"}),
+    "early_stop": frozenset({"round", "best_round", "best_score",
+                             "metric"}),
+    "fault": frozenset({"kind"}),
+    "counters": frozenset({"jit_compiles", "h2d_bytes", "d2h_bytes",
+                           "collective_bytes_est"}),
+    "cost_analysis": frozenset({"op", "flops", "bytes_accessed"}),
+    "artifact": frozenset({"action", "digest"}),
+    "serve_latency": frozenset({"requests", "p50_ms", "p99_ms"}),
+    "run_end": frozenset({"completed_rounds", "wallclock_s"}),
+}
+
+
+def in_scope(path: str) -> bool:
+    return any(re.search(p, path) for p in SCOPE)
+
+
+@dataclass
+class TelemetryModel:
+    """Statically-read catalogs + computed findings."""
+
+    events_path: "str | None" = None
+    events_line: int = 0                      # EVENT_FIELDS assign line
+    schema_version: "int | None" = None
+    required: dict = field(default_factory=dict)   # kind -> frozenset
+    kind_lines: dict = field(default_factory=dict)  # kind -> line
+    extras: "dict | None" = None              # kind -> tuple of patterns
+    fault_kinds: "tuple | None" = None
+    fault_line: int = 0
+    #: counter -> (path, line): the `_c` registry keys plus the run-log
+    #: epilogue's subscript-added keys (the peak-memory pair).
+    counter_lines: dict = field(default_factory=dict)
+    directions: "dict | None" = None          # counter -> direction str
+    directions_site: "tuple | None" = None    # (path, line)
+    findings: list = field(default_factory=list)    # Finding (no line_text)
+
+
+def _emit(m: TelemetryModel, rule: str, path: str, node,
+          message: str) -> None:
+    m.findings.append(Finding(
+        rule=rule, path=path, line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1, message=message))
+
+
+def _str_elts(node: ast.AST) -> "list | None":
+    """Tuple/List/Set of string constants -> their (value, node) pairs;
+    `set()` / `()` count as empty; None when the shape doesn't match."""
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            out.append((e.value, e))
+        return out
+    if isinstance(node, ast.Call):
+        d = callgraph.dotted(node.func)
+        if d == "set" and not node.args:
+            return []
+    return None
+
+
+def _assign_targets(node: ast.AST) -> list:
+    if isinstance(node, ast.Assign):
+        return [t for t in node.targets if isinstance(t, ast.Name)]
+    if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+        return [node.target]
+    return []
+
+
+# --------------------------------------------------------------------- #
+# anchor extraction
+# --------------------------------------------------------------------- #
+def _read_anchors(m: TelemetryModel, trees: dict) -> None:
+    for path, tree in sorted(trees.items()):
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            names = {t.id for t in _assign_targets(node)}
+            v = node.value
+            if v is None:
+                continue
+            if "EVENT_FIELDS" in names and isinstance(v, ast.Dict) \
+                    and m.events_path is None:
+                m.events_path, m.events_line = path, node.lineno
+                for k, val in zip(v.keys, v.values):
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        continue
+                    elts = _str_elts(val)
+                    if elts is None:
+                        continue
+                    m.required[k.value] = frozenset(s for s, _ in elts)
+                    m.kind_lines[k.value] = k.lineno
+            elif "EVENT_EXTRAS" in names and isinstance(v, ast.Dict) \
+                    and m.extras is None:
+                extras: dict = {}
+                for k, val in zip(v.keys, v.values):
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        continue
+                    elts = _str_elts(val)
+                    if elts is not None:
+                        extras[k.value] = tuple(s for s, _ in elts)
+                m.extras = extras
+            elif "FAULT_KINDS" in names and m.fault_kinds is None:
+                elts = _str_elts(v)
+                if elts:
+                    m.fault_kinds = tuple(s for s, _ in elts)
+                    m.fault_line = node.lineno
+            elif "SCHEMA_VERSION" in names and m.schema_version is None \
+                    and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, int):
+                m.schema_version = v.value
+            elif "_c" in names and isinstance(v, ast.Dict) \
+                    and not m.counter_lines:
+                for k in v.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        m.counter_lines[k.value] = (path, k.lineno)
+            elif "COUNTER_DIRECTIONS" in names and isinstance(v, ast.Dict) \
+                    and m.directions is None:
+                m.directions = {}
+                m.directions_site = (path, node.lineno)
+                for k, val in zip(v.keys, v.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str) \
+                            and isinstance(val, ast.Constant):
+                        m.directions[k.value] = val.value
+
+
+def _epilogue_counter_keys(m: TelemetryModel, trees: dict) -> None:
+    """Keys subscript-assigned into a dict that is then splatted into an
+    `.emit("counters", **d)` call — the finish_run_log peak-memory pair.
+    They publish exactly like `_c` keys, so the direction + declaration
+    rules must see them."""
+    for path, tree in sorted(trees.items()):
+        if tree is None:
+            continue
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            splat_vars = set()
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "emit" and n.args \
+                        and isinstance(n.args[0], ast.Constant) \
+                        and n.args[0].value == "counters":
+                    for k in n.keywords:
+                        if k.arg is None and isinstance(k.value, ast.Name):
+                            splat_vars.add(k.value.id)
+            if not splat_vars:
+                continue
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Subscript) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id in splat_vars \
+                                and isinstance(t.slice, ast.Constant) \
+                                and isinstance(t.slice.value, str):
+                            m.counter_lines.setdefault(
+                                t.slice.value, (path, t.lineno))
+
+
+# --------------------------------------------------------------------- #
+# emit-site checks
+# --------------------------------------------------------------------- #
+def _allowed(m: TelemetryModel, kind: str, name: str) -> bool:
+    if name in m.required.get(kind, ()):
+        return True
+    return any(fnmatchcase(name, pat)
+               for pat in (m.extras or {}).get(kind, ()))
+
+
+def _check_kwargs(m: TelemetryModel, path: str, call: ast.Call,
+                  kind: str, skip: "set | None" = None) -> None:
+    if m.extras is None:
+        return                     # extras catalog unresolved: no guessing
+    for k in call.keywords:
+        if k.arg is None or (skip and k.arg in skip):
+            continue
+        if kind == "fault" and k.arg == "kind":
+            if isinstance(k.value, ast.Constant) \
+                    and isinstance(k.value.value, str) \
+                    and m.fault_kinds is not None \
+                    and k.value.value not in m.fault_kinds:
+                _emit(m, RULE_KIND, path, k.value, (
+                    f"fault kind {k.value.value!r} is not in the "
+                    f"FAULT_KINDS catalog ({m.events_path}:"
+                    f"{m.fault_line}) — report's fault table silently "
+                    "drops kinds it cannot group; declare it "
+                    "(docs/ANALYSIS.md undeclared-event-kind)"))
+            continue
+        if not _allowed(m, kind, k.arg):
+            _emit(m, RULE_EXTRA, path, k.value, (
+                f"`{k.arg}=` is neither a required field nor a declared "
+                f"extra of the {kind!r} event — undeclared extras are "
+                "schema drift no reader knows to look for; declare it "
+                f"in EVENT_EXTRAS ({m.events_path}) "
+                "(docs/ANALYSIS.md undeclared-event-extra)"))
+
+
+def _check_emits(m: TelemetryModel, trees: dict) -> None:
+    if not m.required:
+        return
+    for path, tree in sorted(trees.items()):
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "emit":
+                if not (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                kind = node.args[0].value
+                if kind not in m.required:
+                    _emit(m, RULE_KIND, path, node.args[0], (
+                        f"event kind {kind!r} is not declared in "
+                        f"EVENT_FIELDS ({m.events_path}:{m.events_line}) "
+                        "— today this is a ValueError on the first emit; "
+                        "declare the kind (with its required fields) or "
+                        "fix the typo "
+                        "(docs/ANALYSIS.md undeclared-event-kind)"))
+                    continue
+                _check_kwargs(m, path, node, kind)
+            else:
+                d = callgraph.dotted(f)
+                if d is None or d.split(".")[-1] != "emit_fault":
+                    continue
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and m.fault_kinds is not None \
+                        and node.args[0].value not in m.fault_kinds:
+                    _emit(m, RULE_KIND, path, node.args[0], (
+                        f"fault kind {node.args[0].value!r} is not in "
+                        f"the FAULT_KINDS catalog ({m.events_path}:"
+                        f"{m.fault_line}) — report's fault table "
+                        "silently drops kinds it cannot group; declare "
+                        "it (docs/ANALYSIS.md undeclared-event-kind)"))
+                _check_kwargs(m, path, node, "fault")
+
+
+# --------------------------------------------------------------------- #
+# catalog-level checks
+# --------------------------------------------------------------------- #
+def _check_counters(m: TelemetryModel) -> None:
+    if not m.counter_lines:
+        return
+    if m.required and m.extras is not None and "counters" in m.required:
+        for key, (path, line) in sorted(m.counter_lines.items()):
+            if not _allowed(m, "counters", key):
+                _emit(m, RULE_EXTRA, path, _Pos(line), (
+                    f"counter {key!r} is published on the `counters` "
+                    "event but not declared there (required or "
+                    f"EVENT_EXTRAS, {m.events_path}) — a counter no "
+                    "reader knows to look for "
+                    "(docs/ANALYSIS.md undeclared-event-extra)"))
+    if m.directions is None:
+        return
+    dp, dl = m.directions_site
+    for key, (path, line) in sorted(m.counter_lines.items()):
+        direction = m.directions.get(key)
+        if direction is None:
+            _emit(m, RULE_DIRECTION, path, _Pos(line), (
+                f"counter {key!r} has no COUNTER_DIRECTIONS entry "
+                f"({dp}:{dl}) — `report diff` cannot band a counter "
+                "whose adverse direction it does not know and renders "
+                "it direction=?; declare \"lower\", \"higher\", or "
+                "\"neutral\" (never flagged) "
+                "(docs/ANALYSIS.md counter-direction-missing)"))
+        elif direction not in VALID_DIRECTIONS:
+            _emit(m, RULE_DIRECTION, path, _Pos(line), (
+                f"counter {key!r} declares direction {direction!r} — "
+                f"COUNTER_DIRECTIONS values must be one of "
+                f"{'/'.join(VALID_DIRECTIONS)} ({dp}:{dl}) "
+                "(docs/ANALYSIS.md counter-direction-missing)"))
+
+
+def _check_additivity(m: TelemetryModel) -> None:
+    if m.schema_version != PINNED_SCHEMA_VERSION or not m.required:
+        return
+    for kind in sorted(m.required):
+        pinned = PINNED_REQUIRED.get(kind)
+        if pinned is None:
+            continue                    # new kinds are additive and free
+        grown = sorted(m.required[kind] - pinned)
+        if grown:
+            _emit(m, RULE_ADDITIVITY, m.events_path,
+                  _Pos(m.kind_lines.get(kind, m.events_line)), (
+                      f"required field(s) {', '.join(grown)} added to "
+                      f"existing event kind {kind!r} under schema "
+                      f"v{PINNED_SCHEMA_VERSION} — old logs lack the "
+                      "field and read-side validation now rejects them; "
+                      "make it an EVENT_EXTRAS entry (additive) or bump "
+                      "SCHEMA_VERSION and re-pin the snapshot in "
+                      "tools/ddtlint/telemetrycontract.py "
+                      "(docs/ANALYSIS.md event-schema-additivity)"))
+
+
+class _Pos:
+    def __init__(self, lineno: int, col_offset: int = 0):
+        self.lineno = lineno
+        self.col_offset = col_offset
+
+
+# --------------------------------------------------------------------- #
+# model construction
+# --------------------------------------------------------------------- #
+def build(trees: dict) -> TelemetryModel:
+    """{relpath: parsed ast.Module} -> the package-wide telemetry model
+    with findings computed. All catalog anchors are found by NAME
+    (EVENT_FIELDS, EVENT_EXTRAS, FAULT_KINDS, SCHEMA_VERSION, _c,
+    COUNTER_DIRECTIONS) so fixture files can embed a self-contained
+    mini-catalog; unresolved anchors make their rules skip, not guess."""
+    m = TelemetryModel()
+    _read_anchors(m, trees)
+    _epilogue_counter_keys(m, trees)
+    _check_emits(m, trees)
+    _check_counters(m)
+    _check_additivity(m)
+    return m
+
+
+# --------------------------------------------------------------------- #
+# the checker (runner wiring)
+# --------------------------------------------------------------------- #
+class TelemetryContractChecker(Checker):
+    """Emits this file's slice of the package-wide telemetry model's
+    findings (runner builds ONE model over the default scope so emit
+    sites check against the real catalogs; fixture tests get a
+    single-file model built on demand)."""
+
+    rule = RULE_KIND
+    rules = RULES
+    path_scope = SCOPE
+
+    def run(self):
+        m = self.ctx.telemetry_model
+        if m is None:
+            m = build({self.ctx.path: self.ctx.tree})
+        for f in m.findings:
+            if f.path != self.ctx.path:
+                continue
+            self.findings.append(Finding(
+                rule=f.rule, path=f.path, line=f.line, col=f.col,
+                message=f.message,
+                line_text=self.ctx.line_text(f.line)))
+        return self.findings
+
+
+# --------------------------------------------------------------------- #
+# --explain-telemetry
+# --------------------------------------------------------------------- #
+def explain(m: TelemetryModel) -> str:
+    """Byte-stable dump of the derived contract — docs/OBSERVABILITY.md
+    embeds it between `ddtlint:telemetry-contract` markers and
+    tests/test_lint.py keeps the two in sync."""
+    out = ["telemetry contract (tools/ddtlint --explain-telemetry)"]
+    out.append(f"schema: v{m.schema_version}")
+    out.append("events (required | extras):")
+    for kind in sorted(m.required):
+        req = ", ".join(sorted(m.required[kind]))
+        ext = ", ".join(sorted((m.extras or {}).get(kind, ()))) or "-"
+        out.append(f"  {kind}: {req} | {ext}")
+    out.append("fault kinds:")
+    for k in sorted(m.fault_kinds or ()):
+        out.append(f"  {k}")
+    out.append("counter directions:")
+    for k in sorted(m.counter_lines):
+        out.append(f"  {k}: {(m.directions or {}).get(k, '?')}")
+    return "\n".join(out) + "\n"
+
+
+CHECKERS = [TelemetryContractChecker]
