@@ -665,14 +665,17 @@ def main(argv: list[str] | None = None) -> int:
                          "comma-separated model entries, each "
                          "ref[:key=value]* — e.g. "
                          "'a@prod,b@canary:weight=3,c@v2:tier=int4'. "
-                         "Keys: name, weight, tier, max_batch, raw. "
+                         "Keys: name, weight, tier, max_batch, raw, "
+                         "slo_p99_ms (per-request p99 latency "
+                         "objective in ms — enables burn-rate "
+                         "tracking + slo_breach events). "
                          "Refs resolve through --registry (or are "
                          ".npz paths); duplicate names and unknown "
                          "refs fail loudly at boot")
     sv.add_argument("--fleet-config", default=None,
                     help="FLEET mode: JSON fleet config file "
                          "({\"models\": [{name, ref, weight, tier, "
-                         "max_batch, raw}, ...]}); combines with "
+                         "max_batch, raw, slo_p99_ms}, ...]}); combines with "
                          "--models (duplicate names across the two "
                          "fail loudly)")
     sv.add_argument("--max-resident", type=_positive_int, default=None,
@@ -712,6 +715,13 @@ def main(argv: list[str] | None = None) -> int:
                          "immediately instead of waiting out the "
                          "admission window — on by default; "
                          "docs/SERVING.md)")
+    sv.add_argument("--no-request-traces", action="store_true",
+                    help="disable per-request trace propagation (the "
+                         "X-DDT-Trace-Id/X-DDT-Timing response headers, "
+                         "the /debug/requests ring, serve_trace "
+                         "flushes) — on by default; a client-supplied "
+                         "trace id is still echoed back "
+                         "(docs/OBSERVABILITY.md)")
     sv.add_argument("--run-log", default=None,
                     help="JSONL run log for serve_latency SLO events "
                          "(render with `report` — docs/OBSERVABILITY.md)")
@@ -799,6 +809,12 @@ def main(argv: list[str] | None = None) -> int:
              "its serve_latency windows, serving tier, eviction/reload "
              "counts, and artifact provenance (docs/OBSERVABILITY.md); "
              "fails loudly on a log with no fleet data")
+    rsub.add_parser(
+        "slo",
+        help="render the SLO rollup only: one row per model joining "
+             "its declared p99 objective against the observed tail and "
+             "the run's slo_breach burn rates (docs/OBSERVABILITY.md); "
+             "fails loudly on a log with no SLO data")
     dp = rsub.add_parser(
         "diff",
         help="align two run logs by phase and counter and flag adverse "
@@ -1057,7 +1073,8 @@ def main(argv: list[str] | None = None) -> int:
                     max_wait_ms=args.max_wait_ms,
                     max_resident=args.max_resident,
                     run_log=args.run_log,
-                    express_lane=not args.no_express_lane)
+                    express_lane=not args.no_express_lane,
+                    request_traces=not args.no_request_traces)
             except (fleet_control.FleetConfigError, RegistryError,
                     ValueError, OSError) as e:
                 raise SystemExit(f"serve fleet: {e}") from e
@@ -1119,7 +1136,8 @@ def main(argv: list[str] | None = None) -> int:
                 servable, cfg, max_wait_ms=args.max_wait_ms,
                 max_batch=servable.buckets[-1], quantize=args.quantized,
                 raw=args.raw, run_log=run_log,
-                express_lane=not args.no_express_lane)
+                express_lane=not args.no_express_lane,
+                request_traces=not args.no_request_traces)
         else:
             bundle = api.load_model(args.model)
             cfg = TrainConfig(
@@ -1130,7 +1148,8 @@ def main(argv: list[str] | None = None) -> int:
                 bundle, cfg, max_wait_ms=args.max_wait_ms,
                 max_batch=args.max_batch, quantize=args.quantized,
                 raw=args.raw, run_log=args.run_log,
-                express_lane=not args.no_express_lane)
+                express_lane=not args.no_express_lane,
+                request_traces=not args.no_request_traces)
         engine.registry_root = args.registry
         print(json.dumps({
             "cmd": "serve", "model": args.model,
@@ -1237,6 +1256,13 @@ def main(argv: list[str] | None = None) -> int:
                 out_text = tele_report.render_fleet(summary)
                 if args.json:
                     out_text = json.dumps(summary["fleet"])
+            elif getattr(args, "report_cmd", None) == "slo":
+                # `report --log L slo`: just the SLO rollup (render_slo
+                # raises on a log with no SLO data — caught below into
+                # the clean SystemExit, same shape as `fleet`).
+                out_text = tele_report.render_slo(summary)
+                if args.json:
+                    out_text = json.dumps(summary["slo"])
             else:
                 out_text = (json.dumps(summary) if args.json
                             else tele_report.render(summary))

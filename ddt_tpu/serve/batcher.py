@@ -42,13 +42,66 @@ taxes the express path's whole point.
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
+import uuid
 
 
 class ShuttingDown(RuntimeError):
     """Raised to waiters whose request cannot be served because the
     batcher is closing."""
+
+
+#: Trace-id mint (ISSUE 17): a random process prefix + a monotonic
+#: sequence — unique enough to join client logs against serve_trace
+#: records, and O(1) per request (no per-request entropy syscall in the
+#: hot path; the tracing-overhead A/B in scripts/serve_smoke.py holds
+#: the default-on path to 1.1x of --no-request-traces).
+_TRACE_PREFIX = uuid.uuid4().hex[:12]
+_TRACE_SEQ = itertools.count(1)
+
+
+def _gen_trace_id() -> str:
+    return f"{_TRACE_PREFIX}-{next(_TRACE_SEQ):08x}"
+
+
+def trace_breakdown(req: "PendingRequest") -> "dict | None":
+    """The ONE shape home for a completed request's timing breakdown
+    (response `X-DDT-Timing` header, the per-model trace ring, and the
+    flushed `serve_trace` event all render this dict — they cannot
+    drift). Segments, all in ms on the batcher's injected clock:
+
+    - handler_ms — accept -> admit: submit()/express() entry to queue
+      append (express: to gate acquisition), i.e. handler-side overhead;
+    - queue_ms   — admit -> gate: queue + admission-window wait until
+      the batch holding this request acquired the dispatch gate
+      (~0 on the express lane — that is the lane's point);
+    - gate_ms    — gate -> device: batch assembly under the gate
+      (width checks, per-request transform, concat);
+    - device_ms  — the device call (score_binned);
+    - wake_ms    — device done -> result publication;
+    - total_ms   — accept -> publication (the client-observed span
+      minus transport).
+
+    Returns None for an untraced or still-pending request."""
+    m = req.marks
+    if m is None or "wake" not in m:
+        return None
+    acc = m["accept"]
+    adm = m.get("admit", acc)
+    gate = m.get("gate", adm)
+    dev = m.get("device", gate)
+    done = m.get("done", dev)
+    wake = m["wake"]
+    return {
+        "handler_ms": round((adm - acc) * 1e3, 3),
+        "queue_ms": round((gate - adm) * 1e3, 3),
+        "gate_ms": round((dev - gate) * 1e3, 3),
+        "device_ms": round((done - dev) * 1e3, 3),
+        "wake_ms": round((wake - done) * 1e3, 3),
+        "total_ms": round((wake - acc) * 1e3, 3),
+    }
 
 
 class PendingRequest:
@@ -65,10 +118,13 @@ class PendingRequest:
     response to the wrong version; scripts/serve_smoke.py catches it).
     `express` marks a request the express lane dispatched synchronously
     (never queued) — the engine's stats read it for the two-regime
-    telemetry."""
+    telemetry. `trace_id`/`marks` carry the ISSUE 17 request trace:
+    the id round-trips client -> response header, and `marks` (None
+    when tracing is off) accumulates clock marks through the batcher's
+    injected clock seam — trace_breakdown() renders them."""
 
     __slots__ = ("rows", "n", "t_submit", "model_token", "express",
-                 "_event", "_result", "_error")
+                 "trace_id", "marks", "_event", "_result", "_error")
 
     def __init__(self, rows, n: int):
         self.rows = rows
@@ -76,6 +132,8 @@ class PendingRequest:
         self.t_submit = time.perf_counter()
         self.model_token = None
         self.express = False
+        self.trace_id = None
+        self.marks = None
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -120,13 +178,18 @@ class MicroBatcher:
 
     def __init__(self, dispatch, max_wait_ms: float = 1.0,
                  max_batch: int = 256, clock=None, cv=None,
-                 own_thread: bool = True):
+                 own_thread: bool = True, request_traces: bool = True):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
             raise ValueError(
                 f"max_wait_ms must be >= 0, got {max_wait_ms}")
         self._dispatch = dispatch
+        # Per-request trace accumulation (ISSUE 17): on by default; the
+        # CLI's --no-request-traces turns it off (a client-supplied
+        # trace id is still echoed — only the timing marks and ring
+        # entries are skipped).
+        self.request_traces = bool(request_traces)
         self.max_wait_s = max_wait_ms / 1e3
         self.max_batch = int(max_batch)
         # Injectable clock (tests drive the admission-deadline math with
@@ -155,19 +218,34 @@ class MicroBatcher:
                 target=self._loop, name="ddt-serve-batcher", daemon=True)
             self._thread.start()
 
-    def submit(self, rows, n: int) -> PendingRequest:
+    def submit(self, rows, n: int,
+               trace_id: "str | None" = None) -> PendingRequest:
         """Enqueue one request (`rows` is the request's row block, `n`
-        its row count). Returns immediately; wait on the PendingRequest."""
+        its row count). Returns immediately; wait on the PendingRequest.
+        `trace_id` is the client-supplied id (X-DDT-Trace-Id) — honored
+        verbatim, else one is minted when tracing is on."""
         req = PendingRequest(rows, n)
-        req.t_submit = self._clock()
+        t = self._clock()
+        req.t_submit = t
+        if self.request_traces:
+            req.trace_id = trace_id if trace_id else _gen_trace_id()
+            # The clock rides along so the dispatch body (engine.py's
+            # dispatch_batch) stamps gate/device/wake marks on the SAME
+            # timebase — the clock= seam is the whole breakdown's clock.
+            req.marks = {"_clock": self._clock, "accept": t}
+        elif trace_id is not None:
+            req.trace_id = trace_id
         with self._cv:
             if self._closed:
                 raise ShuttingDown("serve batcher is shut down")
             self._q.append(req)
+            if req.marks is not None:
+                req.marks["admit"] = self._clock()
             self._cv.notify_all()
         return req
 
-    def express(self, rows, n: int) -> "PendingRequest | None":
+    def express(self, rows, n: int,
+                trace_id: "str | None" = None) -> "PendingRequest | None":
         """Express lane: dispatch ONE request synchronously on the
         calling thread, bypassing the admission window — but only when
         the lane is open (queue empty, dispatch gate free). Returns the
@@ -195,8 +273,18 @@ class MicroBatcher:
         # ddtlint lock-release rule pins this shape).
         try:
             req = PendingRequest(rows, n)
-            req.t_submit = self._clock()
+            t = self._clock()
+            req.t_submit = t
             req.express = True
+            if self.request_traces:
+                req.trace_id = trace_id if trace_id else _gen_trace_id()
+                # "admit" on the express lane is gate acquisition — the
+                # queue was skipped, so queue_ms in the breakdown is the
+                # lane's ~0 signature.
+                req.marks = {"_clock": self._clock, "accept": t,
+                             "admit": t}
+            elif trace_id is not None:
+                req.trace_id = trace_id
             try:
                 self._dispatch([req], 0)
             # Same error contract as the dispatcher loop: a scoring
@@ -208,6 +296,13 @@ class MicroBatcher:
         finally:
             self._gate.release()
         return req
+
+    def backlog_rows(self) -> int:
+        """Live queued-row count (the /metrics and /healthz live-backlog
+        gauge — ISSUE 17): takes the Condition briefly, reads, releases.
+        Strictly read-only; never signals the dispatcher."""
+        with self._cv:
+            return self.backlog_rows_locked()
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop admitting, drain what is queued, join the dispatcher

@@ -6,7 +6,7 @@ dispatch half). Three jobs, all of them OFF the request hot loop:
 - **parse** the two fleet config surfaces into `FleetSpec`s — the CLI's
   `--models a@prod,b@canary:weight=3` shorthand and the `--fleet-config
   fleet.json` file ({"models": [{"name", "ref", "weight", "tier",
-  "max_batch", "raw"}, ...]} or a bare list) — with loud errors on
+  "max_batch", "raw", "slo_p99_ms"}, ...]} or a bare list) — with loud errors on
   duplicate names, unknown keys, and malformed values (the CLI wraps
   them SystemExit-clean like the registry group);
 - **resolve** every reference at boot (registry name index or an
@@ -54,6 +54,10 @@ class FleetSpec:
     tier: "str | None" = None
     max_batch: int = 256
     raw: bool = False
+    #: per-request p99 latency objective in ms (ISSUE 17) — None means
+    #: no SLO: no burn-rate tracking, no slo_breach events, and the
+    #: health/metrics payloads stay byte-identical to pre-SLO output.
+    slo_p99_ms: "float | None" = None
 
     def __post_init__(self):
         if not self.name:
@@ -66,10 +70,14 @@ class FleetSpec:
             raise FleetConfigError(
                 f"model {self.name!r}: max_batch must be >= 1, got "
                 f"{self.max_batch}")
+        if self.slo_p99_ms is not None and self.slo_p99_ms <= 0:
+            raise FleetConfigError(
+                f"model {self.name!r}: slo_p99_ms must be > 0, got "
+                f"{self.slo_p99_ms}")
 
 
 _SPEC_KEYS = {"name", "ref", "model", "weight", "tier", "max_batch",
-              "raw"}
+              "raw", "slo_p99_ms"}
 
 
 def _default_name(ref: str) -> str:
@@ -112,6 +120,16 @@ def coerce_spec(d: dict, where: str) -> FleetSpec:
         tier = normalize_quantize(tier) if tier is not None else None
     except ValueError as e:
         raise FleetConfigError(f"{where}: {e}") from e
+    slo = d.get("slo_p99_ms")
+    if slo is not None:
+        # Loud junk rejection at parse time: "fast", "", "5ms" all land
+        # here — float('5ms') raising late would blame the wrong layer.
+        try:
+            slo = float(slo)
+        except (TypeError, ValueError):
+            raise FleetConfigError(
+                f"{where}: slo_p99_ms must be a positive number of "
+                f"milliseconds, got {d.get('slo_p99_ms')!r}") from None
     try:
         return FleetSpec(
             name=str(d.get("name") or _default_name(str(ref))),
@@ -119,7 +137,8 @@ def coerce_spec(d: dict, where: str) -> FleetSpec:
             weight=float(d.get("weight", 1.0)),
             tier=tier,
             max_batch=int(d.get("max_batch", 256)),
-            raw=_coerce_bool(d.get("raw", False), where, "raw"))
+            raw=_coerce_bool(d.get("raw", False), where, "raw"),
+            slo_p99_ms=slo)
     except (TypeError, ValueError) as e:
         raise FleetConfigError(f"{where}: {e}") from e
 
@@ -254,8 +273,8 @@ def make_loader(registry_root: "str | None", backend_name: str,
 def build_fleet(specs, *, registry: "str | None" = None,
                 backend: str = "tpu", max_wait_ms: float = 1.0,
                 max_resident: "int | None" = None, run_log=None,
-                express_lane: bool = True,
-                preload: bool = True) -> FleetEngine:
+                express_lane: bool = True, preload: bool = True,
+                request_traces: bool = True) -> FleetEngine:
     """Specs -> a running FleetEngine: validate, resolve every ref
     loudly, build the loader over the registry, and (by default) make
     the first `max_resident` models resident so boot-time failures are
@@ -270,7 +289,8 @@ def build_fleet(specs, *, registry: "str | None" = None,
     engine = FleetEngine(
         specs, make_loader(registry, backend, run_log=run_log),
         max_wait_ms=max_wait_ms, max_resident=max_resident,
-        run_log=run_log, express_lane=express_lane)
+        run_log=run_log, express_lane=express_lane,
+        request_traces=request_traces)
     if preload:
         budget = len(specs) if max_resident is None else max_resident
         try:

@@ -24,6 +24,7 @@ no synchronous file reads — model files are loaded by the CALLER
 
 from __future__ import annotations
 
+import bisect
 import collections
 import dataclasses
 import logging
@@ -34,7 +35,8 @@ import numpy as np
 
 from ddt_tpu.backends import get_backend
 from ddt_tpu.config import TrainConfig
-from ddt_tpu.serve.batcher import MicroBatcher, PendingRequest
+from ddt_tpu.serve.batcher import (MicroBatcher, PendingRequest,
+                                   trace_breakdown)
 from ddt_tpu.telemetry import counters as tele_counters
 # Host-side probability transform (ONE home shared with api.predict —
 # no device round-trip for an [R]-sized vector on the request path).
@@ -250,6 +252,15 @@ class _Window:
     t_start: float = dataclasses.field(default_factory=time.perf_counter)
 
 
+#: FIXED log-spaced latency histogram bucket upper bounds in ms (the
+#: /metrics exposition's `le=` ladder, ISSUE 17): 0.1 ms doubling to
+#: ~3.3 s, plus an implicit +Inf overflow bucket. Fixed — never derived
+#: from observed data — so two scrapes (or two processes) are always
+#: bucket-compatible, the property Prometheus histogram aggregation
+#: assumes.
+HIST_BUCKETS_MS = tuple(round(0.1 * 2.0 ** i, 4) for i in range(16))
+
+
 def _quantile(sorted_vals: list, q: float) -> float:
     """Nearest-rank quantile on a pre-sorted list (p999 on a 100-request
     smoke run must be the honest max, not an interpolation artifact)."""
@@ -261,9 +272,13 @@ def _quantile(sorted_vals: list, q: float) -> float:
 
 class ServeStats:
     """Thread-safe latency/coalesce accounting: a bounded all-time ring
-    plus the current emit window."""
+    plus the current emit window, a NON-RESETTING log-spaced latency
+    histogram (the /metrics exposition — scrapes never reset it, unlike
+    the emit window), and a bounded ring of the last TRACE_RING
+    completed request traces (GET /debug/requests)."""
 
     RING = 65_536
+    TRACE_RING = 256
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -272,13 +287,27 @@ class ServeStats:
         self.requests = 0
         self.coalesce_max = 0
         self.express = 0
+        # Cumulative per-bucket counts on the FIXED HIST_BUCKETS_MS
+        # ladder (+1 overflow slot) + the running latency sum — the
+        # strictly monotonic state /metrics renders; `requests` above is
+        # the matching _count series.
+        self._hist = [0] * (len(HIST_BUCKETS_MS) + 1)
+        self._hist_sum_ms = 0.0
+        self._traces: collections.deque = collections.deque(
+            maxlen=self.TRACE_RING)
 
     def record_batch(self, n_requests: int, queue_depth: int,
-                     latencies_ms: list, express: bool = False) -> None:
+                     latencies_ms: list, express: bool = False,
+                     traces: "list | None" = None) -> None:
         with self._lock:
             self.requests += n_requests
             self.coalesce_max = max(self.coalesce_max, n_requests)
             self._all.extend(latencies_ms)
+            for v in latencies_ms:
+                self._hist[bisect.bisect_left(HIST_BUCKETS_MS, v)] += 1
+                self._hist_sum_ms += v
+            if traces:
+                self._traces.extend(traces)
             w = self._win
             w.batches += 1
             w.requests += n_requests
@@ -328,6 +357,26 @@ class ServeStats:
                 "p999_ms": round(_quantile(lat, 0.999), 4),
             }
 
+    def metrics_state(self) -> dict:
+        """The non-resetting histogram state the /metrics exposition
+        renders: fixed bucket bounds, cumulative-compatible per-bucket
+        counts (last slot = +Inf overflow), running sum, and the
+        lifetime request count. STRICTLY read-only — a scrape must
+        never perturb the emit window (the /metrics vs /stats?emit=1
+        contract tests/test_serve.py pins)."""
+        with self._lock:
+            return {"buckets_ms": list(HIST_BUCKETS_MS),
+                    "counts": list(self._hist),
+                    "sum_ms": round(self._hist_sum_ms, 4),
+                    "count": self.requests,
+                    "express": self.express}
+
+    def traces_snapshot(self) -> list:
+        """Completed-trace ring, oldest first (GET /debug/requests and
+        the serve_trace flush read this; read-only like metrics_state)."""
+        with self._lock:
+            return list(self._traces)
+
 
 def coerce_rows(rows) -> np.ndarray:
     """Submit-side row normalization shared by ServeEngine and the
@@ -344,19 +393,39 @@ def coerce_rows(rows) -> np.ndarray:
     return rows
 
 
-def dispatch_batch(model, batch, queue_depth: int, stats) -> None:
+def dispatch_batch(model, batch, queue_depth: int, stats) -> list:
     """Score ONE admitted micro-batch against `model` and deliver every
     result/error — the per-batch body shared by ServeEngine._dispatch
     and the fleet engine's per-model dispatch (ddt_tpu/serve/fleet.py).
     The caller read the model reference ONCE (hot-swap/eviction
     atomicity: every request in the batch is scored by exactly this
     version); this function never touches engine state beyond `stats`.
+    Returns the per-request latencies (ms) of the delivered requests —
+    the fleet's SLO burn-rate tracker consumes them.
 
     Raw float requests bin HERE, under the same model that scores them —
     binning at submit time could pair model A's bins with model B's
     trees across a swap. Transform failures are PER-REQUEST: a malformed
     submission fails its own waiter only, never the valid requests that
-    happened to share its admission window."""
+    happened to share its admission window.
+
+    Trace marks (ISSUE 17) ride the requests' own `marks` dicts on the
+    batcher's injected clock (marks carry the clock — the whole
+    breakdown stays on one timebase): `gate` at entry (the dispatch
+    gate is held here), `device`/`done` around the device call, `wake`
+    just before result publication. Completed breakdowns land in the
+    stats trace ring BEFORE any waiter wakes — a client that queries
+    /debug/requests the moment result() returns finds its own trace."""
+    clk = None
+    for r in batch:
+        if r.marks is not None:
+            clk = r.marks["_clock"]
+            break
+    if clk is not None:
+        t = clk()
+        for r in batch:
+            if r.marks is not None:
+                r.marks["gate"] = t
     good, blocks = [], []
     for r in batch:
         # Feature-count check against the model ACTUALLY scoring this
@@ -380,12 +449,34 @@ def dispatch_batch(model, batch, queue_depth: int, stats) -> None:
         except Exception as e:  # ddtlint: disable=broad-except
             r.set_error(e)
     if not good:
-        return
+        return []
     Xb = blocks[0] if len(blocks) == 1 else np.concatenate(blocks)
+    if clk is not None:
+        t = clk()
+        for r in good:
+            if r.marks is not None:
+                r.marks["device"] = t
     scores = model.score_binned(Xb)
     done = time.perf_counter()
+    if clk is not None:
+        t = clk()
+        for r in good:
+            if r.marks is not None:
+                r.marks["done"] = t
     lats = [(done - r.t_submit) * 1e3 for r in good]
     express = bool(good and good[0].express)
+    traces = None
+    if clk is not None:
+        t_wake = clk()
+        traces = []
+        for r in good:
+            if r.marks is None:
+                continue
+            r.marks["wake"] = t_wake
+            rec = {"trace_id": r.trace_id, "rows": r.n,
+                   "express": bool(r.express)}
+            rec.update(trace_breakdown(r))
+            traces.append(rec)
     # Stats land BEFORE any waiter wakes: a caller that resets the
     # stats window the moment result() returns must find this batch in
     # the window it completed in, and never see it leak into the next
@@ -394,7 +485,8 @@ def dispatch_batch(model, batch, queue_depth: int, stats) -> None:
     tele_counters.record_serve_batch()
     if express:
         tele_counters.record_serve_express()
-    stats.record_batch(len(good), queue_depth, lats, express=express)
+    stats.record_batch(len(good), queue_depth, lats, express=express,
+                       traces=traces)
     off = 0
     for req in good:
         # Attribution BEFORE the result event fires: a waiter that
@@ -403,6 +495,7 @@ def dispatch_batch(model, batch, queue_depth: int, stats) -> None:
         req.model_token = model.token
         req.set_result(scores[off:off + req.n])
         off += req.n
+    return lats
 
 
 class ServeEngine:
@@ -422,7 +515,8 @@ class ServeEngine:
                  max_batch: int = 256, quantize=False,
                  raw: bool = False, run_log=None,
                  express_lane: bool = True,
-                 model_name: "str | None" = None):
+                 model_name: "str | None" = None,
+                 request_traces: bool = True):
         from ddt_tpu.telemetry.events import RunLog
 
         self.cfg = cfg if cfg is not None else TrainConfig()
@@ -450,11 +544,13 @@ class ServeEngine:
         # --registry` sets it; the HTTP layer resolves refs — this
         # module never does file I/O, the serve-blocking-io contract).
         self.registry_root: "str | None" = None
+        self.request_traces = bool(request_traces)
         self._swap_lock = threading.Lock()
         self._model = self._build(bundle)
         self._batcher = MicroBatcher(self._dispatch,
                                      max_wait_ms=max_wait_ms,
-                                     max_batch=max_batch)
+                                     max_batch=max_batch,
+                                     request_traces=self.request_traces)
 
     # ------------------------------------------------------------------ #
     # model lifecycle
@@ -522,7 +618,8 @@ class ServeEngine:
     # request path
     # ------------------------------------------------------------------ #
 
-    def predict_async(self, rows: np.ndarray) -> PendingRequest:
+    def predict_async(self, rows: np.ndarray,
+                      trace_id: "str | None" = None) -> PendingRequest:
         rows = coerce_rows(rows)
         if rows.shape[1] != self._model.n_features:
             raise ValueError(
@@ -536,10 +633,11 @@ class ServeEngine:
             # returns None and the request coalesces like any other
             # (tail latency never regresses; batcher.py documents the
             # fairness argument).
-            req = self._batcher.express(rows, 1)
+            req = self._batcher.express(rows, 1, trace_id=trace_id)
             if req is not None:
                 return req
-        return self._batcher.submit(rows, rows.shape[0])
+        return self._batcher.submit(rows, rows.shape[0],
+                                    trace_id=trace_id)
 
     def predict(self, rows: np.ndarray, timeout: float | None = 30.0):
         return self.predict_async(rows).result(timeout)
@@ -576,6 +674,45 @@ class ServeEngine:
         if self.run_log is not None:
             self.run_log.emit("serve_latency", **summary)
         return summary
+
+    def debug_traces(self) -> dict:
+        """model name -> completed-trace ring (GET /debug/requests).
+        Anonymous single-model servers key on "default"."""
+        return {self.model_name or "default":
+                self.stats.traces_snapshot()}
+
+    def flush_traces(self, reason: str = "on_demand") -> int:
+        """Flush the completed-trace ring into the run log as ONE
+        schema-additive `serve_trace` event (on demand via
+        GET /debug/requests?emit=1; the fleet also flushes on SLO
+        breach). Returns the number of traces flushed (0 on an empty
+        ring or a log-less engine — nothing is emitted then)."""
+        traces = self.stats.traces_snapshot()
+        if not traces or self.run_log is None:
+            return 0
+        extra = ({"model_name": self.model_name}
+                 if self.model_name is not None else {})
+        self.run_log.emit("serve_trace", traces=traces,
+                          count=len(traces),
+                          model_token=self._model.token,
+                          reason=reason, **extra)
+        return len(traces)
+
+    def metrics_snapshot(self) -> dict:
+        """Live, non-resetting state for the /metrics exposition
+        (serve/metrics.py renders it): per-model latency histograms on
+        the fixed ladder, live backlog, residency. Read-only — the
+        /metrics vs /stats?emit=1 contract."""
+        name = self.model_name or "default"
+        return {
+            "models": {name: {
+                "hist": self.stats.metrics_state(),
+                "backlog_rows": self._batcher.backlog_rows(),
+                "slo": None,
+            }},
+            "resident_models": 1,
+            "max_resident": None,
+        }
 
     def health(self) -> dict:
         m = self._model

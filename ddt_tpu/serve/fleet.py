@@ -42,6 +42,7 @@ lock held.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 
@@ -81,6 +82,117 @@ class _EvictedInFlight(RuntimeError):
     catches this, reloads, and requeues, so the CLIENT never sees it."""
 
 
+class SloBurnTracker:
+    """Rolling multi-window burn-rate tracking for ONE fleet member's
+    latency SLO (ISSUE 17). The objective is a per-request latency bound
+    (FleetSpec.slo_p99_ms) with the p99's implied 1% violation
+    allowance; the burn rate over a window is
+
+        burn = (violating requests / window requests) / ALLOWANCE
+
+    so burn 1.0 spends the error budget exactly, burn 2.0 spends it
+    twice as fast (the SRE burn-rate convention, degenerated to
+    request-count windows over the live latency stream). A breach is a
+    LATCHED transition: it fires when every window with at least
+    MIN_REQUESTS samples burns at or past BREACH_BURN — the multi-window
+    AND is what keeps one slow cold-load from paging — and re-arms only
+    after the fast window cools below 1.0, so a continuously burning
+    model is ONE `slo_breach` event, not one per batch.
+
+    Thread model: its OWN leaf lock, never held while any fleet lock is
+    taken (dispatcher closures and handler threads both call in; the
+    fleet may read `burn_rates()` while holding its Condition because
+    the nesting is always fleet-lock -> tracker-lock, never reversed).
+    Breach payloads are buffered here (`_pending`) and swept by
+    handler-thread touchpoints — the dispatcher never does file I/O."""
+
+    #: rolling windows, seconds — fast page-worthy window first.
+    WINDOWS_S = (30.0, 300.0)
+    #: the p99's violation allowance (1 - 0.99).
+    ALLOWANCE = 0.01
+    #: burn rate at/past which every qualifying window must sit to latch.
+    BREACH_BURN = 2.0
+    #: minimum requests in a window before its burn rate is trusted.
+    MIN_REQUESTS = 20
+
+    def __init__(self, objective_ms):
+        self.objective_ms = float(objective_ms)
+        self._lock = threading.Lock()
+        self._batches = collections.deque()   # (t, n, n_violating)
+        self._latched = False
+        self._pending: list = []
+        self.breaches = 0
+
+    def _prune_locked(self, now) -> None:
+        horizon = now - self.WINDOWS_S[-1]
+        while self._batches and self._batches[0][0] < horizon:
+            self._batches.popleft()
+
+    def _window_stats_locked(self, now) -> dict:
+        out = {}
+        for w in self.WINDOWS_S:
+            cutoff = now - w
+            n = bad = 0
+            for t, k, b in self._batches:
+                if t >= cutoff:
+                    n += k
+                    bad += b
+            out[w] = (n, bad)
+        return out
+
+    def _rate(self, n, bad):
+        if n < self.MIN_REQUESTS:
+            return None
+        return (bad / n) / self.ALLOWANCE
+
+    def record(self, now, latencies_ms) -> "dict | None":
+        """Fold one dispatched batch in; on the transition INTO breach,
+        buffer the event payload and return it (the caller bumps the
+        process counter — a plain int add, safe on any thread)."""
+        bad = sum(1 for v in latencies_ms if v > self.objective_ms)
+        with self._lock:
+            self._batches.append((now, len(latencies_ms), bad))
+            self._prune_locked(now)
+            stats = self._window_stats_locked(now)
+            rates = {w: self._rate(n, b) for w, (n, b) in stats.items()}
+            fast = rates[self.WINDOWS_S[0]]
+            if self._latched:
+                if fast is not None and fast < 1.0:
+                    self._latched = False
+                return None
+            if any(r is None or r < self.BREACH_BURN
+                   for r in rates.values()):
+                return None
+            self._latched = True
+            self.breaches += 1
+            n_fast = stats[self.WINDOWS_S[0]][0]
+            breach = {"burn_rate": round(fast, 3),
+                      "objective_ms": self.objective_ms,
+                      "window_s": self.WINDOWS_S[0],
+                      "requests": n_fast}
+            self._pending.append(breach)
+            return breach
+
+    def burn_rates(self, now) -> dict:
+        """{"30s": rate|None, ...} — None = not enough samples yet."""
+        with self._lock:
+            self._prune_locked(now)
+            stats = self._window_stats_locked(now)
+        return {f"{w:g}s": (None if r is None else round(r, 3))
+                for w, r in ((w, self._rate(n, b))
+                             for w, (n, b) in stats.items())}
+
+    def has_pending(self) -> bool:
+        # Unlocked truthiness read: a stale False only delays the flush
+        # to the next touchpoint, a stale True costs one empty sweep.
+        return bool(self._pending)
+
+    def take_pending(self) -> list:
+        with self._lock:
+            out, self._pending[:] = list(self._pending), []
+        return out
+
+
 class FleetSlot:
     """One fleet member: its spec, admission queue, residency state,
     and telemetry. Pure state — the engine owns every transition (all
@@ -93,6 +205,10 @@ class FleetSlot:
         self.name = spec.name
         self.weight = float(spec.weight)
         self.stats = ServeStats()
+        # SLO burn tracking only when the spec declares an objective
+        # (getattr: pre-ISSUE-17 spec objects have no slo_p99_ms field).
+        objective = getattr(spec, "slo_p99_ms", None)
+        self.slo = SloBurnTracker(objective) if objective else None
         self.model = None            # resident ServableModel | None
         self.loading = False
         self.load_error = None
@@ -123,7 +239,8 @@ class FleetEngine:
     def __init__(self, specs, loader, *, max_wait_ms: float = 1.0,
                  max_resident: "int | None" = None, run_log=None,
                  express_lane: bool = True, clock=None,
-                 on_dispatch=None, autostart: bool = True):
+                 on_dispatch=None, autostart: bool = True,
+                 request_traces: bool = True):
         from ddt_tpu.telemetry.events import RunLog
 
         if max_resident is not None and max_resident < 1:
@@ -133,6 +250,7 @@ class FleetEngine:
         self.max_wait_ms = float(max_wait_ms)
         self.max_resident = max_resident
         self.express_lane = bool(express_lane)
+        self.request_traces = bool(request_traces)
         self.run_log = RunLog.coerce(run_log)
         self._clock = clock if clock is not None else time.perf_counter
         self._on_dispatch = on_dispatch
@@ -176,7 +294,7 @@ class FleetEngine:
         slot.batcher = MicroBatcher(
             self._express_fn(slot), max_wait_ms=self.max_wait_ms,
             max_batch=spec.max_batch, clock=self._clock, cv=self._cv,
-            own_thread=False)
+            own_thread=False, request_traces=self.request_traces)
         self._slots[spec.name] = slot
         self._order.append(spec.name)
         return slot
@@ -192,7 +310,11 @@ class FleetEngine:
             model = slot.model
             if model is None:
                 raise _EvictedInFlight(slot.name)
-            dispatch_batch(model, batch, depth, slot.stats)
+            lats = dispatch_batch(model, batch, depth, slot.stats)
+            trk = slot.slo
+            if trk is not None and lats \
+                    and trk.record(self._clock(), lats) is not None:
+                tele_counters.record_slo_breach()
         return dispatch
 
     def _slot(self, name) -> FleetSlot:
@@ -301,17 +423,32 @@ class FleetEngine:
                 ("fleet_eviction", v.name, v.evictions, v.reloads))
 
     def _flush_events(self) -> None:
-        """Drain dispatcher-buffered lifecycle events into the run log
-        (handler threads: health, emit_latency, reload)."""
+        """Drain dispatcher-buffered lifecycle events AND pending SLO
+        breaches into the run log (handler threads: health,
+        emit_latency, reload, and the request path when a tracker has a
+        breach waiting)."""
         with self._cv:
             pending, self._pending_events[:] = \
                 list(self._pending_events), []
+            slots = list(self._slots.values())
+        breaches = []
+        for s in slots:
+            if s.slo is not None and s.slo.has_pending():
+                for b in s.slo.take_pending():
+                    breaches.append((s, b))
         if self.run_log is None:
             return
         for kind, name, evictions, reloads in pending:
             self.run_log.emit("fault", kind=kind, model_name=name,
                               artifact_digest=None,
                               evictions=evictions, reloads=reloads)
+        for s, b in breaches:
+            self.run_log.emit("fault", kind="slo_breach",
+                              model_name=s.name, **b)
+            # A breach drags the evidence out with it: the slot's trace
+            # ring is flushed as a `serve_trace` event so the slow tail
+            # is attributable after the fact, not just counted.
+            self.flush_traces(reason="slo_breach", only=s.name)
 
     # ------------------------------------------------------------------ #
     # request path
@@ -348,18 +485,24 @@ class FleetEngine:
             raise ModelUnavailableError(name, "evicted during lookup")
         return model.n_features
 
-    def predict_async(self, rows, model: "str | None" = None
-                      ) -> PendingRequest:
+    def predict_async(self, rows, model: "str | None" = None,
+                      trace_id: "str | None" = None) -> PendingRequest:
         name = self._resolve_name(model)
         rows = coerce_rows(rows)
         slot = self._slot(name)
+        # SLO breach sweep: the dispatcher can only BUFFER a breach
+        # (no file I/O on that thread), so the next request for the
+        # slot carries it to the log. has_pending is an unlocked
+        # truthiness read — zero cost on the un-breached hot path.
+        if slot.slo is not None and slot.slo.has_pending():
+            self._flush_events()
         # Residency + enqueue retry loop: an eviction can land between
         # the load and the enqueue (or mid-express) — each lap reloads
         # and tries again; the bound is defensive, in practice one lap.
         for _ in range(8):
             self._ensure_resident(slot)
             if self.express_lane and rows.shape[0] == 1:
-                req = slot.batcher.express(rows, 1)
+                req = slot.batcher.express(rows, 1, trace_id=trace_id)
                 if req is not None:
                     if isinstance(req.exception(), _EvictedInFlight):
                         continue          # raced an eviction: reload
@@ -382,7 +525,8 @@ class FleetEngine:
                 # the model cannot be demoted until the queue drains.
                 if slot.model is not None:
                     slot.last_used = self._next_use_locked()
-                    return slot.batcher.submit(rows, rows.shape[0])
+                    return slot.batcher.submit(rows, rows.shape[0],
+                                               trace_id=trace_id)
         raise ModelUnavailableError(
             name, "could not win the residency race (reload storm?)")
 
@@ -481,7 +625,14 @@ class FleetEngine:
 
     def _batch_fn(self, model, slot):
         def dispatch(batch, depth):
-            dispatch_batch(model, batch, depth, slot.stats)
+            lats = dispatch_batch(model, batch, depth, slot.stats)
+            trk = slot.slo
+            if trk is not None and lats \
+                    and trk.record(self._clock(), lats) is not None:
+                # Counter now (plain int add — dispatcher-safe); the
+                # run-log event waits in the tracker's pending buffer
+                # for a handler-thread sweep (serve-blocking-io).
+                tele_counters.record_slo_breach()
         return dispatch
 
     # ------------------------------------------------------------------ #
@@ -558,6 +709,11 @@ class FleetEngine:
                 raise UnknownModelError(name, self._slots)
             old = slot.model
             slot.spec = spec
+            # Retag re-derives the SLO tracker from the NEW spec: a
+            # changed objective starts a fresh burn history (old-burn
+            # vs new-objective comparisons are meaningless).
+            objective = getattr(spec, "slo_p99_ms", None)
+            slot.slo = SloBurnTracker(objective) if objective else None
             slot.model = new
             slot.ever_resident = True
             slot.last_used = self._next_use_locked()
@@ -599,6 +755,13 @@ class FleetEngine:
                        predict_impl=model.predict_impl,
                        artifact_digest=model.artifact_digest,
                        n_features=model.n_features)
+        if slot.slo is not None:
+            # Schema-additive (ISSUE 17): SLO fields appear ONLY when
+            # the spec declares an objective — a pre-SLO fleet's health
+            # payload is byte-identical to before.
+            out.update(slo_p99_ms=slot.slo.objective_ms,
+                       slo_burn_rate=slot.slo.burn_rates(self._clock()),
+                       slo_breaches=slot.slo.breaches)
         return out
 
     def health(self) -> dict:
@@ -613,6 +776,9 @@ class FleetEngine:
             "fleet": True,
             "models": models,
             "resident": resident,
+            "resident_models": resident,
+            "backlog_rows": sum(m["queued_rows"]
+                                for m in models.values()),
             "max_resident": self.max_resident,
             "express_lane": self.express_lane,
             "evictions": sum(m["evictions"] for m in models.values()),
@@ -622,6 +788,60 @@ class FleetEngine:
     def models(self) -> dict:
         """GET /models payload (the health table, without the envelope)."""
         return self.health()["models"]
+
+    def metrics_snapshot(self) -> dict:
+        """Live per-model exposition state for `GET /metrics` — strictly
+        read-only (non-resetting histograms, live backlog, SLO burn);
+        serve/metrics.py renders it to Prometheus text."""
+        now = self._clock()
+        with self._cv:
+            slots = list(self._slots.values())
+            resident = sum(1 for s in slots if s.model is not None)
+            backlog = {s.name: s.batcher.backlog_rows_locked()
+                       for s in slots}
+        models = {}
+        for s in slots:
+            slo = None
+            if s.slo is not None:
+                slo = {"objective_ms": s.slo.objective_ms,
+                       "burn_rates": s.slo.burn_rates(now),
+                       "breaches": s.slo.breaches}
+            models[s.name] = {"hist": s.stats.metrics_state(),
+                              "backlog_rows": backlog[s.name],
+                              "slo": slo}
+        return {"models": models, "resident_models": resident,
+                "max_resident": self.max_resident}
+
+    def debug_traces(self) -> dict:
+        """{model_name: [trace records]} — each slot's ring of the last
+        N completed request traces (GET /debug/requests)."""
+        with self._cv:
+            slots = list(self._slots.values())
+        return {s.name: s.stats.traces_snapshot() for s in slots}
+
+    def flush_traces(self, reason: str = "on_demand",
+                     only: "str | None" = None) -> int:
+        """Flush trace rings into the run log as `serve_trace` events
+        (one per model with traces); returns the trace count flushed.
+        Handler threads only — this is file I/O."""
+        if self.run_log is None:
+            return 0
+        with self._cv:
+            slots = [s for s in self._slots.values()
+                     if only is None or s.name == only]
+        total = 0
+        for slot in slots:
+            traces = slot.stats.traces_snapshot()
+            if not traces:
+                continue
+            model = slot.model
+            self.run_log.emit(
+                "serve_trace", traces=traces, count=len(traces),
+                model_name=slot.name,
+                model_token=model.token if model is not None else None,
+                reason=reason)
+            total += len(traces)
+        return total
 
     def window_summaries(self, reset: bool = False) -> dict:
         """{model_name: current-window latency summary} for /stats."""
@@ -655,6 +875,11 @@ class FleetEngine:
             if summary["requests"] == 0:
                 continue
             summary["model_name"] = slot.name
+            if slot.slo is not None:
+                # The window rides its objective out (schema-additive):
+                # `report slo` reads it off old logs without needing
+                # the fleet config that set it.
+                summary["slo_p99_ms"] = slot.slo.objective_ms
             model = slot.model
             if model is not None:
                 summary["model_token"] = model.token

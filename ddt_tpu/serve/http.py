@@ -35,7 +35,26 @@ Endpoints (all JSON):
 - GET  /stats     -> current-window latency summary; "?emit=1" also
                   emits it as a run-log `serve_latency` event and
                   resets the window
+- GET  /metrics   Prometheus-style text exposition (ISSUE 17):
+                  process counters, per-model cumulative latency
+                  histograms on the fixed bucket ladder, live
+                  backlog/residency gauges, SLO objective + burn rate.
+                  STRICTLY read-only — a scrape never resets a window
+                  or emits an event (that is /stats?emit=1's job).
+- GET  /debug/requests   {"models": {name: [last-N trace records]}} —
+                  the per-model ring of completed request traces;
+                  "?emit=1" also flushes the rings into the run log as
+                  `serve_trace` events.
 - POST /shutdown  -> drains and stops the server
+
+TRACE PROPAGATION (ISSUE 17): every /predict response carries
+`X-DDT-Trace-Id` (the client's request header of the same name is
+honored, else a server-minted id) and `X-DDT-Timing` — the per-request
+breakdown `handler=...,queue=...,gate=...,device=...,wake=...,
+total=...` (ms; ddt_tpu/serve/batcher.py `trace_breakdown` is the
+shape home). Disabled with `cli serve --no-request-traces`, in which
+case a client-supplied id is still echoed back (propagation without
+measurement).
 
 FLEET servers (`cli serve --models/--fleet-config`, ISSUE 15 —
 docs/SERVING.md "Fleet") add per-model routing and a control plane:
@@ -74,8 +93,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from ddt_tpu.serve.batcher import ShuttingDown
+from ddt_tpu.serve.batcher import ShuttingDown, trace_breakdown
 from ddt_tpu.serve.fleet import ModelUnavailableError, UnknownModelError
+from ddt_tpu.serve.metrics import render_metrics
+from ddt_tpu.telemetry import counters as tele_counters
 
 log = logging.getLogger("ddt_tpu.serve.http")
 
@@ -83,6 +104,23 @@ log = logging.getLogger("ddt_tpu.serve.http")
 #: path form is /models/<name>/predict — both work, binned=raw
 #: included).
 MODEL_HEADER = "X-DDT-Model"
+
+#: trace propagation headers (module doc): the id rides the request in
+#: and the response out; the timing breakdown rides the response only.
+TRACE_HEADER = "X-DDT-Trace-Id"
+TIMING_HEADER = "X-DDT-Timing"
+
+#: X-DDT-Timing segment order (the trace_breakdown keys, ms suffix
+#: stripped on the wire: handler=0.012,queue=1.403,...,total=4.791).
+_TIMING_KEYS = ("handler_ms", "queue_ms", "gate_ms", "device_ms",
+                "wake_ms", "total_ms")
+
+
+def format_timing(breakdown: "dict | None") -> "str | None":
+    """trace_breakdown dict -> the X-DDT-Timing header value."""
+    if breakdown is None:
+        return None
+    return ",".join(f"{k[:-3]}={breakdown[k]}" for k in _TIMING_KEYS)
 
 
 def _swap(engine, ref: str) -> dict:
@@ -200,10 +238,22 @@ def _make_handler(engine, server_box: dict):
         def log_message(self, fmt, *args):   # route through logging
             log.debug("%s " + fmt, self.address_string(), *args)
 
-        def _send(self, code: int, payload: dict) -> None:
+        def _send(self, code: int, payload: dict,
+                  headers: "dict | None" = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_text(self, code: int, text: str) -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -236,6 +286,19 @@ def _make_handler(engine, server_box: dict):
                 path, name = self._route_model()
                 if path == "/healthz":
                     return self._send(200, engine.health())
+                if path == "/metrics":
+                    # Read-only by contract: both snapshot calls only
+                    # READ (counters.snapshot copies, metrics_snapshot
+                    # renders live state) — no window reset, no emit.
+                    return self._send_text(200, render_metrics(
+                        tele_counters.snapshot(),
+                        engine.metrics_snapshot()))
+                if path == "/debug/requests":
+                    out = {"models": engine.debug_traces()}
+                    if "emit=1" in self.path:
+                        out["flushed"] = engine.flush_traces(
+                            reason="on_demand")
+                    return self._send(200, out)
                 if path == "/models" and fleet:
                     return self._send(200, {"models": engine.models()})
                 if path == "/stats":
@@ -319,14 +382,24 @@ def _make_handler(engine, server_box: dict):
                     # ACTUALLY scored the batch — reading engine.
                     # model_token here instead races the hot swap and
                     # mis-attributes responses that straddle it.
+                    trace_id = self.headers.get(TRACE_HEADER)
                     if fleet:
-                        pending = engine.predict_async(rows, model=name)
+                        pending = engine.predict_async(
+                            rows, model=name, trace_id=trace_id)
                     else:
-                        pending = engine.predict_async(rows)
+                        pending = engine.predict_async(
+                            rows, trace_id=trace_id)
                     scores = pending.result(30.0)
+                    headers = {}
+                    if pending.trace_id is not None:
+                        headers[TRACE_HEADER] = pending.trace_id
+                        timing = format_timing(trace_breakdown(pending))
+                        if timing is not None:
+                            headers[TIMING_HEADER] = timing
                     return self._send(200, {
                         "scores": np.asarray(scores).tolist(),
-                        "model": pending.model_token})
+                        "model": pending.model_token},
+                        headers=headers)
                 if path == "/models" and fleet:
                     return self._send(200,
                                       _models_post(engine, self._body()))

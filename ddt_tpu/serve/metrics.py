@@ -1,0 +1,158 @@
+"""Prometheus-style text exposition for `GET /metrics` (ISSUE 17).
+
+A pure renderer: `render_metrics(counters, snapshot)` turns a process
+counter dict (ddt_tpu/telemetry/counters.py `snapshot()`) and an
+engine's `metrics_snapshot()` into the text exposition format
+(version 0.0.4). STRICTLY READ-ONLY semantics — unlike `/stats?emit=1`,
+a scrape never resets a window, never emits an event, never mutates a
+counter: the histograms here are the engines' cumulative-since-boot
+series (ServeStats._hist on the fixed HIST_BUCKETS_MS ladder), so two
+scrapers and an emit loop can interleave freely and every one of them
+sees the same monotone streams.
+
+Series emitted:
+
+- ``ddt_<counter>_total``            one gauge/counter per process
+  counter (every key of telemetry.counters.snapshot(); counters are
+  cumulative since process start);
+- ``ddt_serve_latency_ms_bucket{model,le}`` / ``_sum`` / ``_count``
+  per-model cumulative histogram — per-bucket counts are converted to
+  Prometheus cumulative le-semantics here, with the trailing
+  ``le="+Inf"`` bucket equal to ``_count``;
+- ``ddt_serve_backlog_rows{model}``  live queued rows (instant gauge);
+- ``ddt_serve_resident_models`` / ``ddt_serve_max_resident_models``
+  fleet residency (max omitted when unbounded);
+- ``ddt_serve_slo_objective_ms{model}`` /
+  ``ddt_serve_slo_burn_rate{model,window}`` /
+  ``ddt_serve_slo_breaches_total{model}``  only for models with an SLO
+  configured (burn-rate windows with too few samples are omitted, not
+  rendered as 0 — a 0 burn is a claim, not an absence).
+
+No HTTP, no locks, no engine imports — http.py collects the snapshots
+(each snapshot method does its own locking) and this module only
+formats. Host-side and dependency-free by design.
+"""
+
+from __future__ import annotations
+
+
+def _esc(label: str) -> str:
+    """Escape a label value per the exposition format."""
+    return (str(label).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _num(v) -> str:
+    """Format a sample value: integers bare, floats as-is."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def render_counters(counters: dict) -> "list[str]":
+    """Process counters -> one ``ddt_<name>_total`` series each."""
+    out = []
+    for key in sorted(counters):
+        v = counters[key]
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        name = f"ddt_{key}_total"
+        out.append(f"# TYPE {name} counter")
+        out.append(f"{name} {_num(v)}")
+    return out
+
+
+def _render_hist(model: str, hist: dict) -> "list[str]":
+    """Per-bucket counts -> cumulative le-semantics bucket series."""
+    out = []
+    label = _esc(model)
+    cum = 0
+    buckets = hist.get("buckets_ms") or []
+    counts = hist.get("counts") or []
+    for i, le in enumerate(buckets):
+        cum += counts[i] if i < len(counts) else 0
+        out.append(
+            f'ddt_serve_latency_ms_bucket{{model="{label}",'
+            f'le="{_num(float(le))}"}} {cum}')
+    # The implicit overflow slot: +Inf must equal _count by contract.
+    if len(counts) > len(buckets):
+        cum += counts[len(buckets)]
+    out.append(
+        f'ddt_serve_latency_ms_bucket{{model="{label}",le="+Inf"}} {cum}')
+    out.append(f'ddt_serve_latency_ms_sum{{model="{label}"}} '
+               f'{_num(float(hist.get("sum_ms", 0.0)))}')
+    out.append(f'ddt_serve_latency_ms_count{{model="{label}"}} '
+               f'{_num(hist.get("count", 0))}')
+    return out
+
+
+def render_metrics(counters: dict, snapshot: dict) -> str:
+    """The full `/metrics` body (trailing newline included)."""
+    out = render_counters(counters)
+    models = snapshot.get("models") or {}
+    if models:
+        out.append("# TYPE ddt_serve_latency_ms histogram")
+        for name in sorted(models):
+            out.extend(_render_hist(name, models[name].get("hist") or {}))
+        out.append("# TYPE ddt_serve_backlog_rows gauge")
+        for name in sorted(models):
+            out.append(f'ddt_serve_backlog_rows{{model="{_esc(name)}"}} '
+                       f'{_num(models[name].get("backlog_rows", 0))}')
+    if snapshot.get("resident_models") is not None:
+        out.append("# TYPE ddt_serve_resident_models gauge")
+        out.append(f"ddt_serve_resident_models "
+                   f"{_num(snapshot['resident_models'])}")
+    if snapshot.get("max_resident") is not None:
+        out.append("# TYPE ddt_serve_max_resident_models gauge")
+        out.append(f"ddt_serve_max_resident_models "
+                   f"{_num(snapshot['max_resident'])}")
+    slo_models = {n: m["slo"] for n, m in sorted(models.items())
+                  if m.get("slo")}
+    if slo_models:
+        out.append("# TYPE ddt_serve_slo_objective_ms gauge")
+        for name, slo in slo_models.items():
+            out.append(
+                f'ddt_serve_slo_objective_ms{{model="{_esc(name)}"}} '
+                f'{_num(float(slo["objective_ms"]))}')
+        out.append("# TYPE ddt_serve_slo_burn_rate gauge")
+        for name, slo in slo_models.items():
+            for window, rate in sorted(
+                    (slo.get("burn_rates") or {}).items()):
+                if rate is None:
+                    continue        # not enough samples: omit, don't lie
+                out.append(
+                    f'ddt_serve_slo_burn_rate{{model="{_esc(name)}",'
+                    f'window="{_esc(window)}"}} {_num(float(rate))}')
+        out.append("# TYPE ddt_serve_slo_breaches_total counter")
+        for name, slo in slo_models.items():
+            out.append(
+                f'ddt_serve_slo_breaches_total{{model="{_esc(name)}"}} '
+                f'{_num(slo.get("breaches", 0))}')
+    return "\n".join(out) + "\n"
+
+
+def parse_exposition(text: str) -> dict:
+    """Inverse of render_metrics for tests and the smoke harness:
+    {series_name: {frozenset(label items) or (): value}}. Tolerates
+    comments and blank lines; not a general openmetrics parser."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value = line.rpartition(" ")
+        if "{" in name_part:
+            name, _, rest = name_part.partition("{")
+            labels = {}
+            for item in rest.rstrip("}").split(","):
+                if not item:
+                    continue
+                k, _, v = item.partition("=")
+                labels[k] = v.strip('"')
+            key = frozenset(labels.items())
+        else:
+            name, key = name_part, ()
+        out.setdefault(name, {})[key] = float(value)
+    return out

@@ -91,6 +91,14 @@ _c = {
     # fault(kind=fleet_eviction/fleet_reload) events, not here.
     "fleet_evictions": 0,
     "fleet_reloads": 0,
+    # SLO burn-rate breach transitions (serve/fleet.py, ISSUE 17): the
+    # number of times a model's rolling burn rate crossed INTO breach
+    # (latched — a model burning continuously counts once until it
+    # recovers below a 1.0 burn and breaches again). Each transition
+    # also emits a fault(kind=slo_breach) event with the model, burn
+    # rate, and objective; this counter is the process-lifetime total
+    # the /metrics exposition and report diff read.
+    "slo_breaches": 0,
     # EFFECTIVE per-round g/h HBM stream bytes (grad_stream_bytes below;
     # recorded by the Driver and the streaming trainers every round) —
     # the quantized-gradient win's in-process witness: an f32 run and an
@@ -195,6 +203,10 @@ def record_fleet_eviction() -> None:
 
 def record_fleet_reload() -> None:
     _c["fleet_reloads"] += 1
+
+
+def record_slo_breach() -> None:
+    _c["slo_breaches"] += 1
 
 
 def record_grad_stream(nbytes: int) -> None:

@@ -52,6 +52,10 @@ COUNTER_DIRECTIONS: dict[str, str] = {
     # shows retries or OOM degradations appearing is a regression.
     "fault_retries": "lower",
     "hist_oom_degrades": "lower",
+    # SLO breach transitions (serve/fleet.py, ISSUE 17): a serving A/B
+    # whose B run starts burning its latency budget is a regression no
+    # matter what the request mix looked like.
+    "slo_breaches": "lower",
     # Workload-shape counters: request mix and fleet churn track what
     # was ASKED of the system, not how well it did — deliberately
     # "neutral" so a bigger replay never reads as a regression.

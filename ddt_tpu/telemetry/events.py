@@ -46,6 +46,15 @@ import time
 # kinds fleet_eviction / fleet_reload / fleet_remove (model_name +
 # artifact_digest + running eviction/reload counts as extras), and the
 # fleet_evictions / fleet_reloads process counters.
+# ISSUE 17 extras (schema-ADDITIVE, no version bump — the serve-side
+# operations plane): the `serve_trace` event (a flushed per-model ring
+# of per-request timing breakdowns — trace id, accept→admit, queue/
+# window wait, gate hold, device call, wake; flushed on demand via
+# `GET /debug/requests?emit=1` or automatically on SLO breach), the
+# `slo_breach` fault kind (burn_rate + objective_ms + window_s extras),
+# the `slo_p99_ms` objective extra on serve_latency windows, and the
+# slo_breaches process counter. Pre-SLO logs remain readable and render
+# exactly as before (tests/test_fleet.py pins the mixed-era report).
 SCHEMA_VERSION = 5
 
 #: event type -> REQUIRED payload fields (extras are allowed and common:
@@ -84,7 +93,9 @@ EVENT_FIELDS: dict[str, set] = {
     # (robustness/watchdog.py via the trainers); hot_swap
     # (serve/engine.py + fleet retag, with old/new tokens and the
     # ISSUE 15 model_name extra); fleet_eviction / fleet_reload /
-    # fleet_remove (serve/fleet.py, with model_name + artifact_digest).
+    # fleet_remove (serve/fleet.py, with model_name + artifact_digest);
+    # slo_breach (serve/fleet.py burn-rate tracker, with model_name +
+    # burn_rate + objective_ms + window_s + requests).
     "fault": {"kind"},
     # Device-counter deltas over the run (telemetry.counters).
     "counters": {"jit_compiles", "h2d_bytes", "d2h_bytes",
@@ -117,6 +128,15 @@ EVENT_FIELDS: dict[str, set] = {
     # single-model logs). Consumed by `report`'s serving section and
     # banded (via the bench stamps) by benchwatch.
     "serve_latency": {"requests", "p50_ms", "p99_ms"},
+    # Serve-side request traces (ISSUE 17, schema-additive): one flushed
+    # per-model ring of completed per-request timing breakdowns —
+    # `traces` is [{trace_id, rows, express, handler_ms, queue_ms,
+    # gate_ms, device_ms, wake_ms, total_ms}] (serve/batcher.py
+    # trace_breakdown is the one shape home). Flushed on demand
+    # (GET /debug/requests?emit=1) or on SLO breach, with the model
+    # dimension and the flush reason as extras. Absent from pre-trace
+    # logs; report ignores unknown-to-it events by construction.
+    "serve_trace": {"traces"},
     # Last record of a completed run.
     "run_end": {"completed_rounds", "wallclock_s"},
 }
@@ -158,6 +178,7 @@ EVENT_EXTRAS: dict[str, tuple] = {
         "model_name", "artifact_digest", "evictions",         # fleet
         "reloads", "failed_requests",
         "candidate", "reason",                                # checkpoints
+        "burn_rate", "objective_ms", "window_s", "requests",  # slo_breach
     ),
     # Everything counters.delta() / the finish_run_log epilogue may
     # publish beyond the required four — kept in sync with the `_c`
@@ -167,6 +188,7 @@ EVENT_EXTRAS: dict[str, tuple] = {
         "fault_retries", "hist_oom_degrades",
         "serve_requests", "serve_batches", "serve_hot_swaps",
         "serve_express", "fleet_evictions", "fleet_reloads",
+        "slo_breaches",
         "grad_stream_bytes_est", "grad_quant_rounds",
         "device_peak_bytes", "host_peak_rss_bytes",
     ),
@@ -177,7 +199,8 @@ EVENT_EXTRAS: dict[str, tuple] = {
     "serve_latency": ("batches", "window_s", "p999_ms", "max_ms",
                       "coalesce_mean", "coalesce_max", "queue_depth_max",
                       "express", "model_token", "model_name",
-                      "predict_impl", "artifact_digest"),
+                      "predict_impl", "artifact_digest", "slo_p99_ms"),
+    "serve_trace": ("model_name", "model_token", "reason", "count"),
     "run_end": (),
 }
 
@@ -192,6 +215,7 @@ FAULT_KINDS = (
     "injected", "hist_oom_degrade",
     "straggler_detected", "repartition",
     "hot_swap", "fleet_eviction", "fleet_reload", "fleet_remove",
+    "slo_breach",
 )
 
 ENVELOPE_FIELDS = ("event", "schema", "t", "seq")

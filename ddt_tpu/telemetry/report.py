@@ -204,6 +204,14 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
             [e for e in events if e["event"] == "serve_latency"],
             [e for e in events if e["event"] == "fault"],
             [e for e in events if e["event"] == "artifact"]),
+        # SLO rollup (ISSUE 17): per-model join of declared objectives
+        # (the slo_p99_ms extra serve_latency windows carry) against
+        # observed tails and slo_breach faults — None unless the log
+        # carries EITHER signal, so pre-SLO logs render exactly as
+        # before. `cli report --log L slo` renders just this table.
+        "slo": _slo_summary(
+            [e for e in events if e["event"] == "serve_latency"],
+            [e for e in events if e["event"] == "fault"]),
         # Registry provenance (schema v5): artifact push/load events,
         # each cross-referenced against THIS run's id when they carry
         # one — None on pre-v5 logs.
@@ -327,6 +335,57 @@ def _fleet_summary(serve_ev: list[dict], fault_ev: list[dict],
     }
 
 
+def _slo_summary(serve_ev: list[dict],
+                 fault_ev: list[dict]) -> dict | None:
+    """Per-model SLO rollup (ISSUE 17): join declared objectives (the
+    slo_p99_ms extra on serve_latency windows) against the observed
+    tail and the run's slo_breach faults (burn rate at the transition).
+    Mixed-era logs degrade gracefully by construction: pre-SLO windows
+    simply carry no objective (rendered `-`, never an error), and a
+    model that breached before ever emitting a window enters the table
+    through its faults alone — objective recovered from the breach
+    event's own objective_ms, quantiles honestly absent. None when the
+    log carries neither signal, so pre-SLO logs summarize exactly as
+    before."""
+    breaches = [f for f in fault_ev if f.get("kind") == "slo_breach"]
+    objective_windows = [e for e in serve_ev if e.get("slo_p99_ms")]
+    if not breaches and not objective_windows:
+        return None
+    models: dict = {}
+
+    def rec(name) -> dict:
+        return models.setdefault(name, {
+            "objective_ms": None, "windows": 0, "requests": 0,
+            "p99_ms": None, "worst_p99_ms": None,
+            "breaches": 0, "max_burn_rate": None,
+        })
+
+    for e in serve_ev:
+        name = e.get("model_name") or "default"
+        # Only SLO-era windows open a row; older windows still fold
+        # into an existing row's tail so the worst p99 is honest.
+        if not e.get("slo_p99_ms") and name not in models:
+            continue
+        m = rec(name)
+        m["objective_ms"] = e.get("slo_p99_ms") or m["objective_ms"]
+        m["windows"] += 1
+        m["requests"] += e["requests"]
+        m["p99_ms"] = e["p99_ms"]            # last window's tail
+        m["worst_p99_ms"] = max(m["worst_p99_ms"] or 0.0, e["p99_ms"])
+    for f in breaches:
+        m = rec(f.get("model_name") or "default")
+        m["breaches"] += 1
+        if m["objective_ms"] is None:
+            m["objective_ms"] = f.get("objective_ms")
+        burn = f.get("burn_rate")
+        if burn is not None:
+            m["max_burn_rate"] = max(m["max_burn_rate"] or 0.0, burn)
+    return {
+        "models": dict(sorted(models.items())),
+        "breaches": len(breaches),
+    }
+
+
 def _registry_summary(artifact_ev: list[dict],
                       log_run_id) -> dict | None:
     """Reduce a run's artifact events for the report: one record per
@@ -404,6 +463,39 @@ def render_fleet(summary: dict) -> str:
             f"{ms(m['worst_p99_ms']):>9} "
             f"{(m['tier'] or '-'):<5} {m['evictions']:>4} "
             f"{m['reloads']:>4}  {art}")
+    return "\n".join(out)
+
+
+def render_slo(summary: dict) -> str:
+    """The `report slo` rollup: one row per model joining its declared
+    p99 objective against the observed tail and the run's slo_breach
+    burn rates (docs/OBSERVABILITY.md). Absent values — a pre-SLO
+    window's objective, a breached-before-first-window model's
+    quantiles — render `-`, never an error. Raises ValueError when the
+    log carries no SLO signal at all (no objectives, no breaches)."""
+    slo = summary.get("slo")
+    if not slo:
+        raise ValueError(
+            "log carries no SLO data (no slo_p99_ms objectives on "
+            "serve_latency windows and no slo_breach faults) — was "
+            "this server configured with an SLO (slo_p99_ms=)?")
+
+    def ms(v) -> str:
+        return f"{v:>9.3f}" if v is not None else f"{'-':>9}"
+
+    out = [f"slo: {len(slo['models'])} model(s), "
+           f"{slo['breaches']} breach(es)"]
+    out.append(
+        f"  {'model':<12} {'objective':>9} {'p99_ms':>9} "
+        f"{'worst_p99':>9} {'win':>4} {'reqs':>7} {'breach':>6} "
+        f"{'max_burn':>8}")
+    for name, m in slo["models"].items():
+        burn = (f"{m['max_burn_rate']:>8.2f}"
+                if m.get("max_burn_rate") is not None else f"{'-':>8}")
+        out.append(
+            f"  {name:<12} {ms(m['objective_ms'])} {ms(m['p99_ms'])} "
+            f"{ms(m['worst_p99_ms'])} {m['windows']:>4} "
+            f"{m['requests']:>7} {m['breaches']:>6} {burn}")
     return "\n".join(out)
 
 
@@ -508,6 +600,9 @@ def render(summary: dict) -> str:
 
     if summary.get("fleet"):
         out.append(render_fleet(summary))
+
+    if summary.get("slo"):
+        out.append(render_slo(summary))
 
     if summary.get("registry"):
         r = summary["registry"]

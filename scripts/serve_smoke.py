@@ -35,7 +35,17 @@ One process, five assertions:
    compiles, the run log's per-model serve_latency windows render
    through `report fleet`, and a saturated single-model A/B holds the
    fleet p99 within 1.5x of the plain single-engine baseline on the
-   same run.
+   same run;
+8. (ISSUE 17 metrics arm) the live operations plane under load: a
+   MID-STORM `GET /metrics` scrape whose every process-counter series
+   sits between counter snapshots taken immediately before and after
+   the scrape (counter-for-counter, race-safe bounds — the read-only
+   exposition never lags or invents a counter), one STORMED request
+   pinning a client `X-DDT-Trace-Id` that round-trips through the
+   response headers (with a full five-stage timing breakdown) and the
+   `/debug/requests` ring, and the tracing-overhead A/B: saturated
+   p99 with request traces ON (the default) within 1.1x of
+   `--no-request-traces` (min-of-3 measured windows per side).
 
 Exit 0 = all hold.
 """
@@ -465,6 +475,112 @@ def main() -> int:
     assert p99_fleet <= 1.5 * max(p99_single, 1.0), (
         f"fleet saturated p99 {p99_fleet:.2f} ms vs single-engine "
         f"{p99_single:.2f} ms (> 1.5x)")
+
+    # --- ISSUE 17 metrics arm: mid-storm /metrics scrape, trace id
+    # round-trip on a stormed request, tracing-overhead A/B.
+    from ddt_tpu.serve.metrics import parse_exposition
+
+    engine_m = ServeEngine(bundle_ab, cfg, max_wait_ms=2.0,
+                           max_batch=64)
+    ready_m = threading.Event()
+    th_m = threading.Thread(
+        target=serve_forever, args=(engine_m,),
+        kwargs=dict(port=0, ready_event=ready_m), daemon=True)
+    th_m.start()
+    assert ready_m.wait(60), "metrics-arm server never came up"
+    pm = engine_m.http_port
+
+    pinned = {}
+    errs_m = []
+
+    def metrics_worker(i):
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{pm}/predict",
+                data=json.dumps({"rows": [X[i % 64].tolist()]}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            if i == 37:      # ONE stormed request pins the trace id
+                req.add_header("X-DDT-Trace-Id", "smoke-pin-37")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                json.loads(r.read())
+                if i == 37:
+                    pinned["id"] = r.headers["X-DDT-Trace-Id"]
+                    pinned["timing"] = r.headers["X-DDT-Timing"]
+        except Exception as e:       # noqa: BLE001 — smoke verdict
+            errs_m.append((i, repr(e)))
+
+    with concurrent.futures.ThreadPoolExecutor(16) as pool:
+        futs = [pool.submit(metrics_worker, i) for i in range(96)]
+        # MID-STORM scrape. Race-safe counter-for-counter bound: the
+        # scrape happened between two snapshots of the same process
+        # counters, so every numeric counter's scraped value must sit
+        # inside [before, after].
+        c_before = tele_counters.snapshot()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{pm}/metrics", timeout=60) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            scraped = r.read().decode()
+        c_after = tele_counters.snapshot()
+        for f in futs:
+            f.result(60)
+    assert not errs_m, f"metrics-arm storm failures: {errs_m[:5]}"
+
+    series = parse_exposition(scraped)
+    checked = 0
+    for key, lo in c_before.items():
+        if isinstance(lo, bool) or not isinstance(lo, (int, float)):
+            continue
+        got = series[f"ddt_{key}_total"][()]
+        hi = c_after[key]
+        assert lo <= got <= hi, (
+            f"/metrics counter ddt_{key}_total={got} outside the "
+            f"mid-storm snapshot bounds [{lo}, {hi}]")
+        checked += 1
+    assert checked >= 10, f"only {checked} counters exposed"
+    out["metrics_counters_checked"] = checked
+
+    # The pinned trace id round-trips with a full timing breakdown...
+    assert pinned.get("id") == "smoke-pin-37", pinned
+    stages = {p.split("=")[0] for p in pinned["timing"].split(",")}
+    assert stages == {"handler", "queue", "gate", "device", "wake",
+                      "total"}, pinned
+    # ...and is attributable in the debug ring.
+    dbg = _get(pm, "/debug/requests")
+    assert any(t["trace_id"] == "smoke-pin-37"
+               for t in dbg["models"]["default"]), (
+        "pinned trace id missing from /debug/requests ring")
+    out["trace_round_trip"] = pinned["timing"]
+    _post(pm, "/shutdown", {})
+    th_m.join(30)
+
+    # Tracing-overhead A/B: request traces on (default) vs off
+    # (`serve --no-request-traces`), saturated p99. Rounds INTERLEAVE
+    # between the two engines and each side keeps its min-of-3, so
+    # CPU-box scheduler drift hits both sides equally instead of
+    # penalising whichever happened to measure first.
+    traced = ServeEngine(bundle_ab, cfg, max_wait_ms=2.0, max_batch=64)
+    untraced = ServeEngine(bundle_ab, cfg, max_wait_ms=2.0,
+                           max_batch=64, request_traces=False)
+    sides = (("traced", traced), ("untraced", untraced))
+    for _, eng in sides:                         # warm both sides
+        _saturate(lambda rows: eng.predict(rows, timeout=60.0))
+        eng.stats.window_summary(reset=True)
+    best = {}
+    for _ in range(3):
+        for name, eng in sides:
+            _saturate(lambda rows: eng.predict(rows, timeout=60.0))
+            p = eng.stats.window_summary(reset=True)["p99_ms"]
+            best[name] = min(p, best.get(name, p))
+    traced.close()
+    untraced.close()
+    p99_traced, p99_untraced = best["traced"], best["untraced"]
+    out["p99_traced_ms"] = p99_traced
+    out["p99_untraced_ms"] = p99_untraced
+    assert p99_traced <= 1.1 * max(p99_untraced, 1.0), (
+        f"request tracing costs too much at saturation: p99 "
+        f"{p99_traced:.2f} ms traced vs {p99_untraced:.2f} ms with "
+        f"--no-request-traces (> 1.1x)")
 
     out["ok"] = True
     print(json.dumps(out))

@@ -30,7 +30,8 @@ from ddt_tpu.serve.control import (FleetConfigError, FleetSpec,
                                    resolve_specs, validate_specs)
 from ddt_tpu.serve.engine import ServeEngine
 from ddt_tpu.serve.fleet import (FleetEngine, ModelUnavailableError,
-                                 UnknownModelError)
+                                 SloBurnTracker, UnknownModelError)
+from ddt_tpu.serve.metrics import parse_exposition, render_metrics
 from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry import report as tele_report
 from ddt_tpu.telemetry.events import RunLog, validate_event
@@ -955,6 +956,7 @@ def test_thread_model_clean_on_fleet_tier():
     for rel in ("ddt_tpu/serve/__init__.py", "ddt_tpu/serve/batcher.py",
                 "ddt_tpu/serve/engine.py", "ddt_tpu/serve/fleet.py",
                 "ddt_tpu/serve/control.py", "ddt_tpu/serve/http.py",
+                "ddt_tpu/serve/metrics.py",
                 "ddt_tpu/robustness/watchdog.py"):
         with open(os.path.join(repo, rel), encoding="utf-8") as f:
             sources[rel] = f.read()
@@ -967,3 +969,198 @@ def test_thread_model_clean_on_fleet_tier():
     assert disp.roles == {"dispatcher", "handler"}
     # the fleet's cross-role state is Condition-guarded
     assert ("FleetEngine", "_closed") in m.guarded
+
+
+# --------------------------------------------------------------------- #
+# ISSUE 17: SLO objectives, burn-rate breaches, traces, /metrics
+# --------------------------------------------------------------------- #
+def test_slo_spec_grammar_and_loud_rejection():
+    """slo_p99_ms rides every config surface (--models grammar, JSON
+    entries) and junk is a boot-time refusal, never a silently ignored
+    objective."""
+    specs = parse_models_arg("a@prod:slo_p99_ms=5.0,b@canary")
+    assert specs[0].slo_p99_ms == 5.0
+    assert specs[1].slo_p99_ms is None          # opt-in, never implied
+    assert coerce_spec({"ref": "m@1", "slo_p99_ms": "2.5"},
+                       "t").slo_p99_ms == 2.5
+    with pytest.raises(FleetConfigError, match="positive number"):
+        parse_models_arg("a@prod:slo_p99_ms=fast")
+    with pytest.raises(FleetConfigError, match="must be > 0"):
+        parse_models_arg("a@prod:slo_p99_ms=-1")
+    with pytest.raises(FleetConfigError, match="must be > 0"):
+        FleetSpec(name="a", ref="a@1", slo_p99_ms=0.0)
+
+
+def test_slo_burn_tracker_latching_and_rearm():
+    """The tracker unit-tested on a fake clock: burn rates need
+    MIN_REQUESTS before they are trusted, a breach is a LATCHED
+    transition (continuous burning is ONE event), and the latch re-arms
+    only after the fast window cools below burn 1.0."""
+    trk = SloBurnTracker(10.0)
+    # under MIN_REQUESTS: every window abstains, nothing fires
+    assert trk.record(0.0, [20.0] * 5) is None
+    assert trk.burn_rates(0.0) == {"30s": None, "300s": None}
+    assert not trk.has_pending()
+    # the 20th all-violating sample: both windows qualify at burn 100
+    b = trk.record(1.0, [20.0] * 15)
+    assert b is not None and trk.breaches == 1
+    assert b == {"burn_rate": 100.0, "objective_ms": 10.0,
+                 "window_s": 30.0, "requests": 20}
+    assert trk.has_pending()
+    # latched: continued burning is the SAME breach, not a new page
+    assert trk.record(2.0, [20.0] * 10) is None
+    assert trk.breaches == 1
+    # the bad batches age out of the 30s window; clean traffic cools
+    # the fast burn to 0 -> the latch re-arms
+    assert trk.record(40.0, [1.0] * 50) is None
+    assert trk.burn_rates(40.0)["30s"] == 0.0
+    # a second storm is a NEW breach
+    assert trk.record(41.0, [20.0] * 50) is not None
+    assert trk.breaches == 2
+    pending = trk.take_pending()
+    assert len(pending) == 2 and not trk.has_pending()
+
+
+def test_fleet_slo_breach_counter_fault_and_trace_flush(trained):
+    """Live end-to-end breach: a member with an impossible objective
+    latches exactly ONE breach under sustained violation — the process
+    counter bumps, the slo_breach fault validates with its burn-rate
+    payload, the breach drags the trace ring out as a serve_trace
+    event, and every surface (healthz, metrics snapshot, exposition)
+    tells the same story. The un-SLO'd member stays schema-clean."""
+    log = RunLog()
+    c0 = tele_counters.snapshot()
+    eng = _fleet(trained, ("a", "b"),
+                 overrides={"a": {"slo_p99_ms": 0.0001}}, run_log=log)
+    try:
+        X = trained["X"]
+        for i in range(SloBurnTracker.MIN_REQUESTS + 5):
+            eng.predict(X[i:i + 1], model="a", timeout=60.0)
+        h = eng.health()                  # handler touchpoint sweeps
+        assert tele_counters.delta(c0)["slo_breaches"] == 1
+        ha = h["models"]["a"]
+        assert ha["slo_p99_ms"] == 0.0001
+        assert ha["slo_breaches"] == 1
+        assert ha["slo_burn_rate"]["30s"] >= SloBurnTracker.BREACH_BURN
+        assert not any(k.startswith("slo") for k in h["models"]["b"])
+        faults = [e for e in log.events("fault")
+                  if e.get("kind") == "slo_breach"]
+        assert len(faults) == 1, "latched breach must be ONE event"
+        f = faults[0]
+        assert f["model_name"] == "a" and f["objective_ms"] == 0.0001
+        assert f["burn_rate"] >= SloBurnTracker.BREACH_BURN
+        assert f["requests"] >= SloBurnTracker.MIN_REQUESTS
+        assert f["window_s"] == SloBurnTracker.WINDOWS_S[0]
+        validate_event(dict(f))
+        flushed = [e for e in log.events("serve_trace")
+                   if e.get("reason") == "slo_breach"]
+        assert flushed and flushed[-1]["model_name"] == "a"
+        assert flushed[-1]["count"] == len(flushed[-1]["traces"]) >= 1
+        validate_event(dict(flushed[-1]))
+        snap = eng.metrics_snapshot()
+        assert snap["models"]["a"]["slo"]["breaches"] == 1
+        assert snap["models"]["b"]["slo"] is None
+        series = parse_exposition(
+            render_metrics(tele_counters.snapshot(), snap))
+        ka = frozenset({("model", "a")})
+        assert series["ddt_serve_slo_breaches_total"][ka] == 1.0
+        assert series["ddt_serve_slo_objective_ms"][ka] == 0.0001
+        kw = frozenset({("model", "a"), ("window", "30s")})
+        assert series["ddt_serve_slo_burn_rate"][kw] >= 2.0
+        assert frozenset({("model", "b")}) not in \
+            series["ddt_serve_slo_breaches_total"]
+    finally:
+        eng.close()
+
+
+def test_fleet_healthz_backlog_and_resident_fields(trained):
+    """The ISSUE 17 /healthz additions on a fleet WITHOUT SLOs:
+    backlog_rows + resident_models appear, slo_* keys do not —
+    schema-additive in both directions."""
+    eng = _fleet(trained, ("a", "b"))
+    try:
+        eng.predict(trained["X"][:2], model="a", timeout=60.0)
+        h = eng.health()
+        assert h["resident_models"] == h["resident"] == 2
+        assert h["backlog_rows"] == 0         # idle: queues drained
+        for m in h["models"].values():
+            assert not any(k.startswith("slo") for k in m)
+    finally:
+        eng.close()
+
+
+def test_report_slo_mixed_era_and_fault_only_models():
+    """`report slo` over a mixed pre-SLO / SLO-era log: pre-SLO models
+    never enter the table, absent objectives and quantiles render `-`,
+    and a model that breached before ever emitting a window enters
+    through its fault alone."""
+    base = {"event": "serve_latency", "schema": 5, "t": 1.0, "seq": 1,
+            "requests": 50, "p50_ms": 1.0, "p99_ms": 4.0}
+    events = [
+        dict(base, model_name="old"),                     # pre-SLO era
+        dict(base, seq=2, model_name="new", slo_p99_ms=5.0),
+        dict(base, seq=3, model_name="new", p99_ms=9.0),  # older window
+        {"event": "fault", "schema": 5, "t": 2.0, "seq": 4,
+         "kind": "slo_breach", "model_name": "ghost",
+         "burn_rate": 3.25, "objective_ms": 2.0, "window_s": 30.0,
+         "requests": 40},
+    ]
+    summary = tele_report.summarize(events)
+    slo = summary["slo"]
+    assert set(slo["models"]) == {"new", "ghost"}
+    assert slo["breaches"] == 1
+    g = slo["models"]["ghost"]
+    assert g["objective_ms"] == 2.0 and g["p99_ms"] is None
+    assert g["breaches"] == 1 and g["max_burn_rate"] == 3.25
+    n = slo["models"]["new"]
+    assert n["objective_ms"] == 5.0 and n["windows"] == 2
+    assert n["worst_p99_ms"] == 9.0 and n["breaches"] == 0
+    rendered = tele_report.render_slo(summary)
+    assert "slo: 2 model(s), 1 breach(es)" in rendered
+    ghost_row = next(ln for ln in rendered.splitlines() if "ghost" in ln)
+    assert "-" in ghost_row        # absent quantiles render, not crash
+    assert "slo:" in tele_report.render(summary)
+    # a purely pre-SLO log summarizes with NO slo section and the
+    # dedicated renderer refuses loudly rather than printing zeros
+    pre = tele_report.summarize([dict(base, model_name="old")])
+    assert pre["slo"] is None
+    with pytest.raises(ValueError, match="no SLO data"):
+        tele_report.render_slo(pre)
+    assert "slo:" not in tele_report.render(pre)
+
+
+def test_http_fleet_trace_metrics_and_healthz(served_fleet, trained):
+    """The live-socket sweep of the ISSUE 17 surfaces on a fleet:
+    client trace ids round-trip with a timing breakdown header,
+    /metrics exposes the per-model histogram + residency gauges, the
+    debug ring holds the pinned id, and /healthz carries the fleet-wide
+    backlog/residency rollup."""
+    eng, port = served_fleet
+    X = trained["X"]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/models/a/predict",
+        data=json.dumps({"rows": X[:1].tolist()}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-DDT-Trace-Id": "fleet-pin-42"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        json.loads(r.read())
+        assert r.headers["X-DDT-Trace-Id"] == "fleet-pin-42"
+        timing = r.headers["X-DDT-Timing"]
+    parts = dict(p.split("=") for p in timing.split(","))
+    assert set(parts) == {"handler", "queue", "gate", "device", "wake",
+                          "total"}
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    series = parse_exposition(text)
+    ka = frozenset({("model", "a")})
+    assert series["ddt_serve_latency_ms_count"][ka] >= 1
+    assert series["ddt_serve_resident_models"][()] == 2
+    assert series["ddt_serve_backlog_rows"][ka] == 0
+    dbg = _get(port, "/debug/requests")
+    assert any(t["trace_id"] == "fleet-pin-42"
+               for t in dbg["models"]["a"])
+    h = _get(port, "/healthz")
+    assert h["resident_models"] == 2 and h["backlog_rows"] == 0

@@ -613,6 +613,221 @@ def test_binned_raw_wire_parity_with_float_body(trained):
         th.join(30)
 
 
+# --------------------------------------------------------------------- #
+# per-request trace propagation + /metrics exposition (ISSUE 17)
+# --------------------------------------------------------------------- #
+def test_trace_breakdown_on_express_and_coalesced_lanes(trained):
+    """Every completed request carries a trace id and a full timing
+    breakdown (handler/queue/gate/device/wake summing into total), on
+    BOTH lanes: the express lane's handler segment is structurally zero
+    (accept and admit are the same stamp), the coalesced lane's queue
+    segment covers the admission window. The ring at debug_traces()
+    holds the records."""
+    from ddt_tpu.serve.batcher import trace_breakdown
+
+    eng = _engine(trained, max_wait_ms=2.0)
+    try:
+        X = trained["X"]
+        p_express = eng.predict_async(X[:1])
+        p_express.result(timeout=30.0)
+        assert p_express.trace_id is not None
+        bd = trace_breakdown(p_express)
+        assert bd is not None
+        assert set(bd) == {"handler_ms", "queue_ms", "gate_ms",
+                           "device_ms", "wake_ms", "total_ms"}
+        assert bd["handler_ms"] == 0.0        # express: accept == admit
+        assert bd["device_ms"] > 0.0
+        assert bd["total_ms"] >= bd["device_ms"]
+
+        p_batch = eng.predict_async(X[:3])    # multi-row: queued lane
+        p_batch.result(timeout=30.0)
+        bd2 = trace_breakdown(p_batch)
+        assert bd2 is not None and bd2["total_ms"] > 0.0
+        ring = eng.debug_traces()
+        assert set(ring) == {"default"}
+        ids = [t["trace_id"] for t in ring["default"]]
+        assert p_express.trace_id in ids and p_batch.trace_id in ids
+        rec = next(t for t in ring["default"]
+                   if t["trace_id"] == p_express.trace_id)
+        assert rec["express"] is True and rec["rows"] == 1
+        assert rec["device_ms"] == bd["device_ms"]
+    finally:
+        eng.close()
+
+
+def test_trace_id_propagation_and_opt_out(trained):
+    """A client-supplied trace id is honored verbatim; with
+    request_traces=False no breakdown is measured (marks stay None) but
+    a supplied id still rides through — propagation without
+    measurement — and nothing lands in the ring."""
+    from ddt_tpu.serve.batcher import trace_breakdown
+
+    eng = _engine(trained, max_wait_ms=2.0)
+    try:
+        p = eng.predict_async(trained["X"][:1], trace_id="client-abc-1")
+        p.result(timeout=30.0)
+        assert p.trace_id == "client-abc-1"
+        assert trace_breakdown(p) is not None
+    finally:
+        eng.close()
+    eng2 = _engine(trained, max_wait_ms=2.0, request_traces=False)
+    try:
+        p = eng2.predict_async(trained["X"][:1], trace_id="client-abc-2")
+        p.result(timeout=30.0)
+        assert p.trace_id == "client-abc-2"   # echoed, not measured
+        assert trace_breakdown(p) is None
+        q = eng2.predict_async(trained["X"][:1])
+        q.result(timeout=30.0)
+        assert q.trace_id is None             # no server-minted ids
+        assert eng2.debug_traces() == {"default": []}
+    finally:
+        eng2.close()
+
+
+def test_serve_trace_flush_emits_validating_event(trained):
+    """flush_traces() lands the ring as ONE schema-valid serve_trace
+    event (reason stamped); an empty ring emits nothing."""
+    rl = RunLog()
+    eng = _engine(trained, max_wait_ms=2.0, run_log=rl)
+    try:
+        assert eng.flush_traces() == 0        # nothing served yet
+        for i in range(3):
+            eng.predict(trained["X"][i:i + 1], timeout=30.0)
+        n = eng.flush_traces(reason="on_demand")
+        assert n == 3
+        evs = rl.events("serve_trace")
+        assert len(evs) == 1
+        validate_event(evs[0])
+        assert evs[0]["count"] == 3 and evs[0]["reason"] == "on_demand"
+        assert len(evs[0]["traces"]) == 3
+        assert all(t["total_ms"] >= 0 for t in evs[0]["traces"])
+    finally:
+        eng.close()
+
+
+def test_metrics_exposition_renders_and_parses(trained):
+    """The /metrics body: every process counter becomes a
+    ddt_*_total series, the per-model histogram is CUMULATIVE with
+    le="+Inf" equal to _count, and _count equals the requests served."""
+    from ddt_tpu.serve.metrics import parse_exposition, render_metrics
+    from ddt_tpu.telemetry import counters as tele_counters
+
+    eng = _engine(trained, max_wait_ms=2.0)
+    try:
+        for i in range(5):
+            eng.predict(trained["X"][i:i + 1], timeout=30.0)
+        text = render_metrics(tele_counters.snapshot(),
+                              eng.metrics_snapshot())
+        series = parse_exposition(text)
+        for key, v in tele_counters.snapshot().items():
+            name = f"ddt_{key}_total"
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                assert series[name][()] == float(v), name
+        lab = lambda **kw: frozenset(kw.items())  # noqa: E731
+        count = series["ddt_serve_latency_ms_count"][lab(model="default")]
+        assert count == 5.0
+        buckets = series["ddt_serve_latency_ms_bucket"]
+        inf = buckets[lab(model="default", le="+Inf")]
+        assert inf == count                   # +Inf == _count by contract
+        finite = sorted(
+            ((float(dict(k)["le"]), v) for k, v in buckets.items()
+             if dict(k)["le"] != "+Inf"))
+        vals = [v for _, v in finite]
+        assert vals == sorted(vals)           # cumulative: monotone
+        assert series["ddt_serve_backlog_rows"][lab(model="default")] == 0.0
+        assert series["ddt_serve_resident_models"][()] == 1.0
+        assert "ddt_serve_slo_objective_ms" not in series  # no SLO here
+    finally:
+        eng.close()
+
+
+def test_metrics_scrape_is_read_only_vs_stats_emit(trained):
+    """THE regression pin (ISSUE 17): /metrics never resets anything.
+    Interleave scrapes with /stats?emit=1 over live HTTP — the emitted
+    window still carries every request (scrapes stole none), back-to-
+    back scrapes with no traffic are byte-identical, and the histogram
+    count keeps running across the window reset. Trace id round trip
+    rides the same storm."""
+    import json as _json
+    import urllib.request
+
+    from ddt_tpu.serve.http import serve_forever
+
+    eng = _engine(trained, max_wait_ms=2.0)
+    ready = threading.Event()
+    th = threading.Thread(target=serve_forever, args=(eng,),
+                          kwargs=dict(port=0, ready_event=ready),
+                          daemon=True)
+    th.start()
+    assert ready.wait(60)
+    port = eng.http_port
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return r.read().decode()
+
+    try:
+        X = trained["X"]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=_json.dumps({"rows": X[:1].tolist()}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-DDT-Trace-Id": "pin-roundtrip-7"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+            assert r.headers["X-DDT-Trace-Id"] == "pin-roundtrip-7"
+            timing = r.headers["X-DDT-Timing"]
+        segs = dict(kv.split("=") for kv in timing.split(","))
+        assert set(segs) == {"handler", "queue", "gate", "device",
+                             "wake", "total"}
+        for i in range(1, 6):
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/predict",
+                    data=_json.dumps({"rows": X[i:i + 1].tolist()}
+                                     ).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST"), timeout=30) as r:
+                r.read()
+        scrape_a = get("/metrics")
+        assert _json.loads(get("/stats"))["requests"] == 6
+        scrape_b = get("/metrics")
+        assert scrape_a == scrape_b           # scrape-idempotent
+        # The ?emit=1 window still owns ALL the traffic: the two
+        # scrapes and the plain /stats read in between stole nothing.
+        emitted = _json.loads(get("/stats?emit=1"))
+        assert emitted["requests"] == 6
+        assert _json.loads(get("/stats"))["requests"] == 0  # reset
+        from ddt_tpu.serve.metrics import parse_exposition
+        series = parse_exposition(get("/metrics"))
+        key = frozenset({("model", "default")})
+        assert series["ddt_serve_latency_ms_count"][key] == 6.0
+        # /debug/requests: the ring over HTTP, id still addressable.
+        dbg = _json.loads(get("/debug/requests"))
+        ids = [t["trace_id"] for t in dbg["models"]["default"]]
+        assert "pin-roundtrip-7" in ids
+    finally:
+        post_shutdown = urllib.request.Request(
+            f"http://127.0.0.1:{port}/shutdown", data=b"{}",
+            method="POST")
+        urllib.request.urlopen(post_shutdown, timeout=30).read()
+        th.join(30)
+
+
+def test_single_model_healthz_unchanged_pre_slo(trained):
+    """Satellite pin: a single-model server's health payload gained
+    NOTHING from the SLO machinery (no slo keys, no fleet keys) — the
+    operations plane is schema-additive and fleet-scoped."""
+    eng = _engine(trained)
+    try:
+        h = eng.health()
+        assert not any(k.startswith("slo") for k in h)
+        assert "backlog_rows" not in h and "resident_models" not in h
+    finally:
+        eng.close()
+
+
 def test_v4_serve_log_roundtrips_merge_and_trace(trained, tmp_path):
     """A log WITH serve_latency events survives merge + Perfetto export
     (the event rides as an instant marker)."""

@@ -81,6 +81,16 @@ def test_runlog_emits_and_round_trips_every_event_type(tmp_path):
                               coalesce_mean=7.7, coalesce_max=16,
                               queue_depth_max=3, window_s=1.0,
                               model_token="cafe" * 10),
+        # Schema v5-additive (ISSUE 17 operations plane): one flushed
+        # request-trace ring (breakdown per trace_breakdown's shape).
+        "serve_trace": dict(
+            traces=[{"trace_id": "ab12cd34ef56-00000001", "rows": 1,
+                     "express": True, "handler_ms": 0.012,
+                     "queue_ms": 0.0, "gate_ms": 0.21,
+                     "device_ms": 3.1, "wake_ms": 0.05,
+                     "total_ms": 3.37}],
+            count=1, model_name="higgs", model_token="cafe" * 10,
+            reason="on_demand"),
         "run_end": dict(completed_rounds=2, wallclock_s=0.1),
     }
     assert set(payloads) == set(EVENT_FIELDS)   # exhaustive by contract

@@ -85,6 +85,9 @@ PINNED_REQUIRED = {
     "cost_analysis": frozenset({"op", "flops", "bytes_accessed"}),
     "artifact": frozenset({"action", "digest"}),
     "serve_latency": frozenset({"requests", "p50_ms", "p99_ms"}),
+    # ISSUE 17 (serve-side operations plane): new kind, additive under
+    # v5 — pinned at birth so its required set cannot silently grow.
+    "serve_trace": frozenset({"traces"}),
     "run_end": frozenset({"completed_rounds", "wallclock_s"}),
 }
 
