@@ -16,7 +16,8 @@ import numpy as np
 from ddt_tpu.backends import get_backend
 from ddt_tpu.backends.base import DeviceBackend
 from ddt_tpu.config import TrainConfig
-from ddt_tpu.data.quantizer import BinMapper, fit_bin_mapper
+from ddt_tpu.data.quantizer import (BinMapper, feature_bincounts,
+                                    fit_bin_mapper)
 from ddt_tpu.driver import Driver
 from ddt_tpu.models.tree import TreeEnsemble
 from ddt_tpu.utils.atomic import atomic_savez
@@ -203,6 +204,13 @@ def train(
                     "category ids survive binning"
                 )
         Xb = mapper.transform(np.asarray(X))
+        # Drift reference capture (ISSUE 19): the per-feature bin
+        # histogram of the TRAINING matrix, attached to the mapper so it
+        # rides the artifact (save_model's mapper_* channel) into the
+        # serve tier's divergence scorer. Raw counts — sample size stays
+        # visible; the scorer owns normalization. binned=True training
+        # has no mapper, so no reference (drift simply stays disabled).
+        mapper.ref_counts = feature_bincounts(Xb, mapper.n_bins)
 
     if eval_set is not None:
         # eval_set binned-ness follows the training data's `binned` flag —

@@ -668,7 +668,13 @@ def main(argv: list[str] | None = None) -> int:
                          "Keys: name, weight, tier, max_batch, raw, "
                          "slo_p99_ms (per-request p99 latency "
                          "objective in ms — enables burn-rate "
-                         "tracking + slo_breach events). "
+                         "tracking + slo_breach events), "
+                         "drift (true forces divergence tracking — "
+                         "loud when the artifact has no reference "
+                         "histogram; false disables; default auto), "
+                         "shadow_of=<name> (SHADOW mode: score the "
+                         "named champion's traffic off the response "
+                         "path — docs/SERVING.md). "
                          "Refs resolve through --registry (or are "
                          ".npz paths); duplicate names and unknown "
                          "refs fail loudly at boot")
@@ -815,6 +821,14 @@ def main(argv: list[str] | None = None) -> int:
              "its declared p99 objective against the observed tail and "
              "the run's slo_breach burn rates (docs/OBSERVABILITY.md); "
              "fails loudly on a log with no SLO data")
+    rsub.add_parser(
+        "drift",
+        help="render the drift rollup only: one row per model joining "
+             "rolling-window feature divergence (PSI/JS against the "
+             "training reference) with latched drift alerts, plus the "
+             "champion/challenger shadow comparison "
+             "(docs/OBSERVABILITY.md); fails loudly on a log with no "
+             "drift data")
     dp = rsub.add_parser(
         "diff",
         help="align two run logs by phase and counter and flag adverse "
@@ -1263,6 +1277,14 @@ def main(argv: list[str] | None = None) -> int:
                 out_text = tele_report.render_slo(summary)
                 if args.json:
                     out_text = json.dumps(summary["slo"])
+            elif getattr(args, "report_cmd", None) == "drift":
+                # `report --log L drift`: just the drift rollup
+                # (render_drift raises on a log with no drift signal —
+                # caught below into the clean SystemExit, same shape
+                # as `fleet`/`slo`).
+                out_text = tele_report.render_drift(summary)
+                if args.json:
+                    out_text = json.dumps(summary["drift"])
             else:
                 out_text = (json.dumps(summary) if args.json
                             else tele_report.render(summary))

@@ -42,6 +42,17 @@ class BinMapper:
     # without them would silently merge/permute category ids (failing loudly
     # beats silently, same as the missing_bin guard).
     cat_features: tuple = ()
+    # Per-feature REFERENCE bin histogram of the training matrix (ISSUE 19,
+    # the drift observatory's baseline): int64 [n_features, n_bins] raw
+    # counts, attached by api.train after binning (None when never
+    # captured — binned=True training has no mapper-visible matrix, and
+    # every pre-drift artifact loads with None). Raw counts, not
+    # normalized: the sample size stays visible and the divergence
+    # scorer (serve/drift.py) owns the epsilon smoothing. The mapper
+    # owns the bin space, so it owns the reference distribution too —
+    # save()/load() round-trip it through the same `mapper_*` npz
+    # channel as every other field.
+    ref_counts: "np.ndarray | None" = None
 
     @property
     def n_features(self) -> int:
@@ -126,17 +137,39 @@ class BinMapper:
         return float(self.edges[feature, t])
 
     def save(self) -> dict:
-        return {"edges": self.edges, "n_bins": np.int64(self.n_bins),
-                "missing_bin": np.bool_(self.missing_bin),
-                "cat_features": np.asarray(self.cat_features, np.int32)}
+        d = {"edges": self.edges, "n_bins": np.int64(self.n_bins),
+             "missing_bin": np.bool_(self.missing_bin),
+             "cat_features": np.asarray(self.cat_features, np.int32)}
+        if self.ref_counts is not None:
+            d["ref_counts"] = np.asarray(self.ref_counts, np.int64)
+        return d
 
     @staticmethod
     def load(d: dict) -> "BinMapper":
+        ref = d.get("ref_counts")
         return BinMapper(edges=np.asarray(d["edges"], np.float32),
                          n_bins=int(d["n_bins"]),
                          missing_bin=bool(d.get("missing_bin", False)),
                          cat_features=tuple(
-                             int(f) for f in d.get("cat_features", ())))
+                             int(f) for f in d.get("cat_features", ())),
+                         ref_counts=(None if ref is None
+                                     else np.asarray(ref, np.int64)))
+
+
+def feature_bincounts(Xb: np.ndarray, n_bins: int) -> np.ndarray:
+    """Per-feature bin histogram of a binned uint8 matrix: [rows, F] ->
+    int64 [F, n_bins] counts. The ONE bincount home shared by the
+    training-time reference capture (api.train -> mapper.ref_counts) and
+    the serve-side online accumulator (serve/drift.py), so the two sides
+    of a PSI comparison count bins identically. Vectorized: one flat
+    bincount over feature-offset codes, no per-feature Python loop."""
+    Xb = np.asarray(Xb)
+    if Xb.ndim != 2:
+        raise ValueError(f"Xb must be [rows, features], got {Xb.shape}")
+    n_f = Xb.shape[1]
+    flat = (np.arange(n_f, dtype=np.intp)[None, :] * n_bins
+            + Xb.astype(np.intp, copy=False)).ravel()
+    return np.bincount(flat, minlength=n_f * n_bins).reshape(n_f, n_bins)
 
 
 def fit_bin_mapper(

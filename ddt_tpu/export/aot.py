@@ -357,6 +357,13 @@ def stage_servable(
         "raw": bool(raw),
         "has_mapper": bundle.mapper is not None,
         "has_encoder": getattr(bundle, "encoder", None) is not None,
+        # Drift observatory (ISSUE 19, schema-additive manifest extra):
+        # whether the mapper carries a training reference histogram
+        # (mapper.ref_counts in model.npz) — the serve tier enables
+        # drift scoring iff this is true, and `drift=true` specs can
+        # fail fast at load instead of after the first request.
+        "drift_reference": getattr(bundle.mapper, "ref_counts",
+                                   None) is not None,
         "platforms": list(platforms),
         "lut_platforms": list(lut_platforms or ()),
         "quantized": quantized_meta,

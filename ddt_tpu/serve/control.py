@@ -58,6 +58,18 @@ class FleetSpec:
     #: no SLO: no burn-rate tracking, no slo_breach events, and the
     #: health/metrics payloads stay byte-identical to pre-SLO output.
     slo_p99_ms: "float | None" = None
+    #: champion this model SHADOWS (ISSUE 19) — a challenger scores the
+    #: champion's dispatched batches off the response path
+    #: (serve/drift.py.ShadowScorer). The shadow stays a normal fleet
+    #: member (residency, direct requests by name) but receives none of
+    #: the champion's traffic on the response path. None = not a shadow.
+    shadow_of: "str | None" = None
+    #: drift tracking tri-state (ISSUE 19): None AUTO-enables when the
+    #: artifact carries a training reference histogram
+    #: (mapper.ref_counts); True REQUIRES one (a reference-less artifact
+    #: is a FleetConfigError at load, never a quiet no-op); False
+    #: disables tracking even when a reference is present.
+    drift: "bool | None" = None
 
     def __post_init__(self):
         if not self.name:
@@ -74,10 +86,13 @@ class FleetSpec:
             raise FleetConfigError(
                 f"model {self.name!r}: slo_p99_ms must be > 0, got "
                 f"{self.slo_p99_ms}")
+        if self.shadow_of is not None and self.shadow_of == self.name:
+            raise FleetConfigError(
+                f"model {self.name!r}: cannot shadow itself")
 
 
 _SPEC_KEYS = {"name", "ref", "model", "weight", "tier", "max_batch",
-              "raw", "slo_p99_ms"}
+              "raw", "slo_p99_ms", "shadow_of", "drift"}
 
 
 def _default_name(ref: str) -> str:
@@ -130,6 +145,10 @@ def coerce_spec(d: dict, where: str) -> FleetSpec:
             raise FleetConfigError(
                 f"{where}: slo_p99_ms must be a positive number of "
                 f"milliseconds, got {d.get('slo_p99_ms')!r}") from None
+    drift = d.get("drift")
+    if drift is not None:
+        drift = _coerce_bool(drift, where, "drift")
+    shadow_of = d.get("shadow_of")
     try:
         return FleetSpec(
             name=str(d.get("name") or _default_name(str(ref))),
@@ -138,7 +157,9 @@ def coerce_spec(d: dict, where: str) -> FleetSpec:
             tier=tier,
             max_batch=int(d.get("max_batch", 256)),
             raw=_coerce_bool(d.get("raw", False), where, "raw"),
-            slo_p99_ms=slo)
+            slo_p99_ms=slo,
+            shadow_of=(str(shadow_of) if shadow_of else None),
+            drift=drift)
     except (TypeError, ValueError) as e:
         raise FleetConfigError(f"{where}: {e}") from e
 
@@ -207,6 +228,33 @@ def validate_specs(specs: "list[FleetSpec]") -> "list[FleetSpec]":
                 f"({seen[s.name].ref!r} vs {s.ref!r}); give one of "
                 "them an explicit name=")
         seen[s.name] = s
+    # Shadow topology (ISSUE 19): every challenger names a champion in
+    # THIS fleet, and chains are refused — a shadow of a shadow would
+    # compare against scores that were themselves off-path samples.
+    for s in specs:
+        if s.shadow_of is None:
+            continue
+        champ = seen.get(s.shadow_of)
+        if champ is None:
+            raise FleetConfigError(
+                f"model {s.name!r}: shadow_of={s.shadow_of!r} names no "
+                f"model in this fleet (have: "
+                f"{', '.join(sorted(seen))})")
+        if champ.shadow_of is not None:
+            raise FleetConfigError(
+                f"model {s.name!r}: shadow_of={s.shadow_of!r} is itself "
+                f"a shadow (of {champ.shadow_of!r}); shadow chains are "
+                "not supported")
+    challengers: dict = {}
+    for s in specs:
+        if s.shadow_of is None:
+            continue
+        prev = challengers.setdefault(s.shadow_of, s.name)
+        if prev != s.name:
+            raise FleetConfigError(
+                f"model {s.shadow_of!r} has two challengers "
+                f"({prev!r} and {s.name!r}); one challenger per "
+                "champion")
     return specs
 
 

@@ -393,7 +393,8 @@ def coerce_rows(rows) -> np.ndarray:
     return rows
 
 
-def dispatch_batch(model, batch, queue_depth: int, stats) -> list:
+def dispatch_batch(model, batch, queue_depth: int, stats,
+                   observer=None) -> list:
     """Score ONE admitted micro-batch against `model` and deliver every
     result/error — the per-batch body shared by ServeEngine._dispatch
     and the fleet engine's per-model dispatch (ddt_tpu/serve/fleet.py).
@@ -408,6 +409,14 @@ def dispatch_batch(model, batch, queue_depth: int, stats) -> list:
     trees across a swap. Transform failures are PER-REQUEST: a malformed
     submission fails its own waiter only, never the valid requests that
     happened to share its admission window.
+
+    `observer(Xb, scores, lats)` — the drift/shadow seam (ISSUE 19,
+    ddt_tpu/serve/drift.py) — runs AFTER every waiter has its result:
+    structurally off the response path, so champion responses are
+    bit-identical with or without it, and a failing observer is
+    contained (the dispatcher thread must survive anything a tracker
+    raises). It sees the batch exactly as scored: the concatenated
+    binned uint8 matrix and this model's scores.
 
     Trace marks (ISSUE 17) ride the requests' own `marks` dicts on the
     batcher's injected clock (marks carry the clock — the whole
@@ -495,6 +504,14 @@ def dispatch_batch(model, batch, queue_depth: int, stats) -> list:
         req.model_token = model.token
         req.set_result(scores[off:off + req.n])
         off += req.n
+    if observer is not None:
+        try:
+            observer(Xb, scores, lats)
+        except Exception:  # ddtlint: disable=broad-except
+            # Observers (drift accumulation, shadow enqueue) are strictly
+            # best-effort: they must never take the dispatch loop down or
+            # touch the already-delivered results.
+            pass
     return lats
 
 

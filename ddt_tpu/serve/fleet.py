@@ -46,6 +46,7 @@ import collections
 import threading
 import time
 
+from ddt_tpu.serve import drift as serve_drift
 from ddt_tpu.serve.batcher import MicroBatcher, PendingRequest, ShuttingDown
 from ddt_tpu.serve.engine import ServeStats, coerce_rows, dispatch_batch
 from ddt_tpu.telemetry import counters as tele_counters
@@ -209,6 +210,16 @@ class FleetSlot:
         # (getattr: pre-ISSUE-17 spec objects have no slo_p99_ms field).
         objective = getattr(spec, "slo_p99_ms", None)
         self.slo = SloBurnTracker(objective) if objective else None
+        # Drift observatory (ISSUE 19): the divergence tracker is armed
+        # at LOAD time (the reference histogram lives in the artifact's
+        # mapper) and survives evictions — the rolling window is about
+        # the traffic, not the residency. `shadow` is the attached
+        # challenger's scorer when THIS slot is a shadowed champion;
+        # `observer` is the engine-bound dispatch_batch observer
+        # closure (bound once in _make_slot_locked).
+        self.drift = None            # serve_drift.DriftTracker | None
+        self.shadow = None           # serve_drift.ShadowScorer | None
+        self.observer = None
         self.model = None            # resident ServableModel | None
         self.loading = False
         self.load_error = None
@@ -295,9 +306,47 @@ class FleetEngine:
             self._express_fn(slot), max_wait_ms=self.max_wait_ms,
             max_batch=spec.max_batch, clock=self._clock, cv=self._cv,
             own_thread=False, request_traces=self.request_traces)
+        slot.observer = self._observer_fn(slot)
         self._slots[spec.name] = slot
         self._order.append(spec.name)
+        self._wire_shadow_locked(slot)
         return slot
+
+    def _wire_shadow_locked(self, slot: FleetSlot) -> None:
+        """Attach challenger scorers for a just-created slot, in BOTH
+        directions (a fleet config may list the shadow before or after
+        its champion — boot order is free; control.validate_specs has
+        already refused dangling or chained shadow_of)."""
+        champ_name = getattr(slot.spec, "shadow_of", None)
+        if champ_name is not None:
+            champ = self._slots.get(champ_name)
+            if champ is not None and champ.shadow is None:
+                champ.shadow = serve_drift.ShadowScorer(
+                    slot.name, champ.name, slot, self._clock)
+        for s in self._slots.values():
+            if getattr(s.spec, "shadow_of", None) == slot.name \
+                    and slot.shadow is None:
+                slot.shadow = serve_drift.ShadowScorer(
+                    s.name, slot.name, s, self._clock)
+
+    def _observer_fn(self, slot):
+        """dispatch_batch's post-result observer (ISSUE 19): fold the
+        scored batch into the slot's drift window and hand (rows,
+        scores) to an attached challenger's shadow queue. Runs AFTER
+        every future in the batch has settled — on the dispatcher
+        (batch path) or a handler thread (express lane); both sinks
+        take only their own leaf locks, and an alert transition bumps
+        the process counter here (a plain int add) while the event
+        payload waits in the tracker for a handler-thread flush."""
+        def observe(Xb, scores, lats):
+            trk = slot.drift
+            if trk is not None \
+                    and trk.observe(self._clock(), Xb) is not None:
+                tele_counters.record_drift_alert()
+            scorer = slot.shadow
+            if scorer is not None:
+                scorer.enqueue(Xb, scores)
+        return observe
 
     def _express_fn(self, slot):
         def dispatch(batch, depth):
@@ -310,7 +359,8 @@ class FleetEngine:
             model = slot.model
             if model is None:
                 raise _EvictedInFlight(slot.name)
-            lats = dispatch_batch(model, batch, depth, slot.stats)
+            lats = dispatch_batch(model, batch, depth, slot.stats,
+                                  observer=slot.observer)
             trk = slot.slo
             if trk is not None and lats \
                     and trk.record(self._clock(), lats) is not None:
@@ -353,9 +403,23 @@ class FleetEngine:
                 slot.load_error = f"{type(e).__name__}: {e}"
                 self._cv.notify_all()
             raise ModelUnavailableError(slot.name, slot.load_error) from e
+        # Drift/shadow misconfiguration is a CONFIG error, not a load
+        # failure: it must surface as the structured 4xx (ValueError
+        # family), never the 503 the except-arm above would wrap it in.
+        try:
+            drift_trk = slot.drift if slot.drift is not None \
+                else self._derive_drift(slot.spec, model, slot.name)
+            self._check_shadow_compat(slot, model)
+        except ValueError as e:
+            with self._cv:
+                slot.loading = False
+                slot.load_error = f"{type(e).__name__}: {e}"
+                self._cv.notify_all()
+            raise
         with self._cv:
             slot.loading = False
             slot.model = model
+            slot.drift = drift_trk
             slot.last_used = self._next_use_locked()
             reloaded = slot.ever_resident
             slot.ever_resident = True
@@ -371,6 +435,66 @@ class FleetEngine:
         for v in victims:
             tele_counters.record_fleet_eviction()
             self._emit_lifecycle("fleet_eviction", v)
+
+    def _derive_drift(self, spec, model, name):
+        """DriftTracker for a freshly loaded model, honouring the spec's
+        tri-state `drift` flag: None = auto (track when the artifact
+        carries a training reference histogram), False = never, True =
+        require — a reference-less artifact is then a FleetConfigError
+        (a ValueError: the HTTP boundary renders it as a structured
+        4xx, never a bare 500)."""
+        want = getattr(spec, "drift", None)
+        if want is False:
+            return None
+        ref = getattr(getattr(model, "mapper", None), "ref_counts", None)
+        if ref is None:
+            if want is True:
+                # Deferred import: control.py imports this module at
+                # load; by the time a model loads, control is long
+                # importable — no cycle at module-exec time.
+                from ddt_tpu.serve.control import FleetConfigError
+                raise FleetConfigError(
+                    f"model {name!r}: drift=true but artifact "
+                    f"{spec.ref!r} carries no training reference "
+                    "histogram (mapper.ref_counts) — re-export from a "
+                    "training run that captured one, or drop "
+                    "drift=true")
+            return None
+        return serve_drift.DriftTracker(ref)
+
+    def _check_shadow_compat(self, slot: FleetSlot, model) -> None:
+        """Champion/challenger agreement, checked at load time on
+        whichever side loads second: a challenger scores the champion's
+        OWN binned traffic verbatim, so the widths must match and both
+        must speak the same output convention (`raw`). Violations are
+        FleetConfigError (structured 4xx), raised before publish so the
+        broken pairing never serves."""
+        with self._cv:
+            pairs = []   # (shadow slot, shadow model, champ slot, champ model)
+            champ_name = getattr(slot.spec, "shadow_of", None)
+            if champ_name is not None:
+                champ = self._slots.get(champ_name)
+                if champ is not None and champ.model is not None:
+                    pairs.append((slot, model, champ, champ.model))
+            for s in self._slots.values():
+                if getattr(s.spec, "shadow_of", None) == slot.name \
+                        and s.model is not None:
+                    pairs.append((s, s.model, slot, model))
+        for sh, sh_model, champ, champ_model in pairs:
+            if sh_model.n_features != champ_model.n_features:
+                from ddt_tpu.serve.control import FleetConfigError
+                raise FleetConfigError(
+                    f"shadow {sh.name!r} expects {sh_model.n_features} "
+                    f"features but champion {champ.name!r} serves "
+                    f"{champ_model.n_features} — a challenger must "
+                    "score the champion's own traffic")
+            if bool(getattr(sh.spec, "raw", False)) \
+                    != bool(getattr(champ.spec, "raw", False)):
+                from ddt_tpu.serve.control import FleetConfigError
+                raise FleetConfigError(
+                    f"shadow {sh.name!r} and champion {champ.name!r} "
+                    "disagree on raw= — margin-vs-probability "
+                    "divergence would be meaningless")
 
     def _evict_locked(self, keep: "FleetSlot | None") -> list:
         """LRU demotion down to `max_resident` (called with the fleet
@@ -424,18 +548,22 @@ class FleetEngine:
 
     def _flush_events(self) -> None:
         """Drain dispatcher-buffered lifecycle events AND pending SLO
-        breaches into the run log (handler threads: health,
-        emit_latency, reload, and the request path when a tracker has a
-        breach waiting)."""
+        breaches AND pending drift alerts into the run log (handler
+        threads: health, emit_latency, reload, and the request path
+        when a tracker has something waiting)."""
         with self._cv:
             pending, self._pending_events[:] = \
                 list(self._pending_events), []
             slots = list(self._slots.values())
         breaches = []
+        drifts = []
         for s in slots:
             if s.slo is not None and s.slo.has_pending():
                 for b in s.slo.take_pending():
                     breaches.append((s, b))
+            if s.drift is not None and s.drift.has_pending():
+                for d in s.drift.take_pending():
+                    drifts.append((s, d))
         if self.run_log is None:
             return
         for kind, name, evictions, reloads in pending:
@@ -449,6 +577,11 @@ class FleetEngine:
             # ring is flushed as a `serve_trace` event so the slow tail
             # is attributable after the fact, not just counted.
             self.flush_traces(reason="slo_breach", only=s.name)
+        for s, d in drifts:
+            # Latched alert transitions (drift.py buffered the payload
+            # on whatever thread observed it) land as first-class
+            # `drift` events — `report drift` reads them back.
+            self.run_log.emit("drift", model_name=s.name, **d)
 
     # ------------------------------------------------------------------ #
     # request path
@@ -490,11 +623,13 @@ class FleetEngine:
         name = self._resolve_name(model)
         rows = coerce_rows(rows)
         slot = self._slot(name)
-        # SLO breach sweep: the dispatcher can only BUFFER a breach
-        # (no file I/O on that thread), so the next request for the
-        # slot carries it to the log. has_pending is an unlocked
-        # truthiness read — zero cost on the un-breached hot path.
-        if slot.slo is not None and slot.slo.has_pending():
+        # SLO-breach / drift-alert sweep: the dispatcher can only
+        # BUFFER these (no file I/O on that thread), so the next
+        # request for the slot carries them to the log. has_pending is
+        # an unlocked truthiness read — zero cost on the quiet path.
+        if ((slot.slo is not None and slot.slo.has_pending())
+                or (slot.drift is not None
+                    and slot.drift.has_pending())):
             self._flush_events()
         # Residency + enqueue retry loop: an eviction can land between
         # the load and the enqueue (or mid-express) — each lap reloads
@@ -625,7 +760,8 @@ class FleetEngine:
 
     def _batch_fn(self, model, slot):
         def dispatch(batch, depth):
-            lats = dispatch_batch(model, batch, depth, slot.stats)
+            lats = dispatch_batch(model, batch, depth, slot.stats,
+                                  observer=slot.observer)
             trk = slot.slo
             if trk is not None and lats \
                     and trk.record(self._clock(), lats) is not None:
@@ -654,20 +790,55 @@ class FleetEngine:
                 raise ValueError(
                     f"model {spec.name!r} is already in the fleet "
                     "(remove it first, or retag it)")
+            # Live shadow attach (boot-time specs go through
+            # control.validate_specs; this is the POST /models path, so
+            # the same topology rules apply here — ValueError lands in
+            # the HTTP layer's structured 400 arm).
+            champ_name = getattr(spec, "shadow_of", None)
+            if champ_name is not None:
+                champ = self._slots.get(champ_name)
+                if champ is None:
+                    raise ValueError(
+                        f"shadow_of={champ_name!r} names no fleet "
+                        f"member (serving: "
+                        f"{', '.join(sorted(self._slots)) or 'none'})")
+                if getattr(champ.spec, "shadow_of", None) is not None:
+                    raise ValueError(
+                        f"model {champ_name!r} is itself a shadow — "
+                        "shadow chains are not supported")
+                if champ.shadow is not None:
+                    raise ValueError(
+                        f"model {champ_name!r} already has shadow "
+                        f"{champ.shadow.name!r} (one challenger per "
+                        "champion; remove it first)")
             slot = self._make_slot_locked(spec)
             self._cv.notify_all()
         if load:
             try:
                 self._ensure_resident(slot)
             except BaseException:
+                scorers = []
                 with self._cv:
                     if self._slots.get(spec.name) is slot:
                         del self._slots[spec.name]
                         self._order.remove(spec.name)
                         self._rr = 0
+                        # Detach any scorer the slot creation wired up
+                        # (in either direction) — a rolled-back member
+                        # must not leave a live challenger thread.
+                        for s in self._slots.values():
+                            if s.shadow is not None \
+                                    and s.shadow.name == spec.name:
+                                scorers.append(s.shadow)
+                                s.shadow = None
+                        if slot.shadow is not None:
+                            scorers.append(slot.shadow)
+                            slot.shadow = None
                         slot.batcher.fail_pending_locked(
                             UnknownModelError(spec.name, self._slots))
                         self._cv.notify_all()
+                for scorer in scorers:
+                    scorer.close()    # join: outside the fleet lock
                 raise
         return {"name": slot.name, "resident": slot.model is not None,
                 "weight": slot.weight}
@@ -679,6 +850,21 @@ class FleetEngine:
             slot = self._slots.get(name)
             if slot is None:
                 raise UnknownModelError(name, self._slots)
+            if slot.shadow is not None:
+                # A shadowed champion stays put until the experiment is
+                # torn down explicitly — silently dropping the target
+                # of a live comparison would leave the challenger
+                # scoring nothing without anyone deciding that.
+                raise ValueError(
+                    f"model {name!r} is shadowed by "
+                    f"{slot.shadow.name!r}; remove the shadow first")
+            scorer = None
+            champ_name = getattr(slot.spec, "shadow_of", None)
+            if champ_name is not None:
+                champ = self._slots.get(champ_name)
+                if champ is not None and champ.shadow is not None \
+                        and champ.shadow.name == name:
+                    scorer, champ.shadow = champ.shadow, None
             failed = slot.batcher.fail_pending_locked(
                 UnknownModelError(name, set(self._slots) - {name}))
             del self._slots[name]
@@ -686,6 +872,8 @@ class FleetEngine:
             self._rr = 0
             slot.model = None
             self._cv.notify_all()
+        if scorer is not None:
+            scorer.close()    # removing the challenger detaches it
         if self.run_log is not None:
             self.run_log.emit("fault", kind="fleet_remove",
                               model_name=name, failed_requests=failed)
@@ -704,6 +892,12 @@ class FleetEngine:
         slot = self._slot(name)
         new = self._loader(spec)
         new.warmup()
+        # Retag re-derives the drift tracker from the NEW artifact: the
+        # reference histogram belongs to the training run behind the
+        # new model, so the old rolling window is meaningless against
+        # it. Misconfig raises (structured 4xx) before any swap.
+        new_drift = self._derive_drift(spec, new, name)
+        self._check_shadow_compat(slot, new)
         with self._cv:
             if name not in self._slots:
                 raise UnknownModelError(name, self._slots)
@@ -714,6 +908,7 @@ class FleetEngine:
             # vs new-objective comparisons are meaningless).
             objective = getattr(spec, "slo_p99_ms", None)
             slot.slo = SloBurnTracker(objective) if objective else None
+            slot.drift = new_drift
             slot.model = new
             slot.ever_resident = True
             slot.last_used = self._next_use_locked()
@@ -762,6 +957,23 @@ class FleetEngine:
             out.update(slo_p99_ms=slot.slo.objective_ms,
                        slo_burn_rate=slot.slo.burn_rates(self._clock()),
                        slo_breaches=slot.slo.breaches)
+        if slot.drift is not None:
+            # Schema-additive (ISSUE 19): drift fields appear ONLY when
+            # the artifact carried a reference histogram (same
+            # omit-don't-lie convention as the SLO block). Lock nesting
+            # is fleet-Condition -> tracker-leaf-lock, the SloBurnTracker
+            # precedent.
+            d = slot.drift.state(self._clock())
+            out.update(drift_psi_max=d["psi_max"],
+                       drift_js_max=d["js_max"],
+                       drift_alerting=d["alerting"],
+                       drift_alerts=d["alerts"],
+                       drift_window_rows=d["window_rows"])
+        champ_name = getattr(slot.spec, "shadow_of", None)
+        if champ_name is not None:
+            out["shadow_of"] = champ_name
+        if slot.shadow is not None:
+            out["shadow"] = slot.shadow.summary()
         return out
 
     def health(self) -> dict:
@@ -806,11 +1018,36 @@ class FleetEngine:
                 slo = {"objective_ms": s.slo.objective_ms,
                        "burn_rates": s.slo.burn_rates(now),
                        "breaches": s.slo.breaches}
+            drift = s.drift.state(now) if s.drift is not None else None
+            shadow = s.shadow.summary() if s.shadow is not None else None
             models[s.name] = {"hist": s.stats.metrics_state(),
                               "backlog_rows": backlog[s.name],
-                              "slo": slo}
+                              "slo": slo,
+                              "drift": drift,
+                              "shadow": shadow}
         return {"models": models, "resident_models": resident,
                 "max_resident": self.max_resident}
+
+    def debug_drift(self) -> dict:
+        """GET /debug/drift payload: per-model reference/window state,
+        worst-first per-feature divergence attribution, and the shadow
+        comparison. Handler threads only (flushes pending drift
+        events on the way)."""
+        self._flush_events()
+        now = self._clock()
+        with self._cv:
+            slots = list(self._slots.values())
+        models = {}
+        for s in slots:
+            rec = {"reference": s.drift is not None,
+                   "shadow_of": getattr(s.spec, "shadow_of", None)}
+            if s.drift is not None:
+                rec["state"] = s.drift.state(now)
+                rec["per_feature"] = s.drift.per_feature(now)
+            if s.shadow is not None:
+                rec["shadow"] = s.shadow.summary()
+            models[s.name] = rec
+        return {"fleet": True, "models": models}
 
     def debug_traces(self) -> dict:
         """{model_name: [trace records]} — each slot's ring of the last
@@ -886,6 +1123,26 @@ class FleetEngine:
                 summary["predict_impl"] = model.predict_impl
                 if model.artifact_digest is not None:
                     summary["artifact_digest"] = model.artifact_digest
+            if slot.drift is not None:
+                # Drift rides the latency window out (schema-additive,
+                # ISSUE 19): `report drift` reads divergence off old
+                # logs even when no alert ever latched.
+                d = slot.drift.state(self._clock())
+                if d["psi_max"] is not None:
+                    summary["drift_psi_max"] = d["psi_max"]
+                    summary["drift_js_max"] = d["js_max"]
+                    summary["drift_alerting"] = d["alerting"]
+            if slot.shadow is not None:
+                sh = slot.shadow.summary()
+                summary["shadow_model"] = sh["model"]
+                summary["shadow_rows"] = sh["rows"]
+                if sh["mean_abs_diff"] is not None:
+                    summary["shadow_mean_abs_diff"] = \
+                        sh["mean_abs_diff"]
+                if sh["ms_p50"] is not None:
+                    summary["shadow_ms_p50"] = sh["ms_p50"]
+                if sh["dropped"]:
+                    summary["shadow_dropped"] = sh["dropped"]
             if self.run_log is not None:
                 self.run_log.emit("serve_latency", **summary)
             out[slot.name] = summary
@@ -894,11 +1151,15 @@ class FleetEngine:
     def close(self) -> None:
         with self._cv:
             self._closed = True
+            scorers = [s.shadow for s in self._slots.values()
+                       if s.shadow is not None]
             for slot in self._slots.values():
                 slot.batcher.close()      # no own thread: marks closed
             self._cv.notify_all()
         if self._thread.is_alive():
             self._thread.join(10.0)
+        for scorer in scorers:
+            scorer.close()    # joins the scorer thread — no lock held
         self.emit_latency(reset=True)
         if self.run_log is not None:
             self.run_log.close()

@@ -45,6 +45,12 @@ Endpoints (all JSON):
                   the per-model ring of completed request traces;
                   "?emit=1" also flushes the rings into the run log as
                   `serve_trace` events.
+- GET  /debug/drift   (fleet servers, ISSUE 19) the drift observatory:
+                  per-model rolling-window divergence state (PSI / JS
+                  against the training reference), worst-first
+                  per-feature attribution, and champion/challenger
+                  shadow comparison (docs/OBSERVABILITY.md "Drift
+                  observatory").
 - POST /shutdown  -> drains and stops the server
 
 TRACE PROPAGATION (ISSUE 17): every /predict response carries
@@ -299,6 +305,11 @@ def _make_handler(engine, server_box: dict):
                         out["flushed"] = engine.flush_traces(
                             reason="on_demand")
                     return self._send(200, out)
+                if path == "/debug/drift" and fleet:
+                    # Handler thread: debug_drift flushes any pending
+                    # drift events on the way (file I/O lives here,
+                    # never on the dispatcher).
+                    return self._send(200, engine.debug_drift())
                 if path == "/models" and fleet:
                     return self._send(200, {"models": engine.models()})
                 if path == "/stats":

@@ -26,7 +26,21 @@ Series emitted:
   ``ddt_serve_slo_burn_rate{model,window}`` /
   ``ddt_serve_slo_breaches_total{model}``  only for models with an SLO
   configured (burn-rate windows with too few samples are omitted, not
-  rendered as 0 — a 0 burn is a claim, not an absence).
+  rendered as 0 — a 0 burn is a claim, not an absence);
+- ``ddt_drift_psi_max{model}`` / ``ddt_drift_js_max{model}`` /
+  ``ddt_drift_window_rows{model}`` / ``ddt_drift_alerting{model}`` /
+  ``ddt_drift_psi_threshold{model}`` /
+  ``ddt_drift_model_alerts_total{model}``  the drift observatory
+  (ISSUE 19), only for models whose artifact carried a training
+  reference histogram; divergence gauges are omitted (not zeroed)
+  below the tracker's min-rows floor. The per-model alert counter is
+  named ``_model_alerts_`` so it cannot collide with the process-wide
+  ``ddt_drift_alerts_total`` that render_counters already emits;
+- ``ddt_shadow_scored_rows_total{model,shadow}`` /
+  ``ddt_shadow_mean_abs_diff{model,shadow}`` /
+  ``ddt_shadow_dropped_total{model,shadow}``  champion/challenger
+  shadow comparison, only on shadowed champions (mean-abs-diff omitted
+  until the challenger has actually scored).
 
 No HTTP, no locks, no engine imports — http.py collects the snapshots
 (each snapshot method does its own locking) and this module only
@@ -130,6 +144,68 @@ def render_metrics(counters: dict, snapshot: dict) -> str:
             out.append(
                 f'ddt_serve_slo_breaches_total{{model="{_esc(name)}"}} '
                 f'{_num(slo.get("breaches", 0))}')
+    drift_models = {n: m["drift"] for n, m in sorted(models.items())
+                    if m.get("drift")}
+    if drift_models:
+        out.append("# TYPE ddt_drift_window_rows gauge")
+        for name, d in drift_models.items():
+            out.append(
+                f'ddt_drift_window_rows{{model="{_esc(name)}"}} '
+                f'{_num(d.get("window_rows", 0))}')
+        out.append("# TYPE ddt_drift_psi_threshold gauge")
+        for name, d in drift_models.items():
+            out.append(
+                f'ddt_drift_psi_threshold{{model="{_esc(name)}"}} '
+                f'{_num(float(d["threshold"]))}')
+        # Divergence gauges only once the window clears the tracker's
+        # min-rows floor (psi_max is None below it): omit, don't lie.
+        scored = {n: d for n, d in drift_models.items()
+                  if d.get("psi_max") is not None}
+        if scored:
+            out.append("# TYPE ddt_drift_psi_max gauge")
+            for name, d in scored.items():
+                out.append(
+                    f'ddt_drift_psi_max{{model="{_esc(name)}"}} '
+                    f'{_num(float(d["psi_max"]))}')
+            out.append("# TYPE ddt_drift_js_max gauge")
+            for name, d in scored.items():
+                out.append(
+                    f'ddt_drift_js_max{{model="{_esc(name)}"}} '
+                    f'{_num(float(d["js_max"]))}')
+        out.append("# TYPE ddt_drift_alerting gauge")
+        for name, d in drift_models.items():
+            out.append(
+                f'ddt_drift_alerting{{model="{_esc(name)}"}} '
+                f'{_num(bool(d.get("alerting")))}')
+        out.append("# TYPE ddt_drift_model_alerts_total counter")
+        for name, d in drift_models.items():
+            out.append(
+                f'ddt_drift_model_alerts_total{{model="{_esc(name)}"}} '
+                f'{_num(d.get("alerts", 0))}')
+    shadow_models = {n: m["shadow"] for n, m in sorted(models.items())
+                     if m.get("shadow")}
+    if shadow_models:
+        out.append("# TYPE ddt_shadow_scored_rows_total counter")
+        for name, sh in shadow_models.items():
+            out.append(
+                f'ddt_shadow_scored_rows_total{{model="{_esc(name)}",'
+                f'shadow="{_esc(sh["model"])}"}} '
+                f'{_num(sh.get("rows", 0))}')
+        diffed = {n: sh for n, sh in shadow_models.items()
+                  if sh.get("mean_abs_diff") is not None}
+        if diffed:
+            out.append("# TYPE ddt_shadow_mean_abs_diff gauge")
+            for name, sh in diffed.items():
+                out.append(
+                    f'ddt_shadow_mean_abs_diff{{model="{_esc(name)}",'
+                    f'shadow="{_esc(sh["model"])}"}} '
+                    f'{_num(float(sh["mean_abs_diff"]))}')
+        out.append("# TYPE ddt_shadow_dropped_total counter")
+        for name, sh in shadow_models.items():
+            out.append(
+                f'ddt_shadow_dropped_total{{model="{_esc(name)}",'
+                f'shadow="{_esc(sh["model"])}"}} '
+                f'{_num(sh.get("dropped", 0))}')
     return "\n".join(out) + "\n"
 
 

@@ -111,6 +111,15 @@ _c = {
     # counter; the per-round scales themselves are in-trace values, so
     # they surface via debug logs, not counters).
     "grad_quant_rounds": 0,
+    # Drift alert transitions (serve/drift.py, ISSUE 19): the number of
+    # times a model's rolling-window feature divergence (max per-feature
+    # PSI vs the artifact's training reference histogram) crossed INTO
+    # alert (latched — a model drifting continuously counts once until
+    # it recovers below threshold and alerts again). Each transition
+    # also emits a `drift` event with the model, divergence scores, and
+    # worst feature; this counter is the process-lifetime total the
+    # /metrics exposition and report diff read.
+    "drift_alerts": 0,
 }
 _listener_installed = False
 # When truthy, the compile listener drops events: the cost observatory's
@@ -207,6 +216,10 @@ def record_fleet_reload() -> None:
 
 def record_slo_breach() -> None:
     _c["slo_breaches"] += 1
+
+
+def record_drift_alert() -> None:
+    _c["drift_alerts"] += 1
 
 
 def record_grad_stream(nbytes: int) -> None:

@@ -56,6 +56,11 @@ COUNTER_DIRECTIONS: dict[str, str] = {
     # whose B run starts burning its latency budget is a regression no
     # matter what the request mix looked like.
     "slo_breaches": "lower",
+    # Drift alert transitions (serve/drift.py, ISSUE 19): a serving A/B
+    # whose B run starts diverging from its training reference is a
+    # regression regardless of the request mix — drift is a property of
+    # the traffic-vs-model pairing, not of load.
+    "drift_alerts": "lower",
     # Workload-shape counters: request mix and fleet churn track what
     # was ASKED of the system, not how well it did — deliberately
     # "neutral" so a bigger replay never reads as a regression.

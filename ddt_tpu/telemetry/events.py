@@ -55,6 +55,18 @@ import time
 # the `slo_p99_ms` objective extra on serve_latency windows, and the
 # slo_breaches process counter. Pre-SLO logs remain readable and render
 # exactly as before (tests/test_fleet.py pins the mixed-era report).
+# ISSUE 19 extras (schema-ADDITIVE, no version bump — the drift
+# observatory): the `drift` event (a latched per-model alert transition
+# when a model's rolling-window feature divergence against its
+# artifact's training reference histogram crosses the PSI threshold —
+# ddt_tpu/serve/drift.py; psi_max required, per-feature attribution +
+# Jensen-Shannon score + window shape as extras), the drift_alerts
+# process counter, and the drift/shadow extras on serve_latency windows
+# (drift_psi_max, drift_js_max, shadow_model, shadow_mean_abs_diff,
+# shadow_ms_p50 — how `report drift` recovers per-model drift and
+# champion/challenger comparison from a log). Pre-drift logs remain
+# readable and render exactly as before (tests/test_drift.py pins the
+# mixed-era report).
 SCHEMA_VERSION = 5
 
 #: event type -> REQUIRED payload fields (extras are allowed and common:
@@ -137,6 +149,16 @@ EVENT_FIELDS: dict[str, set] = {
     # dimension and the flush reason as extras. Absent from pre-trace
     # logs; report ignores unknown-to-it events by construction.
     "serve_trace": {"traces"},
+    # Drift alert transition (ISSUE 19, schema-additive): one per
+    # latched crossing of a model's rolling-window feature divergence
+    # into alert — psi_max is the worst per-feature population
+    # stability index vs the artifact's training reference histogram
+    # (serve/drift.py is the one divergence home). Extras carry the
+    # model dimension, the worst feature, the companion Jensen-Shannon
+    # score, and the window shape so the report can rank breaches.
+    # Absent from pre-drift logs; report ignores unknown-to-it events
+    # by construction.
+    "drift": {"psi_max"},
     # Last record of a completed run.
     "run_end": {"completed_rounds", "wallclock_s"},
 }
@@ -188,7 +210,7 @@ EVENT_EXTRAS: dict[str, tuple] = {
         "fault_retries", "hist_oom_degrades",
         "serve_requests", "serve_batches", "serve_hot_swaps",
         "serve_express", "fleet_evictions", "fleet_reloads",
-        "slo_breaches",
+        "slo_breaches", "drift_alerts",
         "grad_stream_bytes_est", "grad_quant_rounds",
         "device_peak_bytes", "host_peak_rss_bytes",
     ),
@@ -199,8 +221,21 @@ EVENT_EXTRAS: dict[str, tuple] = {
     "serve_latency": ("batches", "window_s", "p999_ms", "max_ms",
                       "coalesce_mean", "coalesce_max", "queue_depth_max",
                       "express", "model_token", "model_name",
-                      "predict_impl", "artifact_digest", "slo_p99_ms"),
+                      "predict_impl", "artifact_digest", "slo_p99_ms",
+                      # ISSUE 19 drift/shadow extras: the window's
+                      # divergence scores and, on a shadowed champion,
+                      # the challenger's comparison stats — the signals
+                      # `report drift` joins per model.
+                      "drift_psi_max", "drift_js_max", "drift_alerting",
+                      "shadow_model", "shadow_rows",
+                      "shadow_mean_abs_diff", "shadow_ms_p50",
+                      "shadow_dropped"),
     "serve_trace": ("model_name", "model_token", "reason", "count"),
+    # Drift alert transitions (ISSUE 19): the model dimension, worst-
+    # feature attribution, companion Jensen-Shannon score, window shape,
+    # and the alert threshold that was crossed.
+    "drift": ("model_name", "feature", "js_max", "psi_mean",
+              "window_rows", "window_s", "threshold", "alerts"),
     "run_end": (),
 }
 

@@ -212,6 +212,14 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
         "slo": _slo_summary(
             [e for e in events if e["event"] == "serve_latency"],
             [e for e in events if e["event"] == "fault"]),
+        # Drift rollup (ISSUE 19): per-model join of latched `drift`
+        # alert events against the drift_*/shadow_* extras riding
+        # serve_latency windows — None unless the log carries EITHER
+        # signal, so pre-drift logs render exactly as before.
+        # `cli report --log L drift` renders just this table.
+        "drift": _drift_summary(
+            [e for e in events if e["event"] == "serve_latency"],
+            [e for e in events if e["event"] == "drift"]),
         # Registry provenance (schema v5): artifact push/load events,
         # each cross-referenced against THIS run's id when they carry
         # one — None on pre-v5 logs.
@@ -386,6 +394,68 @@ def _slo_summary(serve_ev: list[dict],
     }
 
 
+def _drift_summary(serve_ev: list[dict],
+                   drift_ev: list[dict]) -> dict | None:
+    """Per-model drift rollup (ISSUE 19): join the observatory's two
+    log signals — latched `drift` alert events and the drift_*/shadow_*
+    extras serve_latency windows carry — into one table. Mixed-era logs
+    degrade gracefully by construction: pre-drift windows simply carry
+    no divergence (rendered `-`, never an error), and a model that
+    alerted before ever emitting a window enters the table through its
+    events alone. None when the log carries neither signal, so
+    pre-drift logs summarize exactly as before."""
+    windows = [e for e in serve_ev
+               if e.get("drift_psi_max") is not None
+               or e.get("shadow_model")]
+    if not drift_ev and not windows:
+        return None
+    models: dict = {}
+
+    def rec(name) -> dict:
+        return models.setdefault(name, {
+            "windows": 0, "requests": 0,
+            "psi_max": None, "worst_psi_max": None, "js_max": None,
+            "alerting": False, "alerts": 0,
+            "worst_feature": None, "threshold": None,
+            "shadow": None,
+        })
+
+    for e in serve_ev:
+        name = e.get("model_name") or "default"
+        has_drift = e.get("drift_psi_max") is not None
+        has_shadow = bool(e.get("shadow_model"))
+        # Only drift-era windows open a row; older windows still fold
+        # into an existing row's traffic so the request count is honest.
+        if not has_drift and not has_shadow and name not in models:
+            continue
+        m = rec(name)
+        m["windows"] += 1
+        m["requests"] += e["requests"]
+        if has_drift:
+            m["psi_max"] = e["drift_psi_max"]     # last window's score
+            m["js_max"] = e.get("drift_js_max")
+            m["worst_psi_max"] = max(m["worst_psi_max"] or 0.0,
+                                     e["drift_psi_max"])
+            m["alerting"] = bool(e.get("drift_alerting"))
+        if has_shadow:
+            m["shadow"] = {
+                "model": e["shadow_model"],
+                "rows": e.get("shadow_rows"),
+                "mean_abs_diff": e.get("shadow_mean_abs_diff"),
+                "ms_p50": e.get("shadow_ms_p50"),
+                "dropped": e.get("shadow_dropped", 0) or 0,
+            }
+    for d in drift_ev:
+        m = rec(d.get("model_name") or "default")
+        m["alerts"] += 1
+        m["worst_psi_max"] = max(m["worst_psi_max"] or 0.0,
+                                 d["psi_max"])
+        m["worst_feature"] = d.get("feature", m["worst_feature"])
+        m["threshold"] = d.get("threshold") or m["threshold"]
+    return {"models": dict(sorted(models.items())),
+            "alerts": len(drift_ev)}
+
+
 def _registry_summary(artifact_ev: list[dict],
                       log_run_id) -> dict | None:
     """Reduce a run's artifact events for the report: one record per
@@ -499,6 +569,55 @@ def render_slo(summary: dict) -> str:
     return "\n".join(out)
 
 
+def render_drift(summary: dict) -> str:
+    """The `report drift` rollup: one row per model joining rolling-
+    window divergence (PSI / JS against the training reference) with
+    latched drift alerts, plus one champion/challenger line per
+    shadowed model (docs/OBSERVABILITY.md "Drift observatory"). Absent
+    values — a pre-drift window's divergence, an alert-only model's
+    window stats — render `-`, never an error. Raises ValueError when
+    the log carries no drift signal at all (no drift events, no
+    drift/shadow window extras)."""
+    dr = summary.get("drift")
+    if not dr:
+        raise ValueError(
+            "log carries no drift data (no drift events and no "
+            "drift_*/shadow_* extras on serve_latency windows) — did "
+            "this fleet serve an artifact with a training reference "
+            "histogram (drift_reference)?")
+
+    def f(v) -> str:
+        return f"{v:>8.4f}" if v is not None else f"{'-':>8}"
+
+    out = [f"drift: {len(dr['models'])} model(s), "
+           f"{dr['alerts']} alert(s)"]
+    out.append(
+        f"  {'model':<12} {'psi_max':>8} {'worst':>8} {'js_max':>8} "
+        f"{'win':>4} {'reqs':>7} {'alerts':>6} {'state':<8} feature")
+    for name, m in dr["models"].items():
+        state = "ALERTING" if m["alerting"] else "ok"
+        feat = m["worst_feature"] if m["worst_feature"] is not None \
+            else "-"
+        out.append(
+            f"  {name:<12} {f(m['psi_max'])} {f(m['worst_psi_max'])} "
+            f"{f(m['js_max'])} {m['windows']:>4} {m['requests']:>7} "
+            f"{m['alerts']:>6} {state:<8} {feat}")
+    for name, m in dr["models"].items():
+        sh = m.get("shadow")
+        if not sh:
+            continue
+        diff = (f"mean_abs_diff={sh['mean_abs_diff']:.6f}"
+                if sh.get("mean_abs_diff") is not None
+                else "mean_abs_diff=-")
+        p50 = (f"p50={sh['ms_p50']:.3f} ms"
+               if sh.get("ms_p50") is not None else "p50=-")
+        out.append(
+            f"  shadow {sh['model']} -> {name}: "
+            f"rows={sh.get('rows') or 0}  {diff}  {p50}  "
+            f"dropped={sh['dropped']}")
+    return "\n".join(out)
+
+
 def render(summary: dict) -> str:
     """Terminal rendering of summarize()'s dict."""
     out: list[str] = []
@@ -603,6 +722,9 @@ def render(summary: dict) -> str:
 
     if summary.get("slo"):
         out.append(render_slo(summary))
+
+    if summary.get("drift"):
+        out.append(render_drift(summary))
 
     if summary.get("registry"):
         r = summary["registry"]

@@ -45,7 +45,18 @@ One process, five assertions:
    response headers (with a full five-stage timing breakdown) and the
    `/debug/requests` ring, and the tracing-overhead A/B: saturated
    p99 with request traces ON (the default) within 1.1x of
-   `--no-request-traces` (min-of-3 measured windows per side).
+   `--no-request-traces` (min-of-3 measured windows per side);
+9. (ISSUE 19 drift arm) the drift observatory end to end: a registry
+   fleet of a drift-tracked champion (+ a shadow challenger) and an
+   un-shifted control model, stormed with covariate-shifted binned
+   traffic — the `/metrics` drift series MOVE between scrapes
+   (absent under MIN_ROWS, present and alerting after the shifted
+   storm), exactly the shifted model fires the latched `drift` event
+   and the `report drift` breach row while the control stays quiet,
+   the challenger scores the champion's own traffic off the response
+   path, and the drift+shadow overhead A/B holds saturated p99
+   within 1.1x of the same fleet with drift off (interleaved
+   min-of-3 windows per side).
 
 Exit 0 = all hold.
 """
@@ -581,6 +592,150 @@ def main() -> int:
         f"request tracing costs too much at saturation: p99 "
         f"{p99_traced:.2f} ms traced vs {p99_untraced:.2f} ms with "
         f"--no-request-traces (> 1.1x)")
+
+    # --- ISSUE 19 drift arm: registry fleet, covariate-shifted storm,
+    # moving /metrics series, latched drift event + report breach row,
+    # shadow challenger, and the drift+shadow overhead A/B.
+    from ddt_tpu.serve import drift as serve_drift
+
+    shifted = X + 5.0 * np.abs(X).max(axis=0)    # off every bin edge
+    with tempfile.TemporaryDirectory() as td:
+        reg = os.path.join(td, "registry")
+        drift_log = os.path.join(td, "drift.jsonl")
+        push_servable(reg, api.ModelBundle(ensemble=res_a.ensemble,
+                                           mapper=res_a.mapper),
+                      name="shifty", max_batch=64, quantize=False)
+        push_servable(reg, api.ModelBundle(ensemble=res_b.ensemble,
+                                           mapper=res_b.mapper),
+                      name="steady", max_batch=64, quantize=False)
+        engine_d = build_fleet(
+            [FleetSpec(name="shifty", ref="shifty@latest", max_batch=64),
+             FleetSpec(name="steady", ref="steady@latest", max_batch=64),
+             FleetSpec(name="shade", ref="steady@latest", max_batch=64,
+                       shadow_of="shifty")],
+            registry=reg, backend="tpu", max_wait_ms=2.0,
+            run_log=drift_log)
+        ready_d = threading.Event()
+        th_d = threading.Thread(
+            target=serve_forever, args=(engine_d,),
+            kwargs=dict(port=0, ready_event=ready_d), daemon=True)
+        th_d.start()
+        assert ready_d.wait(60), "drift-arm server never came up"
+        pd = engine_d.http_port
+
+        def storm(name, rows, total, width=100):
+            errs_d = []
+
+            def w(i):
+                lo = (i * width) % len(rows)
+                try:
+                    _post(pd, f"/models/{name}/predict",
+                          {"rows": rows[lo:lo + width].tolist()})
+                except Exception as e:   # noqa: BLE001 — smoke verdict
+                    errs_d.append((i, repr(e)))
+
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                list(pool.map(w, range(total // width)))
+            assert not errs_d, f"drift-arm storm failures: {errs_d[:5]}"
+
+        def drift_series(name):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{pd}/metrics", timeout=60) as r:
+                parsed = parse_exposition(r.read().decode())
+            key = frozenset({("model", name)})
+            return {s: v[key] for s, v in parsed.items()
+                    if s.startswith("ddt_drift_") and key in v}
+
+        # Scrape 1: under MIN_ROWS the divergence gauges are ABSENT
+        # (omit-don't-lie), only the bookkeeping series render.
+        storm("shifty", shifted, serve_drift.MIN_ROWS // 2)
+        s1 = drift_series("shifty")
+        assert "ddt_drift_psi_max" not in s1, s1
+        assert s1["ddt_drift_alerting"] == 0.0, s1
+        # Scrape 2 after the full shifted storm: the series MOVED —
+        # divergence appears, the alert latched, the counter bumped.
+        storm("shifty", shifted, 2 * serve_drift.MIN_ROWS)
+        storm("steady", X, 2 * serve_drift.MIN_ROWS)    # control
+        s2 = drift_series("shifty")
+        assert s2["ddt_drift_psi_max"] >= serve_drift.PSI_ALERT, s2
+        assert s2["ddt_drift_alerting"] == 1.0, s2
+        assert s2["ddt_drift_model_alerts_total"] == 1.0, s2
+        assert s2["ddt_drift_window_rows"] > s1["ddt_drift_window_rows"]
+        s_ctl = drift_series("steady")
+        assert s_ctl["ddt_drift_alerting"] == 0.0, s_ctl
+        assert s_ctl["ddt_drift_model_alerts_total"] == 0.0, s_ctl
+        out["drift_psi_max"] = s2["ddt_drift_psi_max"]
+
+        # /healthz + /debug/drift agree; the challenger scored the
+        # champion's own traffic off the response path.
+        h = _get(pd, "/healthz")
+        assert h["models"]["shifty"]["drift_alerting"] is True
+        assert h["models"]["steady"]["drift_alerting"] is False
+        dbg = _get(pd, "/debug/drift")
+        assert dbg["models"]["shifty"]["state"]["alerting"] is True
+        assert dbg["models"]["shifty"]["per_feature"][0]["psi"] >= \
+            serve_drift.PSI_ALERT
+        sh = h["models"]["shifty"]["shadow"]
+        assert sh["model"] == "shade" and sh["rows"] > 0, sh
+        out["shadow_rows"] = sh["rows"]
+
+        _post(pd, "/shutdown", {})
+        th_d.join(30)
+
+        # Run log: EXACTLY the shifted model fired the latched event;
+        # report drift renders its breach row, the control stays quiet.
+        events = tele_report.read_events(drift_log)
+        drift_ev = [e for e in events if e["event"] == "drift"]
+        assert [e["model_name"] for e in drift_ev] == ["shifty"], \
+            drift_ev
+        assert drift_ev[0]["psi_max"] >= serve_drift.PSI_ALERT
+        summary = tele_report.summarize(events)
+        dr = summary["drift"]["models"]
+        assert dr["shifty"]["alerts"] == 1 and dr["shifty"]["alerting"]
+        assert dr["steady"]["alerts"] == 0 and not dr["steady"]["alerting"]
+        row = tele_report.render_drift(summary)
+        assert "shifty" in row and "ALERTING" in row and "shade" in row
+        out["drift_events"] = len(drift_ev)
+
+    # Drift+shadow overhead A/B: the same artifact served with the
+    # observatory fully on (tracker + resident challenger) vs drift
+    # explicitly off — interleaved min-of-3 saturated windows, same
+    # discipline as the tracing A/B above.
+    with tempfile.TemporaryDirectory() as td_ab:
+        model_a = os.path.join(td_ab, "a.npz")
+        model_b = os.path.join(td_ab, "b.npz")
+        res_a.save(model_a)
+        res_b.save(model_b)
+        fleet_on = build_fleet(
+            [FleetSpec(name="solo", ref=model_a, max_batch=64),
+             FleetSpec(name="shade", ref=model_b, max_batch=64,
+                       shadow_of="solo")],
+            backend="tpu", max_wait_ms=2.0)
+        fleet_off = build_fleet(
+            [FleetSpec(name="solo", ref=model_a, max_batch=64,
+                       drift=False)],
+            backend="tpu", max_wait_ms=2.0)
+        sides_d = (("drift_on", fleet_on), ("drift_off", fleet_off))
+        for _, eng in sides_d:                       # warm both sides
+            _saturate(lambda rows: eng.predict(rows, model="solo",
+                                               timeout=60.0))
+            eng.window_summaries(reset=True)
+        best_d = {}
+        for _ in range(3):
+            for name, eng in sides_d:
+                _saturate(lambda rows: eng.predict(rows, model="solo",
+                                                   timeout=60.0))
+                p = eng.window_summaries(reset=True)["solo"]["p99_ms"]
+                best_d[name] = min(p, best_d.get(name, p))
+        fleet_on.close()
+        fleet_off.close()
+    p99_on, p99_off = best_d["drift_on"], best_d["drift_off"]
+    out["p99_drift_on_ms"] = p99_on
+    out["p99_drift_off_ms"] = p99_off
+    assert p99_on <= 1.1 * max(p99_off, 1.0), (
+        f"drift+shadow cost too much at saturation: p99 {p99_on:.2f} "
+        f"ms with the observatory on vs {p99_off:.2f} ms drift-off "
+        "(> 1.1x)")
 
     out["ok"] = True
     print(json.dumps(out))

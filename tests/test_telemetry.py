@@ -91,6 +91,11 @@ def test_runlog_emits_and_round_trips_every_event_type(tmp_path):
                      "total_ms": 3.37}],
             count=1, model_name="higgs", model_token="cafe" * 10,
             reason="on_demand"),
+        # Schema v5-additive (ISSUE 19 drift observatory): one latched
+        # divergence-alert transition from serve.drift.DriftTracker.
+        "drift": dict(psi_max=0.41, model_name="higgs", feature=3,
+                      js_max=0.22, psi_mean=0.11, window_rows=512,
+                      window_s=300.0, threshold=0.25, alerts=1),
         "run_end": dict(completed_rounds=2, wallclock_s=0.1),
     }
     assert set(payloads) == set(EVENT_FIELDS)   # exhaustive by contract

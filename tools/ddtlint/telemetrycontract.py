@@ -88,6 +88,9 @@ PINNED_REQUIRED = {
     # ISSUE 17 (serve-side operations plane): new kind, additive under
     # v5 — pinned at birth so its required set cannot silently grow.
     "serve_trace": frozenset({"traces"}),
+    # ISSUE 19 (drift observatory): new kind, additive under v5 —
+    # pinned at birth like serve_trace.
+    "drift": frozenset({"psi_max"}),
     "run_end": frozenset({"completed_rounds", "wallclock_s"}),
 }
 
