@@ -92,6 +92,15 @@ bigdata-smoke:
 registry-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/registry_smoke.py
 
+# Training-ops-plane smoke (docs/OBSERVABILITY.md "The training
+# operations plane"): a real `cli train --status-port` subprocess is
+# scraped twice MID-RUN over a live socket (strictly advancing round
+# counter, /metrics round-tripped through telemetry/exposition.py),
+# `report progress` renders its heartbeats, and the enabled/disabled
+# overhead is measured and bounded at 1.05x.
+train-ops-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/train_ops_smoke.py
+
 # Bench regression sentinel (docs/OBSERVABILITY.md): band every metric
 # of the newest BENCH_r*/MULTICHIP_r* artifact against the history
 # (median ± max(3*MAD, 20%)); exit 1 on an adverse excursion. Point a
@@ -104,4 +113,4 @@ native:
 
 .PHONY: lint lint-baseline lint-smoke tsan-audit test report trace-smoke \
 	profile-smoke kernel-smoke chaos-smoke serve-smoke registry-smoke \
-	bigdata-smoke benchwatch native
+	bigdata-smoke train-ops-smoke benchwatch native

@@ -155,6 +155,7 @@ def train(
     profile: bool = False,
     run_log=None,
     profiler_window=None,
+    status=None,
     **cfg_overrides,
 ) -> TrainResult:
     """Train a GBDT. `X` is float features (quantized here) unless
@@ -167,7 +168,11 @@ def train(
     analysis — rendered by `python -m ddt_tpu.cli report`
     (docs/OBSERVABILITY.md). `profiler_window` (a
     telemetry.profiler.CaptureWindow) captures a programmatic xprof trace
-    around a selected round range, cross-referenced into the manifest."""
+    around a selected round range, cross-referenced into the manifest.
+    `status` (a telemetry.statusd.TrainStatus) attaches the live
+    training operations plane — the trainer updates it at round
+    boundaries and `cli train --status-port` serves it over HTTP; None
+    (the default) keeps the trainer statusd-free entirely."""
     if isinstance(backend, str):
         cfg_overrides["backend"] = backend
         backend = None
@@ -236,6 +241,7 @@ def train(
         profile=profile,
         run_log=run_log,
         profiler_window=profiler_window,
+        status=status,
     )
     ens = driver.fit(
         Xb, np.asarray(y),

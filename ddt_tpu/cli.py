@@ -194,7 +194,7 @@ def _seeded_split(X, y, frac: float, seed: int):
     return X[tr], y[tr], X[va], y[va]
 
 
-def _train_streaming(args, X, y, cfg, encoder) -> int:
+def _train_streaming(args, X, y, cfg, encoder, status=None) -> int:
     """`train --stream-chunks=N | --stream-dir=D`: the BASELINE config-5
     path from the CLI. With --stream-dir, training streams npz shards
     from disk in O(chunk) host memory end to end (data.chunks); with
@@ -232,7 +232,7 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
     try:
         ens, history, mapper, rows, n_chunks, chunk_rows_max = \
             _stream_fit(args, X, y, cfg, cache_root, window,
-                        run_log=run_log)
+                        run_log=run_log, status=status)
     except NotImplementedError as e:   # e.g. feature-parallel streaming
         raise SystemExit(str(e)) from e
     finally:
@@ -275,7 +275,8 @@ def _train_streaming(args, X, y, cfg, encoder) -> int:
     return 0
 
 
-def _stream_fit(args, X, y, cfg, cache_root, window=None, run_log=None):
+def _stream_fit(args, X, y, cfg, cache_root, window=None, run_log=None,
+                status=None):
     """Chunk-source construction + fit_streaming for _train_streaming
     (separated so its caller's finally-cleanup wraps the WHOLE cache
     lifecycle). Returns (ens, history, mapper, rows, n_chunks,
@@ -423,7 +424,8 @@ def _stream_fit(args, X, y, cfg, cache_root, window=None, run_log=None):
                         device_chunk_cache=dev_cache,
                         run_log=run_log,
                         profile=args.profile,
-                        profiler_window=window)
+                        profiler_window=window,
+                        status=status)
     return ens, history, mapper, rows, n_chunks, chunk_rows_max
 
 
@@ -525,6 +527,15 @@ def main(argv: list[str] | None = None) -> int:
                          "(run manifest, per-round records, phase timings, "
                          "device counters; render with the `report` "
                          "subcommand — docs/OBSERVABILITY.md)")
+    tp.add_argument("--status-port", type=int, default=None,
+                    help="serve a read-only live training status daemon on "
+                         "127.0.0.1:<port> for the duration of the run "
+                         "(0 = ephemeral; the bound port is printed as a "
+                         "statusd JSON line at boot): GET /healthz "
+                         "(progress/ETA JSON), /metrics (Prometheus text), "
+                         "/debug/rounds (recent-round ring) — "
+                         "docs/OBSERVABILITY.md; no flag = zero overhead, "
+                         "nothing is imported or allocated")
     tp.add_argument("--subsample", type=float, default=1.0,
                     help="row fraction per boosting round (bagging)")
     tp.add_argument("--colsample-bytree", type=float, default=1.0,
@@ -829,6 +840,15 @@ def main(argv: list[str] | None = None) -> int:
              "champion/challenger shadow comparison "
              "(docs/OBSERVABILITY.md); fails loudly on a log with no "
              "drift data")
+    rsub.add_parser(
+        "progress",
+        help="render the training-progress rollup only: round reached "
+             "vs total, per-heartbeat pace (ms/round, rows/s) and the "
+             "last checkpoint round, from the schema-v5 train_heartbeat "
+             "events — built for logs of runs that DIED mid-round "
+             "(heartbeats land at checkpoint cadence, so the tail "
+             "survives a torn final line); fails loudly on a log with "
+             "no heartbeat data (docs/OBSERVABILITY.md)")
     dp = rsub.add_parser(
         "diff",
         help="align two run logs by phase and counter and flag adverse "
@@ -952,8 +972,29 @@ def main(argv: list[str] | None = None) -> int:
         )
         if file_cfg is not None:
             cfg = cfg.replace(**file_cfg)
+        # Live training status daemon (telemetry/statusd.py). Lazy import
+        # by design: without --status-port the statusd module is never
+        # imported and no status object exists — the train loops' hooks
+        # are all behind `is not None` (asserted in tests/test_statusd.py).
+        status = daemon = None
+        if args.status_port is not None:
+            from ddt_tpu.telemetry.statusd import (TrainStatus,
+                                                   start_statusd)
+
+            status = TrainStatus()
+            daemon = start_statusd(status, port=args.status_port)
+            # Boot line FIRST (flushed): with --status-port=0 the kernel
+            # picks the port, so scrapers read it from here.
+            print(json.dumps({"statusd": {"host": daemon.host,
+                                          "port": daemon.port}}),
+                  flush=True)
         if args.stream_chunks > 0 or args.stream_dir:
-            return _train_streaming(args, X, y, cfg, encoder)
+            try:
+                return _train_streaming(args, X, y, cfg, encoder,
+                                        status=status)
+            finally:
+                if daemon is not None:
+                    daemon.close()
         eval_set = None
         if args.valid_frac > 0:
             X, y, Xv, yv = _seeded_split(X, y, args.valid_frac, args.seed)
@@ -967,16 +1008,23 @@ def main(argv: list[str] | None = None) -> int:
 
             trace_ctx = trace(args.trace_dir)
         window = _capture_window(args)
-        with trace_ctx:
-            res = api.train(
-                X, y, cfg, checkpoint_dir=args.checkpoint_dir,
-                checkpoint_every=args.checkpoint_every,
-                eval_set=eval_set, eval_metric=args.metric,
-                early_stopping_rounds=args.early_stop,
-                profile=args.profile,
-                run_log=args.run_log,
-                profiler_window=window,
-            )
+        try:
+            with trace_ctx:
+                res = api.train(
+                    X, y, cfg, checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every,
+                    eval_set=eval_set, eval_metric=args.metric,
+                    early_stopping_rounds=args.early_stop,
+                    profile=args.profile,
+                    run_log=args.run_log,
+                    profiler_window=window,
+                    status=status,
+                )
+        finally:
+            # Daemon teardown is unconditional — a mid-fit death must not
+            # leave the listener thread holding the port.
+            if daemon is not None:
+                daemon.close()
         dt = time.perf_counter() - t0
         # Persist the COMPLETE artifact: ensemble + training-time BinMapper
         # (+ CategoricalEncoder) so predict never refits preprocessing on
@@ -1285,6 +1333,15 @@ def main(argv: list[str] | None = None) -> int:
                 out_text = tele_report.render_drift(summary)
                 if args.json:
                     out_text = json.dumps(summary["drift"])
+            elif getattr(args, "report_cmd", None) == "progress":
+                # `report --log L progress`: how far a (possibly dead)
+                # run got — heartbeat-round table + pace + last
+                # checkpoint (render_progress raises on a log with no
+                # train_heartbeat events — caught below into the clean
+                # SystemExit, same shape as `fleet`/`slo`/`drift`).
+                out_text = tele_report.render_progress(summary)
+                if args.json:
+                    out_text = json.dumps(summary["progress"])
             else:
                 out_text = (json.dumps(summary) if args.json
                             else tele_report.render(summary))

@@ -76,7 +76,7 @@ from ddt_tpu.telemetry import counters as tele_counters
 from ddt_tpu.telemetry.annotations import phase_ctx
 from ddt_tpu.telemetry.events import (
     PartitionRecorder, RoundRecorder, RunLog, comms_manifest_fields,
-    derive_run_id, emit_early_stop, finish_run_log)
+    derive_run_id, emit_early_stop, emit_train_heartbeat, finish_run_log)
 from ddt_tpu.utils import checkpoint
 from ddt_tpu.utils.profiling import PhaseTimer
 
@@ -131,6 +131,7 @@ class Driver:
         profile: bool = False,
         run_log: "RunLog | str | None" = None,
         profiler_window=None,
+        status=None,
     ):
         self.backend = backend
         self.cfg = cfg
@@ -168,6 +169,11 @@ class Driver:
         # Programmatic xprof capture window (telemetry/profiler.py), or
         # None — every hook below is behind an `is not None` check.
         self._window = profiler_window
+        # Live-ops status aggregate (telemetry/statusd.TrainStatus), or
+        # None — same gating contract as the window above: without
+        # `--status-port` the trainer holds no statusd state and every
+        # round-boundary hook is one `is not None` test (ISSUE 20).
+        self._status = status
 
     def _draw_colsample_mask(self, rnd: int, c: int, F: int) -> np.ndarray:
         """The per-(seed, round, class) colsample feature mask; the draw
@@ -195,6 +201,8 @@ class Driver:
         (telemetry.events.finish_run_log)."""
         if self.profile and self.timer is not None:
             self.timer.log_report(log)
+        if self._status is not None:
+            self._status.set_phase("done")
         finish_run_log(self.run_log, self.timer, counters_start,
                        completed_rounds,
                        round(time.perf_counter() - t0, 4),
@@ -306,7 +314,8 @@ class Driver:
         # differing only in, say, learning_rate must refuse to merge, so
         # no field may be left out.
         run_id = None
-        if self.run_log is not None or self._window is not None:
+        if self.run_log is not None or self._window is not None \
+                or self._status is not None:
             run_id = derive_run_id(
                 trainer="driver", rows=int(R), features=int(F),
                 **dataclasses.asdict(cfg))
@@ -316,6 +325,9 @@ class Driver:
         self.run_id = run_id
         if self._window is not None:
             self._window.bind(run_id)
+        if self._status is not None:
+            self._status.begin_run(run_id=run_id,
+                                   total_rounds=cfg.n_trees, rows=int(R))
         if self.run_log is not None:
             tele_counters.install_jax_listener()
             counters_start = tele_counters.snapshot()
@@ -657,6 +669,16 @@ class Driver:
             self._observe_straggler(rnd, part_rec.flush_round(rnd))
             if self._window is not None:      # xprof window: stop edge
                 self._window.round_end(rnd)
+            tele_counters.record_train_round()
+            if self._status is not None:      # live-ops plane (ISSUE 20)
+                # history only holds on-cadence records; off-cadence
+                # rounds get a fresh bare record for the /debug ring.
+                self._status.round_end(
+                    rnd, dt * 1e3,
+                    self.history[-1]
+                    if (self.history
+                        and self.history[-1].get("round") == rnd + 1)
+                    else RoundRecorder.make_record(rnd, dt * 1e3, None))
 
             if early_stopping_rounds is not None and self.best_round is None:
                 # NaN never compares greater, so a NaN-from-round-1 metric
@@ -694,6 +716,21 @@ class Driver:
                     pending = None
                 checkpoint.maybe_save(self.checkpoint_dir, ens, cfg,
                                       rnd + 1)
+                if self._status is not None:
+                    self._status.checkpoint_saved(rnd + 1)
+            # Liveness heartbeat at the checkpoint CADENCE, checkpoint
+            # directory or not (ISSUE 20): a SIGKILLed run's log ends at
+            # most one cadence past its last heartbeat, which is what
+            # `report progress` rolls up. No-op without a run log.
+            if self.run_log is not None and self.checkpoint_every >= 1 \
+                    and (rnd + 1) % self.checkpoint_every == 0:
+                emit_train_heartbeat(
+                    self.run_log, rnd=rnd, total_rounds=cfg.n_trees,
+                    checkpoint_round=(rnd + 1
+                                      if self.checkpoint_dir is not None
+                                      else None),
+                    ms_per_round=dt * 1e3,
+                    rows_per_s=(R / dt if dt > 0 else None))
             if self.checkpoint_every >= 1 \
                     and (rnd + 1) % self.checkpoint_every == 0 \
                     and self._wants_repartition():
@@ -891,6 +928,15 @@ class Driver:
                 self._recorder.record(
                     r, dt * 1e3 / K, val_score,
                     lambda k=k: float(losses[k]))
+                tele_counters.record_train_round()
+                if self._status is not None:  # live-ops plane (ISSUE 20)
+                    self._status.round_end(
+                        r, dt * 1e3 / K,
+                        self.history[-1]
+                        if (self.history
+                            and self.history[-1].get("round") == r + 1)
+                        else RoundRecorder.make_record(
+                            r, dt * 1e3 / K, None))
                 if early_stopping_rounds is not None:
                     if self.best_round is None:
                         raise ValueError(
@@ -916,5 +962,26 @@ class Driver:
             if rnd < cfg.n_trees:
                 checkpoint.maybe_save(self.checkpoint_dir, ens, cfg, rnd,
                                       self.checkpoint_every)
+                if self._status is not None \
+                        and self.checkpoint_dir is not None \
+                        and rnd % self.checkpoint_every == 0:
+                    self._status.checkpoint_saved(rnd)
+            # Heartbeat when this block CROSSED a cadence boundary: with
+            # a checkpoint dir, blocks break exactly at checkpoint_every
+            # boundaries (the K cap above) so these are the granular
+            # path's heartbeat rounds; without one, block ends are the
+            # only true round boundaries the fused dispatch has, so the
+            # heartbeat lands on the first block end past the mark.
+            if self.run_log is not None and self.checkpoint_every >= 1 \
+                    and (rnd // self.checkpoint_every
+                         > (rnd - K) // self.checkpoint_every):
+                emit_train_heartbeat(
+                    self.run_log, rnd=rnd - 1, total_rounds=cfg.n_trees,
+                    checkpoint_round=(rnd
+                                      if self.checkpoint_dir is not None
+                                      and rnd < cfg.n_trees
+                                      and rnd % self.checkpoint_every == 0
+                                      else None),
+                    ms_per_round=dt * 1e3 / K)
         checkpoint.maybe_save(self.checkpoint_dir, ens, cfg, cfg.n_trees)
         return ens

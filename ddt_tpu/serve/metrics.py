@@ -45,37 +45,22 @@ Series emitted:
 No HTTP, no locks, no engine imports — http.py collects the snapshots
 (each snapshot method does its own locking) and this module only
 formats. Host-side and dependency-free by design.
+
+The exposition primitives (label escaping, sample formatting,
+`render_counters`, and the `parse_exposition` test twin) live in
+`ddt_tpu/telemetry/exposition.py` since ISSUE 20 — ONE dialect shared
+with the training operations plane's statusd `/metrics` — and are
+re-exported here so existing importers are untouched. Only the
+serve-specific series (latency histograms, backlog, residency, SLO,
+drift, shadow) are rendered in this module.
 """
 
 from __future__ import annotations
 
+from ddt_tpu.telemetry.exposition import (_esc, _num, parse_exposition,
+                                          render_counters)
 
-def _esc(label: str) -> str:
-    """Escape a label value per the exposition format."""
-    return (str(label).replace("\\", "\\\\").replace("\n", "\\n")
-            .replace('"', '\\"'))
-
-
-def _num(v) -> str:
-    """Format a sample value: integers bare, floats as-is."""
-    if isinstance(v, bool):
-        return "1" if v else "0"
-    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
-        return str(int(v))
-    return repr(v) if isinstance(v, float) else str(v)
-
-
-def render_counters(counters: dict) -> "list[str]":
-    """Process counters -> one ``ddt_<name>_total`` series each."""
-    out = []
-    for key in sorted(counters):
-        v = counters[key]
-        if not isinstance(v, (int, float)) or isinstance(v, bool):
-            continue
-        name = f"ddt_{key}_total"
-        out.append(f"# TYPE {name} counter")
-        out.append(f"{name} {_num(v)}")
-    return out
+__all__ = ["render_counters", "render_metrics", "parse_exposition"]
 
 
 def _render_hist(model: str, hist: dict) -> "list[str]":
@@ -207,28 +192,3 @@ def render_metrics(counters: dict, snapshot: dict) -> str:
                 f'shadow="{_esc(sh["model"])}"}} '
                 f'{_num(sh.get("dropped", 0))}')
     return "\n".join(out) + "\n"
-
-
-def parse_exposition(text: str) -> dict:
-    """Inverse of render_metrics for tests and the smoke harness:
-    {series_name: {frozenset(label items) or (): value}}. Tolerates
-    comments and blank lines; not a general openmetrics parser."""
-    out: dict = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        name_part, _, value = line.rpartition(" ")
-        if "{" in name_part:
-            name, _, rest = name_part.partition("{")
-            labels = {}
-            for item in rest.rstrip("}").split(","):
-                if not item:
-                    continue
-                k, _, v = item.partition("=")
-                labels[k] = v.strip('"')
-            key = frozenset(labels.items())
-        else:
-            name, key = name_part, ()
-        out.setdefault(name, {})[key] = float(value)
-    return out

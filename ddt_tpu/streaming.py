@@ -47,7 +47,7 @@ from ddt_tpu.telemetry.annotations import phase_ctx
 from ddt_tpu.ops.grow import resolve_hist_subtraction
 from ddt_tpu.telemetry.events import (
     PartitionRecorder, RoundRecorder, RunLog, comms_manifest_fields,
-    derive_run_id, emit_early_stop, finish_run_log)
+    derive_run_id, emit_early_stop, emit_train_heartbeat, finish_run_log)
 from ddt_tpu.utils import checkpoint
 from ddt_tpu.utils.profiling import PhaseTimer
 
@@ -57,11 +57,15 @@ ChunkFn = Callable[[int], tuple[np.ndarray, np.ndarray]]
 
 
 def _emit_round(run_log: "RunLog | None", rnd: int, ms: float,
-                ev: "_StreamEval | None") -> None:
+                ev: "_StreamEval | None", status=None) -> None:
     """Streaming round event: ms + the round's eval score when tracked
     (train loss is deliberately absent — computing it would cost an extra
-    full pass over the chunks)."""
-    if run_log is None:
+    full pass over the chunks). Also the streamed loops' round-boundary
+    progress hook: bumps the train_rounds counter and, when a live
+    TrainStatus is attached (cli --status-port), pushes the round into
+    its rolling window/ring."""
+    tele_counters.record_train_round()
+    if run_log is None and status is None:
         return
     val_score = None
     if ev is not None and ev.history:
@@ -71,7 +75,10 @@ def _emit_round(run_log: "RunLog | None", rnd: int, ms: float,
     rec = RoundRecorder.make_record(rnd, ms, None,
                                     ev.metric if ev is not None else None,
                                     val_score)
-    run_log.emit("round", **rec)
+    if run_log is not None:
+        run_log.emit("round", **rec)
+    if status is not None:
+        status.round_end(rnd, ms, rec)
 
 
 def validate_mapper_config(mapper, cfg: TrainConfig) -> None:
@@ -431,6 +438,7 @@ def fit_streaming(
     run_log: "RunLog | str | None" = None,
     profile: bool = False,
     profiler_window=None,
+    status=None,
 ) -> TreeEnsemble:
     """Train a GBDT over streamed chunks — see _fit_streaming_impl
     directly below for the full contract (validation, checkpointing,
@@ -480,7 +488,7 @@ def fit_streaming(
             early_stopping_rounds=early_stopping_rounds, history=history,
             device_chunk_cache=device_chunk_cache, run_log=run_log,
             profile=profile, cost_collector=cost,
-            profiler_window=profiler_window)
+            profiler_window=profiler_window, status=status)
     finally:
         costmodel.deactivate(cost)
         if profiler_window is not None:
@@ -510,6 +518,7 @@ def _fit_streaming_impl(
     profile: bool = False,
     cost_collector=None,
     profiler_window=None,
+    status=None,
 ) -> TreeEnsemble:
     """Train a GBDT over `n_chunks` streamed chunks.
 
@@ -656,12 +665,19 @@ def _fit_streaming_impl(
     # it (the FULL config feeds it so sweep points differing in any
     # field refuse to merge).
     run_id = None
-    if run_log is not None or profiler_window is not None:
+    if (run_log is not None or profiler_window is not None
+            or status is not None):
         run_id = derive_run_id(
             trainer=trainer_name, rows=int(y_cnt), features=int(F),
             n_chunks=n_chunks, **dataclasses.asdict(cfg))
     if profiler_window is not None:
         profiler_window.bind(run_id)
+    if status is not None:
+        # Live status daemon (telemetry/statusd.py) — seed the run
+        # identity/denominators before round 0 so the first scrape
+        # already answers "which run, how far along".
+        status.begin_run(run_id=run_id, total_rounds=cfg.n_trees,
+                         rows=int(y_cnt))
     if run_log is not None:
         run_log.run_id = run_id
         run_log.emit(
@@ -711,6 +727,8 @@ def _fit_streaming_impl(
         closes path-built logs)."""
         if profile and timer is not None:
             timer.log_report(log)
+        if status is not None:
+            status.set_phase("done")
         finish_run_log(run_log, timer, counters_start, e.n_trees // C,
                        round(time.perf_counter() - t_fit0, 4),
                        partitions=part_rec, costs=cost_collector)
@@ -756,7 +774,7 @@ def _fit_streaming_impl(
             checkpoint_every=checkpoint_every, ev=ev,
             device_chunk_cache=device_chunk_cache,
             ph=ph, run_log=run_log, part_rec=part_rec,
-            window=profiler_window, watchdog=watchdog))
+            window=profiler_window, watchdog=watchdog, status=status))
 
     # The ONE optional O(R·C) structure: per-chunk cached raw scores (4C
     # bytes/row). cache_preds=False recomputes scores from the partial
@@ -968,8 +986,8 @@ def _fit_streaming_impl(
                         else:
                             val_preds[c] += dv
                 stop = ev.record(rnd, np.concatenate(val_preds))
-        _emit_round(run_log, rnd, (time.perf_counter() - t_round) * 1e3,
-                    ev)
+        dt_ms = (time.perf_counter() - t_round) * 1e3
+        _emit_round(run_log, rnd, dt_ms, ev, status=status)
         if profiler_window is not None:       # xprof window: stop edge
             profiler_window.round_end(rnd)
         if stop:
@@ -987,6 +1005,14 @@ def _fit_streaming_impl(
         log.info("streaming: round %d/%d done", rnd + 1, cfg.n_trees)
         checkpoint.maybe_save(checkpoint_dir, ens, cfg, rnd + 1,
                               checkpoint_every)
+        if checkpoint_every >= 1 and (rnd + 1) % checkpoint_every == 0:
+            if status is not None and checkpoint_dir is not None:
+                status.checkpoint_saved(rnd + 1)
+            emit_train_heartbeat(
+                run_log, rnd=rnd, total_rounds=cfg.n_trees,
+                checkpoint_round=(rnd + 1 if checkpoint_dir is not None
+                                  else None),
+                ms_per_round=dt_ms)
 
     checkpoint.maybe_save(checkpoint_dir, ens, cfg, cfg.n_trees)
     return _finish(ens)
@@ -1028,6 +1054,7 @@ def _fit_streaming_device(
     part_rec: "PartitionRecorder | None" = None,
     window=None,
     watchdog=None,
+    status=None,
 ) -> TreeEnsemble:
     """Device streaming loop: see fit_streaming. Per tree it makes
     max_depth histogram passes + 1 leaf pass (+ 1 pred-update pass between
@@ -1327,8 +1354,8 @@ def _fit_streaming_device(
                     scores.append(np.asarray(val_pred[c])[: ev.lens[c]])
             with ph("eval"):
                 stop = ev.record(rnd, np.concatenate(scores))
-        _emit_round(run_log, rnd, (time.perf_counter() - t_round) * 1e3,
-                    ev)
+        dt_ms = (time.perf_counter() - t_round) * 1e3
+        _emit_round(run_log, rnd, dt_ms, ev, status=status)
         if window is not None:                # xprof window: stop edge
             window.round_end(rnd)
         if watchdog is not None:
@@ -1354,6 +1381,14 @@ def _fit_streaming_device(
         log.info("streaming: round %d/%d done", rnd + 1, cfg.n_trees)
         checkpoint.maybe_save(checkpoint_dir, ens, cfg, rnd + 1,
                               checkpoint_every)
+        if checkpoint_every >= 1 and (rnd + 1) % checkpoint_every == 0:
+            if status is not None and checkpoint_dir is not None:
+                status.checkpoint_saved(rnd + 1)
+            emit_train_heartbeat(
+                run_log, rnd=rnd, total_rounds=cfg.n_trees,
+                checkpoint_round=(rnd + 1 if checkpoint_dir is not None
+                                  else None),
+                ms_per_round=dt_ms)
         if (watchdog is not None and cfg.straggler_repartition
                 and watchdog.pending_repartition
                 and checkpoint_every >= 1

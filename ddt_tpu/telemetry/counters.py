@@ -120,6 +120,17 @@ _c = {
     # worst feature; this counter is the process-lifetime total the
     # /metrics exposition and report diff read.
     "drift_alerts": 0,
+    # Training rounds completed process-wide (ISSUE 20): one tick per
+    # boosted round across every trainer path (Driver granular + fused,
+    # streamed host + device loops). The live-ops plane's primary
+    # liveness signal — statusd's /metrics renders it as
+    # ddt_train_rounds_total, and the smoke harness asserts it strictly
+    # advances between two mid-run scrapes.
+    "train_rounds": 0,
+    # train_heartbeat events emitted (ISSUE 20): one per checkpoint
+    # cadence boundary on runs with a run log — the post-mortem
+    # liveness trail a SIGKILLed run leaves behind (report progress).
+    "train_heartbeats": 0,
 }
 _listener_installed = False
 # When truthy, the compile listener drops events: the cost observatory's
@@ -228,6 +239,14 @@ def record_grad_stream(nbytes: int) -> None:
 
 def record_grad_quant_round(n: int = 1) -> None:
     _c["grad_quant_rounds"] += int(n)
+
+
+def record_train_round(n: int = 1) -> None:
+    _c["train_rounds"] += int(n)
+
+
+def record_train_heartbeat() -> None:
+    _c["train_heartbeats"] += 1
 
 
 def snapshot() -> dict:

@@ -71,6 +71,12 @@ COUNTER_DIRECTIONS: dict[str, str] = {
     "fleet_evictions": "neutral",
     "fleet_reloads": "neutral",
     "grad_quant_rounds": "neutral",
+    # Training operations plane (ISSUE 20): rounds completed and
+    # heartbeats emitted track the run's configured shape (n_trees,
+    # checkpoint cadence), not its quality — a longer run must never
+    # read as a regression, so both are "neutral".
+    "train_rounds": "neutral",
+    "train_heartbeats": "neutral",
 }
 
 #: flag floor for near-zero baselines (a 0 -> 3 ms phase is noise, a

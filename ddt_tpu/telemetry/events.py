@@ -67,6 +67,15 @@ import time
 # champion/challenger comparison from a log). Pre-drift logs remain
 # readable and render exactly as before (tests/test_drift.py pins the
 # mixed-era report).
+# ISSUE 20 extras (schema-ADDITIVE, no version bump — the training
+# operations plane): the `train_heartbeat` event, emitted by every
+# trainer path at checkpoint cadence when a run log is attached (round
+# required; total_rounds, checkpoint_round, ms_per_round, rows_per_s as
+# extras) so a SIGKILLed run is diagnosable from its log's last
+# heartbeat (`report progress`), plus the train_rounds /
+# train_heartbeats process counters statusd's live /metrics exposition
+# reads. Pre-heartbeat logs remain readable and render exactly as
+# before (tests/test_statusd.py pins the mixed-era report).
 SCHEMA_VERSION = 5
 
 #: event type -> REQUIRED payload fields (extras are allowed and common:
@@ -159,6 +168,14 @@ EVENT_FIELDS: dict[str, set] = {
     # Absent from pre-drift logs; report ignores unknown-to-it events
     # by construction.
     "drift": {"psi_max"},
+    # Training-liveness heartbeat (ISSUE 20, schema-additive): one per
+    # checkpoint cadence boundary on runs with a run log, from every
+    # trainer path (Driver granular + fused, streamed host + device).
+    # `round` is the 1-based count of completed rounds at emit time;
+    # extras carry the configured total, the latest checkpoint round,
+    # and the rolling rate — the post-mortem trail `report progress`
+    # rolls up when a run dies between heartbeats.
+    "train_heartbeat": {"round"},
     # Last record of a completed run.
     "run_end": {"completed_rounds", "wallclock_s"},
 }
@@ -212,6 +229,7 @@ EVENT_EXTRAS: dict[str, tuple] = {
         "serve_express", "fleet_evictions", "fleet_reloads",
         "slo_breaches", "drift_alerts",
         "grad_stream_bytes_est", "grad_quant_rounds",
+        "train_rounds", "train_heartbeats",
         "device_peak_bytes", "host_peak_rss_bytes",
     ),
     "cost_analysis": ("phase", "calls", "platform", "signature",
@@ -231,6 +249,12 @@ EVENT_EXTRAS: dict[str, tuple] = {
                       "shadow_mean_abs_diff", "shadow_ms_p50",
                       "shadow_dropped"),
     "serve_trace": ("model_name", "model_token", "reason", "count"),
+    # Training heartbeats (ISSUE 20): the run's configured round total,
+    # the last checkpoint boundary crossed, and the rolling rate at
+    # emit time — everything `report progress` needs to place a
+    # mid-run death between two cadence marks.
+    "train_heartbeat": ("total_rounds", "checkpoint_round",
+                        "ms_per_round", "rows_per_s"),
     # Drift alert transitions (ISSUE 19): the model dimension, worst-
     # feature attribution, companion Jensen-Shannon score, window shape,
     # and the alert threshold that was crossed.
@@ -380,6 +404,31 @@ def finish_run_log(run_log: "RunLog | None", timer, counters_start,
     run_log.emit("counters", **d)
     run_log.emit("run_end", completed_rounds=completed_rounds,
                  wallclock_s=wallclock_s)
+
+
+def emit_train_heartbeat(run_log, *, rnd, total_rounds,
+                         checkpoint_round=None, ms_per_round=None,
+                         rows_per_s=None) -> None:
+    """One heartbeat at a checkpoint-cadence boundary — the ONE emit
+    home shared by every trainer path (Driver granular + fused,
+    streamed host + device loops) so the record shape cannot drift.
+    `rnd` is 0-based (the loop variable); the event's `round` is the
+    1-based completed count, matching `round` records. No-op without a
+    run log (the disabled-telemetry contract)."""
+    if run_log is None:
+        return
+    from ddt_tpu.telemetry import counters as tele_counters
+
+    tele_counters.record_train_heartbeat()
+    extras = {}
+    if checkpoint_round is not None:
+        extras["checkpoint_round"] = checkpoint_round
+    if ms_per_round is not None:
+        extras["ms_per_round"] = round(float(ms_per_round), 3)
+    if rows_per_s is not None:
+        extras["rows_per_s"] = round(float(rows_per_s), 1)
+    run_log.emit("train_heartbeat", round=rnd + 1,
+                 total_rounds=total_rounds, **extras)
 
 
 def comms_manifest_fields(backend) -> dict:

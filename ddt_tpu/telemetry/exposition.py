@@ -1,0 +1,77 @@
+"""Prometheus text-exposition primitives shared by train and serve.
+
+ONE dialect home (ISSUE 20): the writer helpers and the parser test
+twin used by every `/metrics` endpoint in the system — the serve tier's
+(`serve/metrics.py`, ISSUE 17) and the training operations plane's
+(`telemetry/statusd.py`). Factored out of `serve/metrics.py` verbatim
+so the two planes cannot drift into different escaping/formatting
+rules; `serve/metrics.py` re-exports them, so its import surface is
+unchanged.
+
+Format is the Prometheus text exposition, version 0.0.4. STRICTLY
+READ-ONLY semantics ride with every consumer: rendering never mutates
+the counters it is handed.
+
+No HTTP, no locks, no engine or trainer imports — callers collect the
+snapshots and this module only formats. Host-side and dependency-free
+by design.
+"""
+
+from __future__ import annotations
+
+#: the Content-Type every /metrics endpoint sends (serve/http.py and
+#: statusd both): Prometheus scrapers key on the version token.
+EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _esc(label: str) -> str:
+    """Escape a label value per the exposition format."""
+    return (str(label).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _num(v) -> str:
+    """Format a sample value: integers bare, floats as-is."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def render_counters(counters: dict) -> "list[str]":
+    """Process counters -> one ``ddt_<name>_total`` series each."""
+    out = []
+    for key in sorted(counters):
+        v = counters[key]
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        name = f"ddt_{key}_total"
+        out.append(f"# TYPE {name} counter")
+        out.append(f"{name} {_num(v)}")
+    return out
+
+
+def parse_exposition(text: str) -> dict:
+    """Inverse of the renderers for tests and the smoke harness:
+    {series_name: {frozenset(label items) or (): value}}. Tolerates
+    comments and blank lines; not a general openmetrics parser."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value = line.rpartition(" ")
+        if "{" in name_part:
+            name, _, rest = name_part.partition("{")
+            labels = {}
+            for item in rest.rstrip("}").split(","):
+                if not item:
+                    continue
+                k, _, v = item.partition("=")
+                labels[k] = v.strip('"')
+            key = frozenset(labels.items())
+        else:
+            name, key = name_part, ()
+        out.setdefault(name, {})[key] = float(value)
+    return out

@@ -22,7 +22,12 @@ merge — a single-host log is pid 0):
   documented here and in docs/OBSERVABILITY.md. Slice args carry the
   device id and the round's hist_allreduce payload estimate.
 - `phase_timings` / `counters` become instant events on the rounds
-  lane with their full payload in args (aggregates have no extent).
+  lane with their full payload in args (aggregates have no extent);
+  `train_heartbeat` rides the same lane (it summarizes the adjacent
+  round slices).
+- tid 999, "events": the catch-all lane — every run-log kind without a
+  dedicated mapping (drift, artifact, future schema additions) renders
+  here as an instant with its full payload, never a silent drop.
 
 Contract (tests/test_flight_recorder.py validates it field by field):
 every record has string `name`, `ph` in {X, i, M}, numeric `ts` >= 0
@@ -39,10 +44,20 @@ import json
 #: one metadata slot per aggregate event type on the rounds lane
 #: (cost_analysis since schema v3: the observatory's per-op records ride
 #: the export as instants so a trace viewer can read the cost model next
-#: to the lanes).
+#: to the lanes; train_heartbeat since ISSUE 20: the checkpoint-cadence
+#: progress pulse belongs next to the round slices it summarizes).
 _INSTANT_EVENTS = ("early_stop", "fault", "run_end", "phase_timings",
                    "serve_latency",
-                   "counters", "partition_skew", "cost_analysis")
+                   "counters", "partition_skew", "cost_analysis",
+                   "train_heartbeat")
+
+#: the catch-all lane (ISSUE 20): run-log kinds with no dedicated
+#: mapping — serve-era events like drift/artifact, and whatever schema
+#: additions come next — used to be DROPPED silently, so the trace
+#: looked complete while hiding whole subsystems. They now render as
+#: instants on one "events" lane. The tid is fixed and high so it never
+#: collides with the per-device partition lanes (tid 1+d).
+_MISC_TID = 999
 
 
 def _payload(rec: dict) -> dict:
@@ -120,6 +135,12 @@ def to_trace_events(events: list[dict]) -> dict:
             lane(pid, 0, "rounds")
             out.append({"name": ev, "ph": "i", "ts": ts(e["t"]), "s": "t",
                         "pid": pid, "tid": 0, "args": _payload(e)})
+            continue
+        # Unmapped kinds (drift, artifact, future schema additions):
+        # instants on the catch-all lane, never a silent drop.
+        lane(pid, _MISC_TID, "events")
+        out.append({"name": ev, "ph": "i", "ts": ts(e["t"]), "s": "t",
+                    "pid": pid, "tid": _MISC_TID, "args": _payload(e)})
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 

@@ -118,6 +118,11 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
         # count).
         ph_host = phase_ev[-1].get("host", 0)
         cost_ev = [e for e in cost_ev if e.get("host", 0) == ph_host]
+    hb_ev = [e for e in events if e["event"] == "train_heartbeat"]
+    if len(hosts) > 1:
+        # Same single-lane rule as the round curve: SPMD hosts emit
+        # identical heartbeats.
+        hb_ev = [e for e in hb_ev if e.get("host", 0) == hosts[0]]
     part_ev = [e for e in events if e["event"] == "partition_phases"]
     skew_ev = [e for e in events if e["event"] == "partition_skew"]
     cross_totals = (_cross_host_totals(part_ev)
@@ -220,6 +225,15 @@ def summarize(events: list[dict], slowest: int = 5) -> dict:
         "drift": _drift_summary(
             [e for e in events if e["event"] == "serve_latency"],
             [e for e in events if e["event"] == "drift"]),
+        # Training-progress rollup (ISSUE 20): how far the run got, from
+        # the checkpoint-cadence train_heartbeat events — the signal
+        # built for logs of runs that DIED mid-round (read_events
+        # tolerates the torn final line; the last intact heartbeat
+        # still places the run). None on logs without heartbeats, so
+        # every earlier log renders exactly as before. `cli report
+        # --log L progress` renders just this table.
+        "progress": _progress_summary(hb_ev, rounds, run_end,
+                                      manifest),
         # Registry provenance (schema v5): artifact push/load events,
         # each cross-referenced against THIS run's id when they carry
         # one — None on pre-v5 logs.
@@ -456,6 +470,44 @@ def _drift_summary(serve_ev: list[dict],
             "alerts": len(drift_ev)}
 
 
+def _progress_summary(hb_ev: list[dict], rounds: list[dict],
+                      run_end, manifest: dict) -> dict | None:
+    """Training-progress rollup (ISSUE 20): reduce the checkpoint-
+    cadence train_heartbeat events into "how far did this run get" —
+    the question asked about a run log whose process died mid-round
+    (no run_end, possibly a torn final line). The furthest round is the
+    max over heartbeats AND intact round records, so a run that died
+    between heartbeats is still placed as precisely as the log allows.
+    None when the log carries no heartbeats, so every pre-ISSUE-20 log
+    summarizes exactly as before."""
+    if not hb_ev:
+        return None
+    last_hb = max((h.get("round", 0) for h in hb_ev), default=0)
+    last_rec = max((r.get("round", 0) for r in rounds), default=0)
+    last_round = max(last_hb, last_rec)
+    total = (hb_ev[-1].get("total_rounds")
+             or manifest.get("n_trees"))
+    ckpt = next((h["checkpoint_round"] for h in reversed(hb_ev)
+                 if h.get("checkpoint_round") is not None), None)
+    return {
+        "heartbeats": len(hb_ev),
+        "last_round": last_round,
+        "total_rounds": total,
+        "pct": (round(100.0 * last_round / total, 1)
+                if total else None),
+        "last_checkpoint_round": ckpt,
+        # A run_end event means the epilogue ran — the run FINISHED
+        # (possibly early-stopped); its absence is the mid-run-death
+        # signal this rollup exists for.
+        "completed": run_end is not None,
+        "beats": [
+            {k: h.get(k) for k in ("round", "total_rounds",
+                                   "checkpoint_round", "ms_per_round",
+                                   "rows_per_s")}
+            for h in hb_ev],
+    }
+
+
 def _registry_summary(artifact_ev: list[dict],
                       log_run_id) -> dict | None:
     """Reduce a run's artifact events for the report: one record per
@@ -615,6 +667,42 @@ def render_drift(summary: dict) -> str:
             f"  shadow {sh['model']} -> {name}: "
             f"rows={sh.get('rows') or 0}  {diff}  {p50}  "
             f"dropped={sh['dropped']}")
+    return "\n".join(out)
+
+
+def render_progress(summary: dict) -> str:
+    """The `report progress` rollup: round reached vs total, the last
+    checkpoint round, and one row per heartbeat with its pace
+    (docs/OBSERVABILITY.md "Training operations plane"). Raises
+    ValueError on a log with no train_heartbeat events — the loud
+    failure `cli report progress` converts into a clean SystemExit."""
+    pg = summary.get("progress")
+    if not pg:
+        raise ValueError(
+            "log carries no training heartbeat data (no "
+            "train_heartbeat events) — heartbeats are emitted at "
+            "checkpoint cadence by schema-v5+ training runs; was this "
+            "log written by an older run, or did the run die before "
+            "the first checkpoint boundary?")
+    state = "completed" if pg["completed"] else "DIED MID-RUN"
+    total = pg["total_rounds"]
+    pct = f" ({pg['pct']:.1f}%)" if pg.get("pct") is not None else ""
+    ckpt = (str(pg["last_checkpoint_round"])
+            if pg.get("last_checkpoint_round") is not None else "-")
+    out = [
+        f"progress: round {pg['last_round']}/{total or '?'}{pct}  "
+        f"[{state}]  heartbeats={pg['heartbeats']}  "
+        f"last_checkpoint={ckpt}"]
+    out.append(
+        f"  {'round':>6} {'ms/round':>9} {'rows/s':>10} {'ckpt':>5}")
+    for h in pg["beats"]:
+        ms = (f"{h['ms_per_round']:>9.1f}"
+              if h.get("ms_per_round") is not None else f"{'-':>9}")
+        rps = (f"{h['rows_per_s']:>10.1f}"
+               if h.get("rows_per_s") is not None else f"{'-':>10}")
+        ck = (str(h["checkpoint_round"])
+              if h.get("checkpoint_round") is not None else "-")
+        out.append(f"  {h.get('round', 0):>6} {ms} {rps} {ck:>5}")
     return "\n".join(out)
 
 

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Training operations plane smoke (ISSUE 20, docs/OBSERVABILITY.md
+"The training operations plane"): a REAL `cli train --status-port`
+subprocess is scraped twice mid-run over a live socket, witnessing
+
+1. the statusd boot line and a strictly advancing round counter across
+   the two scrapes (live progress, not a post-hoc summary);
+2. the /metrics exposition round-tripping through the shared parser
+   (telemetry/exposition.py), with the train-plane series present;
+3. `report progress` rendering the run's heartbeats from its run log;
+4. the zero-overhead contract, measured on a clean (unscraped) pair:
+   --status-port enabled vs disabled, same config, must land within
+   1.05x of each other (compile time excluded via each run log's own
+   counters — compile noise would otherwise dwarf the signal).
+
+`make train-ops-smoke` runs this. Exit 0 iff all four hold.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROWS, TREES = 4001, 14
+
+
+def _train_args(out, log=None, status_port=None):
+    args = [sys.executable, "-m", "ddt_tpu.cli", "train",
+            "--backend=tpu", "--dataset=higgs", f"--rows={ROWS}",
+            f"--trees={TREES}", "--depth=3", "--bins=31",
+            "--fused-block-rounds=1", "--checkpoint-every=4",
+            f"--out={out}"]
+    if log is not None:
+        args.append(f"--run-log={log}")
+    if status_port is not None:
+        args.append(f"--status-port={status_port}")
+    return args
+
+
+def _scrape(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode("utf-8")
+
+
+def _run_train(args, env, tag):
+    """Run one train subprocess, retrying the environment's pre-existing
+    ~10% teardown segfault (SIGSEGV in the import machinery during the
+    final save_model, AFTER training and the run log complete — happens
+    on plain `cli train` with no smoke harness involved at all). A
+    retry is loud; a persistent failure still fails the smoke."""
+    for attempt in range(3):
+        r = subprocess.run(args, stdout=subprocess.DEVNULL,
+                           stderr=subprocess.PIPE, text=True, env=env,
+                           timeout=600)
+        if r.returncode == 0:
+            return True
+        print(f"train-ops smoke: {tag} train attempt {attempt + 1} "
+              f"died rc={r.returncode} (known infra flake if -11); "
+              f"retrying", file=sys.stderr)
+    print(f"train-ops smoke: {tag} train failed 3 times:\n"
+          f"{r.stderr[-2000:]}", file=sys.stderr)
+    return False
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    env = dict(os.environ, PYTHONUNBUFFERED="1")
+    from ddt_tpu.cli import main as cli_main
+    from ddt_tpu.telemetry import report
+    from ddt_tpu.telemetry.diffing import COUNTER_DIRECTIONS
+    from ddt_tpu.telemetry.exposition import parse_exposition
+
+    # The new counters must be registered for diffing before anything
+    # else is worth measuring — an unregistered counter silently
+    # vanishes from `report diff`.
+    for name in ("train_rounds", "train_heartbeats"):
+        if name not in COUNTER_DIRECTIONS:
+            print(f"train-ops smoke: {name} missing from "
+                  "COUNTER_DIRECTIONS", file=sys.stderr)
+            return 1
+
+    with tempfile.TemporaryDirectory(prefix="ddt_ops_smoke_") as td:
+
+        # ---- enabled run: live subprocess, scraped mid-run ---------- #
+        # Retried like _run_train: the environment's teardown segfault
+        # can also kill this child at exit, after the scrapes and the
+        # run log have already succeeded.
+        log = rounds_seen = health = wall_on = None
+        for attempt in range(3):
+            log = os.path.join(td, f"run_{attempt}.jsonl")
+            t0 = time.perf_counter()
+            proc = subprocess.Popen(
+                _train_args(os.path.join(td, "on.npz"), log=log,
+                            status_port=0),
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=env)
+            boot = json.loads(proc.stdout.readline())
+            port = boot["statusd"]["port"]
+            print(json.dumps({"statusd_boot": boot["statusd"]}))
+
+            rounds_seen = []
+            deadline = time.time() + 300
+            while time.time() < deadline and proc.poll() is None:
+                try:
+                    series = parse_exposition(_scrape(port, "/metrics"))
+                    rnd = series.get("ddt_train_round", {}).get(())
+                    if rnd and (not rounds_seen
+                                or rnd > rounds_seen[-1]):
+                        rounds_seen.append(rnd)
+                    if len(rounds_seen) >= 2 and rnd < TREES:
+                        break  # two live MID-RUN scrapes, advancing
+                except OSError:
+                    pass
+                time.sleep(0.05)
+            if len(rounds_seen) < 2:
+                print(f"train-ops smoke: never saw two advancing "
+                      f"mid-run scrapes (saw {rounds_seen})",
+                      file=sys.stderr)
+                proc.kill()
+                return 1
+            health = json.loads(_scrape(port, "/healthz"))
+            if health.get("round", 0) < rounds_seen[0]:
+                print("train-ops smoke: /healthz disagrees with "
+                      "/metrics", file=sys.stderr)
+                proc.kill()
+                return 1
+            proc.stdout.read()
+            rc = proc.wait(timeout=300)
+            wall_on = time.perf_counter() - t0
+            if rc == 0:
+                break
+            print(f"train-ops smoke: scraped train attempt "
+                  f"{attempt + 1} died rc={rc} (known infra flake if "
+                  f"-11); retrying", file=sys.stderr)
+        else:
+            print("train-ops smoke: scraped train failed 3 times",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps({"mid_run_rounds_seen": rounds_seen,
+                          "healthz_round": health["round"]}))
+
+        # ---- the log side: heartbeats + report progress ------------- #
+        events = report.read_events(log)
+        hb = [e for e in events if e["event"] == "train_heartbeat"]
+        if not hb:
+            print("train-ops smoke: no train_heartbeat events",
+                  file=sys.stderr)
+            return 1
+        sm_on = report.summarize(events)
+        if cli_main(["report", f"--log={log}", "progress"]) != 0:
+            print("train-ops smoke: report progress failed",
+                  file=sys.stderr)
+            return 1
+
+        # ---- measured overhead bound -------------------------------- #
+        # The scraped run above is the LIVENESS witness, not the timing
+        # baseline — on a small box the harness's own polling loop
+        # contends with the child for CPU. Overhead is measured on a
+        # clean pair: --status-port enabled (daemon bound, hooks armed,
+        # nobody scraping) vs disabled, both with a run log, comparing
+        # each log's own in-process wallclock minus its own measured
+        # compile seconds (compile time dominates a tiny CPU run and
+        # varies run to run; leaving it in would drown the signal).
+        # Best-of-3 per side, alternating, because a 1-CPU box's
+        # scheduler adds ±20% run-to-run noise — the MIN is the run the
+        # OS interfered with least, which is the honest estimate of
+        # each configuration's intrinsic cost.
+        timings = {"on": [], "off": []}
+        for rep in range(3):
+            for tag, port in (("on", 0), ("off", None)):
+                tlog = os.path.join(td, f"timed_{tag}_{rep}.jsonl")
+                if not _run_train(
+                        _train_args(
+                            os.path.join(td, f"timed_{tag}_{rep}.npz"),
+                            log=tlog, status_port=port),
+                        env, f"timed {tag} rep {rep}"):
+                    return 1
+                sm = report.summarize(report.read_events(tlog))
+                compile_s = (sm["counters"].get("jit_compile_seconds")
+                             or 0.0)
+                timings[tag].append(
+                    max(0.1, (sm["wallclock_s"] or 0.0) - compile_s))
+
+        ratio = min(timings["on"]) / min(timings["off"])
+        print(json.dumps({
+            "overhead": {"train_s_on": round(min(timings["on"]), 3),
+                         "train_s_off": round(min(timings["off"]), 3),
+                         "samples_on": [round(t, 3)
+                                        for t in timings["on"]],
+                         "samples_off": [round(t, 3)
+                                         for t in timings["off"]],
+                         "scraped_run_wall_s": round(wall_on, 3),
+                         "ratio_compile_adjusted": round(ratio, 4)}}))
+        if ratio > 1.05:
+            print(f"train-ops smoke: enabled/disabled overhead "
+                  f"{ratio:.3f}x exceeds 1.05x", file=sys.stderr)
+            return 1
+
+        print(json.dumps({"smoke": "train-ops", "ok": True,
+                          "heartbeats": len(hb),
+                          "scrapes": len(rounds_seen)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -419,7 +419,8 @@ def test_serving_doc_thread_model_in_sync():
                 "ddt_tpu/serve/engine.py", "ddt_tpu/serve/fleet.py",
                 "ddt_tpu/serve/control.py", "ddt_tpu/serve/drift.py",
                 "ddt_tpu/serve/http.py", "ddt_tpu/serve/metrics.py",
-                "ddt_tpu/robustness/watchdog.py"):
+                "ddt_tpu/robustness/watchdog.py",
+                "ddt_tpu/telemetry/statusd.py"):
         sources[rel] = _read_repo(rel)
         trees[rel] = ast_mod.parse(sources[rel])
     model = threadmodel.build(trees, sources)

@@ -1,0 +1,368 @@
+"""Training operations plane (ISSUE 20): the live read-only status
+daemon (ddt_tpu/telemetry/statusd.py), the shared Prometheus exposition
+dialect it reuses (telemetry/exposition.py), the schema-additive
+train_heartbeat event, the zero-overhead-when-disabled contract, and
+`report progress` over a log whose run died mid-round. CPU platform,
+tier-1."""
+
+import json
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ddt_tpu import api
+from ddt_tpu.telemetry import counters as tele_counters
+from ddt_tpu.telemetry import report
+from ddt_tpu.telemetry.events import (
+    EVENT_FIELDS, SCHEMA_VERSION, RunLog, emit_train_heartbeat,
+    validate_event)
+from ddt_tpu.telemetry.exposition import (
+    EXPOSITION_CONTENT_TYPE, parse_exposition, render_counters)
+from ddt_tpu.telemetry.statusd import TrainStatus, start_statusd
+
+
+def _binary(rows, features=7, bins=29, seed=0):
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, bins, size=(rows, features), dtype=np.uint8)
+    y = (Xb[:, 0] > bins // 2).astype(np.float32)
+    return Xb, y
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5)
+
+
+# --------------------------------------------------------------------- #
+# the daemon: live socket sweep
+# --------------------------------------------------------------------- #
+def test_statusd_live_socket_sweep():
+    """All three endpoints answer over a real socket: /healthz carries
+    the progress snapshot (round i/N, rolling pace, ETA, checkpoint
+    age, counters, memory watermarks), /metrics parses through the
+    shared exposition parser with the train-plane series present, and
+    /debug/rounds mirrors the round-record ring. Unknown routes 404
+    with the route list."""
+    st = TrainStatus()
+    st.begin_run(run_id="deadbeef", total_rounds=10, rows=1000)
+    st.round_end(0, 20.0, {"round": 1, "ms_per_round": 20.0})
+    st.round_end(1, 10.0, {"round": 2, "ms_per_round": 10.0})
+    st.checkpoint_saved(2)
+    d = start_statusd(st, port=0)
+    try:
+        assert d.port > 0                      # bound before start() ran
+        h = json.load(_get(d.port, "/healthz"))
+        assert h["run_id"] == "deadbeef"
+        assert h["phase"] == "train"
+        assert (h["round"], h["total_rounds"], h["rows"]) == (2, 10, 1000)
+        assert h["ms_per_round"] == pytest.approx(15.0)
+        assert h["rows_per_s"] == pytest.approx(1000 / 0.015, rel=1e-3)
+        assert h["eta_s"] == pytest.approx(8 * 0.015, rel=1e-3)
+        assert h["last_checkpoint_round"] == 2
+        assert h["checkpoint_age_s"] >= 0
+        assert h["counters"]["fault_retries"] >= 0
+        assert "host_peak_rss_bytes" in h and "device_peak_bytes" in h
+
+        resp = _get(d.port, "/metrics")
+        assert resp.headers["Content-Type"] == EXPOSITION_CONTENT_TYPE
+        series = parse_exposition(resp.read().decode("utf-8"))
+        # Every process counter under the shared ddt_<name>_total
+        # naming, plus the train-plane gauges and the paper-facing
+        # hist-allreduce alias.
+        assert "ddt_train_rounds_total" in series
+        assert "ddt_hist_allreduce_bytes_total" in series
+        assert series["ddt_train_round"][()] == 2.0
+        assert series["ddt_train_total_rounds"][()] == 10.0
+        assert series["ddt_train_rows_per_s"][()] > 0
+        assert "ddt_train_checkpoint_age_seconds" in series
+        assert "ddt_host_peak_rss_bytes" in series
+
+        rr = json.load(_get(d.port, "/debug/rounds"))
+        assert rr["n"] == 2
+        assert [r["round"] for r in rr["rounds"]] == [1, 2]
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(d.port, "/nope")
+        assert ei.value.code == 404
+        assert "/healthz" in json.loads(ei.value.read())["routes"]
+    finally:
+        d.close()
+
+
+def test_statusd_scrape_is_strictly_read_only():
+    """The /metrics contract: scraping mutates NOTHING. Consecutive
+    scrapes with no trainer activity are identical (modulo the host-RSS
+    watermark, which the probe itself may legitimately raise), the
+    rolling window and ring are untouched, and the process counter
+    snapshot is unchanged — the /stats?emit=1 contrast."""
+    st = TrainStatus()
+    st.begin_run(run_id="r", total_rounds=4, rows=100)
+    st.round_end(0, 5.0, {"round": 1, "ms_per_round": 5.0})
+    d = start_statusd(st, port=0)
+    try:
+        before = tele_counters.snapshot()
+        a = _get(d.port, "/metrics").read()
+        b = _get(d.port, "/metrics").read()
+
+        def stable(body):
+            return [ln for ln in body.decode().splitlines()
+                    if not ln.startswith("ddt_host_peak_rss_bytes")]
+
+        assert stable(a) == stable(b)          # scrape #1 changed nothing
+        assert tele_counters.snapshot() == before
+        # The trainer-side state is untouched too: window still holds
+        # exactly one sample, ring exactly one record.
+        assert len(st._round_ms) == 1
+        assert len(st._ring) == 1
+        # /healthz and /debug/rounds are just as inert.
+        json.load(_get(d.port, "/healthz"))
+        json.load(_get(d.port, "/debug/rounds"))
+        assert tele_counters.snapshot() == before
+    finally:
+        d.close()
+
+
+def test_statusd_counters_monotone_across_scrapes():
+    """Round progress between scrapes is visible and monotone in BOTH
+    exposed forms: the ddt_train_rounds_total counter and the
+    ddt_train_round gauge never move backwards."""
+    st = TrainStatus()
+    st.begin_run(run_id="r", total_rounds=100, rows=10)
+    d = start_statusd(st, port=0)
+    try:
+        seen_counter, seen_gauge = [], []
+        for i in range(3):
+            st.round_end(i, 1.0)
+            tele_counters.record_train_round()
+            series = parse_exposition(
+                _get(d.port, "/metrics").read().decode())
+            seen_counter.append(series["ddt_train_rounds_total"][()])
+            seen_gauge.append(series["ddt_train_round"][()])
+        assert seen_counter == sorted(seen_counter)
+        assert seen_counter[-1] >= seen_counter[0] + 2
+        assert seen_gauge == [1.0, 2.0, 3.0]
+    finally:
+        d.close()
+
+
+# --------------------------------------------------------------------- #
+# shared exposition dialect (the serve/metrics.py factoring)
+# --------------------------------------------------------------------- #
+def test_exposition_factored_not_forked():
+    """serve/metrics.py re-exports the ONE dialect from
+    telemetry/exposition.py — identity, not a copy — and the factored
+    writer still renders the exact bytes the serve tier always did."""
+    from ddt_tpu.serve import metrics as serve_metrics
+
+    assert serve_metrics.render_counters is render_counters
+    assert serve_metrics.parse_exposition is parse_exposition
+    # Byte-level regression of the counter block format.
+    assert render_counters({"x_total_bytes": 3}) == [
+        "# TYPE ddt_x_total_bytes_total counter",
+        "ddt_x_total_bytes_total 3",
+    ]
+    text = "\n".join(render_counters({"a": 1, "b": 2.5})) + "\n"
+    parsed = parse_exposition(text)
+    assert parsed["ddt_a_total"][()] == 1.0
+    assert parsed["ddt_b_total"][()] == 2.5
+
+
+def test_new_counters_registered_everywhere():
+    """A counter is only real once all three registries agree: the live
+    counter dict, the counters-event schema extras, and the diff tool's
+    direction table (an unregistered counter silently vanishes from
+    diffs — the failure this test exists to catch)."""
+    from ddt_tpu.telemetry.diffing import COUNTER_DIRECTIONS
+    from ddt_tpu.telemetry.events import EVENT_EXTRAS
+
+    snap = tele_counters.snapshot()
+    for name in ("train_rounds", "train_heartbeats"):
+        assert name in snap
+        assert name in EVENT_EXTRAS["counters"]
+        assert name in COUNTER_DIRECTIONS
+
+
+# --------------------------------------------------------------------- #
+# train_heartbeat: schema-additive, pinned at birth
+# --------------------------------------------------------------------- #
+def test_train_heartbeat_schema_additive(tmp_path):
+    """The new event rides schema v5 WITHOUT a version bump (additive
+    growth contract): required fields pinned at birth in the lint
+    contract, extras validated, and a v5 reader round-trips it."""
+    from tools.ddtlint.telemetrycontract import PINNED_REQUIRED
+
+    assert SCHEMA_VERSION == 5                  # additive, no bump
+    assert EVENT_FIELDS["train_heartbeat"] == {"round"}
+    assert PINNED_REQUIRED["train_heartbeat"] == frozenset({"round"})
+    path = str(tmp_path / "run.jsonl")
+    with RunLog(path) as rl:
+        emit_train_heartbeat(rl, rnd=5, total_rounds=12,
+                             checkpoint_round=6, ms_per_round=37.5,
+                             rows_per_s=12000.0)
+    (ev,) = report.read_events(path)
+    validate_event(ev)
+    assert ev["event"] == "train_heartbeat" and ev["schema"] == 5
+    assert ev["round"] == 6                     # 1-based on the wire
+    assert ev["checkpoint_round"] == 6
+    assert ev["ms_per_round"] == 37.5
+
+
+def test_driver_emits_heartbeats_at_checkpoint_cadence(tmp_path):
+    """An in-memory train with checkpointing writes heartbeats at the
+    cadence, monotone in round, stamping the checkpoint round the
+    fused/granular loops actually saved."""
+    Xb, y = _binary(1201)
+    path = str(tmp_path / "run.jsonl")
+    ckpt = str(tmp_path / "ckpt")
+    with RunLog(path) as rl:
+        api.train(Xb, y, binned=True, n_trees=4, max_depth=3, n_bins=29,
+                  backend="tpu", run_log=rl, checkpoint_dir=ckpt,
+                  checkpoint_every=2)
+    hb = [e for e in report.read_events(path)
+          if e["event"] == "train_heartbeat"]
+    assert hb, "no heartbeats in a checkpointed run"
+    rounds = [e["round"] for e in hb]
+    assert rounds == sorted(rounds)
+    assert rounds[-1] == 4
+    assert any(e.get("checkpoint_round") for e in hb)
+    assert all(e.get("total_rounds") == 4 for e in hb)
+
+
+def test_statusd_tracks_a_real_training_run(tmp_path):
+    """api.train(status=...) drives the aggregate end to end: run
+    identity stamped, every round in the window, checkpoint recorded,
+    phase 'done' at the epilogue."""
+    Xb, y = _binary(1201)
+    st = TrainStatus()
+    api.train(Xb, y, binned=True, n_trees=4, max_depth=3, n_bins=29,
+              backend="tpu", status=st,
+              checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2)
+    h = st.healthz()
+    assert h["round"] == 4 and h["total_rounds"] == 4
+    assert h["phase"] == "done"
+    assert h["run_id"]
+    assert h["last_checkpoint_round"] is not None
+    assert len(st.rounds_ring()) == 4
+
+
+# --------------------------------------------------------------------- #
+# zero overhead when disabled
+# --------------------------------------------------------------------- #
+def test_no_status_port_means_no_statusd_import_or_state(tmp_path):
+    """The disabled-telemetry contract extended to the daemon: a train
+    WITHOUT --status-port never imports the statusd module (it is
+    lazily imported behind the flag) and the Driver's hook slot stays
+    None — asserted, not assumed."""
+    import inspect
+
+    from ddt_tpu.cli import main as cli_main
+    from ddt_tpu.driver import Driver
+
+    assert inspect.signature(api.train).parameters["status"].default \
+        is None
+    assert inspect.signature(Driver.__init__).parameters["status"] \
+        .default is None
+    saved = sys.modules.pop("ddt_tpu.telemetry.statusd", None)
+    try:
+        rc = cli_main([
+            "train", "--backend=tpu", "--dataset=higgs", "--rows=601",
+            "--trees=2", "--depth=3",
+            f"--out={tmp_path / 'm.npz'}"])
+        assert rc == 0
+        assert "ddt_tpu.telemetry.statusd" not in sys.modules
+    finally:
+        if saved is not None:
+            sys.modules["ddt_tpu.telemetry.statusd"] = saved
+
+
+# --------------------------------------------------------------------- #
+# report progress: the mid-run-death question
+# --------------------------------------------------------------------- #
+def _dead_run_log(path, drift=False):
+    """A run log whose process died mid-round: manifest, five rounds,
+    heartbeats at the 2-cadence, NO run_end, and a torn final line."""
+    with RunLog(str(path)) as rl:
+        rl.emit("run_manifest", trainer="driver", backend="tpu",
+                loss="logloss", n_trees=10, max_depth=3, rows=999,
+                features=7)
+        for r in range(5):
+            rl.emit("round", round=r + 1, ms_per_round=100.0,
+                    train_loss=0.6)
+            if (r + 1) % 2 == 0:
+                emit_train_heartbeat(rl, rnd=r, total_rounds=10,
+                                     checkpoint_round=r + 1,
+                                     ms_per_round=100.0,
+                                     rows_per_s=9990.0)
+        if drift:
+            rl.emit("drift", psi_max=0.5, alerts=1)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"event": "round", "schema": 5, "t": 1.0, "seq')
+
+
+def test_report_progress_over_mid_round_death(tmp_path, capsys):
+    """`report progress` places a dead run from its surviving
+    heartbeats: round reached (max over heartbeats AND intact round
+    records), last checkpoint, DIED MID-RUN state — through the torn
+    final line the tolerant reader drops."""
+    path = tmp_path / "dead.jsonl"
+    _dead_run_log(path)
+    summary = report.summarize(report.read_events(str(path)))
+    pg = summary["progress"]
+    assert pg["heartbeats"] == 2
+    assert pg["last_round"] == 5               # round record beats hb 4
+    assert pg["total_rounds"] == 10
+    assert pg["last_checkpoint_round"] == 4
+    assert pg["completed"] is False
+    text = report.render_progress(summary)
+    assert "DIED MID-RUN" in text
+    assert "round 5/10" in text
+
+    from ddt_tpu.cli import main as cli_main
+
+    assert cli_main(["report", f"--log={path}", "progress"]) == 0
+    assert "DIED MID-RUN" in capsys.readouterr().out
+
+
+def test_report_progress_fails_loudly_without_heartbeats(tmp_path):
+    """A log with no heartbeat data must refuse with a loud, specific
+    error — at the renderer (ValueError) and at the CLI (SystemExit),
+    never a silent empty table."""
+    path = tmp_path / "old.jsonl"
+    with RunLog(str(path)) as rl:
+        rl.emit("run_manifest", trainer="driver", backend="tpu",
+                loss="logloss", n_trees=2, max_depth=3, rows=10,
+                features=4)
+        rl.emit("round", round=1, ms_per_round=1.0, train_loss=0.5)
+        rl.emit("run_end", completed_rounds=1, wallclock_s=0.1)
+    summary = report.summarize(report.read_events(str(path)))
+    assert summary["progress"] is None         # pre-ISSUE-20 logs: as-is
+    with pytest.raises(ValueError, match="no training heartbeat"):
+        report.render_progress(summary)
+
+    from ddt_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit, match="report: .*heartbeat"):
+        cli_main(["report", f"--log={path}", "progress"])
+
+
+def test_trace_renders_heartbeats_and_never_drops_kinds(tmp_path):
+    """Perfetto export (the satellite): train_heartbeat lands on the
+    rounds lane as an instant, and kinds with no dedicated mapping
+    (e.g. drift) land on the catch-all 'events' lane instead of
+    silently vanishing."""
+    from ddt_tpu.telemetry import perfetto
+
+    path = tmp_path / "dead.jsonl"
+    _dead_run_log(path, drift=True)
+    trace = perfetto.to_trace_events(report.read_events(str(path)))
+    recs = trace["traceEvents"]
+    hb = [r for r in recs if r["name"] == "train_heartbeat"]
+    assert hb and all(r["tid"] == 0 and r["ph"] == "i" for r in hb)
+    dr = [r for r in recs if r["name"] == "drift"]
+    assert dr and dr[0]["tid"] == perfetto._MISC_TID
+    lanes = {(r["pid"], r["tid"]): r["args"]["name"] for r in recs
+             if r["name"] == "thread_name"}
+    assert lanes[(0, perfetto._MISC_TID)] == "events"

@@ -96,6 +96,11 @@ def test_runlog_emits_and_round_trips_every_event_type(tmp_path):
         "drift": dict(psi_max=0.41, model_name="higgs", feature=3,
                       js_max=0.22, psi_mean=0.11, window_rows=512,
                       window_s=300.0, threshold=0.25, alerts=1),
+        # Schema v5-additive (ISSUE 20 training operations plane): one
+        # checkpoint-cadence progress heartbeat from the train loops.
+        "train_heartbeat": dict(round=6, total_rounds=12,
+                                checkpoint_round=6, ms_per_round=375.1,
+                                rows_per_s=14776.0),
         "run_end": dict(completed_rounds=2, wallclock_s=0.1),
     }
     assert set(payloads) == set(EVENT_FIELDS)   # exhaustive by contract

@@ -91,6 +91,9 @@ PINNED_REQUIRED = {
     # ISSUE 19 (drift observatory): new kind, additive under v5 —
     # pinned at birth like serve_trace.
     "drift": frozenset({"psi_max"}),
+    # ISSUE 20 (training operations plane): new kind, additive under
+    # v5 — pinned at birth like serve_trace/drift.
+    "train_heartbeat": frozenset({"round"}),
     "run_end": frozenset({"completed_rounds", "wallclock_s"}),
 }
 

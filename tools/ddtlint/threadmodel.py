@@ -70,8 +70,12 @@ from tools.ddtlint import callgraph
 from tools.ddtlint.base import Checker
 from tools.ddtlint.findings import Finding
 
-#: files the pass runs on (relpath regexes).
-SCOPE = (r"^ddt_tpu/serve/", r"^ddt_tpu/robustness/watchdog\.py$")
+#: files the pass runs on (relpath regexes). statusd (ISSUE 20) is the
+#: training tier's one concurrent-mutable-state surface — the trainer
+#: thread and HTTP handler threads share its TrainStatus — so it lives
+#: under the same analysis as the serve tier.
+SCOPE = (r"^ddt_tpu/serve/", r"^ddt_tpu/robustness/watchdog\.py$",
+         r"^ddt_tpu/telemetry/statusd\.py$")
 
 RULE_LOCK_ORDER = "lock-order"
 RULE_CROSS_ROLE = "cross-role-state"
